@@ -1,0 +1,32 @@
+"""distkeras_tpu — a TPU-native distributed training framework.
+
+A ground-up JAX/XLA re-design of the capabilities of ``kunlqt/dist-keras``
+(itself a fork of ``cerndb/dist-keras``): distributed Keras-style model
+training with a family of synchronous/asynchronous SGD variants (DOWNPOUR,
+EASGD, AEASGD, ADAG, DynSGD), model/feature transformers, predictors and
+evaluators.
+
+Where the reference distributes work over Apache Spark executors talking to a
+socket parameter server on the driver (reference: ``distkeras/trainers.py``,
+``distkeras/parameter_servers.py``, ``distkeras/networking.py``), this
+framework maps the same algorithm family onto a single SPMD program over a
+``jax.sharding.Mesh``: worker state lives as device-sharded pytrees, the
+parameter-server "center" is a replicated pytree, and all pull/commit traffic
+becomes XLA collectives (``psum``/``pmean``/``ppermute``) over ICI — zero
+socket traffic, no central process.
+
+Package layout:
+    models/     Layer/Sequential model substrate + model zoo (MLP, LeNet-5,
+                ResNet-50, BiLSTM, wide&deep, transformer)
+    ops/        losses, metrics, optimizers, attention ops
+    parallel/   mesh abstraction + trainer family (the reference's
+                trainers.py/workers.py/parameter_servers.py equivalent)
+    data/       columnar dataset + feature transformers (the reference's
+                Spark-DataFrame ingest + transformers.py equivalent)
+    inference/  predictors + evaluators (reference predictors.py/evaluators.py)
+    utils/      serialization, checkpointing, history, profiling
+"""
+
+__version__ = "0.1.0"
+
+from distkeras_tpu.models import Sequential, Model  # noqa: F401

@@ -1,0 +1,12 @@
+"""Model substrate: layers, containers, serialization, model zoo."""
+
+from distkeras_tpu.models.core import (  # noqa: F401
+    LAYER_REGISTRY, Layer, Model, Sequential, register_layer)
+from distkeras_tpu.models.layers import (  # noqa: F401
+    ACTIVATIONS, Activation, AveragePooling2D, BatchNorm, Conv2D, Dense,
+    Dropout, Embedding, Flatten, GlobalAveragePooling2D, MaxPooling2D,
+    Reshape, get_activation)
+from distkeras_tpu.models.recurrent import (  # noqa: F401
+    GRU, LSTM, Bidirectional)
+from distkeras_tpu.models.serialization import (  # noqa: F401
+    deserialize_model, load_model, save_model, serialize_model)

@@ -1,0 +1,187 @@
+"""Core model substrate: Layer protocol, Sequential container, Model handle.
+
+This replaces the reference's dependency on Keras for per-worker compute
+(reference: ``distkeras/workers.py :: Worker.prepare_model`` deserializes and
+compiles a Keras model inside every Spark executor). Here a model is a pure
+spec (layer list) plus pytree variables; ``apply`` is a pure function suitable
+for ``jax.jit`` / ``jax.grad`` / ``shard_map``.
+
+Design notes (TPU-first):
+  * Variables are split into ``params`` (differentiated) and ``state``
+    (non-differentiated collections such as BatchNorm running stats). Both are
+    plain pytrees (lists of dicts aligned with the layer list), so they shard
+    transparently under ``jax.sharding`` and stack transparently under
+    ``vmap`` (used by EnsembleTrainer).
+  * ``apply`` is functional: it returns ``(y, new_state)``; nothing mutates.
+  * Shapes are static: ``init`` threads a concrete ``input_shape`` through the
+    layer stack once, so everything under ``jit`` has static shapes and XLA
+    can tile matmuls/convs onto the MXU.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# Registry: layer class name -> class, used by serialization to rebuild specs.
+LAYER_REGISTRY: Dict[str, type] = {}
+
+
+def register_layer(cls: type) -> type:
+    """Class decorator adding a Layer subclass to the serialization registry."""
+    LAYER_REGISTRY[cls.__name__] = cls
+    return cls
+
+
+class Layer:
+    """Base layer: a pure init/apply pair plus a JSON-able config.
+
+    Subclasses implement:
+      init(rng, input_shape) -> (params, state, output_shape)
+      apply(params, state, x, *, training, rng) -> (y, new_state)
+      get_config() -> dict of constructor kwargs (JSON-serializable)
+    ``input_shape``/``output_shape`` exclude the batch dimension.
+    """
+
+    def init(self, rng: jax.Array, input_shape: Tuple[int, ...]):
+        return {}, {}, input_shape
+
+    def apply(self, params, state, x, *, training: bool = False,
+              rng: Optional[jax.Array] = None):
+        return x, state
+
+    def get_config(self) -> Dict[str, Any]:
+        return {}
+
+    @classmethod
+    def from_config(cls, config: Dict[str, Any]) -> "Layer":
+        return cls(**config)
+
+    @property
+    def name(self) -> str:
+        return type(self).__name__
+
+    def __repr__(self) -> str:
+        cfg = ", ".join(f"{k}={v!r}" for k, v in self.get_config().items())
+        return f"{self.name}({cfg})"
+
+
+@register_layer
+class Sequential(Layer):
+    """Ordered stack of layers — the Keras ``Sequential`` equivalent.
+
+    The reference builds Keras Sequential models in every example and ships
+    them serialized to executors (reference: ``distkeras/utils.py ::
+    serialize_keras_model``). Here the spec is pure Python data; variables are
+    created explicitly by ``init`` and travel separately.
+    """
+
+    def __init__(self, layers: Optional[Sequence[Layer]] = None):
+        self.layers: List[Layer] = list(layers) if layers else []
+
+    def add(self, layer: Layer) -> "Sequential":
+        self.layers.append(layer)
+        return self
+
+    def init(self, rng, input_shape):
+        params, state = [], []
+        shape = tuple(input_shape)
+        for layer in self.layers:
+            rng, sub = jax.random.split(rng)
+            p, s, shape = layer.init(sub, shape)
+            params.append(p)
+            state.append(s)
+        return params, state, shape
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        new_state = []
+        for i, layer in enumerate(self.layers):
+            if rng is not None:
+                rng, sub = jax.random.split(rng)
+            else:
+                sub = None
+            x, s = layer.apply(params[i], state[i], x, training=training,
+                               rng=sub)
+            new_state.append(s)
+        return x, new_state
+
+    def get_config(self):
+        return {
+            "layers": [
+                {"class": l.name, "config": l.get_config()} for l in self.layers
+            ]
+        }
+
+    @classmethod
+    def from_config(cls, config):
+        layers = [
+            LAYER_REGISTRY[spec["class"]].from_config(spec["config"])
+            for spec in config["layers"]
+        ]
+        return cls(layers)
+
+
+class Model:
+    """A built model: spec + variables + loss/optimizer metadata.
+
+    Plays the role of a compiled Keras model in the reference API surface
+    (what ``Trainer.train`` returns; what ``Predictor`` consumes). The object
+    is a thin handle — all compute goes through the pure functions so that
+    trainers can jit/shard them freely.
+    """
+
+    def __init__(self, module: Layer, params, state, input_shape,
+                 output_shape):
+        self.module = module
+        self.params = params
+        self.state = state
+        self.input_shape = tuple(input_shape)
+        self.output_shape = tuple(output_shape)
+        self._jit_fwd = None  # cached jitted forward for predict()
+
+    # -- construction -----------------------------------------------------
+    @classmethod
+    def build(cls, module: Layer, input_shape: Tuple[int, ...],
+              rng: Optional[jax.Array] = None, seed: int = 0) -> "Model":
+        if rng is None:
+            rng = jax.random.PRNGKey(seed)
+        params, state, out_shape = module.init(rng, tuple(input_shape))
+        return cls(module, params, state, input_shape, out_shape)
+
+    # -- compute ----------------------------------------------------------
+    def apply(self, params, state, x, *, training=False, rng=None):
+        return self.module.apply(params, state, x, training=training, rng=rng)
+
+    def predict(self, x, batch_size: Optional[int] = None) -> np.ndarray:
+        """Convenience host-side inference (see inference.predictors for the
+        sharded/batched path the reference's Predictor corresponds to)."""
+        x = jnp.asarray(x)
+        if self._jit_fwd is None:
+            self._jit_fwd = jax.jit(
+                lambda p, s, b: self.module.apply(p, s, b, training=False)[0])
+        fn = self._jit_fwd
+        if batch_size is None:
+            return np.asarray(fn(self.params, self.state, x))
+        outs = []
+        for i in range(0, x.shape[0], batch_size):
+            outs.append(np.asarray(fn(self.params, self.state,
+                                      x[i:i + batch_size])))
+        return np.concatenate(outs, axis=0)
+
+    # -- bookkeeping ------------------------------------------------------
+    def num_params(self) -> int:
+        return sum(int(np.prod(p.shape))
+                   for p in jax.tree_util.tree_leaves(self.params))
+
+    def replace(self, params=None, state=None) -> "Model":
+        return Model(self.module,
+                     params if params is not None else self.params,
+                     state if state is not None else self.state,
+                     self.input_shape, self.output_shape)
+
+    def __repr__(self):
+        return (f"Model({self.module.name}, in={self.input_shape}, "
+                f"out={self.output_shape}, params={self.num_params():,})")

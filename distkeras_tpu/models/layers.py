@@ -1,0 +1,379 @@
+"""Standard layers (Keras-equivalent surface, TPU-first internals).
+
+Covers the layer vocabulary the reference's examples use to build models
+(Dense/Conv2D/MaxPooling2D/Flatten/Dropout/Activation/Embedding — reference:
+``examples/`` MNIST + ATLAS notebooks build Keras Sequential models from
+exactly these), plus BatchNorm for the ResNet-50 north-star config.
+
+TPU notes:
+  * Conv uses NHWC with ``lax.conv_general_dilated`` — XLA's native layout for
+    TPU convolutions (maps onto the MXU).
+  * Compute dtype is configurable per layer (``dtype=jnp.bfloat16``) while
+    params stay float32 — the standard TPU mixed-precision recipe.
+  * Everything is shape-static and control-flow-free so layers fuse cleanly
+    under jit.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from distkeras_tpu.models.core import Layer, register_layer
+
+# ---------------------------------------------------------------------------
+# activations
+# ---------------------------------------------------------------------------
+
+ACTIVATIONS = {
+    "linear": lambda x: x,
+    "relu": jax.nn.relu,
+    "relu6": jax.nn.relu6,
+    "tanh": jnp.tanh,
+    "sigmoid": jax.nn.sigmoid,
+    "softmax": jax.nn.softmax,
+    "log_softmax": jax.nn.log_softmax,
+    "gelu": jax.nn.gelu,
+    "silu": jax.nn.silu,
+    "elu": jax.nn.elu,
+    "leaky_relu": jax.nn.leaky_relu,
+    "softplus": jax.nn.softplus,
+}
+
+
+def get_activation(name):
+    if callable(name):
+        return name
+    if name is None:
+        return ACTIVATIONS["linear"]
+    try:
+        return ACTIVATIONS[name]
+    except KeyError:
+        raise ValueError(
+            f"Unknown activation {name!r}; known: {sorted(ACTIVATIONS)}")
+
+
+# ---------------------------------------------------------------------------
+# initializers (Keras-compatible names)
+# ---------------------------------------------------------------------------
+
+def _fans(shape):
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    if len(shape) == 2:
+        return shape[0], shape[1]
+    # conv kernels HWIO: receptive field * channels
+    receptive = int(np.prod(shape[:-2]))
+    return shape[-2] * receptive, shape[-1] * receptive
+
+
+def init_weights(name: str, rng, shape, dtype=jnp.float32):
+    fan_in, fan_out = _fans(shape)
+    if name == "zeros":
+        return jnp.zeros(shape, dtype)
+    if name == "ones":
+        return jnp.ones(shape, dtype)
+    if name == "glorot_uniform":
+        limit = np.sqrt(6.0 / (fan_in + fan_out))
+        return jax.random.uniform(rng, shape, dtype, -limit, limit)
+    if name == "glorot_normal":
+        std = np.sqrt(2.0 / (fan_in + fan_out))
+        return jax.random.normal(rng, shape, dtype) * std
+    if name == "he_normal":
+        std = np.sqrt(2.0 / fan_in)
+        return jax.random.normal(rng, shape, dtype) * std
+    if name == "he_uniform":
+        limit = np.sqrt(6.0 / fan_in)
+        return jax.random.uniform(rng, shape, dtype, -limit, limit)
+    if name == "lecun_normal":
+        std = np.sqrt(1.0 / fan_in)
+        return jax.random.normal(rng, shape, dtype) * std
+    if name == "uniform_scaling":
+        return jax.random.uniform(rng, shape, dtype, -0.05, 0.05)
+    raise ValueError(f"Unknown initializer {name!r}")
+
+
+# ---------------------------------------------------------------------------
+# dense / activation / dropout / reshape
+# ---------------------------------------------------------------------------
+
+@register_layer
+class Dense(Layer):
+    """Fully-connected layer. Keras ``Dense`` equivalent.
+
+    ``dtype`` selects the compute/matmul dtype (bf16 recommended on TPU);
+    parameters are stored float32 and cast at apply time.
+    """
+
+    def __init__(self, units: int, activation=None, use_bias: bool = True,
+                 kernel_init: str = "glorot_uniform", dtype: str = "float32"):
+        self.units = int(units)
+        self.activation = activation
+        self.use_bias = use_bias
+        self.kernel_init = kernel_init
+        self.dtype = dtype
+
+    def init(self, rng, input_shape):
+        in_dim = input_shape[-1]
+        params = {"kernel": init_weights(self.kernel_init, rng,
+                                         (in_dim, self.units))}
+        if self.use_bias:
+            params["bias"] = jnp.zeros((self.units,))
+        return params, {}, tuple(input_shape[:-1]) + (self.units,)
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        dt = jnp.dtype(self.dtype)
+        y = jnp.matmul(x.astype(dt), params["kernel"].astype(dt))
+        if self.use_bias:
+            y = y + params["bias"].astype(dt)
+        y = get_activation(self.activation)(y)
+        return y.astype(jnp.float32) if dt != jnp.float32 else y, state
+
+    def get_config(self):
+        return {"units": self.units, "activation": self.activation,
+                "use_bias": self.use_bias, "kernel_init": self.kernel_init,
+                "dtype": self.dtype}
+
+
+@register_layer
+class Activation(Layer):
+    def __init__(self, activation: str):
+        self.activation = activation
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        return get_activation(self.activation)(x), state
+
+    def get_config(self):
+        return {"activation": self.activation}
+
+
+@register_layer
+class Dropout(Layer):
+    """Inverted dropout; identity when not training or rng is None."""
+
+    def __init__(self, rate: float):
+        self.rate = float(rate)
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        if not training or rng is None or self.rate <= 0.0:
+            return x, state
+        keep = 1.0 - self.rate
+        mask = jax.random.bernoulli(rng, keep, x.shape)
+        return jnp.where(mask, x / keep, 0.0), state
+
+    def get_config(self):
+        return {"rate": self.rate}
+
+
+@register_layer
+class Flatten(Layer):
+    def init(self, rng, input_shape):
+        return {}, {}, (int(np.prod(input_shape)),)
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        return x.reshape(x.shape[0], -1), state
+
+
+@register_layer
+class Reshape(Layer):
+    def __init__(self, target_shape: Sequence[int]):
+        self.target_shape = tuple(int(d) for d in target_shape)
+
+    def init(self, rng, input_shape):
+        return {}, {}, self.target_shape
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        return x.reshape((x.shape[0],) + self.target_shape), state
+
+    def get_config(self):
+        return {"target_shape": list(self.target_shape)}
+
+
+# ---------------------------------------------------------------------------
+# convolution / pooling (NHWC)
+# ---------------------------------------------------------------------------
+
+def _pair(v):
+    return (v, v) if isinstance(v, int) else tuple(v)
+
+
+@register_layer
+class Conv2D(Layer):
+    """2-D convolution, NHWC/HWIO — XLA's native TPU conv layout."""
+
+    def __init__(self, filters: int, kernel_size, strides=1, padding="SAME",
+                 activation=None, use_bias: bool = True,
+                 kernel_init: str = "he_normal", dtype: str = "float32"):
+        self.filters = int(filters)
+        self.kernel_size = _pair(kernel_size)
+        self.strides = _pair(strides)
+        self.padding = padding.upper()
+        self.activation = activation
+        self.use_bias = use_bias
+        self.kernel_init = kernel_init
+        self.dtype = dtype
+
+    def init(self, rng, input_shape):
+        h, w, c = input_shape
+        kh, kw = self.kernel_size
+        params = {"kernel": init_weights(self.kernel_init, rng,
+                                         (kh, kw, c, self.filters))}
+        if self.use_bias:
+            params["bias"] = jnp.zeros((self.filters,))
+        out = jax.eval_shape(
+            lambda x, k: lax.conv_general_dilated(
+                x, k, self.strides, self.padding,
+                dimension_numbers=("NHWC", "HWIO", "NHWC")),
+            jax.ShapeDtypeStruct((1, h, w, c), jnp.float32),
+            jax.ShapeDtypeStruct((kh, kw, c, self.filters), jnp.float32))
+        return params, {}, tuple(out.shape[1:])
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        dt = jnp.dtype(self.dtype)
+        y = lax.conv_general_dilated(
+            x.astype(dt), params["kernel"].astype(dt), self.strides,
+            self.padding, dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        if self.use_bias:
+            y = y + params["bias"].astype(dt)
+        y = get_activation(self.activation)(y)
+        return y.astype(jnp.float32) if dt != jnp.float32 else y, state
+
+    def get_config(self):
+        return {"filters": self.filters,
+                "kernel_size": list(self.kernel_size),
+                "strides": list(self.strides), "padding": self.padding,
+                "activation": self.activation, "use_bias": self.use_bias,
+                "kernel_init": self.kernel_init, "dtype": self.dtype}
+
+
+class _Pool2D(Layer):
+    def __init__(self, pool_size=2, strides=None, padding="VALID"):
+        self.pool_size = _pair(pool_size)
+        self.strides = _pair(strides) if strides is not None else self.pool_size
+        self.padding = padding.upper()
+
+    def _reduce(self, x):
+        raise NotImplementedError
+
+    def init(self, rng, input_shape):
+        out = jax.eval_shape(
+            lambda x: self._reduce(x),
+            jax.ShapeDtypeStruct((1,) + tuple(input_shape), jnp.float32))
+        return {}, {}, tuple(out.shape[1:])
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        return self._reduce(x), state
+
+    def get_config(self):
+        return {"pool_size": list(self.pool_size),
+                "strides": list(self.strides), "padding": self.padding}
+
+
+@register_layer
+class MaxPooling2D(_Pool2D):
+    def _reduce(self, x):
+        return lax.reduce_window(
+            x, -jnp.inf, lax.max, (1,) + self.pool_size + (1,),
+            (1,) + self.strides + (1,), self.padding)
+
+
+@register_layer
+class AveragePooling2D(_Pool2D):
+    def _reduce(self, x):
+        ones = lax.reduce_window(
+            jnp.ones_like(x), 0.0, lax.add, (1,) + self.pool_size + (1,),
+            (1,) + self.strides + (1,), self.padding)
+        summed = lax.reduce_window(
+            x, 0.0, lax.add, (1,) + self.pool_size + (1,),
+            (1,) + self.strides + (1,), self.padding)
+        return summed / ones
+
+
+@register_layer
+class GlobalAveragePooling2D(Layer):
+    def init(self, rng, input_shape):
+        return {}, {}, (input_shape[-1],)
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        return jnp.mean(x, axis=(1, 2)), state
+
+
+# ---------------------------------------------------------------------------
+# batch norm
+# ---------------------------------------------------------------------------
+
+@register_layer
+class BatchNorm(Layer):
+    """Batch normalization with functional running stats.
+
+    Running mean/var live in the ``state`` collection and are returned
+    (not mutated) from ``apply`` — this is what lets BN work unchanged under
+    jit/shard_map in the distributed trainers. When training under a
+    data-parallel mesh axis, pass ``axis_name`` so batch statistics are
+    all-reduced over ICI (the cross-replica BN the reference could never do —
+    each Spark executor normalized over its local batch only).
+    """
+
+    def __init__(self, momentum: float = 0.99, epsilon: float = 1e-3,
+                 axis_name: Optional[str] = None):
+        self.momentum = float(momentum)
+        self.epsilon = float(epsilon)
+        self.axis_name = axis_name
+
+    def init(self, rng, input_shape):
+        dim = input_shape[-1]
+        params = {"scale": jnp.ones((dim,)), "offset": jnp.zeros((dim,))}
+        state = {"mean": jnp.zeros((dim,)), "var": jnp.ones((dim,))}
+        return params, state, tuple(input_shape)
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        axes = tuple(range(x.ndim - 1))
+        if training:
+            mean = jnp.mean(x, axis=axes)
+            mean2 = jnp.mean(jnp.square(x), axis=axes)
+            if self.axis_name is not None:
+                mean = lax.pmean(mean, self.axis_name)
+                mean2 = lax.pmean(mean2, self.axis_name)
+            var = mean2 - jnp.square(mean)
+            m = self.momentum
+            new_state = {"mean": m * state["mean"] + (1 - m) * mean,
+                         "var": m * state["var"] + (1 - m) * var}
+        else:
+            mean, var = state["mean"], state["var"]
+            new_state = state
+        inv = lax.rsqrt(var + self.epsilon) * params["scale"]
+        return (x - mean) * inv + params["offset"], new_state
+
+    def get_config(self):
+        return {"momentum": self.momentum, "epsilon": self.epsilon,
+                "axis_name": self.axis_name}
+
+
+# ---------------------------------------------------------------------------
+# embedding
+# ---------------------------------------------------------------------------
+
+@register_layer
+class Embedding(Layer):
+    def __init__(self, vocab_size: int, dim: int,
+                 embeddings_init: str = "uniform_scaling"):
+        self.vocab_size = int(vocab_size)
+        self.dim = int(dim)
+        self.embeddings_init = embeddings_init
+
+    def init(self, rng, input_shape):
+        params = {"embeddings": init_weights(self.embeddings_init, rng,
+                                             (self.vocab_size, self.dim))}
+        return params, {}, tuple(input_shape) + (self.dim,)
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        return jnp.take(params["embeddings"], x.astype(jnp.int32), axis=0), \
+            state
+
+    def get_config(self):
+        return {"vocab_size": self.vocab_size, "dim": self.dim,
+                "embeddings_init": self.embeddings_init}

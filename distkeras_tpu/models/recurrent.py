@@ -1,0 +1,185 @@
+"""Recurrent layers (LSTM / GRU / Bidirectional) built on ``lax.scan``.
+
+The reference's examples train (Bi)LSTM Keras models and run them through the
+``Predictor`` path (BASELINE config 5: batched BiLSTM inference). TPU-first
+implementation notes:
+  * The time loop is a single ``lax.scan`` — traced once, compiled once; no
+    Python-level unrolling, static sequence length.
+  * The four LSTM gate matmuls are fused into one ``[in+hidden, 4*units]``
+    matmul per step so the MXU sees one large GEMM instead of eight small
+    ones.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from distkeras_tpu.models.core import Layer, register_layer
+from distkeras_tpu.models.layers import get_activation, init_weights
+
+
+@register_layer
+class LSTM(Layer):
+    """LSTM over inputs shaped ``[batch, time, features]``.
+
+    ``return_sequences=False`` (default, Keras-compatible) yields the final
+    hidden state ``[batch, units]``; ``True`` yields ``[batch, time, units]``.
+    """
+
+    def __init__(self, units: int, return_sequences: bool = False,
+                 reverse: bool = False, kernel_init: str = "glorot_uniform",
+                 dtype: str = "float32"):
+        self.units = int(units)
+        self.return_sequences = bool(return_sequences)
+        self.reverse = bool(reverse)
+        self.kernel_init = kernel_init
+        self.dtype = dtype
+
+    def init(self, rng, input_shape):
+        t, f = input_shape
+        k1, k2 = jax.random.split(rng)
+        params = {
+            # fused input->gates and hidden->gates kernels, gate order ifgo
+            "wx": init_weights(self.kernel_init, k1, (f, 4 * self.units)),
+            "wh": init_weights("glorot_uniform", k2,
+                               (self.units, 4 * self.units)),
+            # forget-gate bias init to 1.0 (standard trick, helps gradients)
+            "b": jnp.concatenate([
+                jnp.zeros((self.units,)), jnp.ones((self.units,)),
+                jnp.zeros((2 * self.units,))]),
+        }
+        out = (t, self.units) if self.return_sequences else (self.units,)
+        return params, {}, out
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        dt = jnp.dtype(self.dtype)
+        b = x.shape[0]
+        wx, wh, bias = (params["wx"].astype(dt), params["wh"].astype(dt),
+                        params["b"].astype(dt))
+        # Pre-compute all input projections in one big [B*T, 4U] GEMM.
+        xproj = jnp.matmul(x.astype(dt), wx) + bias  # [B, T, 4U]
+        xproj = jnp.swapaxes(xproj, 0, 1)            # time-major for scan
+
+        def step(carry, xp):
+            h, c = carry
+            gates = xp + jnp.matmul(h, wh)
+            i, f, g, o = jnp.split(gates, 4, axis=-1)
+            i, f, o = (jax.nn.sigmoid(i), jax.nn.sigmoid(f),
+                       jax.nn.sigmoid(o))
+            g = jnp.tanh(g)
+            c = f * c + i * g
+            h = o * jnp.tanh(c)
+            return (h, c), h
+
+        h0 = jnp.zeros((b, self.units), dt)
+        (h, _), hs = lax.scan(step, (h0, h0), xproj, reverse=self.reverse)
+        if self.return_sequences:
+            out = jnp.swapaxes(hs, 0, 1)
+        else:
+            # for a reversed pass the "final" state is still the scan carry
+            out = h
+        return out.astype(jnp.float32) if dt != jnp.float32 else out, state
+
+    def get_config(self):
+        return {"units": self.units, "return_sequences": self.return_sequences,
+                "reverse": self.reverse, "kernel_init": self.kernel_init,
+                "dtype": self.dtype}
+
+
+@register_layer
+class GRU(Layer):
+    """GRU over ``[batch, time, features]`` with fused gate matmuls."""
+
+    def __init__(self, units: int, return_sequences: bool = False,
+                 reverse: bool = False, kernel_init: str = "glorot_uniform",
+                 dtype: str = "float32"):
+        self.units = int(units)
+        self.return_sequences = bool(return_sequences)
+        self.reverse = bool(reverse)
+        self.kernel_init = kernel_init
+        self.dtype = dtype
+
+    def init(self, rng, input_shape):
+        t, f = input_shape
+        k1, k2 = jax.random.split(rng)
+        params = {
+            "wx": init_weights(self.kernel_init, k1, (f, 3 * self.units)),
+            "wh": init_weights("glorot_uniform", k2,
+                               (self.units, 3 * self.units)),
+            "b": jnp.zeros((3 * self.units,)),
+        }
+        out = (t, self.units) if self.return_sequences else (self.units,)
+        return params, {}, out
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        dt = jnp.dtype(self.dtype)
+        b = x.shape[0]
+        wx, wh, bias = (params["wx"].astype(dt), params["wh"].astype(dt),
+                        params["b"].astype(dt))
+        xproj = jnp.matmul(x.astype(dt), wx) + bias
+        xproj = jnp.swapaxes(xproj, 0, 1)
+        u = self.units
+
+        def step(h, xp):
+            hp = jnp.matmul(h, wh)
+            r = jax.nn.sigmoid(xp[..., :u] + hp[..., :u])
+            z = jax.nn.sigmoid(xp[..., u:2 * u] + hp[..., u:2 * u])
+            n = jnp.tanh(xp[..., 2 * u:] + r * hp[..., 2 * u:])
+            h = (1 - z) * n + z * h
+            return h, h
+
+        h0 = jnp.zeros((b, u), dt)
+        h, hs = lax.scan(step, h0, xproj, reverse=self.reverse)
+        out = jnp.swapaxes(hs, 0, 1) if self.return_sequences else h
+        return out.astype(jnp.float32) if dt != jnp.float32 else out, state
+
+    def get_config(self):
+        return {"units": self.units, "return_sequences": self.return_sequences,
+                "reverse": self.reverse, "kernel_init": self.kernel_init,
+                "dtype": self.dtype}
+
+
+@register_layer
+class Bidirectional(Layer):
+    """Runs a forward and a backward copy of an LSTM/GRU and concatenates.
+
+    Keras ``Bidirectional(LSTM(...))`` equivalent, used by the BiLSTM
+    inference baseline (BASELINE config 5).
+    """
+
+    def __init__(self, layer=None, **layer_config):
+        if layer is None:
+            # from_config path: rebuild from serialized sub-layer spec
+            from distkeras_tpu.models.core import LAYER_REGISTRY
+            spec = layer_config.pop("layer_spec")
+            layer = LAYER_REGISTRY[spec["class"]].from_config(spec["config"])
+        self.forward = layer
+        import copy
+        self.backward = copy.copy(layer)
+        self.backward.reverse = True
+
+    def init(self, rng, input_shape):
+        k1, k2 = jax.random.split(rng)
+        pf, sf, of = self.forward.init(k1, input_shape)
+        pb, sb, ob = self.backward.init(k2, input_shape)
+        out = tuple(of[:-1]) + (of[-1] + ob[-1],)
+        return {"forward": pf, "backward": pb}, \
+            {"forward": sf, "backward": sb}, out
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        yf, sf = self.forward.apply(params["forward"], state["forward"], x,
+                                    training=training, rng=rng)
+        # NOTE: lax.scan(reverse=True) keeps stacked outputs positionally
+        # aligned with inputs, so no flip is needed for return_sequences.
+        yb, sb = self.backward.apply(params["backward"], state["backward"], x,
+                                     training=training, rng=rng)
+        return jnp.concatenate([yf, yb], axis=-1), \
+            {"forward": sf, "backward": sb}
+
+    def get_config(self):
+        return {"layer_spec": {"class": self.forward.name,
+                               "config": self.forward.get_config()}}

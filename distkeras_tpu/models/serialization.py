@@ -1,0 +1,94 @@
+"""Model serialization: architecture JSON + weight arrays.
+
+Mirrors the reference's model-shipping capability (reference:
+``distkeras/utils.py :: serialize_keras_model / deserialize_keras_model``,
+which packs Keras architecture JSON + a weights list so the model can cross
+the driver→executor boundary). Here the same format idea serves (a) on-disk
+persistence and (b) hashing/equality in tests. In-process the trainers never
+serialize — pytrees move between devices via shardings, not pickles.
+
+Format: a dict ``{"format", "class", "config", "input_shape", "weights"}``
+where ``weights`` maps flattened pytree paths to numpy arrays. ``save_model``
+writes it as ``<path>.json`` + ``<path>.npz``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Tuple
+
+import jax
+import numpy as np
+
+from distkeras_tpu.models.core import LAYER_REGISTRY, Model
+
+FORMAT_VERSION = "distkeras_tpu.model.v1"
+
+
+def _flatten_with_paths(tree) -> Dict[str, np.ndarray]:
+    flat = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        key = "/".join(str(getattr(p, "key", getattr(p, "idx", p)))
+                       for p in path)
+        flat[key] = np.asarray(leaf)
+    return flat
+
+
+def _unflatten_like(template, flat: Dict[str, np.ndarray]):
+    paths_leaves = jax.tree_util.tree_flatten_with_path(template)
+    leaves = []
+    for path, leaf in paths_leaves[0]:
+        key = "/".join(str(getattr(p, "key", getattr(p, "idx", p)))
+                       for p in path)
+        arr = flat[key]
+        if tuple(arr.shape) != tuple(leaf.shape):
+            raise ValueError(
+                f"weight {key!r} shape {arr.shape} != expected {leaf.shape}")
+        leaves.append(jax.numpy.asarray(arr, dtype=leaf.dtype))
+    return jax.tree_util.tree_unflatten(paths_leaves[1], leaves)
+
+
+def serialize_model(model: Model) -> Dict[str, Any]:
+    """Model -> plain dict (arch config + numpy weights)."""
+    return {
+        "format": FORMAT_VERSION,
+        "class": model.module.name,
+        "config": model.module.get_config(),
+        "input_shape": list(model.input_shape),
+        "params": _flatten_with_paths(model.params),
+        "state": _flatten_with_paths(model.state),
+    }
+
+
+def deserialize_model(payload: Dict[str, Any]) -> Model:
+    """Plain dict -> Model (rebuilds spec from registry, restores weights)."""
+    if payload.get("format") != FORMAT_VERSION:
+        raise ValueError(f"Unknown model format: {payload.get('format')!r}")
+    module = LAYER_REGISTRY[payload["class"]].from_config(payload["config"])
+    model = Model.build(module, tuple(payload["input_shape"]))
+    params = _unflatten_like(model.params, payload["params"])
+    state = _unflatten_like(model.state, payload["state"])
+    return model.replace(params=params, state=state)
+
+
+def save_model(model: Model, path: str) -> None:
+    payload = serialize_model(model)
+    arch = {k: payload[k] for k in ("format", "class", "config",
+                                    "input_shape")}
+    with open(path + ".json", "w") as f:
+        json.dump(arch, f, indent=2)
+    arrays = {f"params:{k}": v for k, v in payload["params"].items()}
+    arrays.update({f"state:{k}": v for k, v in payload["state"].items()})
+    np.savez(path + ".npz", **arrays)
+
+
+def load_model(path: str) -> Model:
+    with open(path + ".json") as f:
+        arch = json.load(f)
+    arrays = np.load(path + ".npz")
+    params = {k[len("params:"):]: arrays[k] for k in arrays.files
+              if k.startswith("params:")}
+    state = {k[len("state:"):]: arrays[k] for k in arrays.files
+             if k.startswith("state:")}
+    return deserialize_model({**arch, "params": params, "state": state})

@@ -1,0 +1,6 @@
+"""Pure ops: losses, metrics, optimizers, attention."""
+
+from distkeras_tpu.ops.losses import LOSSES, get_loss  # noqa: F401
+from distkeras_tpu.ops.metrics import METRICS, get_metric  # noqa: F401
+from distkeras_tpu.ops.optimizers import (  # noqa: F401
+    OPTIMIZERS, Optimizer, apply_updates, get_optimizer)

@@ -1,0 +1,112 @@
+"""Loss functions (Keras-name-compatible registry).
+
+The reference passes Keras loss names straight through to ``model.compile``
+inside each worker (reference: ``distkeras/workers.py :: Worker.prepare_model``
+compiles with the trainer's ``loss`` kwarg). Here losses are pure functions
+``(y_true, y_pred) -> scalar`` resolved from the same string names, so trainer
+constructors keep the reference's ergonomics
+(``loss='categorical_crossentropy'``).
+
+All losses reduce with a mean over the batch; elementwise math happens in
+float32 regardless of the model's compute dtype for numerical safety.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Union
+
+import jax
+import jax.numpy as jnp
+
+EPS = 1e-7
+
+LossFn = Callable[[jnp.ndarray, jnp.ndarray], jnp.ndarray]
+
+
+def mean_squared_error(y_true, y_pred):
+    return jnp.mean(jnp.square(y_pred.astype(jnp.float32) -
+                               y_true.astype(jnp.float32)))
+
+
+def mean_absolute_error(y_true, y_pred):
+    return jnp.mean(jnp.abs(y_pred.astype(jnp.float32) -
+                            y_true.astype(jnp.float32)))
+
+
+def categorical_crossentropy(y_true, y_pred):
+    """One-hot targets vs probability outputs (post-softmax), Keras-style."""
+    p = jnp.clip(y_pred.astype(jnp.float32), EPS, 1.0 - EPS)
+    return -jnp.mean(jnp.sum(y_true.astype(jnp.float32) * jnp.log(p),
+                             axis=-1))
+
+
+def categorical_crossentropy_from_logits(y_true, y_pred):
+    """One-hot targets vs raw logits — the numerically preferred TPU path
+    (fuses log_softmax into the loss; avoids a softmax round-trip)."""
+    logp = jax.nn.log_softmax(y_pred.astype(jnp.float32), axis=-1)
+    return -jnp.mean(jnp.sum(y_true.astype(jnp.float32) * logp, axis=-1))
+
+
+def sparse_categorical_crossentropy(y_true, y_pred):
+    """Integer targets vs probability outputs."""
+    p = jnp.clip(y_pred.astype(jnp.float32), EPS, 1.0 - EPS)
+    logp = jnp.log(p)
+    picked = jnp.take_along_axis(
+        logp, y_true.astype(jnp.int32)[..., None], axis=-1)[..., 0]
+    return -jnp.mean(picked)
+
+
+def sparse_categorical_crossentropy_from_logits(y_true, y_pred):
+    logp = jax.nn.log_softmax(y_pred.astype(jnp.float32), axis=-1)
+    picked = jnp.take_along_axis(
+        logp, y_true.astype(jnp.int32)[..., None], axis=-1)[..., 0]
+    return -jnp.mean(picked)
+
+
+def binary_crossentropy(y_true, y_pred):
+    p = jnp.clip(y_pred.astype(jnp.float32), EPS, 1.0 - EPS)
+    t = y_true.astype(jnp.float32)
+    return -jnp.mean(t * jnp.log(p) + (1.0 - t) * jnp.log(1.0 - p))
+
+
+def binary_crossentropy_from_logits(y_true, y_pred):
+    x = y_pred.astype(jnp.float32)
+    t = y_true.astype(jnp.float32)
+    # stable formulation: max(x,0) - x*t + log(1+exp(-|x|))
+    return jnp.mean(jnp.maximum(x, 0) - x * t +
+                    jnp.log1p(jnp.exp(-jnp.abs(x))))
+
+
+def hinge(y_true, y_pred):
+    t = y_true.astype(jnp.float32)
+    # Keras-compatible: 0/1 binary labels are converted to -1/+1 (traced-safe
+    # via a scalar select, no Python control flow).
+    is_binary = jnp.all((t == 0.0) | (t == 1.0))
+    t = jnp.where(is_binary, 2.0 * t - 1.0, t)
+    return jnp.mean(jnp.maximum(0.0, 1.0 - t * y_pred.astype(jnp.float32)))
+
+
+LOSSES = {
+    "mse": mean_squared_error,
+    "mean_squared_error": mean_squared_error,
+    "mae": mean_absolute_error,
+    "mean_absolute_error": mean_absolute_error,
+    "categorical_crossentropy": categorical_crossentropy,
+    "categorical_crossentropy_from_logits":
+        categorical_crossentropy_from_logits,
+    "sparse_categorical_crossentropy": sparse_categorical_crossentropy,
+    "sparse_categorical_crossentropy_from_logits":
+        sparse_categorical_crossentropy_from_logits,
+    "binary_crossentropy": binary_crossentropy,
+    "binary_crossentropy_from_logits": binary_crossentropy_from_logits,
+    "hinge": hinge,
+}
+
+
+def get_loss(loss: Union[str, LossFn]) -> LossFn:
+    if callable(loss):
+        return loss
+    try:
+        return LOSSES[loss]
+    except KeyError:
+        raise ValueError(f"Unknown loss {loss!r}; known: {sorted(LOSSES)}")

@@ -1,0 +1,54 @@
+"""Metrics as pure batched functions.
+
+The reference computes accuracy offline on the driver by comparing DataFrame
+columns (reference: ``distkeras/evaluators.py :: AccuracyEvaluator``). Here
+metrics are vectorized jnp functions usable both inside jitted eval steps and
+from the host-side ``Evaluator`` wrappers in ``inference/evaluators.py``.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Union
+
+import jax.numpy as jnp
+
+from distkeras_tpu.ops import losses
+
+
+def accuracy(y_true, y_pred):
+    """Classification accuracy. Handles one-hot or integer ``y_true`` and
+    probability/logit vectors, sigmoid scores, or integer predictions in
+    ``y_pred`` (binary float scores are thresholded at 0.5)."""
+    if y_pred.ndim > 1 and y_pred.shape[-1] > 1:
+        y_pred = jnp.argmax(y_pred, axis=-1)
+    elif jnp.issubdtype(y_pred.dtype, jnp.floating):
+        y_pred = (y_pred >= 0.5)
+    if y_true.ndim > 1 and y_true.shape[-1] > 1:
+        y_true = jnp.argmax(y_true, axis=-1)
+    return jnp.mean((y_pred.reshape(-1).astype(jnp.int32) ==
+                     y_true.reshape(-1).astype(jnp.int32))
+                    .astype(jnp.float32))
+
+
+def top_k_accuracy(y_true, y_pred, k: int = 5):
+    if y_true.ndim > 1 and y_true.shape[-1] > 1:
+        y_true = jnp.argmax(y_true, axis=-1)
+    topk = jnp.argsort(y_pred, axis=-1)[..., -k:]
+    hit = jnp.any(topk == y_true[..., None].astype(jnp.int32), axis=-1)
+    return jnp.mean(hit.astype(jnp.float32))
+
+
+METRICS = {
+    "accuracy": accuracy,
+    "top_5_accuracy": lambda t, p: top_k_accuracy(t, p, 5),
+    "mse": losses.mean_squared_error,
+}
+
+
+def get_metric(metric: Union[str, Callable]):
+    if callable(metric):
+        return metric
+    try:
+        return METRICS[metric]
+    except KeyError:
+        raise ValueError(f"Unknown metric {metric!r}; known: {sorted(METRICS)}")

@@ -1,0 +1,165 @@
+"""Per-worker optimizers as pure pytree transforms.
+
+The reference hands a Keras optimizer name to every worker's ``model.compile``
+(the ``worker_optimizer`` constructor kwarg on every trainer — reference:
+``distkeras/trainers.py :: Trainer.__init__``). Here an optimizer is a pure
+``(init, update)`` pair over pytrees — stateless functions that jit/shard
+transparently, so the same optimizer code runs single-chip, under vmap
+(EnsembleTrainer), and under shard_map with a per-worker leading axis
+(the distributed trainer family).
+
+API (optax-compatible shape, independent implementation):
+    opt = get_optimizer('adam', learning_rate=1e-3)
+    state = opt.init(params)
+    updates, state = opt.update(grads, state, params)
+    params = apply_updates(params, updates)
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple, Union
+
+import jax
+import jax.numpy as jnp
+
+
+class Optimizer(NamedTuple):
+    init: Callable[[Any], Any]
+    update: Callable[[Any, Any, Any], Any]  # (grads, state, params) ->
+    #                                          (updates, new_state)
+    name: str = "optimizer"
+
+
+def apply_updates(params, updates):
+    return jax.tree_util.tree_map(lambda p, u: p + u, params, updates)
+
+
+def _zeros_like(params):
+    return jax.tree_util.tree_map(jnp.zeros_like, params)
+
+
+def sgd(learning_rate: float = 0.01, momentum: float = 0.0,
+        nesterov: bool = False) -> Optimizer:
+    lr, mu = float(learning_rate), float(momentum)
+
+    def init(params):
+        return {"velocity": _zeros_like(params)} if mu else {}
+
+    def update(grads, state, params=None):
+        if not mu:
+            return jax.tree_util.tree_map(lambda g: -lr * g, grads), state
+        vel = jax.tree_util.tree_map(lambda v, g: mu * v - lr * g,
+                                     state["velocity"], grads)
+        if nesterov:
+            upd = jax.tree_util.tree_map(lambda v, g: mu * v - lr * g,
+                                         vel, grads)
+        else:
+            upd = vel
+        return upd, {"velocity": vel}
+
+    return Optimizer(init, update, "sgd")
+
+
+def adagrad(learning_rate: float = 0.01, epsilon: float = 1e-7) -> Optimizer:
+    lr, eps = float(learning_rate), float(epsilon)
+
+    def init(params):
+        return {"accum": _zeros_like(params)}
+
+    def update(grads, state, params=None):
+        accum = jax.tree_util.tree_map(lambda a, g: a + jnp.square(g),
+                                       state["accum"], grads)
+        upd = jax.tree_util.tree_map(
+            lambda g, a: -lr * g / (jnp.sqrt(a) + eps), grads, accum)
+        return upd, {"accum": accum}
+
+    return Optimizer(init, update, "adagrad")
+
+
+def rmsprop(learning_rate: float = 0.001, rho: float = 0.9,
+            epsilon: float = 1e-7) -> Optimizer:
+    lr, r, eps = float(learning_rate), float(rho), float(epsilon)
+
+    def init(params):
+        return {"ms": _zeros_like(params)}
+
+    def update(grads, state, params=None):
+        ms = jax.tree_util.tree_map(
+            lambda m, g: r * m + (1 - r) * jnp.square(g), state["ms"], grads)
+        upd = jax.tree_util.tree_map(
+            lambda g, m: -lr * g / (jnp.sqrt(m) + eps), grads, ms)
+        return upd, {"ms": ms}
+
+    return Optimizer(init, update, "rmsprop")
+
+
+def adam(learning_rate: float = 0.001, beta1: float = 0.9,
+         beta2: float = 0.999, epsilon: float = 1e-7) -> Optimizer:
+    lr, b1, b2, eps = (float(learning_rate), float(beta1), float(beta2),
+                       float(epsilon))
+
+    def init(params):
+        return {"m": _zeros_like(params), "v": _zeros_like(params),
+                "t": jnp.zeros((), jnp.int32)}
+
+    def update(grads, state, params=None):
+        t = state["t"] + 1
+        m = jax.tree_util.tree_map(lambda m_, g: b1 * m_ + (1 - b1) * g,
+                                   state["m"], grads)
+        v = jax.tree_util.tree_map(
+            lambda v_, g: b2 * v_ + (1 - b2) * jnp.square(g), state["v"],
+            grads)
+        # bias correction folded into the step size (scalar, jit-friendly)
+        tf = t.astype(jnp.float32)
+        step = lr * jnp.sqrt(1 - b2 ** tf) / (1 - b1 ** tf)
+        upd = jax.tree_util.tree_map(
+            lambda m_, v_: -step * m_ / (jnp.sqrt(v_) + eps), m, v)
+        return upd, {"m": m, "v": v, "t": t}
+
+    return Optimizer(init, update, "adam")
+
+
+def adadelta(learning_rate: float = 1.0, rho: float = 0.95,
+             epsilon: float = 1e-7) -> Optimizer:
+    lr, r, eps = float(learning_rate), float(rho), float(epsilon)
+
+    def init(params):
+        return {"acc_g": _zeros_like(params), "acc_u": _zeros_like(params)}
+
+    def update(grads, state, params=None):
+        acc_g = jax.tree_util.tree_map(
+            lambda a, g: r * a + (1 - r) * jnp.square(g), state["acc_g"],
+            grads)
+        upd = jax.tree_util.tree_map(
+            lambda g, ag, au: -lr * g * jnp.sqrt(au + eps) /
+            jnp.sqrt(ag + eps), grads, acc_g, state["acc_u"])
+        acc_u = jax.tree_util.tree_map(
+            lambda a, u: r * a + (1 - r) * jnp.square(u), state["acc_u"], upd)
+        return upd, {"acc_g": acc_g, "acc_u": acc_u}
+
+    return Optimizer(init, update, "adadelta")
+
+
+OPTIMIZERS = {
+    "sgd": sgd,
+    "momentum": lambda **kw: sgd(momentum=kw.pop("momentum", 0.9), **kw),
+    "nesterov": lambda **kw: sgd(momentum=kw.pop("momentum", 0.9),
+                                 nesterov=True, **kw),
+    "adagrad": adagrad,
+    "rmsprop": rmsprop,
+    "adam": adam,
+    "adadelta": adadelta,
+}
+
+
+def get_optimizer(opt: Union[str, Optimizer], **kwargs) -> Optimizer:
+    """Resolve ``'adam'`` / ``('sgd', lr=0.1)`` / Optimizer -> Optimizer,
+    matching the reference's string ``worker_optimizer`` ergonomics."""
+    if isinstance(opt, Optimizer):
+        return opt
+    try:
+        factory = OPTIMIZERS[opt]
+    except KeyError:
+        raise ValueError(f"Unknown optimizer {opt!r}; "
+                         f"known: {sorted(OPTIMIZERS)}")
+    return factory(**kwargs)

@@ -1,0 +1,37 @@
+"""Test configuration: force an 8-device virtual CPU mesh.
+
+Mirrors the reference's `local[*]` Spark-master testing pattern (SURVEY §4):
+multi-worker behavior is exercised on one machine. Here that means JAX's
+virtual host-platform devices — 8 CPU "chips" — so every distributed trainer
+test runs real shard_map collectives without TPU hardware.
+
+The environment's sitecustomize may register a hardware backend and set
+``jax_platforms`` programmatically at interpreter startup; we override both
+the XLA flags (before the CPU client is instantiated) and the platform
+selection here, which runs before any test imports jax.
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    return jax.devices()
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _check_virtual_mesh():
+    assert jax.default_backend() == "cpu" and len(jax.devices()) == 8, (
+        "tests expect 8 virtual CPU devices; got "
+        f"{jax.default_backend()}: {jax.devices()}")

@@ -1,0 +1,163 @@
+"""Unit tests for the layer substrate (shapes, math, jit-ability)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distkeras_tpu.models import (
+    Activation, AveragePooling2D, BatchNorm, Bidirectional, Conv2D, Dense,
+    Dropout, Embedding, Flatten, GlobalAveragePooling2D, GRU, LSTM,
+    MaxPooling2D, Model, Reshape, Sequential)
+
+RNG = jax.random.PRNGKey(0)
+
+
+def build(layers, input_shape):
+    return Model.build(Sequential(layers), input_shape, rng=RNG)
+
+
+def test_dense_shapes_and_math():
+    m = build([Dense(4, use_bias=True)], (3,))
+    x = jnp.ones((2, 3))
+    y, _ = m.apply(m.params, m.state, x)
+    assert y.shape == (2, 4)
+    expected = x @ m.params[0]["kernel"] + m.params[0]["bias"]
+    np.testing.assert_allclose(np.asarray(y), np.asarray(expected), rtol=1e-6)
+
+
+def test_dense_activation():
+    m = build([Dense(4, activation="relu")], (3,))
+    y, _ = m.apply(m.params, m.state, -jnp.ones((2, 3)))
+    assert (np.asarray(y) >= 0).all()
+
+
+def test_mlp_stack_output_shape():
+    m = build([Dense(32, activation="relu"), Dense(10, activation="softmax")],
+              (784,))
+    assert m.output_shape == (10,)
+    y, _ = m.apply(m.params, m.state, jnp.zeros((5, 784)))
+    np.testing.assert_allclose(np.asarray(y).sum(axis=-1), 1.0, rtol=1e-5)
+
+
+def test_conv_pool_flatten_lenet_shapes():
+    m = build([
+        Conv2D(6, 5, padding="SAME", activation="tanh"),
+        MaxPooling2D(2),
+        Conv2D(16, 5, padding="VALID", activation="tanh"),
+        MaxPooling2D(2),
+        Flatten(),
+        Dense(120, activation="tanh"),
+        Dense(10),
+    ], (28, 28, 1))
+    assert m.output_shape == (10,)
+    y, _ = m.apply(m.params, m.state, jnp.zeros((2, 28, 28, 1)))
+    assert y.shape == (2, 10)
+
+
+def test_avgpool_math():
+    m = build([AveragePooling2D(2)], (4, 4, 1))
+    x = jnp.arange(16.0).reshape(1, 4, 4, 1)
+    y, _ = m.apply(m.params, m.state, x)
+    np.testing.assert_allclose(np.asarray(y)[0, 0, 0, 0],
+                               np.mean([0, 1, 4, 5]))
+
+
+def test_global_avg_pool():
+    m = build([GlobalAveragePooling2D()], (5, 5, 3))
+    y, _ = m.apply(m.params, m.state, jnp.ones((2, 5, 5, 3)))
+    assert y.shape == (2, 3)
+
+
+def test_dropout_train_vs_eval():
+    m = build([Dropout(0.5)], (100,))
+    x = jnp.ones((4, 100))
+    y_eval, _ = m.apply(m.params, m.state, x, training=False)
+    np.testing.assert_array_equal(np.asarray(y_eval), np.asarray(x))
+    y_train, _ = m.apply(m.params, m.state, x, training=True,
+                         rng=jax.random.PRNGKey(1))
+    arr = np.asarray(y_train)
+    assert (arr == 0).any() and (arr == 2.0).any()
+
+
+def test_batchnorm_normalizes_and_updates_state():
+    m = build([BatchNorm(momentum=0.5)], (8,))
+    x = jax.random.normal(jax.random.PRNGKey(2), (64, 8)) * 3 + 1
+    y, new_state = m.apply(m.params, m.state, x, training=True)
+    np.testing.assert_allclose(np.asarray(y).mean(axis=0), 0.0, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(y).std(axis=0), 1.0, atol=1e-2)
+    assert not np.allclose(np.asarray(new_state[0]["mean"]), 0.0)
+    # eval mode uses running stats, not batch stats
+    y2, s2 = m.apply(m.params, new_state, x, training=False)
+    np.testing.assert_array_equal(np.asarray(s2[0]["mean"]),
+                                  np.asarray(new_state[0]["mean"]))
+
+
+def test_embedding_lookup():
+    m = build([Embedding(10, 4)], ())
+    ids = jnp.array([[1, 2], [3, 4]])
+    y, _ = m.apply(m.params, m.state, ids)
+    assert y.shape == (2, 2, 4)
+    np.testing.assert_array_equal(
+        np.asarray(y[0, 0]), np.asarray(m.params[0]["embeddings"][1]))
+
+
+def test_lstm_shapes():
+    m = build([LSTM(16)], (12, 8))
+    assert m.output_shape == (16,)
+    y, _ = m.apply(m.params, m.state, jnp.zeros((3, 12, 8)))
+    assert y.shape == (3, 16)
+    m2 = build([LSTM(16, return_sequences=True)], (12, 8))
+    y2, _ = m2.apply(m2.params, m2.state, jnp.zeros((3, 12, 8)))
+    assert y2.shape == (3, 12, 16)
+
+
+def test_gru_shapes():
+    m = build([GRU(7, return_sequences=True)], (5, 3))
+    y, _ = m.apply(m.params, m.state, jnp.ones((2, 5, 3)))
+    assert y.shape == (2, 5, 7)
+
+
+def test_bidirectional_concat():
+    m = build([Bidirectional(LSTM(8, return_sequences=True))], (6, 4))
+    assert m.output_shape == (6, 16)
+    y, _ = m.apply(m.params, m.state,
+                   jax.random.normal(jax.random.PRNGKey(3), (2, 6, 4)))
+    assert y.shape == (2, 6, 16)
+
+
+def test_reverse_lstm_positional_alignment():
+    """reverse=True outputs must align positionally with inputs."""
+    m = build([LSTM(4, return_sequences=True, reverse=True)], (5, 2))
+    x = jax.random.normal(jax.random.PRNGKey(4), (1, 5, 2))
+    y, _ = m.apply(m.params, m.state, x)
+    # the backward pass's "first" computed state is at the last time index of
+    # its scan; positionally, output at t=0 must depend on ALL of x (it is the
+    # end of the reversed scan). Check: perturbing x at t=4 changes y at t=0.
+    x2 = x.at[0, 4].add(1.0)
+    y2, _ = m.apply(m.params, m.state, x2)
+    assert not np.allclose(np.asarray(y[0, 0]), np.asarray(y2[0, 0]))
+
+
+def test_whole_model_is_jittable():
+    m = build([Dense(16, activation="relu"), Dense(4)], (8,))
+
+    @jax.jit
+    def fwd(params, state, x):
+        return m.apply(params, state, x)[0]
+
+    y = fwd(m.params, m.state, jnp.ones((2, 8)))
+    assert y.shape == (2, 4)
+
+
+def test_reshape_layer():
+    m = build([Reshape((4, 2))], (8,))
+    y, _ = m.apply(m.params, m.state, jnp.zeros((3, 8)))
+    assert y.shape == (3, 4, 2)
+
+
+def test_model_predict_batched():
+    m = build([Dense(4)], (8,))
+    out = m.predict(np.ones((10, 8)), batch_size=3)
+    assert out.shape == (10, 4)
+    np.testing.assert_allclose(out, m.predict(np.ones((10, 8))), rtol=1e-6)

@@ -1,0 +1,130 @@
+"""Unit tests for optimizers and losses (convergence on tiny problems)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distkeras_tpu.ops import apply_updates, get_loss, get_optimizer
+from distkeras_tpu.ops.metrics import accuracy, top_k_accuracy
+
+
+@pytest.mark.parametrize("name,kwargs", [
+    ("sgd", {"learning_rate": 0.1}),
+    ("momentum", {"learning_rate": 0.05}),
+    ("nesterov", {"learning_rate": 0.05}),
+    ("adagrad", {"learning_rate": 0.5}),
+    ("rmsprop", {"learning_rate": 0.05}),
+    ("adam", {"learning_rate": 0.1}),
+    ("adadelta", {"learning_rate": 2.0}),
+])
+def test_optimizer_minimizes_quadratic(name, kwargs):
+    opt = get_optimizer(name, **kwargs)
+    params = {"w": jnp.array([3.0, -2.0]), "b": jnp.array(1.5)}
+    target = {"w": jnp.array([1.0, 1.0]), "b": jnp.array(0.0)}
+
+    def loss_fn(p):
+        return (jnp.sum(jnp.square(p["w"] - target["w"])) +
+                jnp.square(p["b"] - target["b"]))
+
+    state = opt.init(params)
+
+    @jax.jit
+    def step(params, state):
+        grads = jax.grad(loss_fn)(params)
+        updates, state = opt.update(grads, state, params)
+        return apply_updates(params, updates), state
+
+    for _ in range(300):
+        params, state = step(params, state)
+    assert float(loss_fn(params)) < 1e-2, f"{name} failed to converge"
+
+
+def test_sgd_step_math():
+    opt = get_optimizer("sgd", learning_rate=0.5)
+    params = {"w": jnp.array([2.0])}
+    grads = {"w": jnp.array([1.0])}
+    state = opt.init(params)
+    updates, _ = opt.update(grads, state, params)
+    new = apply_updates(params, updates)
+    np.testing.assert_allclose(np.asarray(new["w"]), [1.5])
+
+
+def test_optimizer_state_is_pytree():
+    opt = get_optimizer("adam")
+    params = {"a": jnp.ones((3,)), "b": {"c": jnp.ones((2, 2))}}
+    state = opt.init(params)
+    leaves = jax.tree_util.tree_leaves(state)
+    assert all(hasattr(l, "shape") for l in leaves)
+
+
+@pytest.mark.parametrize("loss_name", [
+    "mse", "mae", "categorical_crossentropy",
+    "categorical_crossentropy_from_logits", "binary_crossentropy",
+    "binary_crossentropy_from_logits", "hinge",
+])
+def test_losses_scalar_and_nonnegative(loss_name):
+    loss = get_loss(loss_name)
+    if "binary" in loss_name or loss_name == "hinge":
+        y_true = jnp.array([[1.0], [0.0], [1.0]])
+        if loss_name == "hinge":
+            y_true = 2 * y_true - 1
+        y_pred = jnp.array([[0.8], [0.3], [0.6]])
+    else:
+        y_true = jnp.eye(4)[:3]
+        y_pred = jax.nn.softmax(jnp.ones((3, 4)))
+    val = loss(y_true, y_pred)
+    assert val.shape == ()
+    assert float(val) >= -1e-6
+
+
+def test_crossentropy_from_logits_matches_probs():
+    logits = jax.random.normal(jax.random.PRNGKey(0), (5, 7))
+    y = jax.nn.one_hot(jnp.arange(5) % 7, 7)
+    a = get_loss("categorical_crossentropy")(y, jax.nn.softmax(logits))
+    b = get_loss("categorical_crossentropy_from_logits")(y, logits)
+    np.testing.assert_allclose(float(a), float(b), rtol=1e-5)
+
+
+def test_sparse_crossentropy_matches_dense():
+    logits = jax.random.normal(jax.random.PRNGKey(1), (5, 7))
+    labels = jnp.arange(5) % 7
+    dense = get_loss("categorical_crossentropy_from_logits")(
+        jax.nn.one_hot(labels, 7), logits)
+    sparse = get_loss("sparse_categorical_crossentropy_from_logits")(
+        labels, logits)
+    np.testing.assert_allclose(float(dense), float(sparse), rtol=1e-5)
+
+
+def test_accuracy_metric():
+    y_true = jnp.array([0, 1, 2, 1])
+    y_pred = jax.nn.one_hot(jnp.array([0, 1, 0, 1]), 3)
+    assert float(accuracy(y_true, y_pred)) == pytest.approx(0.75)
+    y_true_oh = jax.nn.one_hot(y_true, 3)
+    assert float(accuracy(y_true_oh, y_pred)) == pytest.approx(0.75)
+
+
+def test_binary_accuracy_thresholds_sigmoid_scores():
+    y_true = jnp.array([1, 1, 0, 0])
+    y_pred = jnp.array([[0.9], [0.8], [0.2], [0.1]])  # perfect predictions
+    assert float(accuracy(y_true, y_pred)) == pytest.approx(1.0)
+    assert float(accuracy(y_true, jnp.array([0.9, 0.3, 0.2, 0.6]))) == \
+        pytest.approx(0.5)
+
+
+def test_hinge_converts_binary_labels():
+    loss = get_loss("hinge")
+    y01 = jnp.array([[1.0], [0.0]])
+    ypm = jnp.array([[1.0], [-1.0]])
+    y_pred = jnp.array([[2.0], [-2.0]])
+    # 0/1 labels behave like +-1 labels (Keras conversion semantics)
+    np.testing.assert_allclose(float(loss(y01, y_pred)),
+                               float(loss(ypm, y_pred)))
+    assert float(loss(ypm, y_pred)) == pytest.approx(0.0)
+
+
+def test_top_k_accuracy():
+    y_true = jnp.array([2, 0])
+    y_pred = jnp.array([[0.1, 0.3, 0.2, 0.4], [0.9, 0.05, 0.03, 0.02]])
+    assert float(top_k_accuracy(y_true, y_pred, k=2)) == pytest.approx(0.5)
+    assert float(top_k_accuracy(y_true, y_pred, k=3)) == pytest.approx(1.0)
