@@ -1,0 +1,8 @@
+"""Data plane: columnar Dataset + feature transformers (Spark-DataFrame
+ingest replacement)."""
+
+from distkeras_tpu.data.dataset import Dataset  # noqa: F401
+from distkeras_tpu.data.transformers import (  # noqa: F401
+    DenseTransformer, LabelIndexTransformer, MinMaxTransformer,
+    OneHotTransformer, ReshapeTransformer, StandardScaleTransformer,
+    Transformer)

@@ -1,0 +1,148 @@
+"""Columnar dataset — the Spark-DataFrame replacement.
+
+The reference's data plane is a Spark DataFrame: named columns, row-oriented
+iteration inside executors, `features_col`/`label_col` selection by every
+trainer/predictor (reference: ``distkeras/trainers.py`` constructor kwargs;
+``distkeras/workers.py`` assembles minibatches from Row iterators —
+per-row marshalling that SURVEY §3.1 flags as a real bottleneck).
+
+TPU-first redesign: a ``Dataset`` is a dict of named **columnar numpy
+arrays**. Batches are zero-copy slices of contiguous columns, already shaped
+``[batch, ...]`` for direct device transfer — no per-row materialization
+anywhere. The API keeps the DataFrame ergonomics the reference's users have
+(named columns, select/with_column/shuffle, features/label selection).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+class Dataset:
+    """Immutable columnar dataset: named numpy columns of equal length."""
+
+    def __init__(self, columns: Dict[str, np.ndarray]):
+        if not columns:
+            raise ValueError("Dataset needs at least one column")
+        lengths = {k: len(v) for k, v in columns.items()}
+        if len(set(lengths.values())) != 1:
+            raise ValueError(f"Column length mismatch: {lengths}")
+        self._columns = {k: np.asarray(v) for k, v in columns.items()}
+
+    # -- construction -----------------------------------------------------
+    @classmethod
+    def from_arrays(cls, features, labels=None, features_col: str = "features",
+                    label_col: str = "label") -> "Dataset":
+        cols = {features_col: np.asarray(features)}
+        if labels is not None:
+            cols[label_col] = np.asarray(labels)
+        return cls(cols)
+
+    @classmethod
+    def from_records(cls, records: Sequence[Dict]) -> "Dataset":
+        """List-of-dicts (row) input -> columnar storage."""
+        if not records:
+            raise ValueError("empty records")
+        keys = records[0].keys()
+        return cls({k: np.asarray([r[k] for r in records]) for k in keys})
+
+    # -- introspection ----------------------------------------------------
+    @property
+    def columns(self) -> List[str]:
+        return list(self._columns)
+
+    def __len__(self) -> int:
+        return len(next(iter(self._columns.values())))
+
+    def __getitem__(self, col: str) -> np.ndarray:
+        try:
+            return self._columns[col]
+        except KeyError:
+            raise KeyError(
+                f"No column {col!r}; available: {self.columns}")
+
+    def __contains__(self, col: str) -> bool:
+        return col in self._columns
+
+    def __repr__(self):
+        spec = ", ".join(f"{k}:{v.dtype}{list(v.shape[1:])}"
+                         for k, v in self._columns.items())
+        return f"Dataset(rows={len(self)}, {spec})"
+
+    # -- transformations (all return new Datasets) ------------------------
+    def select(self, cols: Sequence[str]) -> "Dataset":
+        return Dataset({c: self[c] for c in cols})
+
+    def with_column(self, name: str, values: np.ndarray) -> "Dataset":
+        """Reference parity: ``utils.new_dataframe_row`` appended a column to
+        every row; columnar equivalent is one array assignment."""
+        cols = dict(self._columns)
+        cols[name] = np.asarray(values)
+        return Dataset(cols)
+
+    def drop(self, name: str) -> "Dataset":
+        cols = {k: v for k, v in self._columns.items() if k != name}
+        return Dataset(cols)
+
+    def shuffle(self, seed: int = 0) -> "Dataset":
+        """Reference parity: ``utils.shuffle(df)`` (rand column + sort).
+        Columnar equivalent: one permutation applied to every column."""
+        perm = np.random.RandomState(seed).permutation(len(self))
+        return Dataset({k: v[perm] for k, v in self._columns.items()})
+
+    def take(self, n: int) -> "Dataset":
+        return Dataset({k: v[:n] for k, v in self._columns.items()})
+
+    def skip(self, n: int) -> "Dataset":
+        return Dataset({k: v[n:] for k, v in self._columns.items()})
+
+    def split(self, fraction: float) -> Tuple["Dataset", "Dataset"]:
+        n = int(len(self) * fraction)
+        return self.take(n), self.skip(n)
+
+    def map_column(self, col: str, fn: Callable[[np.ndarray], np.ndarray],
+                   output_col: Optional[str] = None) -> "Dataset":
+        """Vectorized column map — the engine under every feature
+        transformer (fn sees the WHOLE column at once, never rows)."""
+        return self.with_column(output_col or col, fn(self[col]))
+
+    def concat(self, other: "Dataset") -> "Dataset":
+        if set(self.columns) != set(other.columns):
+            raise ValueError("column sets differ")
+        return Dataset({k: np.concatenate([self[k], other[k]])
+                        for k in self.columns})
+
+    # -- training views ---------------------------------------------------
+    def arrays(self, features_col: str = "features",
+               label_col: Optional[str] = "label"):
+        X = self[features_col]
+        if np.issubdtype(X.dtype, np.integer):
+            # token-id features (Embedding models): keep exact integers —
+            # a float32 cast would corrupt ids above 2^24
+            X = np.ascontiguousarray(X)
+        else:
+            X = np.ascontiguousarray(X, dtype=np.float32)
+        if label_col is None or label_col not in self:
+            return X, None
+        y = self[label_col]
+        if np.issubdtype(y.dtype, np.integer):
+            y = np.ascontiguousarray(y)
+        else:
+            y = np.ascontiguousarray(y, dtype=np.float32)
+        return X, y
+
+    def batches(self, batch_size: int, features_col: str = "features",
+                label_col: Optional[str] = "label",
+                drop_remainder: bool = True
+                ) -> Iterator[Tuple[np.ndarray, Optional[np.ndarray]]]:
+        """Contiguous columnar minibatches (replaces the reference's per-row
+        Row-iterator minibatch assembly in ``workers.py``)."""
+        X, y = self.arrays(features_col, label_col)
+        n = len(X)
+        end = (n // batch_size) * batch_size if drop_remainder else n
+        for i in range(0, end, batch_size):
+            xb = X[i:i + batch_size]
+            yb = y[i:i + batch_size] if y is not None else None
+            yield xb, yb

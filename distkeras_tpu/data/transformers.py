@@ -1,0 +1,169 @@
+"""Feature/label transformers — vectorized columnar ops.
+
+Reference parity: ``distkeras/transformers.py`` implements each transformer
+as a Spark map/udf over rows (OneHotTransformer, LabelIndexTransformer,
+MinMaxTransformer, ReshapeTransformer, DenseTransformer — SURVEY §2.1).
+Here each is a single vectorized numpy op over a whole column — same API
+shape (``Transformer.transform(dataset) -> dataset``), columnar execution.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from distkeras_tpu.data.dataset import Dataset
+
+
+class Transformer:
+    """Base: pure ``Dataset -> Dataset`` map (reference:
+    ``transformers.py :: Transformer.transform(df)``)."""
+
+    def transform(self, dataset: Dataset) -> Dataset:
+        raise NotImplementedError
+
+    def __call__(self, dataset: Dataset) -> Dataset:
+        return self.transform(dataset)
+
+
+class OneHotTransformer(Transformer):
+    """Integer label column -> one-hot float vector column.
+
+    Reference parity: ``transformers.py :: OneHotTransformer`` /
+    ``utils.to_dense_vector``.
+    """
+
+    def __init__(self, output_dim: int, input_col: str = "label",
+                 output_col: str = "label_encoded"):
+        self.output_dim = int(output_dim)
+        self.input_col = input_col
+        self.output_col = output_col
+
+    def transform(self, dataset: Dataset) -> Dataset:
+        labels = dataset[self.input_col].astype(np.int64).reshape(-1)
+        if labels.size and (labels.min() < 0 or
+                            labels.max() >= self.output_dim):
+            raise ValueError(
+                f"labels out of range [0, {self.output_dim}): "
+                f"min={labels.min()}, max={labels.max()}")
+        eye = np.eye(self.output_dim, dtype=np.float32)
+        return dataset.with_column(self.output_col, eye[labels])
+
+
+class LabelIndexTransformer(Transformer):
+    """Probability/score vector column -> argmax class index column.
+
+    Reference parity: ``transformers.py :: LabelIndexTransformer`` (the step
+    between ``ModelPredictor`` output and ``AccuracyEvaluator`` in every
+    example pipeline).
+    """
+
+    def __init__(self, output_dim: Optional[int] = None,
+                 input_col: str = "prediction",
+                 output_col: str = "predicted_index"):
+        self.output_dim = output_dim  # kept for API parity; argmax needs none
+        self.input_col = input_col
+        self.output_col = output_col
+
+    def transform(self, dataset: Dataset) -> Dataset:
+        preds = np.asarray(dataset[self.input_col])
+        if preds.ndim == 1 or preds.shape[-1] == 1:
+            idx = (preds.reshape(len(preds), -1)[:, 0] >= 0.5).astype(np.int64)
+        else:
+            idx = np.argmax(preds, axis=-1).astype(np.int64)
+        return dataset.with_column(self.output_col, idx)
+
+
+class MinMaxTransformer(Transformer):
+    """Rescale a numeric column into ``[o_min, o_max]``.
+
+    Reference parity: ``transformers.py :: MinMaxTransformer`` (used to scale
+    pixel values in the MNIST workflow). Ranges may be given (``i_min`` /
+    ``i_max``) as in the reference, or inferred from the data.
+    """
+
+    def __init__(self, o_min: float = 0.0, o_max: float = 1.0,
+                 i_min: Optional[float] = None, i_max: Optional[float] = None,
+                 input_col: str = "features",
+                 output_col: str = "features_normalized"):
+        self.o_min, self.o_max = float(o_min), float(o_max)
+        self.i_min, self.i_max = i_min, i_max
+        self.input_col = input_col
+        self.output_col = output_col
+
+    def transform(self, dataset: Dataset) -> Dataset:
+        x = dataset[self.input_col].astype(np.float32)
+        i_min = np.float32(self.i_min if self.i_min is not None else x.min())
+        i_max = np.float32(self.i_max if self.i_max is not None else x.max())
+        span = i_max - i_min
+        if span == 0:
+            scaled = np.zeros_like(x)
+        else:
+            scaled = (x - i_min) / span
+        out = scaled * (self.o_max - self.o_min) + self.o_min
+        return dataset.with_column(self.output_col, out)
+
+
+class ReshapeTransformer(Transformer):
+    """Reshape each row of a column (flat pixel vector -> image tensor).
+
+    Reference parity: ``transformers.py :: ReshapeTransformer`` (MNIST 784
+    -> 28x28x1 before the CNN examples).
+    """
+
+    def __init__(self, input_col: str, output_col: str,
+                 shape: Sequence[int]):
+        self.input_col = input_col
+        self.output_col = output_col
+        self.shape = tuple(int(d) for d in shape)
+
+    def transform(self, dataset: Dataset) -> Dataset:
+        x = dataset[self.input_col]
+        return dataset.with_column(self.output_col,
+                                   x.reshape((len(x),) + self.shape))
+
+
+class DenseTransformer(Transformer):
+    """Ensure a column is a dense, contiguous float array.
+
+    Reference parity: ``transformers.py :: DenseTransformer`` (Spark sparse
+    vector -> dense vector). Accepts scipy-style sparse matrices or object
+    arrays of per-row sparse/list values.
+    """
+
+    def __init__(self, input_col: str = "features",
+                 output_col: str = "features_dense"):
+        self.input_col = input_col
+        self.output_col = output_col
+
+    def transform(self, dataset: Dataset) -> Dataset:
+        x = dataset[self.input_col]
+        if hasattr(x, "toarray"):  # scipy sparse matrix column
+            dense = np.asarray(x.toarray(), dtype=np.float32)
+        elif x.dtype == object:
+            dense = np.stack([
+                np.asarray(r.toarray()).reshape(-1)
+                if hasattr(r, "toarray") else np.asarray(r, dtype=np.float32)
+                for r in x]).astype(np.float32)
+        else:
+            dense = np.ascontiguousarray(x, dtype=np.float32)
+        return dataset.with_column(self.output_col, dense)
+
+
+class StandardScaleTransformer(Transformer):
+    """Zero-mean/unit-variance scaling (capability add beyond the reference's
+    MinMax; common preprocessing for the physics examples)."""
+
+    def __init__(self, input_col: str = "features",
+                 output_col: str = "features_scaled", epsilon: float = 1e-8):
+        self.input_col = input_col
+        self.output_col = output_col
+        self.epsilon = float(epsilon)
+
+    def transform(self, dataset: Dataset) -> Dataset:
+        x = dataset[self.input_col].astype(np.float32)
+        mean = x.mean(axis=0, keepdims=True)
+        std = x.std(axis=0, keepdims=True)
+        return dataset.with_column(self.output_col,
+                                   (x - mean) / (std + self.epsilon))

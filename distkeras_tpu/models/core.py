@@ -165,10 +165,16 @@ class Model:
         fn = self._jit_fwd
         if batch_size is None:
             return np.asarray(fn(self.params, self.state, x))
+        n = x.shape[0]
         outs = []
-        for i in range(0, x.shape[0], batch_size):
-            outs.append(np.asarray(fn(self.params, self.state,
-                                      x[i:i + batch_size])))
+        for i in range(0, n, batch_size):
+            xb = x[i:i + batch_size]
+            pad = batch_size - xb.shape[0]
+            if pad:  # pad the remainder so every call shares ONE jit shape
+                xb = jnp.concatenate(
+                    [xb, jnp.zeros((pad,) + xb.shape[1:], xb.dtype)])
+            yb = np.asarray(fn(self.params, self.state, xb))
+            outs.append(yb[:batch_size - pad] if pad else yb)
         return np.concatenate(outs, axis=0)
 
     # -- bookkeeping ------------------------------------------------------

@@ -62,14 +62,27 @@ def serialize_model(model: Model) -> Dict[str, Any]:
 
 
 def deserialize_model(payload: Dict[str, Any]) -> Model:
-    """Plain dict -> Model (rebuilds spec from registry, restores weights)."""
+    """Plain dict -> Model (rebuilds spec from registry, restores weights).
+
+    Uses ``jax.eval_shape`` to get the parameter template, so no random
+    initialization work is done just to be overwritten (matters for
+    ResNet-scale models)."""
     if payload.get("format") != FORMAT_VERSION:
         raise ValueError(f"Unknown model format: {payload.get('format')!r}")
     module = LAYER_REGISTRY[payload["class"]].from_config(payload["config"])
-    model = Model.build(module, tuple(payload["input_shape"]))
-    params = _unflatten_like(model.params, payload["params"])
-    state = _unflatten_like(model.state, payload["state"])
-    return model.replace(params=params, state=state)
+    input_shape = tuple(payload["input_shape"])
+    rng = jax.random.PRNGKey(0)
+    captured = {}
+
+    def abstract_init():
+        p, s, out_shape = module.init(rng, input_shape)
+        captured["out_shape"] = out_shape  # static python tuple
+        return p, s
+
+    p_template, s_template = jax.eval_shape(abstract_init)
+    params = _unflatten_like(p_template, payload["params"])
+    state = _unflatten_like(s_template, payload["state"])
+    return Model(module, params, state, input_shape, captured["out_shape"])
 
 
 def save_model(model: Model, path: str) -> None:

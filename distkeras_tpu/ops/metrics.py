@@ -18,11 +18,14 @@ from distkeras_tpu.ops import losses
 def accuracy(y_true, y_pred):
     """Classification accuracy. Handles one-hot or integer ``y_true`` and
     probability/logit vectors, sigmoid scores, or integer predictions in
-    ``y_pred`` (binary float scores are thresholded at 0.5)."""
+    ``y_pred``. Binary float scores are thresholded at 0.5 when they look
+    like probabilities (all values in [0, 1]) and at 0.0 otherwise (logits);
+    the check is a traced scalar select, so it stays jit-compatible."""
     if y_pred.ndim > 1 and y_pred.shape[-1] > 1:
         y_pred = jnp.argmax(y_pred, axis=-1)
     elif jnp.issubdtype(y_pred.dtype, jnp.floating):
-        y_pred = (y_pred >= 0.5)
+        is_prob = jnp.all((y_pred >= 0.0) & (y_pred <= 1.0))
+        y_pred = y_pred >= jnp.where(is_prob, 0.5, 0.0)
     if y_true.ndim > 1 and y_true.shape[-1] > 1:
         y_true = jnp.argmax(y_true, axis=-1)
     return jnp.mean((y_pred.reshape(-1).astype(jnp.int32) ==

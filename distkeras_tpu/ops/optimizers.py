@@ -156,6 +156,11 @@ def get_optimizer(opt: Union[str, Optimizer], **kwargs) -> Optimizer:
     """Resolve ``'adam'`` / ``('sgd', lr=0.1)`` / Optimizer -> Optimizer,
     matching the reference's string ``worker_optimizer`` ergonomics."""
     if isinstance(opt, Optimizer):
+        if kwargs:
+            raise ValueError(
+                f"got both an Optimizer instance and kwargs {sorted(kwargs)};"
+                " configure the instance directly instead (the kwargs would"
+                " be silently ignored)")
         return opt
     try:
         factory = OPTIMIZERS[opt]

@@ -1,0 +1,8 @@
+"""Parallel layer: trainer hierarchy + device-mesh distributed engine."""
+
+from distkeras_tpu.parallel.distributed import (  # noqa: F401
+    ADAG, AEASGD, DOWNPOUR, AveragingTrainer, DistributedTrainer, DynSGD,
+    EASGD)
+from distkeras_tpu.parallel.mesh import make_mesh, make_mesh_2d  # noqa: F401
+from distkeras_tpu.parallel.trainers import (  # noqa: F401
+    EnsembleTrainer, SingleTrainer, Trainer)

@@ -1,0 +1,231 @@
+"""Distributed trainer family: DOWNPOUR, EASGD, AEASGD, ADAG, DynSGD,
+AveragingTrainer.
+
+Reference parity: ``distkeras/trainers.py`` concrete classes (SURVEY §2.1).
+Constructor surfaces mirror the reference (``num_workers``, ``batch_size``,
+``communication_window``, ``num_epoch``, ``features_col``, ``label_col``,
+algorithm hyper-parameters), but training runs on a ``jax.sharding.Mesh``
+via the SPMD engine in ``parallel/engine.py`` instead of Spark executors +
+a socket parameter server — see that module's docstring for the mapping.
+
+Notable surface differences from the reference, by design:
+  * no ``master_host``/``master_port`` — there is no socket PS;
+  * ``parallelism_factor`` is accepted for API compatibility but ignored
+    (workers map 1:1 onto mesh positions; Spark-style oversubscription has
+    no TPU equivalent);
+  * ``trainer.parameter_server`` is replaced by the replicated center state
+    inside the engine.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Union
+
+import jax
+import numpy as np
+
+from distkeras_tpu.data.dataset import Dataset
+from distkeras_tpu.models.core import Model
+from distkeras_tpu.parallel.engine import (
+    AdagAlgo, AveragingAlgo, DistAlgorithm, DistributedEngine, DownpourAlgo,
+    DynSGDAlgo, ElasticAlgo, EngineConfig, shard_epoch_data)
+from distkeras_tpu.parallel.mesh import make_mesh
+from distkeras_tpu.parallel.trainers import Trainer
+
+
+class DistributedTrainer(Trainer):
+    """Base for all mesh-distributed trainers.
+
+    Reference: ``trainers.py :: DistributedTrainer`` (adds num_workers,
+    communication_window, the PS service and worker allocation). Here
+    ``allocate_algorithm()`` plays the role of the reference's
+    ``allocate_worker()`` + ``allocate_parameter_server()`` pair: it fixes
+    the commit protocol both sides of the (now compiled-in) exchange.
+    """
+
+    def __init__(self, keras_model: Model, num_workers: Optional[int] = None,
+                 communication_window: int = 5,
+                 parallelism_factor: int = 1, mesh=None, **kwargs):
+        super().__init__(keras_model, **kwargs)
+        self.num_workers = int(num_workers or len(jax.devices()))
+        self.communication_window = communication_window
+        self.parallelism_factor = parallelism_factor  # API parity; unused
+        self.mesh = mesh
+
+    def allocate_algorithm(self) -> DistAlgorithm:
+        raise NotImplementedError
+
+    # window may be overridden per-train (AveragingTrainer binds it to the
+    # epoch length)
+    def _window(self, steps_per_epoch: int) -> Union[int, Sequence[int]]:
+        return self.communication_window
+
+    def train(self, dataset: Dataset) -> Model:
+        model = self.master_model
+        X, y = self._training_arrays(dataset)
+
+        mesh = self.mesh or make_mesh(self.num_workers)
+        # probe epoch shape once to size the window (and fail fast on tiny
+        # datasets)
+        _, _, S = shard_epoch_data(X, y, self.num_workers, self.batch_size)
+        engine = DistributedEngine(
+            model.module, self.loss, self.worker_optimizer,
+            self.allocate_algorithm(), mesh,
+            EngineConfig(num_workers=self.num_workers,
+                         window=self._window(S)))
+        state = engine.init_state(model.params, model.state,
+                                  jax.random.PRNGKey(self.seed))
+        state = jax.device_put(state, engine.shardings())
+
+        self.record_training_start()
+        for epoch in range(self.num_epoch):
+            perm = self._epoch_perm(epoch, len(X))
+            Xs, Ys, S = shard_epoch_data(X, y, self.num_workers,
+                                         self.batch_size, perm)
+            state, losses = engine.run_epoch(state, Xs, Ys)
+            self.history.append_epoch(loss=jax.device_get(losses))
+        self.record_training_stop()
+
+        params, mstate = engine.extract_model(state)
+        trained = model.replace(params=params, state=mstate)
+        self.master_model = trained
+        return trained
+
+
+class DOWNPOUR(DistributedTrainer):
+    """Asynchronous DOWNPOUR SGD (Dean et al. 2012).
+
+    Reference: ``trainers.py :: DOWNPOUR`` with ``DOWNPOURWorker`` +
+    ``DeltaParameterServer`` (SURVEY §3.3): accumulate
+    ``communication_window`` local steps, commit the delta, pull fresh
+    center. Commits are staggered across workers to reproduce async PS
+    arrival order (engine docstring).
+    """
+
+    def __init__(self, keras_model: Model, communication_window: int = 5,
+                 commit_scale: float = 1.0, **kwargs):
+        super().__init__(keras_model,
+                         communication_window=communication_window, **kwargs)
+        self.commit_scale = float(commit_scale)
+
+    def allocate_algorithm(self):
+        return DownpourAlgo(commit_scale=self.commit_scale)
+
+
+class EASGD(DistributedTrainer):
+    """Synchronous Elastic Averaging SGD (Zhang et al. 2015).
+
+    Reference: ``trainers.py :: EASGD`` — barrier rounds: every worker
+    exchanges an elastic difference with the center every
+    ``communication_window`` steps, simultaneously. ``alpha = rho *
+    learning_rate`` as in the reference worker; ``learning_rate`` here is
+    the elastic/exploration rate (the worker optimizer's own learning rate
+    is configured via ``worker_optimizer``/``optimizer_kwargs``).
+    """
+
+    def __init__(self, keras_model: Model, rho: float = 5.0,
+                 learning_rate: float = 0.01, communication_window: int = 5,
+                 center_mode: str = "sum", **kwargs):
+        # learning_rate is the ELASTIC rate, not the worker optimizer's —
+        # do not forward it to the base (which would configure the optimizer)
+        super().__init__(keras_model,
+                         communication_window=communication_window, **kwargs)
+        self.rho = float(rho)
+        self.learning_rate = float(learning_rate)
+        self.center_mode = center_mode
+
+    @property
+    def alpha(self) -> float:
+        return self.rho * self.learning_rate
+
+    def allocate_algorithm(self):
+        if (self.center_mode == "sum"
+                and self.alpha * self.num_workers >= 1.0):
+            import warnings
+            warnings.warn(
+                f"EASGD stability: num_workers * alpha = "
+                f"{self.alpha * self.num_workers:.2f} >= 1 with "
+                f"center_mode='sum'; the center update can oscillate. "
+                f"Lower rho/learning_rate or use center_mode='mean'.",
+                stacklevel=2)
+        return ElasticAlgo(alpha=self.alpha, synchronous=True,
+                           center_mode=self.center_mode)
+
+
+class AEASGD(EASGD):
+    """Asynchronous EASGD — the reference's flagship algorithm (SURVEY §3.2).
+
+    Reference: ``trainers.py :: AEASGD`` with ``AEASGDWorker``: each worker
+    elastic-exchanges with the center at its own cadence. Emulated by
+    staggered commit offsets; each commit is a masked psum touching only
+    that worker's elastic difference.
+    """
+
+    def __init__(self, keras_model: Model, rho: float = 5.0,
+                 learning_rate: float = 0.01, communication_window: int = 32,
+                 center_mode: str = "sum", **kwargs):
+        super().__init__(keras_model, rho=rho, learning_rate=learning_rate,
+                         communication_window=communication_window,
+                         center_mode=center_mode, **kwargs)
+
+    def allocate_algorithm(self):
+        return ElasticAlgo(alpha=self.alpha, synchronous=False,
+                           center_mode=self.center_mode)
+
+
+class ADAG(DistributedTrainer):
+    """ADAG — asynchronous commits with adaptive per-parameter server
+    accumulation (reference: ``trainers.py :: ADAG`` +
+    ``ADAGParameterServer``)."""
+
+    def __init__(self, keras_model: Model, communication_window: int = 5,
+                 adag_learning_rate: float = 0.05, epsilon: float = 1e-8,
+                 **kwargs):
+        super().__init__(keras_model,
+                         communication_window=communication_window, **kwargs)
+        self.adag_learning_rate = float(adag_learning_rate)
+        self.epsilon = float(epsilon)
+
+    def allocate_algorithm(self):
+        return AdagAlgo(adag_lr=self.adag_learning_rate,
+                        epsilon=self.epsilon)
+
+
+class DynSGD(DistributedTrainer):
+    """DynSGD — staleness-scaled asynchronous SGD (reference:
+    ``trainers.py :: DynSGD`` + ``DynSGDParameterServer``; SURVEY §3.3:
+    commit tagged with last-pull ``num_updates``, server scales delta by
+    1/staleness).
+
+    ``communication_window`` may be per-worker (a list of K_i) to model
+    heterogeneous worker speeds — the scenario DynSGD exists for.
+    """
+
+    def __init__(self, keras_model: Model,
+                 communication_window: Union[int, Sequence[int]] = 5,
+                 **kwargs):
+        super().__init__(keras_model,
+                         communication_window=communication_window, **kwargs)
+
+    def allocate_algorithm(self):
+        return DynSGDAlgo()
+
+
+class AveragingTrainer(DistributedTrainer):
+    """Per-epoch weight averaging over independently training workers.
+
+    Reference: ``trainers.py :: AveragingTrainer`` (SURVEY §2.1). The commit
+    window is bound to the epoch length, so workers train a full epoch shard
+    independently and then synchronously average — exactly the reference's
+    per-epoch semantics, as one compiled program.
+    """
+
+    def __init__(self, keras_model: Model, **kwargs):
+        kwargs.setdefault("communication_window", 0)  # bound at train time
+        super().__init__(keras_model, **kwargs)
+
+    def _window(self, steps_per_epoch: int):
+        return steps_per_epoch
+
+    def allocate_algorithm(self):
+        return AveragingAlgo()

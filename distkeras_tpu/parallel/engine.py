@@ -1,0 +1,434 @@
+"""The SPMD distributed-training engine: staggered-window workers + a
+replicated center, all inside one jitted ``shard_map``.
+
+This module is the TPU-native replacement for the reference's entire
+distributed runtime — the Spark executor loop (``distkeras/workers.py``),
+the socket parameter server (``distkeras/parameter_servers.py``) and the
+pickled-TCP wire protocol (``distkeras/networking.py``) collapse into a
+single compiled program over a device mesh (SURVEY §5.8: the north star is
+zero socket-PS traffic, all comms via ICI collectives).
+
+Mapping of reference concepts:
+
+  reference (Spark + socket PS)            here (SPMD mesh)
+  ---------------------------------------  --------------------------------
+  Spark executor running Worker.train      mesh position along ``workers``
+  per-worker minibatch loop                ``lax.scan`` over micro-steps
+  PS 'pull' (TCP round-trip)               read of the replicated center
+  PS 'commit' (TCP round-trip)             masked ``psum`` over ICI
+  communication_window local steps         commit mask every K micro-steps
+  PS mutex / commit serialization          staggered per-worker offsets so
+                                           commits interleave like async
+                                           arrivals (at most ~1/step)
+  PS state (center weights, num_updates)   replicated pytrees in the carry
+
+Async semantics on a synchronous mesh (SURVEY §7 "hard parts" (a)): true
+async PS arrival order is modeled by giving each worker a commit *phase
+offset* within its window. Worker i commits at global micro-steps t where
+``(t + 1 + offset_i) % K_i == 0``. With offsets spread uniformly, commits
+serialize through the (replicated) center exactly like the reference PS
+serialized them through its mutex — a DynSGD worker therefore observes the
+same staleness profile (center advanced by ~n-1 foreign commits per window)
+as it would against the socket PS. Setting all offsets to 0 recovers the
+synchronous barrier-round algorithms (EASGD, averaging).
+
+Everything — local steps, masked collectives, server updates — runs inside
+one ``lax.scan`` under ``shard_map`` under ``jit``: per epoch there is ONE
+Python dispatch, and XLA overlaps the per-window psum with local compute
+where the schedule allows.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from distkeras_tpu.ops.optimizers import Optimizer
+from distkeras_tpu.parallel.worker import (  # noqa: F401  (re-export)
+    TrainCarry, make_train_step, shard_epoch_data)
+
+Pytree = Any
+
+
+def _tmap(f, *trees):
+    return jax.tree_util.tree_map(f, *trees)
+
+
+def _select(mask, a, b):
+    """Pytree-wise ``where(mask, a, b)`` with a scalar bool mask."""
+    return _tmap(lambda x, y: jnp.where(mask, x, y), a, b)
+
+
+# ---------------------------------------------------------------------------
+# Algorithm plug-ins (the reference's ParameterServer subclasses, SURVEY §2.1)
+# ---------------------------------------------------------------------------
+
+class DistAlgorithm:
+    """Commit/serve behavior of one distributed SGD variant.
+
+    Roles map onto the reference's split: ``contrib``/``worker_post`` are the
+    worker-side commit protocol (``workers.py :: *Worker.train`` window
+    body), ``server_update`` is the PS-side handler
+    (``parameter_servers.py :: *ParameterServer.handle_commit``).
+    """
+
+    #: async emulation (staggered offsets) vs synchronous barrier rounds
+    staggered: bool = True
+    #: whether workers track a pull-time snapshot of the center
+    needs_pull: bool = False
+
+    def init_server(self, params: Pytree) -> Dict[str, Pytree]:
+        return {}
+
+    def init_worker_extras(self, num_workers: int) -> Dict[str, jnp.ndarray]:
+        return {}
+
+    def contrib(self, w_params, pull, center, server, extras) -> Pytree:
+        """Per-worker commit payload (pre-masking), e.g. a delta or an
+        elastic difference."""
+        raise NotImplementedError
+
+    def server_update(self, center, server, total, n_commits
+                      ) -> Tuple[Pytree, Dict]:
+        """Apply the psum of masked contributions to the center."""
+        raise NotImplementedError
+
+    def worker_post(self, w_params, pull, contrib, new_center, new_server,
+                    extras, mask) -> Tuple[Pytree, Pytree, Dict]:
+        """Worker-side effect of its own commit (pull fresh center, subtract
+        elastic term, record clock, ...). Applied only where ``mask``."""
+        return w_params, pull, extras
+
+    def finalize(self, center, workers_stacked, pulls_stacked,
+                 num_workers: int) -> Pytree:
+        """Host-side flush after the last epoch (uncommitted residual)."""
+        return center
+
+
+@dataclass
+class DownpourAlgo(DistAlgorithm):
+    """DOWNPOUR (Dean et al. 2012): workers accumulate K local steps, commit
+    the accumulated delta, pull a fresh center.
+
+    Reference: ``workers.py :: DOWNPOURWorker`` + ``parameter_servers.py ::
+    DeltaParameterServer`` (``handle_commit``: ``center += delta``).
+    ``commit_scale`` scales committed deltas (1.0 = the reference's naive
+    sum; 1/n tames the effective learning rate when many workers commit).
+    """
+    commit_scale: float = 1.0
+    staggered: bool = True
+    needs_pull: bool = True
+
+    def contrib(self, w_params, pull, center, server, extras):
+        return _tmap(lambda x, p: (x - p) * self.commit_scale, w_params, pull)
+
+    def server_update(self, center, server, total, n_commits):
+        return _tmap(jnp.add, center, total), server
+
+    def worker_post(self, w_params, pull, contrib, new_center, new_server,
+                    extras, mask):
+        return (_select(mask, new_center, w_params),
+                _select(mask, new_center, pull), extras)
+
+    def finalize(self, center, workers, pulls, n):
+        # flush each worker's uncommitted delta into the center
+        resid = _tmap(lambda w, p: (w - p).sum(axis=0) * self.commit_scale,
+                      workers, pulls)
+        return _tmap(jnp.add, center, resid)
+
+
+@dataclass
+class ElasticAlgo(DistAlgorithm):
+    """EASGD family (Zhang et al. 2015). Elastic difference
+    ``e_i = alpha * (x_i - center)`` pulls worker and center toward each
+    other: worker does ``x_i -= e_i``, center accumulates ``+e_i``.
+
+    Reference: ``workers.py :: EASGDWorker/AEASGDWorker`` (elastic symmetric
+    force, ``alpha = learning_rate * rho``) + the EASGD parameter servers.
+    ``synchronous=True`` = barrier rounds (EASGD); ``False`` = staggered
+    async emulation (AEASGD).
+
+    ``center_mode``: 'sum' is the paper/reference update
+    (``center += sum_i e_i`` — requires ``n * alpha < 1`` for stability);
+    'mean' divides by the number of committers that step, stable for any n.
+    """
+    alpha: float = 0.1
+    synchronous: bool = False
+    center_mode: str = "sum"
+    needs_pull: bool = False
+
+    def __post_init__(self):
+        self.staggered = not self.synchronous
+
+    def contrib(self, w_params, pull, center, server, extras):
+        return _tmap(lambda x, c: self.alpha * (x - c), w_params, center)
+
+    def server_update(self, center, server, total, n_commits):
+        if self.center_mode == "mean":
+            denom = jnp.maximum(n_commits, 1.0)
+            total = _tmap(lambda t: t / denom, total)
+        return _tmap(jnp.add, center, total), server
+
+    def worker_post(self, w_params, pull, contrib, new_center, new_server,
+                    extras, mask):
+        new_params = _tmap(lambda x, e: x - jnp.where(mask, e, 0.0),
+                           w_params, contrib)
+        return new_params, pull, extras
+
+
+@dataclass
+class AdagAlgo(DistAlgorithm):
+    """ADAG — adaptive per-parameter accumulation on the server.
+
+    Reference: ``parameter_servers.py :: ADAGParameterServer`` keeps a
+    per-parameter accumulator over committed deltas (SURVEY §2.1). Concrete
+    server rule used here (Adagrad applied to commits; re-verify the exact
+    reference formula once the mount is populated):
+        acc    += delta^2
+        center += adag_lr * delta / (sqrt(acc) + eps)
+    """
+    adag_lr: float = 0.05
+    epsilon: float = 1e-8
+    commit_scale: float = 1.0
+    staggered: bool = True
+    needs_pull: bool = True
+
+    def init_server(self, params):
+        return {"acc": _tmap(jnp.zeros_like, params)}
+
+    def contrib(self, w_params, pull, center, server, extras):
+        return _tmap(lambda x, p: (x - p) * self.commit_scale, w_params, pull)
+
+    def server_update(self, center, server, total, n_commits):
+        acc = _tmap(lambda a, t: a + jnp.square(t), server["acc"], total)
+        center = _tmap(
+            lambda c, t, a: c + self.adag_lr * t /
+            (jnp.sqrt(a) + self.epsilon),
+            center, total, acc)
+        return center, {"acc": acc}
+
+    def worker_post(self, w_params, pull, contrib, new_center, new_server,
+                    extras, mask):
+        return (_select(mask, new_center, w_params),
+                _select(mask, new_center, pull), extras)
+
+
+@dataclass
+class DynSGDAlgo(DistAlgorithm):
+    """DynSGD — staleness-aware delta scaling (Hermans).
+
+    Reference: ``parameter_servers.py :: DynSGDParameterServer`` scales each
+    commit by 1/staleness, where staleness = center updates since the
+    worker's last pull (SURVEY §3.3). Server clock = ``num_updates``; each
+    worker carries its last-pull clock value; commit applies
+    ``delta / max(1, clock - last_pull + 1)``.
+    """
+    staggered: bool = True
+    needs_pull: bool = True
+
+    def init_server(self, params):
+        return {"clock": jnp.zeros((), jnp.int32)}
+
+    def init_worker_extras(self, num_workers):
+        return {"last_pull": jnp.zeros((num_workers,), jnp.int32)}
+
+    def contrib(self, w_params, pull, center, server, extras):
+        staleness = jnp.maximum(
+            1, server["clock"] - extras["last_pull"] + 1).astype(jnp.float32)
+        return _tmap(lambda x, p: (x - p) / staleness, w_params, pull)
+
+    def server_update(self, center, server, total, n_commits):
+        clock = server["clock"] + n_commits.astype(jnp.int32)
+        return _tmap(jnp.add, center, total), {"clock": clock}
+
+    def worker_post(self, w_params, pull, contrib, new_center, new_server,
+                    extras, mask):
+        extras = {"last_pull": jnp.where(mask, new_server["clock"],
+                                         extras["last_pull"])}
+        return (_select(mask, new_center, w_params),
+                _select(mask, new_center, pull), extras)
+
+
+@dataclass
+class AveragingAlgo(DistAlgorithm):
+    """Per-round weight averaging: center := mean of worker params; workers
+    restart from the average.
+
+    Reference: ``trainers.py :: AveragingTrainer`` (per-epoch averaging of
+    independently trained replicas). Here the round length is the commit
+    window (set to steps-per-epoch by the trainer for exact parity).
+    """
+    staggered = False
+    needs_pull = False
+
+    def contrib(self, w_params, pull, center, server, extras):
+        return w_params
+
+    def server_update(self, center, server, total, n_commits):
+        denom = jnp.maximum(n_commits, 1.0)
+        avg = _tmap(lambda t: t / denom, total)
+        committed = n_commits > 0
+        return _select(committed, avg, center), server
+
+    def worker_post(self, w_params, pull, contrib, new_center, new_server,
+                    extras, mask):
+        return _select(mask, new_center, w_params), pull, extras
+
+    def finalize(self, center, workers, pulls, n):
+        return _tmap(lambda w: w.mean(axis=0), workers)
+
+
+# ---------------------------------------------------------------------------
+# The engine
+# ---------------------------------------------------------------------------
+
+@dataclass
+class EngineConfig:
+    num_workers: int
+    window: Union[int, Sequence[int]]  # K, scalar or per-worker
+    axis_name: str = "workers"
+
+
+class DistributedEngine:
+    """Compiles and runs the per-epoch SPMD program for one algorithm."""
+
+    def __init__(self, module, loss_fn: Callable, optimizer: Optimizer,
+                 algo: DistAlgorithm, mesh: Mesh, config: EngineConfig):
+        self.module = module
+        self.loss_fn = loss_fn
+        self.optimizer = optimizer
+        self.algo = algo
+        self.mesh = mesh
+        self.config = config
+
+        n = config.num_workers
+        K = config.window
+        Ks = np.full((n,), K, np.int32) if np.isscalar(K) \
+            else np.asarray(K, np.int32)
+        if Ks.shape != (n,):
+            raise ValueError(f"window must be scalar or length-{n}")
+        if algo.staggered:
+            offsets = (np.arange(n) * Ks) // n
+        else:
+            offsets = np.zeros((n,), np.int32)
+        self._Ks = jnp.asarray(Ks)
+        self._offsets = jnp.asarray(offsets % np.maximum(Ks, 1))
+        self._epoch_fn = None  # built lazily (jitted shard_map)
+
+    # -- state ------------------------------------------------------------
+    def init_state(self, params: Pytree, model_state: Pytree,
+                   rng: jax.Array) -> Dict:
+        """Build the replicated-center + stacked-worker state pytree."""
+        n = self.config.num_workers
+        stack = lambda tree: _tmap(
+            lambda x: jnp.broadcast_to(x, (n,) + x.shape), tree)
+        worker = {
+            "params": stack(params),
+            "state": stack(model_state),
+            "opt": jax.vmap(self.optimizer.init)(stack(params)),
+            "rng": jax.random.split(rng, n),
+            "pull": stack(params) if self.algo.needs_pull else {},
+            "extras": self.algo.init_worker_extras(n),
+        }
+        server = {
+            "aux": self.algo.init_server(params),
+            "t": jnp.zeros((), jnp.int32),  # global micro-step counter
+        }
+        return {"worker": worker,
+                "center": {"params": params, "state": model_state},
+                "server": server}
+
+    def shardings(self) -> Dict:
+        """NamedShardings matching ``init_state`` for explicit device_put."""
+        ws = NamedSharding(self.mesh, P(self.config.axis_name))
+        rs = NamedSharding(self.mesh, P())
+        return {"worker": ws, "center": rs, "server": rs}
+
+    # -- compiled epoch ---------------------------------------------------
+    def _build(self):
+        axis = self.config.axis_name
+        train_step = make_train_step(self.module, self.loss_fn,
+                                     self.optimizer)
+        algo = self.algo
+        Ks, offsets = self._Ks, self._offsets
+
+        def inner(state, X, Y):
+            # per-device blocks: worker leaves [1, ...] -> [...]
+            w = _tmap(lambda a: a[0], state["worker"])
+            center = state["center"]
+            server_aux = state["server"]["aux"]
+            gt0 = state["server"]["t"]
+            widx = lax.axis_index(axis)
+            K = Ks[widx]
+            offset = offsets[widx]
+
+            def body(carry, batch):
+                w, center, server_aux, gt = carry
+                xb, yb = batch
+                tc = TrainCarry(w["params"], w["state"], w["opt"], w["rng"])
+                tc, loss = train_step(tc, (xb, yb))
+                w = {**w, "params": tc.params, "state": tc.state,
+                     "opt": tc.opt_state, "rng": tc.rng}
+
+                mask = ((gt + 1 + offset) % jnp.maximum(K, 1)) == 0
+                maskf = mask.astype(jnp.float32)
+                contrib = algo.contrib(w["params"], w["pull"],
+                                       center["params"], server_aux,
+                                       w["extras"])
+                masked = _tmap(lambda c: c * maskf, contrib)
+                total = lax.psum(masked, axis)
+                n_commits = lax.psum(maskf, axis)
+                new_cparams, new_aux = algo.server_update(
+                    center["params"], server_aux, total, n_commits)
+                new_params, new_pull, new_extras = algo.worker_post(
+                    w["params"], w["pull"], contrib, new_cparams, new_aux,
+                    w["extras"], mask)
+                w = {**w, "params": new_params, "pull": new_pull,
+                     "extras": new_extras}
+                center2 = {**center, "params": new_cparams}
+                return (w, center2, new_aux, gt + 1), loss
+
+            (w, center, server_aux, gt), losses = lax.scan(
+                body, (w, center, server_aux, gt0), (X[:, 0], Y[:, 0]))
+
+            new_state = {
+                "worker": _tmap(lambda a: a[None], w),
+                "center": center,
+                "server": {"aux": server_aux, "t": gt},
+            }
+            return new_state, losses[:, None]
+
+        state_specs = {"worker": P(axis), "center": P(), "server": P()}
+        mapped = jax.shard_map(
+            inner, mesh=self.mesh,
+            in_specs=(state_specs, P(None, axis), P(None, axis)),
+            out_specs=(state_specs, P(None, axis)),
+            check_vma=False)
+        self._epoch_fn = jax.jit(mapped, donate_argnums=(0,))
+
+    def run_epoch(self, state: Dict, Xs, Ys):
+        """Run S micro-steps. ``Xs``/``Ys``: ``[S, W, batch, ...]``."""
+        if self._epoch_fn is None:
+            self._build()
+        return self._epoch_fn(state, Xs, Ys)
+
+    # -- final model ------------------------------------------------------
+    def extract_model(self, state: Dict) -> Tuple[Pytree, Pytree]:
+        """Final (params, model_state): algorithm-flushed center params +
+        worker-averaged model state (BN stats etc.)."""
+        host = jax.device_get(state)
+        center = self.algo.finalize(
+            host["center"]["params"], host["worker"]["params"],
+            host["worker"]["pull"], self.config.num_workers)
+        mstate = _tmap(lambda s: s.mean(axis=0) if hasattr(s, "mean") else s,
+                       host["worker"]["state"])
+        return center, mstate
+
+

@@ -1,0 +1,63 @@
+"""Device-mesh abstraction over ICI/DCN.
+
+This is the scheduling substrate that replaces Apache Spark in the reference
+(SURVEY §1: "The scheduler is Spark" — dist-keras submits one Spark job whose
+partitions become training workers). Here "workers" are positions along an
+axis of a ``jax.sharding.Mesh``; placing work is a sharding annotation, and
+worker↔center communication compiles to XLA collectives over ICI instead of
+pickled TCP to a driver thread (reference: ``distkeras/networking.py``).
+
+Axis conventions used across the framework:
+  * ``workers`` — data-parallel worker axis (the reference's num_workers)
+  * ``tp``      — tensor-parallel axis (no reference equivalent)
+  * ``sp``      — sequence-parallel axis for ring attention (no reference
+                  equivalent)
+Multi-host: build the mesh over ``jax.devices()`` after
+``jax.distributed.initialize()`` — the same code then spans hosts over DCN.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(num_workers: Optional[int] = None,
+              axis_name: str = "workers",
+              devices: Optional[Sequence] = None) -> Mesh:
+    """1-D worker mesh: the data-parallel Spark-executor-pool equivalent."""
+    devices = list(devices if devices is not None else jax.devices())
+    n = num_workers or len(devices)
+    if n > len(devices):
+        raise ValueError(
+            f"num_workers={n} exceeds available devices ({len(devices)}). "
+            "The reference oversubscribed Spark executors via "
+            "parallelism_factor; on a TPU mesh workers map 1:1 onto chips.")
+    return Mesh(np.array(devices[:n]), (axis_name,))
+
+
+def make_mesh_2d(shape: Dict[str, int],
+                 devices: Optional[Sequence] = None) -> Mesh:
+    """N-D mesh, e.g. ``{"workers": 4, "tp": 2}``. Axis order follows dict
+    order; the innermost axis should be the highest-bandwidth one (tp/sp over
+    ICI neighbors)."""
+    devices = list(devices if devices is not None else jax.devices())
+    sizes = list(shape.values())
+    total = int(np.prod(sizes))
+    if total > len(devices):
+        raise ValueError(f"mesh {shape} needs {total} devices, "
+                         f"have {len(devices)}")
+    arr = np.array(devices[:total]).reshape(sizes)
+    return Mesh(arr, tuple(shape.keys()))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def worker_sharded(mesh: Mesh, axis_name: str = "workers") -> NamedSharding:
+    """Sharding for arrays with a leading per-worker axis."""
+    return NamedSharding(mesh, P(axis_name))

@@ -1,0 +1,201 @@
+"""Trainer hierarchy — orchestration layer.
+
+Reference parity: ``distkeras/trainers.py`` (SURVEY §2.1): ``Trainer`` base
+(master model, loss, worker optimizer, history/time bookkeeping, serialize),
+``SingleTrainer``, ``AveragingTrainer``, ``EnsembleTrainer``, and the
+distributed family (``DOWNPOUR``, ``EASGD``, ``AEASGD``, ``ADAG``,
+``DynSGD``) — those distributed trainers live in
+``distkeras_tpu/parallel/distributed.py`` and share this base.
+
+API ergonomics match the reference: constructor kwargs
+``(model, worker_optimizer, loss, batch_size, num_epoch, features_col,
+label_col, ...)`` and ``trainer.train(dataset) -> Model``.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distkeras_tpu.data.dataset import Dataset
+from distkeras_tpu.models.core import Model
+from distkeras_tpu.models.serialization import serialize_model
+from distkeras_tpu.ops.losses import get_loss
+from distkeras_tpu.ops.optimizers import Optimizer, get_optimizer
+from distkeras_tpu.parallel.worker import (
+    TrainCarry, make_epoch_runner, make_train_step, stack_batches)
+from distkeras_tpu.utils.history import History
+
+
+class Trainer:
+    """Base trainer: holds the master model + loss/optimizer spec + history.
+
+    Reference: ``trainers.py :: Trainer`` (serialized master model, loss,
+    worker_optimizer, history, training-time bookkeeping).
+    """
+
+    def __init__(self, keras_model: Model,
+                 worker_optimizer: Union[str, Optimizer] = "sgd",
+                 loss: Union[str, Callable] = "categorical_crossentropy",
+                 metrics: Optional[List[str]] = None,
+                 features_col: str = "features", label_col: str = "label",
+                 batch_size: int = 32, num_epoch: int = 1,
+                 learning_rate: Optional[float] = None, seed: int = 0,
+                 shuffle_each_epoch: bool = True,
+                 optimizer_kwargs: Optional[dict] = None):
+        self.master_model = keras_model
+        opt_kwargs = dict(optimizer_kwargs or {})
+        if learning_rate is not None and not isinstance(worker_optimizer,
+                                                        Optimizer):
+            opt_kwargs.setdefault("learning_rate", learning_rate)
+        self.worker_optimizer = get_optimizer(worker_optimizer, **opt_kwargs)
+        self.loss = get_loss(loss)
+        self.metrics = metrics or []
+        self.features_col = features_col
+        self.label_col = label_col
+        self.batch_size = int(batch_size)
+        self.num_epoch = int(num_epoch)
+        self.seed = int(seed)
+        self.shuffle_each_epoch = bool(shuffle_each_epoch)
+        self.history = History()
+
+    # -- reference-parity bookkeeping -------------------------------------
+    def record_training_start(self):
+        self.history.record_training_start()
+
+    def record_training_stop(self):
+        self.history.record_training_stop()
+
+    def get_training_time(self) -> float:
+        return self.history.get_training_time()
+
+    def get_history(self) -> History:
+        return self.history
+
+    def get_averaged_history(self) -> np.ndarray:
+        """Per-step losses averaged over workers (scalar per step)."""
+        losses = self.history.losses()
+        return losses.mean(axis=-1) if losses.ndim > 1 else losses
+
+    def serialize(self):
+        """Reference: ``Trainer.serialize`` — serialized master model."""
+        return serialize_model(self.master_model)
+
+    # -- data plumbing -----------------------------------------------------
+    def _training_arrays(self, dataset: Dataset):
+        X, y = dataset.arrays(self.features_col, self.label_col)
+        if y is None:
+            raise ValueError(
+                f"label column {self.label_col!r} not in dataset "
+                f"(columns: {dataset.columns})")
+        return X, y
+
+    def _epoch_perm(self, epoch: int, n: int):
+        if not self.shuffle_each_epoch:
+            return None
+        return np.random.RandomState(self.seed + 1000 * epoch).permutation(n)
+
+    def train(self, dataset: Dataset) -> Model:
+        raise NotImplementedError
+
+
+class SingleTrainer(Trainer):
+    """Single-device training — the minimum end-to-end slice.
+
+    Reference: ``trainers.py :: SingleTrainer.train`` coalesces the DataFrame
+    to one partition and runs a SequentialWorker's per-batch Keras loop there
+    (SURVEY §3.1). Here the whole epoch is ONE jitted ``lax.scan`` over
+    ``[steps, batch, ...]`` stacked columnar data.
+    """
+
+    def train(self, dataset: Dataset) -> Model:
+        model = self.master_model
+        X, y = self._training_arrays(dataset)
+        step = make_train_step(model.module, self.loss, self.worker_optimizer)
+        runner = make_epoch_runner(step)
+        carry = TrainCarry(
+            params=model.params, state=model.state,
+            opt_state=self.worker_optimizer.init(model.params),
+            rng=jax.random.PRNGKey(self.seed))
+
+        self.record_training_start()
+        for epoch in range(self.num_epoch):
+            perm = self._epoch_perm(epoch, len(X))
+            Xs, Ys, n_steps = stack_batches(X, y, self.batch_size, perm)
+            carry, losses = runner(carry, Xs, Ys)
+            self.history.append_epoch(loss=jax.device_get(losses))
+        self.record_training_stop()
+
+        trained = model.replace(params=jax.device_get(carry.params),
+                                state=jax.device_get(carry.state))
+        self.master_model = trained
+        return trained
+
+
+class EnsembleTrainer(Trainer):
+    """Trains ``num_models`` independent models in parallel via ``vmap``.
+
+    Reference: ``trainers.py :: EnsembleTrainer`` trains k independent Keras
+    models on k Spark partition groups. TPU-native: the k model replicas are
+    ONE stacked pytree trained by a vmapped scan — XLA batches the k small
+    matmuls into bigger MXU ops. Each replica gets its own init seed, its own
+    dropout stream, and its own per-epoch data permutation.
+    """
+
+    def __init__(self, keras_model: Model, num_models: int = 2, **kwargs):
+        super().__init__(keras_model, **kwargs)
+        self.num_models = int(num_models)
+        self.models_: List[Model] = []
+
+    def train(self, dataset: Dataset) -> List[Model]:
+        base = self.master_model
+        X, y = self._training_arrays(dataset)
+        k = self.num_models
+
+        # independent inits: re-init the module with k different seeds
+        inits = [Model.build(base.module, base.input_shape, seed=self.seed + i)
+                 for i in range(k)]
+        params = jax.tree_util.tree_map(
+            lambda *xs: jnp.stack(xs), *[m.params for m in inits])
+        state = jax.tree_util.tree_map(
+            lambda *xs: jnp.stack(xs), *[m.state for m in inits])
+        opt_state = jax.vmap(self.worker_optimizer.init)(params)
+        rngs = jax.random.split(jax.random.PRNGKey(self.seed), k)
+
+        step = make_train_step(base.module, self.loss, self.worker_optimizer)
+
+        @jax.jit
+        def run_epoch(carry, Xk, Yk):
+            def per_model(c, xy):
+                return jax.lax.scan(step, c, xy)
+            return jax.vmap(per_model)(carry, (Xk, Yk))
+
+        carry = TrainCarry(params, state, opt_state, rngs)
+        self.record_training_start()
+        for epoch in range(self.num_epoch):
+            stacked = [stack_batches(
+                X, y, self.batch_size,
+                np.random.RandomState(self.seed + 1000 * epoch + i)
+                .permutation(len(X)) if self.shuffle_each_epoch else None)
+                for i in range(k)]
+            Xk = np.stack([s[0] for s in stacked])  # [k, steps, bs, ...]
+            Yk = np.stack([s[1] for s in stacked])
+            carry, losses = run_epoch(carry, Xk, Yk)
+            # losses: [k, steps] -> record as [steps, k]
+            self.history.append_epoch(loss=jax.device_get(losses).T)
+        self.record_training_stop()
+
+        params_h = jax.device_get(carry.params)
+        state_h = jax.device_get(carry.state)
+        self.models_ = [
+            base.replace(
+                params=jax.tree_util.tree_map(lambda p: p[i], params_h),
+                state=jax.tree_util.tree_map(lambda s: s[i], state_h))
+            for i in range(k)]
+        # master model = first member (reference returns the model list; we
+        # keep both: return list, stash members on .models_)
+        self.master_model = self.models_[0]
+        return self.models_

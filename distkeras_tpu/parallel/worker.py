@@ -1,0 +1,102 @@
+"""Worker compute: the jitted local training loop.
+
+Reference parity: ``distkeras/workers.py`` — a Worker deserializes the model
+in its executor, assembles minibatches from a row iterator and calls Keras
+``train_on_batch`` per batch (SURVEY §3.1 hot loop). The TPU-native redesign
+collapses that entire per-worker loop into a ``lax.scan`` over a stacked
+``[steps, batch, ...]`` array inside ONE jitted call: no per-batch Python
+dispatch, no per-row marshalling, static shapes throughout so XLA keeps the
+MXU busy.
+
+The same ``train_step`` body is reused by every trainer:
+  * SingleTrainer scans it directly,
+  * EnsembleTrainer vmaps it over a stacked model axis,
+  * the distributed trainers run it under ``shard_map`` with a collective
+    exchange spliced between windows (see ``parallel/engine.py``).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from distkeras_tpu.ops.optimizers import Optimizer, apply_updates
+
+
+class TrainCarry(NamedTuple):
+    """Scan carry for a local training loop (a pure-pytree 'worker')."""
+    params: any
+    state: any
+    opt_state: any
+    rng: jax.Array
+
+
+def make_train_step(module, loss_fn: Callable,
+                    optimizer: Optimizer) -> Callable:
+    """Build the per-minibatch step: grad -> optimizer update -> new carry.
+
+    Equivalent role to one ``model.train_on_batch`` call in the reference
+    worker loop, as a pure function usable under scan/vmap/shard_map.
+    """
+
+    def train_step(carry: TrainCarry, batch) -> Tuple[TrainCarry, jax.Array]:
+        xb, yb = batch
+        rng, sub = jax.random.split(carry.rng)
+
+        def objective(params):
+            out, new_state = module.apply(params, carry.state, xb,
+                                          training=True, rng=sub)
+            return loss_fn(yb, out), new_state
+
+        (loss, new_state), grads = jax.value_and_grad(
+            objective, has_aux=True)(carry.params)
+        updates, new_opt_state = optimizer.update(grads, carry.opt_state,
+                                                  carry.params)
+        new_params = apply_updates(carry.params, updates)
+        return TrainCarry(new_params, new_state, new_opt_state, rng), loss
+
+    return train_step
+
+
+def make_epoch_runner(train_step: Callable) -> Callable:
+    """Jitted scan of ``train_step`` over ``[steps, batch, ...]`` data."""
+
+    @jax.jit
+    def run(carry: TrainCarry, X: jax.Array, Y: jax.Array):
+        carry, losses = lax.scan(train_step, carry, (X, Y))
+        return carry, losses
+
+    return run
+
+
+def shard_epoch_data(X, Y, num_workers: int, batch_size: int, perm=None):
+    """Host-side: shape one epoch into ``[S, num_workers, batch, ...]``.
+
+    Plays the role of the reference's ``df.rdd.repartition(num_workers *
+    parallelism_factor)`` — but as a zero-copy reshape of the columnar
+    arrays, not a cluster shuffle. Drops the remainder (drop_remainder
+    batching). The single-device path is the same contract with
+    ``num_workers=1`` (see ``stack_batches``).
+    """
+    if perm is not None:
+        X, Y = X[perm], Y[perm]
+    per_step = num_workers * batch_size
+    S = len(X) // per_step
+    n = S * per_step
+    if S == 0:
+        raise ValueError(
+            f"dataset ({len(X)} rows) smaller than one global step "
+            f"({num_workers} workers x batch_size {batch_size})")
+    Xs = X[:n].reshape((S, num_workers, batch_size) + X.shape[1:])
+    Ys = Y[:n].reshape((S, num_workers, batch_size) + Y.shape[1:])
+    return Xs, Ys, S
+
+
+def stack_batches(X, Y, batch_size: int, perm=None):
+    """Single-worker epoch stacking: ``[n_steps, batch_size, ...]``."""
+    Xs, Ys, S = shard_epoch_data(X, Y, 1, batch_size, perm)
+    return Xs[:, 0], Ys[:, 0], S

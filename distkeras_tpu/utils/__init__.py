@@ -1,0 +1,3 @@
+"""Utilities: history, checkpointing, profiling."""
+
+from distkeras_tpu.utils.history import History  # noqa: F401

@@ -1,0 +1,70 @@
+"""Training history + wall-clock bookkeeping.
+
+Reference parity: ``distkeras/trainers.py :: Trainer`` keeps
+``record_training_start/stop``, ``get_training_time`` and per-worker Keras
+``history`` objects collected to the driver (SURVEY §5.1). Here history is
+a plain dict of numpy arrays filled from jitted scan outputs — one device →
+host transfer per epoch, not one per batch.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+
+class History:
+    """Per-run training record: loss per step (per worker where relevant),
+    epoch boundaries, wall-clock timings."""
+
+    def __init__(self):
+        self.epochs: List[Dict[str, np.ndarray]] = []
+        self._start: Optional[float] = None
+        self._stop: Optional[float] = None
+
+    # -- wall clock (reference: Trainer.record_training_start/stop) -------
+    def record_training_start(self) -> None:
+        self._start = time.time()
+
+    def record_training_stop(self) -> None:
+        self._stop = time.time()
+
+    def get_training_time(self) -> float:
+        if self._start is None:
+            return 0.0
+        end = self._stop if self._stop is not None else time.time()
+        return end - self._start
+
+    # -- metrics ----------------------------------------------------------
+    def append_epoch(self, **metrics: np.ndarray) -> None:
+        self.epochs.append({k: np.asarray(v) for k, v in metrics.items()})
+
+    def losses(self) -> np.ndarray:
+        """All per-step losses, concatenated across epochs. Shape
+        ``[total_steps]`` (single worker) or ``[total_steps, num_workers]``."""
+        if not self.epochs:
+            return np.array([])
+        return np.concatenate([e["loss"] for e in self.epochs], axis=0)
+
+    def final_loss(self) -> float:
+        losses = self.losses()
+        if losses.size == 0:
+            return float("nan")
+        tail = losses[-max(1, len(losses) // 10):]
+        return float(np.mean(tail))
+
+    def steps_per_second(self) -> float:
+        t = self.get_training_time()
+        n = sum(len(e["loss"]) for e in self.epochs)
+        return n / t if t > 0 else 0.0
+
+    def summary(self) -> Dict[str, float]:
+        return {
+            "training_time": self.get_training_time(),
+            "num_epochs": len(self.epochs),
+            "num_steps": int(sum(len(e["loss"]) for e in self.epochs)),
+            "final_loss": self.final_loss(),
+            "steps_per_second": self.steps_per_second(),
+        }

@@ -1,0 +1,135 @@
+"""Tests for the columnar Dataset and transformers (reference parity:
+``distkeras/transformers.py`` + Spark DataFrame ingest semantics)."""
+
+import numpy as np
+import pytest
+
+from distkeras_tpu.data import (
+    Dataset, DenseTransformer, LabelIndexTransformer, MinMaxTransformer,
+    OneHotTransformer, ReshapeTransformer, StandardScaleTransformer)
+
+
+def make_ds(n=10, d=4):
+    rs = np.random.RandomState(0)
+    return Dataset({"features": rs.randn(n, d).astype(np.float32),
+                    "label": rs.randint(0, 3, size=n)})
+
+
+def test_dataset_basics():
+    ds = make_ds(10, 4)
+    assert len(ds) == 10
+    assert set(ds.columns) == {"features", "label"}
+    assert ds["features"].shape == (10, 4)
+    with pytest.raises(KeyError, match="available"):
+        ds["nope"]
+
+
+def test_length_mismatch_rejected():
+    with pytest.raises(ValueError, match="mismatch"):
+        Dataset({"a": np.zeros(3), "b": np.zeros(4)})
+
+
+def test_from_records_row_to_columnar():
+    ds = Dataset.from_records([{"x": 1, "y": 2.0}, {"x": 3, "y": 4.0}])
+    np.testing.assert_array_equal(ds["x"], [1, 3])
+
+
+def test_shuffle_is_consistent_across_columns():
+    ds = make_ds(50)
+    # tag each row so we can check feature/label stay paired
+    ds = ds.with_column("row_id", np.arange(50))
+    shuffled = ds.shuffle(seed=1)
+    assert not np.array_equal(shuffled["row_id"], np.arange(50))
+    orig_feats = ds["features"][shuffled["row_id"]]
+    np.testing.assert_array_equal(shuffled["features"], orig_feats)
+
+
+def test_split_take_skip_concat():
+    ds = make_ds(10)
+    a, b = ds.split(0.7)
+    assert len(a) == 7 and len(b) == 3
+    np.testing.assert_array_equal(a.concat(b)["label"], ds["label"])
+
+
+def test_batches_are_contiguous_and_drop_remainder():
+    ds = make_ds(10)
+    batches = list(ds.batches(3))
+    assert len(batches) == 3
+    for xb, yb in batches:
+        assert xb.shape == (3, 4) and yb.shape == (3,)
+        assert xb.flags["C_CONTIGUOUS"]
+    assert len(list(ds.batches(3, drop_remainder=False))) == 4
+
+
+def test_one_hot_transformer():
+    ds = make_ds(6)
+    out = OneHotTransformer(3, input_col="label",
+                            output_col="label_encoded").transform(ds)
+    enc = out["label_encoded"]
+    assert enc.shape == (6, 3)
+    np.testing.assert_array_equal(np.argmax(enc, 1), ds["label"])
+    np.testing.assert_allclose(enc.sum(axis=1), 1.0)
+    with pytest.raises(ValueError, match="out of range"):
+        OneHotTransformer(2, input_col="label").transform(ds)
+
+
+def test_label_index_transformer_argmax_and_binary():
+    preds = np.array([[0.1, 0.7, 0.2], [0.8, 0.1, 0.1]])
+    ds = Dataset({"prediction": preds})
+    out = LabelIndexTransformer(3).transform(ds)
+    np.testing.assert_array_equal(out["predicted_index"], [1, 0])
+    ds2 = Dataset({"prediction": np.array([[0.9], [0.2]])})
+    out2 = LabelIndexTransformer().transform(ds2)
+    np.testing.assert_array_equal(out2["predicted_index"], [1, 0])
+
+
+def test_minmax_transformer():
+    x = np.array([[0.0], [127.5], [255.0]])
+    ds = Dataset({"features": x})
+    out = MinMaxTransformer(0.0, 1.0, i_min=0.0, i_max=255.0).transform(ds)
+    np.testing.assert_allclose(out["features_normalized"],
+                               [[0.0], [0.5], [1.0]])
+    # inferred range
+    out2 = MinMaxTransformer(-1.0, 1.0).transform(ds)
+    np.testing.assert_allclose(out2["features_normalized"],
+                               [[-1.0], [0.0], [1.0]])
+
+
+def test_reshape_transformer():
+    ds = Dataset({"features": np.zeros((5, 784))})
+    out = ReshapeTransformer("features", "matrix", (28, 28, 1)).transform(ds)
+    assert out["matrix"].shape == (5, 28, 28, 1)
+
+
+def test_dense_transformer_object_rows():
+    rows = np.empty(2, dtype=object)
+    rows[0] = [1.0, 2.0]
+    rows[1] = [3.0, 4.0]
+    ds = Dataset({"features": rows})
+    out = DenseTransformer().transform(ds)
+    assert out["features_dense"].dtype == np.float32
+    np.testing.assert_array_equal(out["features_dense"], [[1, 2], [3, 4]])
+
+
+def test_standard_scale_transformer():
+    ds = make_ds(200, 3)
+    out = StandardScaleTransformer().transform(ds)
+    scaled = out["features_scaled"]
+    np.testing.assert_allclose(scaled.mean(axis=0), 0.0, atol=1e-5)
+    np.testing.assert_allclose(scaled.std(axis=0), 1.0, atol=1e-2)
+
+
+def test_pipeline_chain_mnist_style():
+    """The reference's canonical preprocessing chain (SURVEY §3.5):
+    normalize -> one-hot -> reshape, all columnar."""
+    rs = np.random.RandomState(1)
+    ds = Dataset({"features": rs.randint(0, 256, (8, 784)).astype(np.float32),
+                  "label": rs.randint(0, 10, 8)})
+    for t in [MinMaxTransformer(0, 1, i_min=0, i_max=255),
+              OneHotTransformer(10),
+              ReshapeTransformer("features_normalized", "matrix",
+                                 (28, 28, 1))]:
+        ds = t.transform(ds)
+    assert ds["matrix"].shape == (8, 28, 28, 1)
+    assert ds["label_encoded"].shape == (8, 10)
+    assert float(ds["matrix"].max()) <= 1.0

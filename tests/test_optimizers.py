@@ -112,6 +112,12 @@ def test_binary_accuracy_thresholds_sigmoid_scores():
         pytest.approx(0.5)
 
 
+def test_binary_accuracy_with_logits():
+    y_true = jnp.array([1, 1, 0, 0])
+    logits = jnp.array([0.3, 2.0, -0.2, -1.0])  # all correct at 0 threshold
+    assert float(accuracy(y_true, logits)) == pytest.approx(1.0)
+
+
 def test_hinge_converts_binary_labels():
     loss = get_loss("hinge")
     y01 = jnp.array([[1.0], [0.0]])

@@ -1,0 +1,94 @@
+"""End-to-end tests for SingleTrainer / EnsembleTrainer (BASELINE config 1:
+MLP on MNIST-like data, single device, CPU-runnable)."""
+
+import jax
+import numpy as np
+import pytest
+
+from distkeras_tpu.data import Dataset, OneHotTransformer
+from distkeras_tpu.models import Dense, Model, Sequential
+from distkeras_tpu.ops.metrics import accuracy
+from distkeras_tpu.parallel import EnsembleTrainer, SingleTrainer
+
+
+def synthetic_classification(n=2048, d=16, classes=4, seed=0):
+    rs = np.random.RandomState(seed)
+    X = rs.randn(n, d).astype(np.float32)
+    W = rs.randn(d, classes)
+    y = np.argmax(X @ W + 0.1 * rs.randn(n, classes), axis=1)
+    return Dataset({"features": X, "label": y})
+
+
+def mlp(d=16, classes=4, seed=0):
+    return Model.build(Sequential([
+        Dense(64, activation="relu"),
+        Dense(classes),
+    ]), (d,), seed=seed)
+
+
+def test_single_trainer_converges():
+    ds = OneHotTransformer(4, output_col="label_encoded").transform(
+        synthetic_classification())
+    trainer = SingleTrainer(
+        mlp(), worker_optimizer="adam", learning_rate=0.01,
+        loss="categorical_crossentropy_from_logits",
+        features_col="features", label_col="label_encoded",
+        batch_size=64, num_epoch=5)
+    model = trainer.train(ds)
+    losses = trainer.get_history().losses()
+    assert losses.shape == (5 * (2048 // 64),)
+    assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+    preds = model.predict(ds["features"])
+    acc = float(accuracy(ds["label"], preds))
+    assert acc > 0.85, acc
+    assert trainer.get_training_time() > 0
+
+
+def test_single_trainer_sparse_loss_and_history_summary():
+    ds = synthetic_classification()
+    trainer = SingleTrainer(
+        mlp(), worker_optimizer="sgd", learning_rate=0.1,
+        loss="sparse_categorical_crossentropy_from_logits",
+        batch_size=128, num_epoch=3)
+    trainer.train(ds)
+    s = trainer.get_history().summary()
+    assert s["num_epochs"] == 3
+    assert s["num_steps"] == 3 * (2048 // 128)
+    assert s["steps_per_second"] > 0
+    assert np.isfinite(s["final_loss"])
+
+
+def test_single_trainer_batch_too_large_raises():
+    ds = synthetic_classification(n=16)
+    trainer = SingleTrainer(mlp(), batch_size=64,
+                            loss="sparse_categorical_crossentropy_from_logits")
+    with pytest.raises(ValueError, match="batch_size"):
+        trainer.train(ds)
+
+
+def test_single_trainer_missing_label_column():
+    ds = Dataset({"features": np.zeros((8, 16), np.float32)})
+    trainer = SingleTrainer(mlp())
+    with pytest.raises(ValueError, match="label"):
+        trainer.train(ds)
+
+
+def test_ensemble_trainer_trains_independent_models():
+    ds = synthetic_classification()
+    trainer = EnsembleTrainer(
+        mlp(), num_models=3, worker_optimizer="adam", learning_rate=0.01,
+        loss="sparse_categorical_crossentropy_from_logits",
+        batch_size=128, num_epoch=3)
+    models = trainer.train(ds)
+    assert len(models) == 3
+    # members differ (different seeds) but all learned
+    k0 = np.asarray(models[0].params[0]["kernel"])
+    k1 = np.asarray(models[1].params[0]["kernel"])
+    assert not np.allclose(k0, k1)
+    for m in models:
+        preds = m.predict(ds["features"])
+        assert float(accuracy(ds["label"], preds)) > 0.8
+    losses = trainer.get_history().losses()
+    assert losses.shape == (3 * (2048 // 128), 3)
+    # averaged history is scalar per step
+    assert trainer.get_averaged_history().shape == (3 * (2048 // 128),)
