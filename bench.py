@@ -1,0 +1,110 @@
+"""Benchmark: ResNet-50 training throughput, images/sec on one chip.
+
+BASELINE metric: "ImageNet ResNet-50 imgs/sec/chip" (BASELINE.json). The
+reference repo publishes no numbers (BASELINE.md: ``"published": {}``), so
+``vs_baseline`` is reported against a fixed public anchor:
+1000 imgs/sec/chip — the long-standing mixed-precision ResNet-50 training
+throughput of a single datacenter GPU of the reference's era, the hardware
+its Spark workers would have used.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Method: synthetic ImageNet-shaped data resident on device, bf16 compute /
+f32 params, full training step (fwd + bwd + SGD-momentum update) compiled
+once and timed over repeated steps. Falls back to smaller batch sizes on
+OOM, and to a reduced step count on CPU so the script stays runnable
+anywhere.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# persistent compilation cache: the ResNet-50 train step is a large graph;
+# caching makes repeat bench runs (and driver re-runs) start in seconds
+try:
+    jax.config.update("jax_compilation_cache_dir", "/tmp/distkeras_jax_cache")
+except Exception:
+    pass
+
+BASELINE_IMGS_PER_SEC_PER_CHIP = 1000.0
+
+
+def build_train_step(module, optimizer, loss_fn):
+    from distkeras_tpu.parallel.worker import TrainCarry, make_train_step
+
+    step = make_train_step(module, loss_fn, optimizer)
+
+    @jax.jit
+    def train_step(carry, xb, yb):
+        carry, loss = step(carry, (xb, yb))
+        return carry, loss
+
+    return train_step
+
+
+def bench_resnet50(batch_size: int, steps: int, image_size: int = 224):
+    from distkeras_tpu.models import Model, zoo
+    from distkeras_tpu.ops import get_loss, get_optimizer
+    from distkeras_tpu.parallel.worker import TrainCarry
+
+    module = zoo.resnet50(num_classes=1000, dtype="bfloat16")
+    model = Model.build(module, (image_size, image_size, 3), seed=0)
+    optimizer = get_optimizer("momentum", learning_rate=0.1)
+    loss_fn = get_loss("sparse_categorical_crossentropy_from_logits")
+    train_step = build_train_step(module, optimizer, loss_fn)
+
+    rs = np.random.RandomState(0)
+    xb = jnp.asarray(rs.rand(batch_size, image_size, image_size, 3),
+                     jnp.float32)
+    yb = jnp.asarray(rs.randint(0, 1000, batch_size))
+    carry = TrainCarry(model.params, model.state,
+                       optimizer.init(model.params), jax.random.PRNGKey(0))
+
+    # compile + warmup
+    carry, loss = train_step(carry, xb, yb)
+    jax.block_until_ready(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        carry, loss = train_step(carry, xb, yb)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+    return batch_size * steps / dt, float(loss)
+
+
+def main():
+    platform = jax.default_backend()
+    on_accel = platform not in ("cpu",)
+    steps = 20 if on_accel else 2
+    batch_candidates = [128, 64, 32] if on_accel else [8]
+
+    imgs_per_sec, last_loss = None, None
+    for bs in batch_candidates:
+        try:
+            imgs_per_sec, last_loss = bench_resnet50(bs, steps)
+            break
+        except Exception as e:  # OOM etc. — try smaller batch
+            msg = str(e).lower()
+            if "resource" in msg or "memory" in msg or "oom" in msg:
+                continue
+            raise
+    if imgs_per_sec is None:
+        raise RuntimeError("all batch sizes failed")
+
+    print(json.dumps({
+        "metric": "resnet50_train_imgs_per_sec_per_chip",
+        "value": round(imgs_per_sec, 2),
+        "unit": "imgs/sec",
+        "vs_baseline": round(imgs_per_sec / BASELINE_IMGS_PER_SEC_PER_CHIP,
+                             4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

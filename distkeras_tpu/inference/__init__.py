@@ -1,0 +1,6 @@
+"""Inference layer: sharded predictors + evaluators."""
+
+from distkeras_tpu.inference.evaluators import (  # noqa: F401
+    AccuracyEvaluator, Evaluator)
+from distkeras_tpu.inference.predictors import (  # noqa: F401
+    ModelPredictor, Predictor)

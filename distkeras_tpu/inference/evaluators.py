@@ -1,0 +1,43 @@
+"""Evaluators: offline metric computation over dataset columns.
+
+Reference parity: ``distkeras/evaluators.py`` — ``Evaluator.evaluate(df)``
+compares a label column against a prediction column over the RDD;
+``AccuracyEvaluator`` is the concrete accuracy case used at the end of every
+example pipeline (SURVEY §3.4: ModelPredictor -> LabelIndexTransformer ->
+AccuracyEvaluator).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Union
+
+import jax.numpy as jnp
+import numpy as np
+
+from distkeras_tpu.data.dataset import Dataset
+from distkeras_tpu.ops.metrics import get_metric
+
+
+class Evaluator:
+    """Base evaluator: apply a metric to (label_col, prediction_col)."""
+
+    def __init__(self, metric: Union[str, Callable],
+                 label_col: str = "label",
+                 prediction_col: str = "prediction"):
+        self.metric = get_metric(metric)
+        self.label_col = label_col
+        self.prediction_col = prediction_col
+
+    def evaluate(self, dataset: Dataset) -> float:
+        y_true = jnp.asarray(dataset[self.label_col])
+        y_pred = jnp.asarray(dataset[self.prediction_col])
+        return float(self.metric(y_true, y_pred))
+
+
+class AccuracyEvaluator(Evaluator):
+    """Reference parity: ``evaluators.py :: AccuracyEvaluator``."""
+
+    def __init__(self, label_col: str = "label",
+                 prediction_col: str = "prediction"):
+        super().__init__("accuracy", label_col=label_col,
+                         prediction_col=prediction_col)

@@ -1,0 +1,101 @@
+"""Predictors: sharded batch inference appending a prediction column.
+
+Reference parity: ``distkeras/predictors.py`` — ``Predictor.predict(df)``
+maps partitions of a Spark DataFrame through a deserialized Keras model,
+appending the raw model output as a new column; ``ModelPredictor`` names the
+output column (SURVEY §3.4, which also flags the reference's per-ROW
+``model.predict`` as a bottleneck).
+
+TPU-native redesign: inference is one jitted forward over batches that are
+**sharded across the device mesh on the batch axis** (the "pmapped batch
+over chips" the north star asks for). Rows are padded to the global batch so
+every call reuses a single compiled shape.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from distkeras_tpu.data.dataset import Dataset
+from distkeras_tpu.models.core import Model
+from distkeras_tpu.parallel.mesh import make_mesh
+
+
+class Predictor:
+    """Batched, mesh-sharded inference (reference:
+    ``predictors.py :: Predictor``).
+
+    ``predict(dataset)`` returns the dataset with ``output_col`` appended —
+    the same DataFrame-in/DataFrame-out contract as the reference.
+    """
+
+    def __init__(self, keras_model: Model, features_col: str = "features",
+                 output_col: str = "prediction",
+                 batch_size_per_device: int = 128,
+                 mesh: Optional[Mesh] = None):
+        self.model = keras_model
+        self.features_col = features_col
+        self.output_col = output_col
+        self.batch_size_per_device = int(batch_size_per_device)
+        self.mesh = mesh if mesh is not None else make_mesh()
+        self._fn = None
+
+    def _build(self):
+        mesh = self.mesh
+        batch_axis = mesh.axis_names[0]
+        sharded = NamedSharding(mesh, P(batch_axis))
+        replicated = NamedSharding(mesh, P())
+        model = self.model
+
+        @jax.jit
+        def fwd(params, state, xb):
+            y, _ = model.module.apply(params, state, xb, training=False)
+            return y
+
+        self._fn = fwd
+        self._in_sharding = sharded
+        self._rep = replicated
+
+    def predict(self, dataset: Dataset) -> Dataset:
+        if self._fn is None:
+            self._build()
+        X = dataset[self.features_col]
+        if np.issubdtype(X.dtype, np.integer):
+            X = np.ascontiguousarray(X)
+        else:
+            X = np.ascontiguousarray(X, dtype=np.float32)
+        n = len(X)
+        n_dev = self.mesh.devices.size
+        global_batch = n_dev * self.batch_size_per_device
+
+        params = jax.device_put(self.model.params, self._rep)
+        state = jax.device_put(self.model.state, self._rep)
+
+        outs = []
+        for i in range(0, n, global_batch):
+            xb = X[i:i + global_batch]
+            pad = global_batch - len(xb)
+            if pad:  # pad to the one compiled shape
+                xb = np.concatenate(
+                    [xb, np.zeros((pad,) + xb.shape[1:], xb.dtype)])
+            xb = jax.device_put(jnp.asarray(xb), self._in_sharding)
+            yb = np.asarray(self._fn(params, state, xb))
+            outs.append(yb[:global_batch - pad] if pad else yb)
+        preds = np.concatenate(outs, axis=0)
+        return dataset.with_column(self.output_col, preds)
+
+
+class ModelPredictor(Predictor):
+    """Reference parity: ``predictors.py :: ModelPredictor`` — Predictor
+    with a user-named output column (kept as a distinct class so reference
+    code ports 1:1)."""
+
+    def __init__(self, keras_model: Model, features_col: str = "features",
+                 output_col: str = "prediction", **kwargs):
+        super().__init__(keras_model, features_col=features_col,
+                         output_col=output_col, **kwargs)

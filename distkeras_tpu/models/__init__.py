@@ -6,7 +6,9 @@ from distkeras_tpu.models.layers import (  # noqa: F401
     ACTIVATIONS, Activation, AveragePooling2D, BatchNorm, Conv2D, Dense,
     Dropout, Embedding, Flatten, GlobalAveragePooling2D, MaxPooling2D,
     Reshape, get_activation)
+from distkeras_tpu.models.blocks import Residual, WideAndDeep  # noqa: F401
 from distkeras_tpu.models.recurrent import (  # noqa: F401
     GRU, LSTM, Bidirectional)
+from distkeras_tpu.models import zoo  # noqa: F401
 from distkeras_tpu.models.serialization import (  # noqa: F401
     deserialize_model, load_model, save_model, serialize_model)

@@ -1,0 +1,135 @@
+"""Composite blocks: residual containers and multi-branch layers.
+
+The reference never needed these (its examples are Sequential-only Keras
+models), but the north-star config (ResNet-50 on ImageNet, BASELINE config
+3) requires residual topology. Blocks are Layers themselves, so they nest
+inside ``Sequential`` and serialize through the same registry.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from distkeras_tpu.models.core import LAYER_REGISTRY, Layer, Sequential, \
+    register_layer
+from distkeras_tpu.models.layers import get_activation
+
+
+def _layer_spec(layer: Optional[Layer]):
+    if layer is None:
+        return None
+    return {"class": layer.name, "config": layer.get_config()}
+
+
+def _layer_from_spec(spec):
+    if spec is None:
+        return None
+    return LAYER_REGISTRY[spec["class"]].from_config(spec["config"])
+
+
+@register_layer
+class Residual(Layer):
+    """``y = act(main(x) + shortcut(x))`` — the ResNet block skeleton.
+
+    ``shortcut=None`` means identity (requires matching shapes). Both
+    branches are arbitrary Layers (usually Sequentials).
+    """
+
+    def __init__(self, main: Layer = None, shortcut: Optional[Layer] = None,
+                 activation: Optional[str] = "relu", main_spec=None,
+                 shortcut_spec=None):
+        self.main = main if main is not None else _layer_from_spec(main_spec)
+        self.shortcut = (shortcut if shortcut is not None
+                         else _layer_from_spec(shortcut_spec))
+        self.activation = activation
+
+    def init(self, rng, input_shape):
+        k1, k2 = jax.random.split(rng)
+        pm, sm, out_main = self.main.init(k1, input_shape)
+        if self.shortcut is not None:
+            ps, ss, out_short = self.shortcut.init(k2, input_shape)
+        else:
+            ps, ss, out_short = {}, {}, tuple(input_shape)
+        if tuple(out_main) != tuple(out_short):
+            raise ValueError(
+                f"Residual branch shapes differ: main {out_main} vs "
+                f"shortcut {out_short}")
+        return ({"main": pm, "shortcut": ps},
+                {"main": sm, "shortcut": ss}, tuple(out_main))
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        if rng is not None:
+            rng, r1, r2 = jax.random.split(rng, 3)
+        else:
+            r1 = r2 = None
+        y, sm = self.main.apply(params["main"], state["main"], x,
+                                training=training, rng=r1)
+        if self.shortcut is not None:
+            sc, ss = self.shortcut.apply(params["shortcut"],
+                                         state["shortcut"], x,
+                                         training=training, rng=r2)
+        else:
+            sc, ss = x, state["shortcut"]
+        out = y + sc
+        out = get_activation(self.activation)(out)
+        return out, {"main": sm, "shortcut": ss}
+
+    def get_config(self):
+        return {"main_spec": _layer_spec(self.main),
+                "shortcut_spec": _layer_spec(self.shortcut),
+                "activation": self.activation}
+
+
+@register_layer
+class WideAndDeep(Layer):
+    """Wide & Deep (Cheng et al. 2016) as a single-input layer.
+
+    BASELINE config 4 is "DOWNPOUR wide-and-deep on Criteo". The input row
+    concatenates wide (cross/one-hot) features and deep features:
+    ``x = [wide (wide_dim) | deep (rest)]``; output logits are
+    ``Linear(wide) + MLP(deep)``.
+    """
+
+    def __init__(self, wide_dim: int, deep_hidden=(256, 128),
+                 num_classes: int = 2, activation: str = "relu",
+                 dtype: str = "float32"):
+        from distkeras_tpu.models.layers import Dense
+        self.wide_dim = int(wide_dim)
+        self.deep_hidden = tuple(int(h) for h in deep_hidden)
+        self.num_classes = int(num_classes)
+        self.activation = activation
+        self.dtype = dtype
+        self.wide = Dense(self.num_classes, use_bias=True, dtype=dtype)
+        layers = []
+        for h in self.deep_hidden:
+            layers.append(Dense(h, activation=activation, dtype=dtype))
+        layers.append(Dense(self.num_classes, dtype=dtype))
+        self.deep = Sequential(layers)
+
+    def init(self, rng, input_shape):
+        total = input_shape[-1]
+        if total <= self.wide_dim:
+            raise ValueError(
+                f"input dim {total} must exceed wide_dim {self.wide_dim}")
+        k1, k2 = jax.random.split(rng)
+        pw, sw, _ = self.wide.init(k1, (self.wide_dim,))
+        pd, sd, _ = self.deep.init(k2, (total - self.wide_dim,))
+        return ({"wide": pw, "deep": pd}, {"wide": sw, "deep": sd},
+                (self.num_classes,))
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        xw, xd = x[..., :self.wide_dim], x[..., self.wide_dim:]
+        yw, sw = self.wide.apply(params["wide"], state["wide"], xw,
+                                 training=training)
+        yd, sd = self.deep.apply(params["deep"], state["deep"], xd,
+                                 training=training, rng=rng)
+        return yw + yd, {"wide": sw, "deep": sd}
+
+    def get_config(self):
+        return {"wide_dim": self.wide_dim,
+                "deep_hidden": list(self.deep_hidden),
+                "num_classes": self.num_classes,
+                "activation": self.activation, "dtype": self.dtype}

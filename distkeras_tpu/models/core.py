@@ -148,8 +148,18 @@ class Model:
               rng: Optional[jax.Array] = None, seed: int = 0) -> "Model":
         if rng is None:
             rng = jax.random.PRNGKey(seed)
-        params, state, out_shape = module.init(rng, tuple(input_shape))
-        return cls(module, params, state, input_shape, out_shape)
+        # Jit the whole init: one compiled program instead of hundreds of
+        # small eager dispatches (a deep ResNet has ~500 init ops; eager
+        # dispatch per op is prohibitively slow on remote/TPU backends).
+        captured = {}
+
+        def initf(rng):
+            params, state, out_shape = module.init(rng, tuple(input_shape))
+            captured["out_shape"] = out_shape  # static python tuple
+            return params, state
+
+        params, state = jax.jit(initf)(rng)
+        return cls(module, params, state, input_shape, captured["out_shape"])
 
     # -- compute ----------------------------------------------------------
     def apply(self, params, state, x, *, training=False, rng=None):

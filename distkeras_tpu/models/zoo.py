@@ -1,0 +1,128 @@
+"""Model zoo: builders for every BASELINE evaluation config.
+
+  1. ``mlp``               — SingleTrainer MNIST MLP (config 1)
+  2. ``lenet5``            — ADAG LeNet-5 on CIFAR-10 (config 2)
+  3. ``resnet50``          — AEASGD ResNet-50 on ImageNet (config 3)
+  4. ``wide_and_deep``     — DOWNPOUR wide&deep on Criteo (config 4)
+  5. ``bilstm_classifier`` — Predictor batched BiLSTM inference (config 5)
+
+The reference builds these ad hoc in example notebooks; here they are
+first-class builders returning ``Sequential`` specs (build with
+``Model.build(spec, input_shape)``).
+
+TPU notes: convs/matmuls accept ``dtype='bfloat16'`` for MXU-friendly mixed
+precision; ResNet uses NHWC + BatchNorm with optional cross-replica
+``axis_name``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+from distkeras_tpu.models.blocks import Residual, WideAndDeep
+from distkeras_tpu.models.core import Sequential
+from distkeras_tpu.models.layers import (
+    Activation, BatchNorm, Conv2D, Dense, Dropout, Flatten,
+    GlobalAveragePooling2D, MaxPooling2D)
+from distkeras_tpu.models.recurrent import LSTM, Bidirectional
+
+
+def mlp(hidden: Sequence[int] = (512, 256), num_classes: int = 10,
+        activation: str = "relu", dropout: float = 0.0,
+        dtype: str = "float32") -> Sequential:
+    """MNIST-style MLP (BASELINE config 1; the reference's
+    ``examples/mnist.py`` MLP equivalent)."""
+    layers = []
+    for h in hidden:
+        layers.append(Dense(h, activation=activation, dtype=dtype))
+        if dropout > 0:
+            layers.append(Dropout(dropout))
+    layers.append(Dense(num_classes, dtype=dtype))
+    return Sequential(layers)
+
+
+def lenet5(num_classes: int = 10, dtype: str = "float32") -> Sequential:
+    """LeNet-5 (BASELINE config 2: ADAG on CIFAR-10). Classic topology,
+    NHWC, tanh activations as in the original."""
+    return Sequential([
+        Conv2D(6, 5, padding="SAME", activation="tanh", dtype=dtype),
+        MaxPooling2D(2),
+        Conv2D(16, 5, padding="VALID", activation="tanh", dtype=dtype),
+        MaxPooling2D(2),
+        Flatten(),
+        Dense(120, activation="tanh", dtype=dtype),
+        Dense(84, activation="tanh", dtype=dtype),
+        Dense(num_classes, dtype=dtype),
+    ])
+
+
+def _bottleneck(filters: int, stride: int, project: bool,
+                dtype: str, bn_axis_name: Optional[str]) -> Residual:
+    """ResNet-v1.5 bottleneck: 1x1 -> 3x3(stride) -> 1x1(4f), BN after each
+    conv, relu after the residual add."""
+    bn = lambda: BatchNorm(axis_name=bn_axis_name)
+    main = Sequential([
+        Conv2D(filters, 1, use_bias=False, dtype=dtype), bn(),
+        Activation("relu"),
+        Conv2D(filters, 3, strides=stride, use_bias=False, dtype=dtype),
+        bn(), Activation("relu"),
+        Conv2D(4 * filters, 1, use_bias=False, dtype=dtype), bn(),
+    ])
+    shortcut = None
+    if project:
+        shortcut = Sequential([
+            Conv2D(4 * filters, 1, strides=stride, use_bias=False,
+                   dtype=dtype), bn(),
+        ])
+    return Residual(main, shortcut, activation="relu")
+
+
+def resnet(stage_sizes: Sequence[int], num_classes: int = 1000,
+           width: int = 64, dtype: str = "float32",
+           bn_axis_name: Optional[str] = None) -> Sequential:
+    """ResNet-v1.5 family over bottleneck blocks (NHWC)."""
+    layers = [
+        Conv2D(width, 7, strides=2, use_bias=False, dtype=dtype),
+        BatchNorm(axis_name=bn_axis_name), Activation("relu"),
+        MaxPooling2D(3, strides=2, padding="SAME"),
+    ]
+    filters = width
+    for stage, blocks in enumerate(stage_sizes):
+        for block in range(blocks):
+            stride = 2 if (stage > 0 and block == 0) else 1
+            project = (block == 0)
+            layers.append(_bottleneck(filters, stride, project, dtype,
+                                      bn_axis_name))
+        filters *= 2
+    layers += [GlobalAveragePooling2D(), Dense(num_classes, dtype=dtype)]
+    return Sequential(layers)
+
+
+def resnet50(num_classes: int = 1000, dtype: str = "float32",
+             bn_axis_name: Optional[str] = None) -> Sequential:
+    """ResNet-50 (BASELINE config 3 / the north-star model)."""
+    return resnet([3, 4, 6, 3], num_classes, 64, dtype, bn_axis_name)
+
+
+def resnet18_thin(num_classes: int = 10, width: int = 8,
+                  dtype: str = "float32") -> Sequential:
+    """A few-block thin ResNet for CPU-mesh tests (same topology family)."""
+    return resnet([1, 1], num_classes, width, dtype)
+
+
+def bilstm_classifier(units: int = 64, num_classes: int = 2,
+                      dtype: str = "float32") -> Sequential:
+    """BiLSTM sequence classifier (BASELINE config 5: batched Predictor
+    inference over sharded data)."""
+    return Sequential([
+        Bidirectional(LSTM(units, return_sequences=True, dtype=dtype)),
+        Bidirectional(LSTM(units, dtype=dtype)),
+        Dense(num_classes, dtype=dtype),
+    ])
+
+
+def wide_and_deep(wide_dim: int, deep_hidden: Sequence[int] = (256, 128),
+                  num_classes: int = 2, dtype: str = "float32") -> Sequential:
+    """Wide & Deep for Criteo-style CTR (BASELINE config 4)."""
+    return Sequential([
+        WideAndDeep(wide_dim, deep_hidden, num_classes, dtype=dtype)])

@@ -73,17 +73,30 @@ class DistributedTrainer(Trainer):
             self.allocate_algorithm(), mesh,
             EngineConfig(num_workers=self.num_workers,
                          window=self._window(S)))
-        state = engine.init_state(model.params, model.state,
+
+        # resume restores the CENTER; workers restart from it — the same
+        # semantic as the reference's Spark task retry, which re-trains a
+        # partition from the current PS center (SURVEY §5.3)
+        manager = self._checkpoint_manager()
+        tree, start_epoch = self._maybe_resume(
+            manager, {"params": model.params, "state": model.state})
+        state = engine.init_state(tree["params"], tree["state"],
                                   jax.random.PRNGKey(self.seed))
         state = jax.device_put(state, engine.shardings())
 
         self.record_training_start()
-        for epoch in range(self.num_epoch):
+        for epoch in range(start_epoch, self.num_epoch):
             perm = self._epoch_perm(epoch, len(X))
             Xs, Ys, S = shard_epoch_data(X, y, self.num_workers,
                                          self.batch_size, perm)
             state, losses = engine.run_epoch(state, Xs, Ys)
             self.history.append_epoch(loss=jax.device_get(losses))
+            # cadence check BEFORE extract_model: the full-state device->host
+            # transfer is expensive and must only happen on save epochs
+            if manager is not None and self._should_checkpoint(epoch):
+                cp, cs = engine.extract_model(state)
+                manager.save(epoch, {"params": cp, "state": cs},
+                             metadata={"epoch": epoch})
         self.record_training_stop()
 
         params, mstate = engine.extract_model(state)

@@ -45,7 +45,9 @@ class Trainer:
                  batch_size: int = 32, num_epoch: int = 1,
                  learning_rate: Optional[float] = None, seed: int = 0,
                  shuffle_each_epoch: bool = True,
-                 optimizer_kwargs: Optional[dict] = None):
+                 optimizer_kwargs: Optional[dict] = None,
+                 checkpoint_dir: Optional[str] = None,
+                 checkpoint_every: int = 1, resume: bool = False):
         self.master_model = keras_model
         opt_kwargs = dict(optimizer_kwargs or {})
         if learning_rate is not None and not isinstance(worker_optimizer,
@@ -61,6 +63,37 @@ class Trainer:
         self.seed = int(seed)
         self.shuffle_each_epoch = bool(shuffle_each_epoch)
         self.history = History()
+        # checkpoint/resume (capability ADD over the reference, which has
+        # none — SURVEY §5.4); snapshots the master/center model per epoch
+        self.checkpoint_dir = checkpoint_dir
+        self.checkpoint_every = int(checkpoint_every)
+        if self.checkpoint_every < 1:
+            raise ValueError(
+                f"checkpoint_every must be >= 1, got {checkpoint_every}")
+        self.resume = bool(resume)
+
+    def _checkpoint_manager(self):
+        if self.checkpoint_dir is None:
+            return None
+        from distkeras_tpu.utils.checkpoint import CheckpointManager
+        return CheckpointManager(self.checkpoint_dir)
+
+    def _maybe_resume(self, manager, template):
+        """Restore the checkpointed tree (same structure as ``template``).
+        Returns ``(tree, start_epoch)``; the step is fixed once so weights
+        and metadata always come from the SAME checkpoint."""
+        if manager is None or not self.resume:
+            return template, 0
+        latest = manager.latest_step()
+        if latest is None:
+            return template, 0
+        tree = manager.restore(template, step=latest)
+        meta = manager.metadata(step=latest)
+        return tree, int(meta.get("epoch", -1)) + 1
+
+    def _should_checkpoint(self, epoch: int) -> bool:
+        return ((epoch + 1) % self.checkpoint_every == 0
+                or epoch == self.num_epoch - 1)
 
     # -- reference-parity bookkeeping -------------------------------------
     def record_training_start(self):
@@ -116,17 +149,30 @@ class SingleTrainer(Trainer):
         X, y = self._training_arrays(dataset)
         step = make_train_step(model.module, self.loss, self.worker_optimizer)
         runner = make_epoch_runner(step)
-        carry = TrainCarry(
-            params=model.params, state=model.state,
-            opt_state=self.worker_optimizer.init(model.params),
-            rng=jax.random.PRNGKey(self.seed))
+
+        # SingleTrainer checkpoints the FULL carry (params + model state +
+        # optimizer state + rng), so a resumed run is bitwise-identical to
+        # an uninterrupted one. (Distributed trainers checkpoint the center
+        # only — the documented PS-retry semantic.)
+        manager = self._checkpoint_manager()
+        fresh = {"params": model.params, "state": model.state,
+                 "opt": self.worker_optimizer.init(model.params),
+                 "rng": jax.random.PRNGKey(self.seed)}
+        tree, start_epoch = self._maybe_resume(manager, fresh)
+        carry = TrainCarry(params=tree["params"], state=tree["state"],
+                           opt_state=tree["opt"], rng=tree["rng"])
 
         self.record_training_start()
-        for epoch in range(self.num_epoch):
+        for epoch in range(start_epoch, self.num_epoch):
             perm = self._epoch_perm(epoch, len(X))
             Xs, Ys, n_steps = stack_batches(X, y, self.batch_size, perm)
             carry, losses = runner(carry, Xs, Ys)
             self.history.append_epoch(loss=jax.device_get(losses))
+            if manager is not None and self._should_checkpoint(epoch):
+                manager.save(epoch,
+                             {"params": carry.params, "state": carry.state,
+                              "opt": carry.opt_state, "rng": carry.rng},
+                             metadata={"epoch": epoch})
         self.record_training_stop()
 
         trained = model.replace(params=jax.device_get(carry.params),

@@ -1,3 +1,5 @@
 """Utilities: history, checkpointing, profiling."""
 
+from distkeras_tpu.utils.checkpoint import CheckpointManager  # noqa: F401
 from distkeras_tpu.utils.history import History  # noqa: F401
+from distkeras_tpu.utils import profiling  # noqa: F401

@@ -1,0 +1,74 @@
+"""Profiling hooks: jax.profiler traces + step timing.
+
+The reference's only instrumentation is wall-clock bookkeeping on the
+trainer (SURVEY §5.1: ``record_training_start/stop`` + collected Keras
+histories). Here profiling is first-class: XLA-level traces via
+``jax.profiler`` (viewable in TensorBoard/XProf) and cheap step timers that
+feed ``History.steps_per_second``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Dict, List, Optional
+
+import jax
+
+
+@contextlib.contextmanager
+def trace(logdir: str):
+    """Capture an XLA/device trace for the enclosed block.
+
+    Usage::
+        with profiling.trace("/tmp/xprof"):
+            trainer.train(dataset)
+    """
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+class StepTimer:
+    """Accumulates wall-clock per named phase; negligible overhead (two
+    ``perf_counter`` calls per phase)."""
+
+    def __init__(self):
+        self.totals: Dict[str, float] = {}
+        self.counts: Dict[str, int] = {}
+
+    @contextlib.contextmanager
+    def phase(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            self.totals[name] = self.totals.get(name, 0.0) + dt
+            self.counts[name] = self.counts.get(name, 0) + 1
+
+    def summary(self) -> Dict[str, Dict[str, float]]:
+        return {
+            name: {"total_s": self.totals[name],
+                   "count": self.counts[name],
+                   "mean_s": self.totals[name] / self.counts[name]}
+            for name in self.totals
+        }
+
+
+def device_memory_stats() -> Optional[List[Dict]]:
+    """Per-device memory stats where the backend exposes them (TPU does;
+    virtual CPU devices usually return None)."""
+    stats = []
+    for d in jax.devices():
+        try:
+            s = d.memory_stats()
+        except Exception:
+            s = None
+        if s:
+            stats.append({"device": str(d),
+                          "bytes_in_use": s.get("bytes_in_use"),
+                          "bytes_limit": s.get("bytes_limit")})
+    return stats or None

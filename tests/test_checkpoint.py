@@ -1,0 +1,145 @@
+"""Checkpoint/resume tests (capability ADD over the reference — SURVEY §5.4
+documents that dist-keras has none)."""
+
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from distkeras_tpu.data import Dataset
+from distkeras_tpu.models import Dense, Model, Sequential
+from distkeras_tpu.parallel import DOWNPOUR, SingleTrainer
+from distkeras_tpu.utils import CheckpointManager
+from distkeras_tpu.utils.profiling import StepTimer, device_memory_stats
+
+
+def test_manager_save_restore_roundtrip(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), max_to_keep=2)
+    tree = {"a": np.arange(6.0).reshape(2, 3), "b": {"c": np.ones(4)}}
+    mgr.save(0, tree, metadata={"epoch": 0})
+    restored = mgr.restore({"a": np.zeros((2, 3)), "b": {"c": np.zeros(4)}})
+    np.testing.assert_array_equal(restored["a"], tree["a"])
+    assert mgr.metadata() == {"epoch": 0}
+
+
+def test_manager_gc_keeps_newest(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), max_to_keep=2)
+    for step in range(5):
+        mgr.save(step, {"x": np.full(3, step)})
+    assert mgr.all_steps() == [3, 4]
+    assert mgr.latest_step() == 4
+    restored = mgr.restore({"x": np.zeros(3)})
+    np.testing.assert_array_equal(restored["x"], [4, 4, 4])
+
+
+def test_manager_restore_empty_raises(tmp_path):
+    mgr = CheckpointManager(str(tmp_path))
+    with pytest.raises(FileNotFoundError):
+        mgr.restore({"x": np.zeros(2)})
+
+
+def _ds(n=512):
+    rs = np.random.RandomState(0)
+    X = rs.randn(n, 8).astype(np.float32)
+    y = (X.sum(axis=1) > 0).astype(np.int64)
+    return Dataset({"features": X, "label": y})
+
+
+def _mlp():
+    return Model.build(Sequential([Dense(16, activation="relu"), Dense(2)]),
+                       (8,), seed=0)
+
+
+def test_single_trainer_checkpoints_and_resumes(tmp_path):
+    ckpt = str(tmp_path / "ck")
+    t1 = SingleTrainer(_mlp(), batch_size=32, num_epoch=3,
+                       worker_optimizer="sgd", learning_rate=0.1,
+                       loss="sparse_categorical_crossentropy_from_logits",
+                       checkpoint_dir=ckpt)
+    m1 = t1.train(_ds())
+    mgr = CheckpointManager(ckpt)
+    assert mgr.latest_step() == 2  # 3 epochs -> last epoch index 2
+
+    # resume: a new trainer set for 5 epochs should only run epochs 3..4
+    t2 = SingleTrainer(_mlp(), batch_size=32, num_epoch=5,
+                       worker_optimizer="sgd", learning_rate=0.1,
+                       loss="sparse_categorical_crossentropy_from_logits",
+                       checkpoint_dir=ckpt, resume=True)
+    t2.train(_ds())
+    assert len(t2.get_history().epochs) == 2
+    assert mgr.latest_step() == 4
+
+
+def test_distributed_trainer_checkpoints_center(tmp_path):
+    ckpt = str(tmp_path / "ck")
+    tr = DOWNPOUR(_mlp(), num_workers=4, batch_size=16,
+                  communication_window=2, num_epoch=2,
+                  worker_optimizer="sgd", learning_rate=0.05,
+                  loss="sparse_categorical_crossentropy_from_logits",
+                  checkpoint_dir=ckpt)
+    model = tr.train(_ds())
+    mgr = CheckpointManager(ckpt)
+    assert mgr.latest_step() == 1
+    # checkpointed center equals the returned master model's params
+    restored = mgr.restore({"params": model.params, "state": model.state})
+    for a, b in zip(jax.tree_util.tree_leaves(restored["params"]),
+                    jax.tree_util.tree_leaves(model.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+
+
+def test_resume_is_exact_for_single_trainer(tmp_path):
+    """Full-carry checkpoints: crash+resume must be bitwise-identical to an
+    uninterrupted run (optimizer moments and rng restored too)."""
+    ds = _ds()
+
+    def make(num_epoch, ckpt=None, resume=False):
+        return SingleTrainer(
+            _mlp(), batch_size=32, num_epoch=num_epoch,
+            worker_optimizer="adam", learning_rate=0.01,
+            loss="sparse_categorical_crossentropy_from_logits",
+            checkpoint_dir=ckpt, resume=resume)
+
+    uninterrupted = make(4).train(ds)
+
+    ckpt = str(tmp_path / "ck2")
+    make(2, ckpt=ckpt).train(ds)            # "crash" after epoch 2
+    resumed = make(4, ckpt=ckpt, resume=True).train(ds)
+
+    for a, b in zip(jax.tree_util.tree_leaves(uninterrupted.params),
+                    jax.tree_util.tree_leaves(resumed.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_invalid_checkpoint_cadence_rejected(tmp_path):
+    with pytest.raises(ValueError, match="checkpoint_every"):
+        SingleTrainer(_mlp(), checkpoint_dir=str(tmp_path),
+                      checkpoint_every=0,
+                      loss="sparse_categorical_crossentropy_from_logits")
+    with pytest.raises(ValueError, match="max_to_keep"):
+        CheckpointManager(str(tmp_path), max_to_keep=0)
+
+
+def test_predictor_respects_custom_mesh_axis_name():
+    from distkeras_tpu.inference import Predictor
+    from distkeras_tpu.parallel import make_mesh
+    mesh = make_mesh(4, axis_name="data")
+    model = _mlp()
+    ds = Dataset({"features": np.ones((10, 8), np.float32)})
+    out = Predictor(model, mesh=mesh, batch_size_per_device=2).predict(ds)
+    assert out["prediction"].shape == (10, 2)
+
+
+def test_step_timer():
+    t = StepTimer()
+    with t.phase("train"):
+        pass
+    with t.phase("train"):
+        pass
+    s = t.summary()
+    assert s["train"]["count"] == 2
+    assert s["train"]["total_s"] >= 0
+
+
+def test_device_memory_stats_no_crash():
+    device_memory_stats()  # None on virtual CPU devices; must not raise
