@@ -7,6 +7,9 @@ from distkeras_tpu.models.layers import (  # noqa: F401
     Dropout, Embedding, Flatten, GlobalAveragePooling2D, MaxPooling2D,
     Reshape, get_activation)
 from distkeras_tpu.models.blocks import Residual, WideAndDeep  # noqa: F401
+from distkeras_tpu.models.attention import (  # noqa: F401
+    LayerNorm, MultiHeadAttention, PositionalEmbedding, RMSNorm,
+    TransformerBlock, TransformerMLP)
 from distkeras_tpu.models.recurrent import (  # noqa: F401
     GRU, LSTM, Bidirectional)
 from distkeras_tpu.models import zoo  # noqa: F401

@@ -1,0 +1,305 @@
+"""Transformer layers: norms, multi-head attention, transformer block.
+
+The reference has no attention/transformer models (SURVEY §5.7 — dist-keras
+predates transformers; examples stop at (Bi)LSTM). These layers are the TPU
+build's long-context model family, designed mesh-first:
+
+  * Attention projection params are stored as ``[d_model, heads, head_dim]``
+    so tensor parallelism is a single ``PartitionSpec(None, "tensor", None)``
+    on the heads axis (see ``parallel.sharding``).
+  * The MLP keeps its two matmuls as explicit ``w1``/``w2`` for the standard
+    column→row TP split.
+  * ``attn_impl`` selects the compute path per layer: ``"xla"`` (fused
+    reference), ``"flash"`` (Pallas kernel), or ``"ring"`` (sequence-parallel
+    ring attention over a mesh axis — set by the SPMD trainer).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from distkeras_tpu.models.core import (Layer, layer_from_spec, layer_spec,
+                                       register_layer)
+from distkeras_tpu.models.layers import Dropout, get_activation, init_weights
+from distkeras_tpu.ops.attention import apply_rope, dot_product_attention
+
+
+@register_layer
+class LayerNorm(Layer):
+    def __init__(self, epsilon: float = 1e-5):
+        self.epsilon = float(epsilon)
+
+    def init(self, rng, input_shape):
+        dim = input_shape[-1]
+        return {"scale": jnp.ones((dim,)), "offset": jnp.zeros((dim,))}, {}, \
+            tuple(input_shape)
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        xf = x.astype(jnp.float32)
+        mean = jnp.mean(xf, axis=-1, keepdims=True)
+        var = jnp.var(xf, axis=-1, keepdims=True)
+        y = (xf - mean) * jax.lax.rsqrt(var + self.epsilon)
+        y = y * params["scale"] + params["offset"]
+        return y.astype(x.dtype), state
+
+    def get_config(self):
+        return {"epsilon": self.epsilon}
+
+
+@register_layer
+class RMSNorm(Layer):
+    def __init__(self, epsilon: float = 1e-6):
+        self.epsilon = float(epsilon)
+
+    def init(self, rng, input_shape):
+        dim = input_shape[-1]
+        return {"scale": jnp.ones((dim,))}, {}, tuple(input_shape)
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        xf = x.astype(jnp.float32)
+        y = xf * jax.lax.rsqrt(
+            jnp.mean(jnp.square(xf), axis=-1, keepdims=True) + self.epsilon)
+        return (y * params["scale"]).astype(x.dtype), state
+
+    def get_config(self):
+        return {"epsilon": self.epsilon}
+
+
+@register_layer
+class PositionalEmbedding(Layer):
+    """Learned absolute position embeddings added to a [B, S, D] input."""
+
+    def __init__(self, max_len: int):
+        self.max_len = int(max_len)
+
+    def init(self, rng, input_shape):
+        dim = input_shape[-1]
+        params = {"embeddings": init_weights("uniform_scaling", rng,
+                                             (self.max_len, dim))}
+        return params, {}, tuple(input_shape)
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        s = x.shape[1]
+        return x + params["embeddings"][:s][None].astype(x.dtype), state
+
+    def get_config(self):
+        return {"max_len": self.max_len}
+
+
+def _attention_compute(q, k, v, *, causal, impl, axis_name=None):
+    """Dispatch on attention implementation. q/k/v are BSHD."""
+    if impl == "flash":
+        from distkeras_tpu.ops.flash_attention import flash_attention
+        return flash_attention(q, k, v, causal=causal)
+    if impl == "ring":
+        if not axis_name:
+            raise ValueError(
+                "attn_impl='ring' requires seq_axis_name (the mesh axis the "
+                "sequence is sharded over, e.g. 'sp' from parallel.mesh); "
+                "without it RoPE positions and causal masks would silently "
+                "use shard-local coordinates")
+        from distkeras_tpu.ops.ring_attention import ring_attention
+        return ring_attention(q, k, v, axis_name=axis_name, causal=causal)
+    return dot_product_attention(q, k, v, causal=causal)
+
+
+@register_layer
+class MultiHeadAttention(Layer):
+    """Multi-head self-attention over [B, S, d_model].
+
+    Projections are single einsums against ``[d_model, H, Dh]`` tensors —
+    one MXU matmul each; the heads axis is the TP shard axis.
+    """
+
+    def __init__(self, num_heads: int, head_dim: Optional[int] = None,
+                 causal: bool = True, use_rope: bool = True,
+                 dtype: str = "float32", attn_impl: str = "xla",
+                 seq_axis_name: Optional[str] = None,
+                 kernel_init: str = "glorot_uniform"):
+        self.num_heads = int(num_heads)
+        self.head_dim = head_dim if head_dim is None else int(head_dim)
+        self.causal = bool(causal)
+        self.use_rope = bool(use_rope)
+        self.dtype = dtype
+        self.attn_impl = attn_impl
+        self.seq_axis_name = seq_axis_name
+        self.kernel_init = kernel_init
+
+    def init(self, rng, input_shape):
+        d_model = input_shape[-1]
+        dh = self.head_dim or d_model // self.num_heads
+        ks = jax.random.split(rng, 4)
+        shape = (d_model, self.num_heads, dh)
+        params = {
+            "wq": init_weights(self.kernel_init, ks[0], shape),
+            "wk": init_weights(self.kernel_init, ks[1], shape),
+            "wv": init_weights(self.kernel_init, ks[2], shape),
+            "wo": init_weights(self.kernel_init, ks[3],
+                               (self.num_heads, dh, d_model)),
+        }
+        return params, {}, tuple(input_shape)
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        dt = jnp.dtype(self.dtype)
+        xc = x.astype(dt)
+        q = jnp.einsum("bsd,dhe->bshe", xc, params["wq"].astype(dt))
+        k = jnp.einsum("bsd,dhe->bshe", xc, params["wk"].astype(dt))
+        v = jnp.einsum("bsd,dhe->bshe", xc, params["wv"].astype(dt))
+        if self.use_rope:
+            positions = None
+            if self.attn_impl == "ring" and self.seq_axis_name:
+                # global positions for this sequence shard
+                idx = jax.lax.axis_index(self.seq_axis_name)
+                positions = idx * x.shape[1] + jnp.arange(x.shape[1])
+            q = apply_rope(q, positions)
+            k = apply_rope(k, positions)
+        out = _attention_compute(q, k, v, causal=self.causal,
+                                 impl=self.attn_impl,
+                                 axis_name=self.seq_axis_name)
+        y = jnp.einsum("bshe,hed->bsd", out, params["wo"].astype(dt))
+        return y.astype(x.dtype), state
+
+    def get_config(self):
+        return {"num_heads": self.num_heads, "head_dim": self.head_dim,
+                "causal": self.causal, "use_rope": self.use_rope,
+                "dtype": self.dtype, "attn_impl": self.attn_impl,
+                "seq_axis_name": self.seq_axis_name,
+                "kernel_init": self.kernel_init}
+
+
+@register_layer
+class TransformerMLP(Layer):
+    """Position-wise MLP with the standard column→row TP-splittable pair."""
+
+    def __init__(self, hidden_dim: int, activation: str = "gelu",
+                 dtype: str = "float32",
+                 kernel_init: str = "glorot_uniform"):
+        self.hidden_dim = int(hidden_dim)
+        self.activation = activation
+        self.dtype = dtype
+        self.kernel_init = kernel_init
+
+    def init(self, rng, input_shape):
+        d = input_shape[-1]
+        k1, k2 = jax.random.split(rng)
+        params = {
+            "w1": init_weights(self.kernel_init, k1, (d, self.hidden_dim)),
+            "b1": jnp.zeros((self.hidden_dim,)),
+            "w2": init_weights(self.kernel_init, k2, (self.hidden_dim, d)),
+            "b2": jnp.zeros((d,)),
+        }
+        return params, {}, tuple(input_shape)
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        dt = jnp.dtype(self.dtype)
+        act = get_activation(self.activation)
+        h = act(x.astype(dt) @ params["w1"].astype(dt) +
+                params["b1"].astype(dt))
+        y = h @ params["w2"].astype(dt) + params["b2"].astype(dt)
+        return y.astype(x.dtype), state
+
+    def get_config(self):
+        return {"hidden_dim": self.hidden_dim, "activation": self.activation,
+                "dtype": self.dtype, "kernel_init": self.kernel_init}
+
+
+@register_layer
+class TransformerBlock(Layer):
+    """Pre-norm residual block: x + attn(norm(x)); x + mlp(norm(x)).
+
+    ``mlp`` may be a ``TransformerMLP`` or a ``models.moe.MoE`` (expert
+    parallelism); both expose the same Layer protocol.
+    """
+
+    def __init__(self, num_heads: int, mlp_ratio: int = 4,
+                 head_dim: Optional[int] = None, causal: bool = True,
+                 use_rope: bool = True, activation: str = "gelu",
+                 norm: str = "rmsnorm", dtype: str = "float32",
+                 attn_impl: str = "xla",
+                 seq_axis_name: Optional[str] = None,
+                 mlp_layer: Optional[Layer] = None,
+                 dropout_rate: float = 0.0):
+        self.num_heads = int(num_heads)
+        self.mlp_ratio = int(mlp_ratio)
+        self.head_dim = head_dim
+        self.causal = causal
+        self.use_rope = use_rope
+        self.activation = activation
+        self.norm = norm
+        self.dtype = dtype
+        self.attn_impl = attn_impl
+        self.seq_axis_name = seq_axis_name
+        self.dropout_rate = float(dropout_rate)
+        self._mlp_override = mlp_layer
+
+        norm_cls = RMSNorm if norm == "rmsnorm" else LayerNorm
+        self.norm1 = norm_cls()
+        self.norm2 = norm_cls()
+        self._dropout = Dropout(self.dropout_rate)
+        self.attn = MultiHeadAttention(
+            num_heads, head_dim=head_dim, causal=causal, use_rope=use_rope,
+            dtype=dtype, attn_impl=attn_impl, seq_axis_name=seq_axis_name)
+        self.mlp = mlp_layer  # resolved in init once d_model is known
+
+    def init(self, rng, input_shape):
+        d_model = input_shape[-1]
+        if self.mlp is None:
+            self.mlp = TransformerMLP(self.mlp_ratio * d_model,
+                                      activation=self.activation,
+                                      dtype=self.dtype)
+        ks = jax.random.split(rng, 4)
+        p, s = {}, {}
+        for name, layer, k in (("norm1", self.norm1, ks[0]),
+                               ("attn", self.attn, ks[1]),
+                               ("norm2", self.norm2, ks[2]),
+                               ("mlp", self.mlp, ks[3])):
+            p[name], s[name], _ = layer.init(k, tuple(input_shape))
+        return p, s, tuple(input_shape)
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        new_state = dict(state)
+        h, new_state["norm1"] = self.norm1.apply(
+            params["norm1"], state["norm1"], x, training=training)
+        a, new_state["attn"] = self.attn.apply(
+            params["attn"], state["attn"], h, training=training)
+
+        def drop(y, key):  # both residual branches share the Dropout layer
+            return self._dropout.apply({}, {}, y, training=training,
+                                       rng=key)[0]
+
+        use_dropout = self.dropout_rate and training and rng is not None
+        if use_dropout:
+            rng, sub = jax.random.split(rng)
+            a = drop(a, sub)
+        x = x + a
+        h, new_state["norm2"] = self.norm2.apply(
+            params["norm2"], state["norm2"], x, training=training)
+        m, new_state["mlp"] = self.mlp.apply(
+            params["mlp"], state["mlp"], h, training=training, rng=rng)
+        if use_dropout:
+            rng, sub = jax.random.split(rng)
+            m = drop(m, sub)
+        return x + m, new_state
+
+    def get_config(self):
+        cfg = {"num_heads": self.num_heads, "mlp_ratio": self.mlp_ratio,
+               "head_dim": self.head_dim, "causal": self.causal,
+               "use_rope": self.use_rope, "activation": self.activation,
+               "norm": self.norm, "dtype": self.dtype,
+               "attn_impl": self.attn_impl,
+               "seq_axis_name": self.seq_axis_name,
+               "dropout_rate": self.dropout_rate}
+        if self._mlp_override is not None:
+            cfg["mlp_layer"] = layer_spec(self._mlp_override)
+        return cfg
+
+    @classmethod
+    def from_config(cls, config):
+        config = dict(config)
+        spec = config.pop("mlp_layer", None)
+        if spec is not None:
+            config["mlp_layer"] = layer_from_spec(spec)
+        return cls(**config)

@@ -13,21 +13,13 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-from distkeras_tpu.models.core import LAYER_REGISTRY, Layer, Sequential, \
-    register_layer
+from distkeras_tpu.models.core import (Layer, Sequential, layer_from_spec,
+                                       layer_spec, register_layer)
 from distkeras_tpu.models.layers import get_activation
 
-
-def _layer_spec(layer: Optional[Layer]):
-    if layer is None:
-        return None
-    return {"class": layer.name, "config": layer.get_config()}
-
-
-def _layer_from_spec(spec):
-    if spec is None:
-        return None
-    return LAYER_REGISTRY[spec["class"]].from_config(spec["config"])
+# retained aliases (pre-refactor internal names)
+_layer_spec = layer_spec
+_layer_from_spec = layer_from_spec
 
 
 @register_layer

@@ -36,6 +36,21 @@ def register_layer(cls: type) -> type:
     return cls
 
 
+def layer_spec(layer):
+    """Layer -> registry spec dict (None passes through) — the one encoding
+    every container (Sequential/Residual/TransformerBlock/...) uses."""
+    if layer is None:
+        return None
+    return {"class": layer.name, "config": layer.get_config()}
+
+
+def layer_from_spec(spec):
+    """Registry spec dict -> Layer (None passes through)."""
+    if spec is None:
+        return None
+    return LAYER_REGISTRY[spec["class"]].from_config(spec["config"])
+
+
 class Layer:
     """Base layer: a pure init/apply pair plus a JSON-able config.
 

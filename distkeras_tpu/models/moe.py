@@ -1,0 +1,103 @@
+"""Mixture-of-experts MLP with expert parallelism over a mesh axis.
+
+Absent from the reference (SURVEY §2.3: expert parallelism "out of scope"
+for the Spark design) — this is a TPU-native addition. Design:
+
+  * Expert weights are stacked on a leading ``[num_experts, ...]`` axis, so
+    expert parallelism is a single ``PartitionSpec("expert", ...)`` shard of
+    that axis (see ``parallel.sharding``).
+  * Routing is a **static-shape dense top-k**: the router's softmax is
+    masked to the top-k experts per token and every (local) expert runs on
+    every token. There is no gather/scatter and no capacity dropping —
+    data-dependent dispatch would force dynamic shapes XLA can't tile; the
+    masked-dense form keeps the MXU fed and is exact (same output as
+    dispatched top-k).
+  * Under expert parallelism each device computes only its ``E / A`` local
+    experts and the weighted outputs are ``psum``'d over the ``expert``
+    axis — compute per device drops by the axis size A.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from distkeras_tpu.models.core import Layer, register_layer
+from distkeras_tpu.models.layers import get_activation, init_weights
+
+
+@register_layer
+class MoE(Layer):
+    """Top-k gated mixture of expert MLPs over [B, S, d_model]."""
+
+    def __init__(self, num_experts: int, hidden_dim: int, top_k: int = 2,
+                 activation: str = "gelu", dtype: str = "float32",
+                 expert_axis_name: Optional[str] = None,
+                 kernel_init: str = "glorot_uniform"):
+        self.num_experts = int(num_experts)
+        self.hidden_dim = int(hidden_dim)
+        self.top_k = int(top_k)
+        self.activation = activation
+        self.dtype = dtype
+        self.expert_axis_name = expert_axis_name
+        self.kernel_init = kernel_init
+
+    def init(self, rng, input_shape):
+        d = input_shape[-1]
+        e, hid = self.num_experts, self.hidden_dim
+        kg, k1, k2 = jax.random.split(rng, 3)
+        # per-expert init: split so experts start decorrelated
+        w1 = jnp.stack([init_weights(self.kernel_init, k, (d, hid))
+                        for k in jax.random.split(k1, e)])
+        w2 = jnp.stack([init_weights(self.kernel_init, k, (hid, d))
+                        for k in jax.random.split(k2, e)])
+        params = {
+            "gate": init_weights(self.kernel_init, kg, (d, e)),
+            "w1": w1, "b1": jnp.zeros((e, hid)),
+            "w2": w2, "b2": jnp.zeros((e, d)),
+        }
+        return params, {}, tuple(input_shape)
+
+    def _gate_probs(self, x, gate):
+        """[B, S, E] routing weights: softmax over top-k logits, 0 elsewhere."""
+        logits = jnp.einsum("bsd,de->bse", x.astype(jnp.float32),
+                            gate.astype(jnp.float32))
+        if self.top_k < self.num_experts:
+            kth = lax.top_k(logits, self.top_k)[0][..., -1:]
+            logits = jnp.where(logits >= kth, logits, -jnp.inf)
+        return jax.nn.softmax(logits, axis=-1)
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        dt = jnp.dtype(self.dtype)
+        act = get_activation(self.activation)
+        probs = self._gate_probs(x, params["gate"])     # [B, S, E] f32
+
+        xc = x.astype(dt)
+        # local experts: [El, ...] slice when sharded over the expert axis
+        h = jnp.einsum("bsd,edf->besf", xc, params["w1"].astype(dt))
+        h = act(h + params["b1"].astype(dt)[None, :, None, :])
+        y = jnp.einsum("besf,efd->besd", h, params["w2"].astype(dt))
+        y = y + params["b2"].astype(dt)[None, :, None, :]
+
+        if self.expert_axis_name is None:
+            out = jnp.einsum("bse,besd->bsd", probs.astype(dt), y)
+        else:
+            # Sharded: this shard holds experts [idx*El, (idx+1)*El); pick
+            # the matching slice of the (replicated) router probabilities,
+            # then combine across the axis.
+            el = y.shape[1]
+            idx = lax.axis_index(self.expert_axis_name)
+            local = lax.dynamic_slice_in_dim(probs, idx * el, el, axis=-1)
+            out = jnp.einsum("bse,besd->bsd", local.astype(dt), y)
+            out = lax.psum(out, self.expert_axis_name)
+        return out.astype(x.dtype), state
+
+    def get_config(self):
+        return {"num_experts": self.num_experts, "hidden_dim": self.hidden_dim,
+                "top_k": self.top_k, "activation": self.activation,
+                "dtype": self.dtype,
+                "expert_axis_name": self.expert_axis_name,
+                "kernel_init": self.kernel_init}

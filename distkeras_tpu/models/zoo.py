@@ -22,7 +22,7 @@ from typing import Optional, Sequence, Tuple
 from distkeras_tpu.models.blocks import Residual, WideAndDeep
 from distkeras_tpu.models.core import Sequential
 from distkeras_tpu.models.layers import (
-    Activation, BatchNorm, Conv2D, Dense, Dropout, Flatten,
+    Activation, BatchNorm, Conv2D, Dense, Dropout, Embedding, Flatten,
     GlobalAveragePooling2D, MaxPooling2D)
 from distkeras_tpu.models.recurrent import LSTM, Bidirectional
 
@@ -126,3 +126,43 @@ def wide_and_deep(wide_dim: int, deep_hidden: Sequence[int] = (256, 128),
     """Wide & Deep for Criteo-style CTR (BASELINE config 4)."""
     return Sequential([
         WideAndDeep(wide_dim, deep_hidden, num_classes, dtype=dtype)])
+
+
+def transformer_lm(vocab_size: int, d_model: int = 512, num_heads: int = 8,
+                   num_layers: int = 6, mlp_ratio: int = 4,
+                   max_len: Optional[int] = None, use_rope: bool = True,
+                   norm: str = "rmsnorm", dtype: str = "float32",
+                   attn_impl: str = "xla",
+                   seq_axis_name: Optional[str] = None,
+                   moe_every: int = 0, num_experts: int = 0,
+                   moe_expert_axis: Optional[str] = None) -> Sequential:
+    """Decoder-only causal transformer LM — the long-context flagship.
+
+    Absent from the reference (no attention models; SURVEY §5.7); this is
+    the model the TP/SP/EP parallelism layers are exercised on. Tokens
+    [B, S] int in, logits [B, S, vocab] out.
+
+    ``moe_every=k`` (with ``num_experts``) swaps every k-th block's MLP for
+    a mixture-of-experts layer (expert-parallel over ``moe_expert_axis``).
+    """
+    from distkeras_tpu.models.attention import (
+        LayerNorm, PositionalEmbedding, RMSNorm, TransformerBlock)
+
+    layers = [Embedding(vocab_size, d_model)]
+    if not use_rope:
+        if max_len is None:
+            raise ValueError("max_len required when use_rope=False")
+        layers.append(PositionalEmbedding(max_len))
+    for i in range(num_layers):
+        mlp_layer = None
+        if moe_every and num_experts and (i + 1) % moe_every == 0:
+            from distkeras_tpu.models.moe import MoE
+            mlp_layer = MoE(num_experts, mlp_ratio * d_model,
+                            dtype=dtype, expert_axis_name=moe_expert_axis)
+        layers.append(TransformerBlock(
+            num_heads, mlp_ratio=mlp_ratio, causal=True, use_rope=use_rope,
+            norm=norm, dtype=dtype, attn_impl=attn_impl,
+            seq_axis_name=seq_axis_name, mlp_layer=mlp_layer))
+    layers.append(RMSNorm() if norm == "rmsnorm" else LayerNorm())
+    layers.append(Dense(vocab_size, use_bias=False, dtype=dtype))
+    return Sequential(layers)

@@ -1,5 +1,9 @@
 """Pure ops: losses, metrics, optimizers, attention."""
 
+from distkeras_tpu.ops.attention import (  # noqa: F401
+    apply_rope, causal_mask, dot_product_attention)
+from distkeras_tpu.ops.flash_attention import flash_attention  # noqa: F401
+from distkeras_tpu.ops.ring_attention import ring_attention  # noqa: F401
 from distkeras_tpu.ops.losses import LOSSES, get_loss  # noqa: F401
 from distkeras_tpu.ops.metrics import METRICS, get_metric  # noqa: F401
 from distkeras_tpu.ops.optimizers import (  # noqa: F401

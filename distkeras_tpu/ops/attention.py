@@ -1,0 +1,96 @@
+"""Attention ops: scaled dot-product attention, RoPE, causal masking.
+
+The reference has no attention models at all (SURVEY §5.7 — dist-keras
+predates transformers; its examples are MLP/CNN/(Bi)LSTM). This module is
+part of the TPU build's first-class long-context story: the functional core
+consumed by ``models.attention.MultiHeadAttention``, the Pallas flash kernel
+(``ops.flash_attention``) and the sequence-parallel ring variant
+(``ops.ring_attention``).
+
+Conventions:
+  * Layout is **BSHD**: ``q/k/v`` are ``[batch, seq, heads, head_dim]``.
+  * Softmax math is float32 regardless of input dtype (bf16-safe).
+  * ``NEG_INF`` is a large finite negative instead of ``-inf`` so fully
+    masked rows produce zeros, not NaNs.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -0.7 * float(jnp.finfo(jnp.float32).max)
+
+
+def causal_mask(q_len: int, k_len: int, q_offset: int = 0,
+                k_offset: int = 0) -> jnp.ndarray:
+    """Boolean [q_len, k_len] mask, True where attention is allowed.
+
+    Offsets give the global position of the first row/column — used by the
+    ring variant where each device holds a sequence shard.
+    """
+    q_pos = q_offset + jnp.arange(q_len)[:, None]
+    k_pos = k_offset + jnp.arange(k_len)[None, :]
+    return q_pos >= k_pos
+
+
+def dot_product_attention(q, k, v, *, causal: bool = False,
+                          mask: Optional[jnp.ndarray] = None,
+                          scale: Optional[float] = None) -> jnp.ndarray:
+    """Reference (pure-XLA) attention. BSHD in, BSHD out.
+
+    XLA fuses this well for moderate sequence lengths; the Pallas flash
+    kernel (``ops.flash_attention``) avoids materializing the [S, S] scores
+    for long sequences.
+    """
+    head_dim = q.shape[-1]
+    if scale is None:
+        scale = head_dim ** -0.5
+    # [B, H, Sq, Sk] scores in f32
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if causal:
+        allowed = causal_mask(q.shape[1], k.shape[1])
+        s = jnp.where(allowed[None, None], s, NEG_INF)
+    if mask is not None:
+        s = jnp.where(mask, s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v,
+                     preferred_element_type=jnp.float32)
+    return out.astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# rotary position embeddings
+# ---------------------------------------------------------------------------
+
+def rope_frequencies(head_dim: int, base: float = 10000.0) -> jnp.ndarray:
+    """Inverse frequencies for RoPE: [head_dim // 2] float32."""
+    return 1.0 / (base ** (jnp.arange(0, head_dim, 2,
+                                      dtype=jnp.float32) / head_dim))
+
+
+def apply_rope(x, positions=None, base: float = 10000.0):
+    """Rotary position embedding on a BSHD tensor.
+
+    ``positions``: optional [S] or [B, S] int array of global token positions
+    (defaults to 0..S-1 — pass explicit positions for sequence-sharded
+    shards in ring attention).
+    """
+    b, s, h, d = x.shape
+    if positions is None:
+        positions = jnp.arange(s)
+    positions = jnp.asarray(positions, jnp.float32)
+    if positions.ndim == 1:
+        positions = positions[None, :]  # [1, S] broadcasts over batch
+    freqs = rope_frequencies(d, base)                   # [D/2]
+    angles = positions[..., None] * freqs               # [B?, S, D/2]
+    cos = jnp.cos(angles)[:, :, None, :]                # [B?, S, 1, D/2]
+    sin = jnp.sin(angles)[:, :, None, :]
+    x1, x2 = x[..., ::2].astype(jnp.float32), x[..., 1::2].astype(jnp.float32)
+    r1 = x1 * cos - x2 * sin
+    r2 = x2 * cos + x1 * sin
+    out = jnp.stack([r1, r2], axis=-1).reshape(b, s, h, d)
+    return out.astype(x.dtype)

@@ -1,0 +1,251 @@
+"""Flash attention as a Pallas TPU kernel (blockwise, online softmax).
+
+Absent from the reference (no attention models; SURVEY §5.7) — this is the
+TPU build's hot-op kernel for the long-context path. The forward pass never
+materializes the ``[S, S]`` score matrix: the grid is
+``(batch*heads, q_blocks, k_blocks)`` with the K axis innermost ("arbitrary"
+= sequential on TPU), so exactly one ``[block_k, D]`` tile of K and V is
+resident in VMEM at a time while the online-softmax carry (running max
+``m``, normalizer ``l``, accumulator ``acc``) persists in VMEM scratch
+across the K sweep. Causal q/k tiles above the diagonal skip their compute
+via ``pl.when``. Sequence lengths that don't divide the block sizes are
+zero-padded and the pad keys masked off.
+
+The backward pass is a blockwise XLA recomputation (``lax.scan`` over K
+blocks, recomputing probabilities from the saved log-sum-exp) — O(S) memory
+like the forward, with XLA fusing the per-block einsums. A fully in-kernel
+backward is a later optimization.
+
+On non-TPU backends the kernel runs in Pallas interpreter mode (tests) or
+falls back to the fused-XLA reference (``ops.attention``) for speed.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+try:
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover
+    pltpu = None
+
+from distkeras_tpu.ops.attention import (NEG_INF, causal_mask,
+                                         dot_product_attention)
+
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
+                *, scale: float, causal: bool, k_len: int):
+    """One (batch*head, q_block, k_block) program.
+
+    Block shapes: q_ref [1, bq, D]; k_ref/v_ref [1, bk, D];
+    o_ref [1, bq, D]; lse_ref [1, bq]. Scratch m/l [bq, 1], acc [bq, D]
+    persist across the (sequential, innermost) k grid axis.
+    """
+    qi, ki = pl.program_id(1), pl.program_id(2)
+    nk = pl.num_programs(2)
+    block_q, block_k = q_ref.shape[1], k_ref.shape[1]
+
+    @pl.when(ki == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    # causal: tiles strictly above the diagonal contribute nothing
+    run = (ki * block_k <= qi * block_q + block_q - 1) if causal \
+        else (ki >= 0)
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32) * scale
+        kblk = k_ref[0].astype(jnp.float32)
+        vblk = v_ref[0].astype(jnp.float32)
+        s = lax.dot_general(q, kblk, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+        q_pos = (qi * block_q +
+                 lax.broadcasted_iota(jnp.int32, s.shape, 0))
+        k_pos = (ki * block_k +
+                 lax.broadcasted_iota(jnp.int32, s.shape, 1))
+        if causal:
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        # mask zero-padded keys past the true sequence end
+        if k_len % block_k:
+            s = jnp.where(k_pos < k_len, s, NEG_INF)
+        m_prev, l_prev, acc_prev = m_ref[:], l_ref[:], acc_ref[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        m_ref[:] = m_new
+        l_ref[:] = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[:] = acc_prev * alpha + lax.dot_general(
+            p, vblk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        l = l_ref[:]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
+        lse_ref[0] = (m_ref[:] + jnp.log(l_safe))[:, 0]
+
+
+def _pad_seq(x, block: int):
+    s = x.shape[1]
+    pad = (-s) % block
+    if pad:
+        x = jnp.pad(x, ((0, 0), (0, pad), (0, 0), (0, 0)))
+    return x
+
+
+def _flash_forward(q, k, v, scale: float, causal: bool, block_q: int,
+                   block_k: int, interpret: bool):
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    qp, kp, vp = _pad_seq(q, block_q), _pad_seq(k, block_k), _pad_seq(v,
+                                                                      block_k)
+    sq_p, sk_p = qp.shape[1], kp.shape[1]
+
+    # BSHD -> (B*H, S, D): one grid row per (batch, head)
+    qf = qp.transpose(0, 2, 1, 3).reshape(b * h, sq_p, d)
+    kf = kp.transpose(0, 2, 1, 3).reshape(b * h, sk_p, d)
+    vf = vp.transpose(0, 2, 1, 3).reshape(b * h, sk_p, d)
+
+    grid = (b * h, sq_p // block_q, sk_p // block_k)
+    kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                               k_len=sk)
+    kwargs = {}
+    if pltpu is not None and not interpret:
+        kwargs["compiler_params"] = pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"))
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q), lambda bh, qi, ki: (bh, qi)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, sq_p, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, sq_p), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        interpret=interpret,
+        **kwargs,
+    )(qf, kf, vf)
+    out = out.reshape(b, h, sq_p, d).transpose(0, 2, 1, 3)[:, :sq]
+    lse = lse.reshape(b, h, sq_p)[:, :, :sq]
+    return out, lse
+
+
+def _flash_backward(res, g, scale: float, causal: bool, block_k: int):
+    """Blockwise XLA backward: scan over K/V blocks, recompute P from lse."""
+    q, k, v, out, lse = res
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    block_k = min(block_k, sk)
+    pad = (-sk) % block_k
+    if pad:
+        k = jnp.pad(k, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, pad), (0, 0), (0, 0)))
+
+    qf = q.astype(jnp.float32) * scale
+    g32 = g.astype(jnp.float32)
+    # delta_i = sum_j P_ij dP_ij = rowsum(dO * O)  (flash attention trick)
+    delta = jnp.sum(g32 * out.astype(jnp.float32), axis=-1)   # [B, Sq, H]
+
+    nkb = (sk + pad) // block_k
+
+    def body(dq_acc, kb):
+        ks = lax.dynamic_slice_in_dim(k, kb * block_k, block_k, axis=1)
+        vs = lax.dynamic_slice_in_dim(v, kb * block_k, block_k, axis=1)
+        s = jnp.einsum("bqhd,bkhd->bhqk", qf, ks.astype(jnp.float32),
+                       preferred_element_type=jnp.float32)
+        allowed = causal_mask(sq, block_k, k_offset=kb * block_k) \
+            if causal else True
+        k_valid = (kb * block_k + jnp.arange(block_k)) < sk
+        mask = jnp.logical_and(allowed, k_valid[None, :]) if causal \
+            else k_valid[None, :]
+        s = jnp.where(mask[None, None], s, NEG_INF)
+        p = jnp.exp(s - lse[..., None])                       # [B,H,Sq,bk]
+        dv = jnp.einsum("bhqk,bqhd->bkhd", p, g32,
+                        preferred_element_type=jnp.float32)
+        dp = jnp.einsum("bqhd,bkhd->bhqk", g32, vs.astype(jnp.float32),
+                        preferred_element_type=jnp.float32)
+        ds = p * (dp - delta.transpose(0, 2, 1)[..., None])   # [B,H,Sq,bk]
+        dq_blk = jnp.einsum("bhqk,bkhd->bqhd", ds, ks.astype(jnp.float32),
+                            preferred_element_type=jnp.float32) * scale
+        dk = jnp.einsum("bhqk,bqhd->bkhd", ds, qf,
+                        preferred_element_type=jnp.float32)
+        return dq_acc + dq_blk, (dk, dv)
+
+    dq, (dks, dvs) = lax.scan(body, jnp.zeros(q.shape, jnp.float32),
+                              jnp.arange(nkb))
+    dk = dks.transpose(1, 0, 2, 3, 4).reshape(b, sk + pad, h, d)[:, :sk]
+    dv = dvs.transpose(1, 0, 2, 3, 4).reshape(b, sk + pad, h, d)[:, :sk]
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, scale, causal, block_q, block_k, interpret):
+    out, _ = _flash_forward(q, k, v, scale, causal, block_q, block_k,
+                            interpret)
+    return out
+
+
+def _flash_fwd_rule(q, k, v, scale, causal, block_q, block_k, interpret):
+    out, lse = _flash_forward(q, k, v, scale, causal, block_q, block_k,
+                              interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bwd_rule(scale, causal, block_q, block_k, interpret, res, g):
+    return _flash_backward(res, g, scale, causal, block_k)
+
+
+_flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+def flash_attention(q, k, v, *, causal: bool = False,
+                    scale: Optional[float] = None,
+                    block_q: int = DEFAULT_BLOCK_Q,
+                    block_k: int = DEFAULT_BLOCK_K,
+                    interpret: Optional[bool] = None) -> jnp.ndarray:
+    """Flash attention, BSHD in/out. Differentiable (custom VJP).
+
+    ``interpret=None`` auto-selects: real kernel on TPU, interpreter mode
+    elsewhere (falling back to the fused-XLA reference for big shapes or
+    when ``interpret=False`` is forced off-TPU, where Mosaic can't lower).
+    """
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    if pltpu is None:  # no Pallas TPU support in this jax build
+        return dot_product_attention(q, k, v, causal=causal, scale=scale)
+    on_tpu = jax.default_backend() == "tpu"
+    if interpret is None:
+        interpret = not on_tpu
+        if interpret and q.shape[1] * k.shape[1] > 256 * 256:
+            # interpreter is too slow for big shapes; use the XLA reference
+            return dot_product_attention(q, k, v, causal=causal, scale=scale)
+    if not on_tpu and not interpret:
+        return dot_product_attention(q, k, v, causal=causal, scale=scale)
+    return _flash(q, k, v, scale, causal, block_q, block_k, interpret)

@@ -1,0 +1,78 @@
+"""Ring attention: exact sequence-parallel attention over a mesh axis.
+
+Absent from the reference (SURVEY §5.7: no sequence dimension sharding of
+any kind) — this is the TPU build's long-context core. Each device holds a
+sequence shard of Q/K/V; K/V blocks rotate around the ring via
+``lax.ppermute`` (ICI neighbor exchange) while each device accumulates its
+queries' attention with the online-softmax recurrence. Memory per device is
+O(S_local²) scores; the full [S, S] matrix never exists anywhere, and the
+K/V transfer overlaps with the block computation under XLA's latency-hiding
+scheduler.
+
+``ring_attention`` must be called **inside** a ``shard_map`` whose
+``axis_name`` axis shards the sequence dimension (the trainer and
+``MultiHeadAttention(attn_impl="ring")`` arrange this).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from distkeras_tpu.ops.attention import NEG_INF, causal_mask
+
+
+def ring_attention(q, k, v, *, axis_name: str, causal: bool = False,
+                   scale: Optional[float] = None) -> jnp.ndarray:
+    """BSHD sequence-sharded attention. q/k/v: local shards [B, Sl, H, D]."""
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    n = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    b, s_local, h, d = q.shape
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    qf = q.astype(jnp.float32) * scale
+
+    def body(t, carry):
+        m, l, acc, kc, vc = carry
+        src = (idx - t) % n                                  # block owner
+        s = jnp.einsum("bqhd,bkhd->bhqk", qf, kc.astype(jnp.float32),
+                       preferred_element_type=jnp.float32)
+        if causal:
+            allowed = causal_mask(s_local, s_local,
+                                  q_offset=idx * s_local,
+                                  k_offset=src * s_local)    # [Sl, Sl]
+            s = jnp.where(allowed[None, None], s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc * alpha.transpose(0, 2, 1, 3) + jnp.einsum(
+            "bhqk,bkhd->bqhd", p, vc.astype(jnp.float32),
+            preferred_element_type=jnp.float32)
+        # rotate K/V to the next device (wasted on the final step, but the
+        # loop stays uniform — XLA overlaps it with the block compute)
+        kc = lax.ppermute(kc, axis_name, perm)
+        vc = lax.ppermute(vc, axis_name, perm)
+        return m_new, l_new, acc_new, kc, vc
+
+    # initial accumulators must carry the same varying-axes type as the
+    # loop body's outputs (jax >= 0.7 shard_map vma check)
+    def _vary(x):
+        try:
+            return lax.pcast(x, axis_name, to="varying")
+        except (AttributeError, TypeError):
+            return lax.pvary(x, axis_name)
+
+    m0 = _vary(jnp.full((b, h, s_local, 1), NEG_INF, jnp.float32))
+    l0 = _vary(jnp.zeros((b, h, s_local, 1), jnp.float32))
+    acc0 = _vary(jnp.zeros((b, s_local, h, d), jnp.float32))
+    m, l, acc, _, _ = lax.fori_loop(0, n, body, (m0, l0, acc0, k, v))
+
+    l_safe = jnp.where(l == 0.0, 1.0, l)                     # [B, H, Sl, 1]
+    out = acc / l_safe.transpose(0, 2, 1, 3)
+    return out.astype(q.dtype)

@@ -85,6 +85,7 @@ class DistributedTrainer(Trainer):
         state = jax.device_put(state, engine.shardings())
 
         self.record_training_start()
+        extracted = None  # (params, state) pulled on the final-epoch save
         for epoch in range(start_epoch, self.num_epoch):
             perm = self._epoch_perm(epoch, len(X))
             Xs, Ys, S = shard_epoch_data(X, y, self.num_workers,
@@ -93,13 +94,17 @@ class DistributedTrainer(Trainer):
             self.history.append_epoch(loss=jax.device_get(losses))
             # cadence check BEFORE extract_model: the full-state device->host
             # transfer is expensive and must only happen on save epochs
+            extracted = None
             if manager is not None and self._should_checkpoint(epoch):
-                cp, cs = engine.extract_model(state)
-                manager.save(epoch, {"params": cp, "state": cs},
+                extracted = engine.extract_model(state)
+                manager.save(epoch, {"params": extracted[0],
+                                     "state": extracted[1]},
                              metadata={"epoch": epoch})
         self.record_training_stop()
 
-        params, mstate = engine.extract_model(state)
+        # the forced last-epoch save already pulled the final state
+        params, mstate = extracted if extracted is not None \
+            else engine.extract_model(state)
         trained = model.replace(params=params, state=mstate)
         self.master_model = trained
         return trained
