@@ -12,6 +12,7 @@ from distkeras_tpu.models.attention import (  # noqa: F401
     TransformerBlock, TransformerMLP)
 from distkeras_tpu.models.recurrent import (  # noqa: F401
     GRU, LSTM, Bidirectional)
+from distkeras_tpu.models.moe import MoE  # noqa: F401  (registers 'MoE')
 from distkeras_tpu.models import zoo  # noqa: F401
 from distkeras_tpu.models.serialization import (  # noqa: F401
     deserialize_model, load_model, save_model, serialize_model)

@@ -70,10 +70,17 @@ class RMSNorm(Layer):
 
 @register_layer
 class PositionalEmbedding(Layer):
-    """Learned absolute position embeddings added to a [B, S, D] input."""
+    """Learned absolute position embeddings added to a [B, S, D] input.
 
-    def __init__(self, max_len: int):
+    Under sequence parallelism the input holds one shard of the sequence, so
+    set ``seq_axis_name`` to the mesh axis the sequence is sharded over: the
+    layer then offsets into the table by ``axis_index * shard_len`` to use
+    GLOBAL positions (mirroring the RoPE handling in MultiHeadAttention).
+    """
+
+    def __init__(self, max_len: int, seq_axis_name: Optional[str] = None):
         self.max_len = int(max_len)
+        self.seq_axis_name = seq_axis_name
 
     def init(self, rng, input_shape):
         dim = input_shape[-1]
@@ -83,10 +90,25 @@ class PositionalEmbedding(Layer):
 
     def apply(self, params, state, x, *, training=False, rng=None):
         s = x.shape[1]
-        return x + params["embeddings"][:s][None].astype(x.dtype), state
+        if self.seq_axis_name:
+            # fail loudly if the table can't cover the GLOBAL sequence —
+            # dynamic_slice would silently clamp out-of-range shard starts
+            global_len = s * jax.lax.axis_size(self.seq_axis_name)
+            if global_len > self.max_len:
+                raise ValueError(
+                    f"PositionalEmbedding(max_len={self.max_len}) is too "
+                    f"small for global sequence length {global_len} "
+                    f"({s} per shard over axis '{self.seq_axis_name}')")
+            start = jax.lax.axis_index(self.seq_axis_name) * s
+            emb = jax.lax.dynamic_slice_in_dim(params["embeddings"],
+                                               start, s, axis=0)
+        else:
+            emb = params["embeddings"][:s]
+        return x + emb[None].astype(x.dtype), state
 
     def get_config(self):
-        return {"max_len": self.max_len}
+        return {"max_len": self.max_len,
+                "seq_axis_name": self.seq_axis_name}
 
 
 def _attention_compute(q, k, v, *, causal, impl, axis_name=None):
@@ -130,15 +152,17 @@ class MultiHeadAttention(Layer):
 
     def init(self, rng, input_shape):
         d_model = input_shape[-1]
-        dh = self.head_dim or d_model // self.num_heads
+        h, dh = self.num_heads, self.head_dim or d_model // self.num_heads
         ks = jax.random.split(rng, 4)
-        shape = (d_model, self.num_heads, dh)
+        # initialize as the LOGICAL 2D matrices and reshape: the generic
+        # fan rules would treat [d_model, H, Dh] as a conv kernel and
+        # inflate both fans by the leading axis, shrinking the init scale
+        w2d = lambda k, m, n: init_weights(self.kernel_init, k, (m, n))
         params = {
-            "wq": init_weights(self.kernel_init, ks[0], shape),
-            "wk": init_weights(self.kernel_init, ks[1], shape),
-            "wv": init_weights(self.kernel_init, ks[2], shape),
-            "wo": init_weights(self.kernel_init, ks[3],
-                               (self.num_heads, dh, d_model)),
+            "wq": w2d(ks[0], d_model, h * dh).reshape(d_model, h, dh),
+            "wk": w2d(ks[1], d_model, h * dh).reshape(d_model, h, dh),
+            "wv": w2d(ks[2], d_model, h * dh).reshape(d_model, h, dh),
+            "wo": w2d(ks[3], h * dh, d_model).reshape(h, dh, d_model),
         }
         return params, {}, tuple(input_shape)
 
@@ -246,7 +270,10 @@ class TransformerBlock(Layer):
 
     def init(self, rng, input_shape):
         d_model = input_shape[-1]
-        if self.mlp is None:
+        if self._mlp_override is None:
+            # re-resolve on every init: the hidden dim tracks d_model, so a
+            # block instance re-initialized at a different width must not
+            # keep the previous width's MLP
             self.mlp = TransformerMLP(self.mlp_ratio * d_model,
                                       activation=self.activation,
                                       dtype=self.dtype)
@@ -270,18 +297,21 @@ class TransformerBlock(Layer):
             return self._dropout.apply({}, {}, y, training=training,
                                        rng=key)[0]
 
+        # independent keys per consumer: an rng-consuming mlp_layer must not
+        # derive keys that collide with the block's own dropout keys
+        k_drop1 = k_mlp = k_drop2 = None
+        if rng is not None:
+            k_drop1, k_mlp, k_drop2 = jax.random.split(rng, 3)
         use_dropout = self.dropout_rate and training and rng is not None
         if use_dropout:
-            rng, sub = jax.random.split(rng)
-            a = drop(a, sub)
+            a = drop(a, k_drop1)
         x = x + a
         h, new_state["norm2"] = self.norm2.apply(
             params["norm2"], state["norm2"], x, training=training)
         m, new_state["mlp"] = self.mlp.apply(
-            params["mlp"], state["mlp"], h, training=training, rng=rng)
+            params["mlp"], state["mlp"], h, training=training, rng=k_mlp)
         if use_dropout:
-            rng, sub = jax.random.split(rng)
-            m = drop(m, sub)
+            m = drop(m, k_drop2)
         return x + m, new_state
 
     def get_config(self):

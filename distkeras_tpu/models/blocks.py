@@ -17,10 +17,6 @@ from distkeras_tpu.models.core import (Layer, Sequential, layer_from_spec,
                                        layer_spec, register_layer)
 from distkeras_tpu.models.layers import get_activation
 
-# retained aliases (pre-refactor internal names)
-_layer_spec = layer_spec
-_layer_from_spec = layer_from_spec
-
 
 @register_layer
 class Residual(Layer):
@@ -33,9 +29,9 @@ class Residual(Layer):
     def __init__(self, main: Layer = None, shortcut: Optional[Layer] = None,
                  activation: Optional[str] = "relu", main_spec=None,
                  shortcut_spec=None):
-        self.main = main if main is not None else _layer_from_spec(main_spec)
+        self.main = main if main is not None else layer_from_spec(main_spec)
         self.shortcut = (shortcut if shortcut is not None
-                         else _layer_from_spec(shortcut_spec))
+                         else layer_from_spec(shortcut_spec))
         self.activation = activation
 
     def init(self, rng, input_shape):
@@ -70,8 +66,8 @@ class Residual(Layer):
         return out, {"main": sm, "shortcut": ss}
 
     def get_config(self):
-        return {"main_spec": _layer_spec(self.main),
-                "shortcut_spec": _layer_spec(self.shortcut),
+        return {"main_spec": layer_spec(self.main),
+                "shortcut_spec": layer_spec(self.shortcut),
                 "activation": self.activation}
 
 

@@ -124,19 +124,11 @@ class Sequential(Layer):
         return x, new_state
 
     def get_config(self):
-        return {
-            "layers": [
-                {"class": l.name, "config": l.get_config()} for l in self.layers
-            ]
-        }
+        return {"layers": [layer_spec(l) for l in self.layers]}
 
     @classmethod
     def from_config(cls, config):
-        layers = [
-            LAYER_REGISTRY[spec["class"]].from_config(spec["config"])
-            for spec in config["layers"]
-        ]
-        return cls(layers)
+        return cls([layer_from_spec(spec) for spec in config["layers"]])
 
 
 class Model:

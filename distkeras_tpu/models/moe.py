@@ -66,8 +66,13 @@ class MoE(Layer):
         logits = jnp.einsum("bsd,de->bse", x.astype(jnp.float32),
                             gate.astype(jnp.float32))
         if self.top_k < self.num_experts:
-            kth = lax.top_k(logits, self.top_k)[0][..., -1:]
-            logits = jnp.where(logits >= kth, logits, -jnp.inf)
+            # mask from top_k INDICES, not a >= kth-value test: on tied
+            # logits the value test would admit every tied expert, breaking
+            # the exact-top-k contract
+            idxs = lax.top_k(logits, self.top_k)[1]
+            mask = jax.nn.one_hot(idxs, self.num_experts,
+                                  dtype=jnp.bool_).any(axis=-2)
+            logits = jnp.where(mask, logits, -jnp.inf)
         return jax.nn.softmax(logits, axis=-1)
 
     def apply(self, params, state, x, *, training=False, rng=None):

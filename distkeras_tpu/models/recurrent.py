@@ -154,9 +154,8 @@ class Bidirectional(Layer):
     def __init__(self, layer=None, **layer_config):
         if layer is None:
             # from_config path: rebuild from serialized sub-layer spec
-            from distkeras_tpu.models.core import LAYER_REGISTRY
-            spec = layer_config.pop("layer_spec")
-            layer = LAYER_REGISTRY[spec["class"]].from_config(spec["config"])
+            from distkeras_tpu.models.core import layer_from_spec
+            layer = layer_from_spec(layer_config.pop("layer_spec"))
         self.forward = layer
         import copy
         self.backward = copy.copy(layer)
@@ -181,5 +180,5 @@ class Bidirectional(Layer):
             {"forward": sf, "backward": sb}
 
     def get_config(self):
-        return {"layer_spec": {"class": self.forward.name,
-                               "config": self.forward.get_config()}}
+        from distkeras_tpu.models.core import layer_spec
+        return {"layer_spec": layer_spec(self.forward)}

@@ -152,7 +152,10 @@ def transformer_lm(vocab_size: int, d_model: int = 512, num_heads: int = 8,
     if not use_rope:
         if max_len is None:
             raise ValueError("max_len required when use_rope=False")
-        layers.append(PositionalEmbedding(max_len))
+        # thread the sequence axis through so positions are global under
+        # sequence parallelism (shard-local positions would be silently wrong)
+        layers.append(PositionalEmbedding(max_len,
+                                          seq_axis_name=seq_axis_name))
     for i in range(num_layers):
         mlp_layer = None
         if moe_every and num_experts and (i + 1) % moe_every == 0:

@@ -2,8 +2,16 @@
 
 from distkeras_tpu.ops.attention import (  # noqa: F401
     apply_rope, causal_mask, dot_product_attention)
-from distkeras_tpu.ops.flash_attention import flash_attention  # noqa: F401
 from distkeras_tpu.ops.ring_attention import ring_attention  # noqa: F401
+
+
+def __getattr__(name):
+    # lazy: keep the Pallas dependency off the common import path (losses/
+    # optimizer-only consumers, and jax builds without pallas)
+    if name == "flash_attention":
+        from distkeras_tpu.ops.flash_attention import flash_attention
+        return flash_attention
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 from distkeras_tpu.ops.losses import LOSSES, get_loss  # noqa: F401
 from distkeras_tpu.ops.metrics import METRICS, get_metric  # noqa: F401
 from distkeras_tpu.ops.optimizers import (  # noqa: F401

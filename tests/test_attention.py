@@ -11,6 +11,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from jax import shard_map
 
 from distkeras_tpu.models import Model, Sequential, TransformerBlock, zoo
+from distkeras_tpu.models.attention import MultiHeadAttention
 from distkeras_tpu.models.moe import MoE
 from distkeras_tpu.ops.attention import (apply_rope, causal_mask,
                                          dot_product_attention)
@@ -219,3 +220,70 @@ def test_transformer_block_serialization_roundtrip():
     y1, _ = model.module.apply(model.params, model.state, x)
     y2, _ = model2.module.apply(model2.params, model2.state, x)
     np.testing.assert_allclose(np.asarray(y1), np.asarray(y2), atol=1e-6)
+
+
+def test_moe_topk_exact_on_tied_logits():
+    # tied router logits (zero input -> all logits equal) must still
+    # activate exactly top_k experts, not every tied one
+    moe = MoE(8, 4, top_k=2)
+    params, _, _ = moe.init(jax.random.PRNGKey(8), (4,))
+    x = jnp.zeros((1, 4, 4))
+    probs = moe._gate_probs(x, params["gate"])
+    nonzero = (np.asarray(probs) > 0).sum(-1)
+    assert (nonzero == 2).all(), nonzero
+
+
+def test_transformer_block_reinit_tracks_d_model():
+    # re-initializing the same block instance at a different width must
+    # resize the auto-resolved MLP (regression: stale cached hidden_dim)
+    blk = TransformerBlock(num_heads=2, mlp_ratio=4)
+    Model.build(Sequential([blk]), (8, 16), seed=0)
+    assert blk.mlp.hidden_dim == 64
+    m2 = Model.build(Sequential([blk]), (8, 32), seed=0)
+    assert blk.mlp.hidden_dim == 128
+    assert m2.params[0]["mlp"]["w1"].shape == (32, 128)
+
+
+def test_positional_embedding_global_under_seq_sharding(devices):
+    from distkeras_tpu.models.attention import PositionalEmbedding
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    d, s, n = 4, 16, 8
+    pe_global = PositionalEmbedding(s)
+    pe_sharded = PositionalEmbedding(s, seq_axis_name="sp")
+    params, _, _ = pe_global.init(jax.random.PRNGKey(0), (s, d))
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, s, d))
+    ref, _ = pe_global.apply(params, {}, x)
+
+    mesh = Mesh(np.array(devices[:n]), ("sp",))
+    fn = jax.shard_map(
+        lambda p, xx: pe_sharded.apply(p, {}, xx)[0],
+        mesh=mesh, in_specs=(P(), P(None, "sp")), out_specs=P(None, "sp"))
+    out = jax.jit(fn)(params, x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-6)
+
+
+def test_attention_init_uses_logical_2d_fans():
+    # glorot limit must come from the logical (d_model, H*Dh) matrix, not
+    # conv-kernel fan rules over the 3D shape (regression: ~6x-too-small init)
+    mha = MultiHeadAttention(num_heads=8, head_dim=64)
+    params, _, _ = mha.init(jax.random.PRNGKey(0), (16, 512))
+    limit = np.sqrt(6.0 / (512 + 512))
+    wq = np.asarray(params["wq"])
+    assert wq.max() > 0.9 * limit, (wq.max(), limit)
+    assert abs(wq).max() <= limit * 1.0001
+
+
+def test_positional_embedding_undersized_table_raises(devices):
+    from distkeras_tpu.models.attention import PositionalEmbedding
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    pe = PositionalEmbedding(16, seq_axis_name="sp")  # global seq is 32
+    params, _, _ = pe.init(jax.random.PRNGKey(0), (32, 4))
+    x = jnp.zeros((1, 32, 4))
+    mesh = Mesh(np.array(devices[:8]), ("sp",))
+    fn = jax.shard_map(
+        lambda p, xx: pe.apply(p, {}, xx)[0],
+        mesh=mesh, in_specs=(P(), P(None, "sp")), out_specs=P(None, "sp"))
+    with pytest.raises(ValueError, match="too small"):
+        jax.jit(fn)(params, x)
