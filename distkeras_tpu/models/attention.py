@@ -90,7 +90,7 @@ class PositionalEmbedding(Layer):
 
     def apply(self, params, state, x, *, training=False, rng=None):
         s = x.shape[1]
-        if self.seq_axis_name:
+        if self.seq_axis_name and self._axis_bound():
             # fail loudly if the table can't cover the GLOBAL sequence —
             # dynamic_slice would silently clamp out-of-range shard starts
             global_len = s * jax.lax.axis_size(self.seq_axis_name)
@@ -105,6 +105,16 @@ class PositionalEmbedding(Layer):
         else:
             emb = params["embeddings"][:s]
         return x + emb[None].astype(x.dtype), state
+
+    def _axis_bound(self) -> bool:
+        """True when tracing inside a shard_map that binds the axis. Outside
+        (e.g. unsharded eval via model.predict) the input holds the FULL
+        sequence, so shard-local slicing is the correct behavior."""
+        try:
+            jax.lax.axis_size(self.seq_axis_name)
+            return True
+        except NameError:
+            return False
 
     def get_config(self):
         return {"max_len": self.max_len,

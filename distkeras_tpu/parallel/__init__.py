@@ -1,4 +1,5 @@
-"""Parallel layer: trainer hierarchy + device-mesh distributed engine."""
+"""Parallel layer: trainer hierarchy + device-mesh distributed engine +
+host-side parameter-server family (true-async / DCN fallback)."""
 
 from distkeras_tpu.parallel.distributed import (  # noqa: F401
     ADAG, AEASGD, DOWNPOUR, AveragingTrainer, DistributedTrainer, DynSGD,
@@ -6,3 +7,7 @@ from distkeras_tpu.parallel.distributed import (  # noqa: F401
 from distkeras_tpu.parallel.mesh import make_mesh, make_mesh_2d  # noqa: F401
 from distkeras_tpu.parallel.trainers import (  # noqa: F401
     EnsembleTrainer, SingleTrainer, Trainer)
+from distkeras_tpu.parallel.async_host import HostAsyncTrainer  # noqa: F401
+from distkeras_tpu.parallel.parameter_servers import (  # noqa: F401
+    ADAGParameterServer, DeltaParameterServer, DynSGDParameterServer,
+    EASGDParameterServer, ParameterServer, PSClient)

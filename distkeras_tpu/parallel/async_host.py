@@ -1,0 +1,231 @@
+"""True-async training: thread-per-worker against a parameter server.
+
+Reference parity: this IS the reference's concurrency model —
+``distkeras/workers.py :: NetworkWorker`` subclasses racing against the
+driver-side PS, with staleness arising from wall-clock scheduling rather
+than the SPMD engine's deterministic staggering (``parallel/engine.py``
+docstring). Use the engine for production throughput (one compiled program,
+ICI collectives); use this family to reproduce the reference's genuine
+async dynamics, to train across processes/hosts over DCN via the socket
+PS, or to exercise heterogeneous worker cadences for real.
+
+One worker = one Python thread driving its own model replica:
+
+    pull center -> K local jitted steps -> algorithm commit -> repeat
+
+On a multi-device host each worker's replica lives on its own device
+(``jax.device_put`` pins the carry; jit follows placement), so threads
+genuinely overlap device compute. The PS applies commits under its mutex,
+exactly serializing concurrent arrivals like the reference
+(``parameter_servers.py :: SocketParameterServer`` handler threads).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+import jax
+import numpy as np
+
+from distkeras_tpu.data.dataset import Dataset
+from distkeras_tpu.models.core import Model
+from distkeras_tpu.parallel.parameter_servers import (
+    ADAGParameterServer, DeltaParameterServer, DynSGDParameterServer,
+    EASGDParameterServer, ParameterServer, PSClient)
+from distkeras_tpu.parallel.trainers import Trainer
+from distkeras_tpu.parallel.worker import TrainCarry, make_train_step
+from distkeras_tpu.parallel.worker import shard_epoch_data
+
+_ALGORITHMS = ("downpour", "easgd", "dynsgd", "adag")
+
+
+class HostAsyncTrainer(Trainer):
+    """Asynchronous PS training with real thread-level concurrency.
+
+    ``algorithm`` selects the worker/server commit protocol (reference
+    worker classes in brackets):
+
+      * ``"downpour"`` — commit accumulated delta, pull fresh center
+        [``DOWNPOURWorker`` + ``DeltaParameterServer``]
+      * ``"easgd"``    — elastic difference exchange at own cadence
+        [``AEASGDWorker`` + EASGD server]
+      * ``"dynsgd"``   — delta commit tagged with last-pull clock; server
+        scales by 1/staleness [``DynSGDWorker`` + ``DynSGDParameterServer``]
+      * ``"adag"``     — delta commit; adaptive per-parameter server rule
+        [``ADAGWorker`` + ``ADAGParameterServer``]
+
+    ``transport="inprocess"`` calls the PS directly (one process, the
+    default); ``"socket"`` starts the PS on a TCP port and routes every
+    pull/commit through the framed wire protocol — the reference's exact
+    data path, useful as the DCN fallback and for protocol tests.
+
+    ``communication_window`` may be per-worker (list of K_i) to create REAL
+    heterogeneous cadences — the scenario DynSGD exists for.
+    """
+
+    def __init__(self, keras_model: Model, algorithm: str = "downpour",
+                 num_workers: Optional[int] = None,
+                 communication_window: Union[int, Sequence[int]] = 5,
+                 rho: float = 5.0, elastic_lr: float = 0.01,
+                 adag_learning_rate: float = 0.05,
+                 transport: str = "inprocess", **kwargs):
+        super().__init__(keras_model, **kwargs)
+        if algorithm not in _ALGORITHMS:
+            raise ValueError(f"algorithm must be one of {_ALGORITHMS}, "
+                             f"got {algorithm!r}")
+        if transport not in ("inprocess", "socket"):
+            raise ValueError(f"transport must be 'inprocess' or 'socket', "
+                             f"got {transport!r}")
+        self.algorithm = algorithm
+        self.num_workers = int(num_workers or len(jax.devices()))
+        self.communication_window = communication_window
+        self.alpha = float(rho) * float(elastic_lr)
+        self.adag_learning_rate = float(adag_learning_rate)
+        self.transport = transport
+        self.parameter_server: Optional[ParameterServer] = None
+
+    # -- PS allocation (reference: allocate_parameter_server) --------------
+    def allocate_parameter_server(self, params) -> ParameterServer:
+        if self.algorithm == "dynsgd":
+            return DynSGDParameterServer(params)
+        if self.algorithm == "adag":
+            return ADAGParameterServer(
+                params, learning_rate=self.adag_learning_rate)
+        if self.algorithm == "easgd":
+            return EASGDParameterServer(params)
+        return DeltaParameterServer(params)
+
+    def _windows(self) -> np.ndarray:
+        K = self.communication_window
+        if np.isscalar(K):
+            return np.full((self.num_workers,), int(K), np.int64)
+        Ks = np.asarray(K, np.int64)
+        if Ks.shape != (self.num_workers,):
+            raise ValueError(
+                f"communication_window must be scalar or length-"
+                f"{self.num_workers}, got shape {Ks.shape}")
+        return Ks
+
+    # -- the worker thread body (reference: *Worker.train) ------------------
+    def _worker_loop(self, widx: int, client: PSClient, device,
+                     step_fn, model: Model, Xw, Yw, K: int,
+                     out: Dict[int, Any], errors: List):
+        try:
+            leaves0, clock = client.pull()
+            treedef = jax.tree_util.tree_structure(model.params)
+            unflat = lambda ls: jax.tree_util.tree_unflatten(treedef, ls)
+            params = jax.device_put(unflat(leaves0), device)
+            carry = TrainCarry(
+                params,
+                jax.device_put(model.state, device),
+                jax.device_put(self.worker_optimizer.init(params), device),
+                jax.device_put(
+                    jax.random.PRNGKey(self.seed + 7919 * (widx + 1)),
+                    device))
+            pull_leaves = leaves0
+            losses = []
+            for s in range(Xw.shape[0]):
+                xb = jax.device_put(Xw[s], device)
+                yb = jax.device_put(Yw[s], device)
+                carry, loss = step_fn(carry, (xb, yb))
+                losses.append(loss)
+                if (s + 1) % K != 0:
+                    continue
+                w_leaves = [np.asarray(l)
+                            for l in jax.tree_util.tree_leaves(carry.params)]
+                if self.algorithm == "easgd":
+                    center, clock = client.pull()
+                    elastic = [self.alpha * (w - c)
+                               for w, c in zip(w_leaves, center)]
+                    new_w = [w - e for w, e in zip(w_leaves, elastic)]
+                    carry = carry._replace(
+                        params=jax.device_put(unflat(new_w), device))
+                    client.commit(elastic)
+                else:
+                    delta = [w - p for w, p in zip(w_leaves, pull_leaves)]
+                    client.commit(delta, clock=clock)
+                    pull_leaves, clock = client.pull()
+                    carry = carry._replace(
+                        params=jax.device_put(unflat(pull_leaves), device))
+            out[widx] = {
+                "losses": np.asarray(jax.device_get(losses)),
+                "state": jax.device_get(carry.state),
+                # uncommitted residual, flushed into the center post-join
+                "params": [np.asarray(l) for l in
+                           jax.tree_util.tree_leaves(carry.params)],
+                "pull": pull_leaves,
+            }
+        except Exception as e:  # surface thread failures to the caller
+            errors.append((widx, e))
+        finally:
+            client.close()
+
+    def train(self, dataset: Dataset) -> Model:
+        model = self.master_model
+        X, y = self._training_arrays(dataset)
+        n = self.num_workers
+        Ks = self._windows()
+        devices = jax.devices()
+
+        self.parameter_server = self.allocate_parameter_server(model.params)
+        self.parameter_server.initialize()
+        port = None
+        if self.transport == "socket":
+            port = self.parameter_server.start(host="127.0.0.1")
+
+        step_fn = jax.jit(make_train_step(model.module, self.loss,
+                                          self.worker_optimizer))
+
+        self.record_training_start()
+        try:
+            for epoch in range(0, self.num_epoch):
+                perm = self._epoch_perm(epoch, len(X))
+                Xs, Ys, S = shard_epoch_data(X, y, n, self.batch_size, perm)
+                out: Dict[int, Any] = {}
+                errors: List = []
+                threads = []
+                for i in range(n):
+                    client = (PSClient(host="127.0.0.1", port=port)
+                              if port is not None
+                              else PSClient(ps=self.parameter_server))
+                    t = threading.Thread(
+                        target=self._worker_loop,
+                        args=(i, client, devices[i % len(devices)], step_fn,
+                              model, Xs[:, i], Ys[:, i], int(Ks[i]), out,
+                              errors),
+                        daemon=True)
+                    t.start()
+                    threads.append(t)
+                for t in threads:
+                    t.join()
+                if errors:
+                    raise errors[0][1]
+                losses = np.stack([out[i]["losses"] for i in range(n)],
+                                  axis=1)
+                self.history.append_epoch(loss=losses)
+
+                # flush uncommitted partial-window residuals EVERY epoch —
+                # workers re-pull the center at the next epoch start, which
+                # would silently discard this progress otherwise (reference
+                # workers never reset mid-job, so they lose nothing)
+                if self.algorithm != "easgd":
+                    for i in range(n):
+                        delta = [w - p for w, p in zip(out[i]["params"],
+                                                       out[i]["pull"])]
+                        if any(np.any(d) for d in delta):
+                            self.parameter_server.handle_commit(
+                                {"delta": delta,
+                                 "clock": self.parameter_server.num_updates})
+        finally:
+            self.record_training_stop()
+            self.parameter_server.stop()
+
+        center = self.parameter_server.get_model()
+        mstate = jax.tree_util.tree_map(
+            lambda *xs: np.mean(np.stack(xs), axis=0)
+            if np.asarray(xs[0]).dtype.kind == "f" else xs[0],
+            *[out[i]["state"] for i in range(n)])
+        trained = model.replace(params=center, state=mstate)
+        self.master_model = trained
+        return trained

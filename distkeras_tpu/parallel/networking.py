@@ -1,0 +1,181 @@
+"""Host-side control-plane networking: framed messages over TCP.
+
+Reference parity: ``distkeras/networking.py`` (SURVEY §2.1) —
+``determine_host_address``, ``connect``, ``send_data``/``recv_data`` with
+length-prefixed pickle framing. In the reference this carried ALL gradient
+traffic (worker↔parameter-server pull/commit); here it is strictly a
+**control plane**: job submission (``deploy``), the socket parameter-server
+fallback for DCN-scale experiments, and daemon RPC. The data plane — every
+per-step gradient/weight exchange of the SPMD trainers — rides XLA
+collectives over ICI/DCN (``parallel/engine.py``), never these sockets
+(SURVEY §5.8 north star: zero socket-PS traffic).
+
+Differences from the reference, by design:
+  * an explicit magic + length + format header instead of bare pickled
+    frames, so a stray connection can't crash the server mid-unpickle;
+  * numpy arrays ship as raw buffers (zero pickle memo overhead) under
+    format tag ``NPY``; everything else is pickled (trusted-cluster
+    assumption, as in the reference);
+  * ``serve_forever`` helper with a clean shutdown path — the reference
+    unblocked its ``accept()`` loop with a self-connect trick
+    (``parameter_servers.py :: SocketParameterServer.stop`` [verify]); here
+    the listener socket is simply closed and the error swallowed.
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+import socket
+import struct
+import threading
+from typing import Any, Callable, Optional, Tuple
+
+import numpy as np
+
+MAGIC = b"DKT1"
+_FMT_PICKLE = 0
+_FMT_NPY = 1
+_HEADER = struct.Struct("!4sBQ")  # magic, format, payload length
+
+
+def determine_host_address() -> str:
+    """Best-effort routable address of this host (reference:
+    ``networking.py :: determine_host_address``). Opens a UDP socket to a
+    public address (no traffic is sent) and reads the chosen source addr;
+    falls back to localhost on isolated machines."""
+    try:
+        with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+            s.connect(("8.8.8.8", 80))
+            return s.getsockname()[0]
+    except OSError:
+        return "127.0.0.1"
+
+
+def connect(host: str, port: int, timeout: Optional[float] = None
+            ) -> socket.socket:
+    """TCP connect with Nagle disabled — control messages are small and
+    latency-bound (reference: ``networking.py :: connect``)."""
+    sock = socket.create_connection((host, port), timeout=timeout)
+    sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    return sock
+
+
+def _encode(obj: Any) -> Tuple[int, bytes]:
+    if isinstance(obj, np.ndarray) and obj.dtype != object:
+        buf = io.BytesIO()
+        np.save(buf, obj, allow_pickle=False)
+        return _FMT_NPY, buf.getvalue()
+    return _FMT_PICKLE, pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+
+
+def send_data(sock: socket.socket, obj: Any) -> None:
+    """Write one framed message (reference: ``networking.py :: send_data``)."""
+    fmt, payload = _encode(obj)
+    sock.sendall(_HEADER.pack(MAGIC, fmt, len(payload)) + payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    chunks = []
+    while n:
+        chunk = sock.recv(min(n, 1 << 20))
+        if not chunk:
+            raise ConnectionError("socket closed mid-frame")
+        chunks.append(chunk)
+        n -= len(chunk)
+    return b"".join(chunks)
+
+
+def recv_data(sock: socket.socket) -> Any:
+    """Read one framed message (reference: ``networking.py :: recv_data``)."""
+    magic, fmt, length = _HEADER.unpack(_recv_exact(sock, _HEADER.size))
+    if magic != MAGIC:
+        raise ValueError(f"bad frame magic {magic!r}")
+    payload = _recv_exact(sock, length)
+    if fmt == _FMT_NPY:
+        return np.load(io.BytesIO(payload), allow_pickle=False)
+    return pickle.loads(payload)
+
+
+class MessageServer:
+    """Threaded request/response server over framed messages.
+
+    The skeleton of both the socket parameter server and the punchcard-style
+    job daemon (reference: ``parameter_servers.py :: SocketParameterServer``'s
+    accept loop + per-connection handler threads). ``handler(msg) -> reply``
+    runs under no lock — handlers do their own synchronization; a handler
+    exception becomes an ``{"error": ...}`` reply instead of killing the
+    connection.
+
+    SECURITY: the payload format includes pickle, so a connected peer can
+    execute code in this process. The default bind is therefore localhost;
+    pass an explicit ``host`` (e.g. ``"0.0.0.0"``) only on a trusted-cluster
+    network — the same trust model as the reference's pickled-TCP protocol.
+    """
+
+    def __init__(self, handler: Callable[[Any], Any],
+                 host: str = "127.0.0.1", port: int = 0):
+        self._handler = handler
+        self._host, self._port = host, port
+        self._listener: Optional[socket.socket] = None
+        self._accept_thread: Optional[threading.Thread] = None
+        self._running = False
+
+    @property
+    def port(self) -> int:
+        if self._listener is None:
+            raise RuntimeError("server not started")
+        return self._listener.getsockname()[1]
+
+    def start(self) -> "MessageServer":
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((self._host, self._port))
+        self._listener.listen(128)
+        self._running = True
+        self._accept_thread = threading.Thread(target=self._accept_loop,
+                                               daemon=True)
+        self._accept_thread.start()
+        return self
+
+    def _accept_loop(self):
+        while self._running:
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                return  # listener closed by stop()
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            # per-connection threads are daemonized and self-terminating;
+            # holding references would only accumulate dead Thread objects
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             daemon=True).start()
+
+    def _serve_conn(self, conn: socket.socket):
+        try:
+            with conn:
+                while True:
+                    try:
+                        msg = recv_data(conn)
+                    except (ConnectionError, ValueError, OSError):
+                        return
+                    try:
+                        reply = self._handler(msg)
+                    except Exception as e:  # noqa: BLE001 — reply, don't die
+                        reply = {"error": f"{type(e).__name__}: {e}"}
+                    send_data(conn, reply)
+        except (BrokenPipeError, OSError):
+            return
+
+    def stop(self):
+        self._running = False
+        if self._listener is not None:
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+
+
+def request(sock: socket.socket, msg: Any) -> Any:
+    """One round-trip on an open connection."""
+    send_data(sock, msg)
+    return recv_data(sock)

@@ -1,0 +1,209 @@
+"""Parameter servers: central-state pull/commit services.
+
+Reference parity: ``distkeras/parameter_servers.py`` (SURVEY §2.1) —
+``ParameterServer`` (center model, ``num_updates``, mutex),
+``DeltaParameterServer``, ``ADAGParameterServer``, ``DynSGDParameterServer``,
+and the EASGD server, fronted by the pickled-TCP protocol in
+``networking.py``.
+
+Role in the TPU framework: the DEFAULT distributed path has **no parameter
+server at all** — the SPMD engine (``parallel/engine.py``) compiles the
+center into the training program and replaces pull/commit with masked ICI
+collectives. This module exists for the two cases a host-side center is
+still the right tool:
+
+  * **true-async training** across worker threads/processes whose step
+    cadence genuinely differs (``parallel/async_host.py``) — the reference's
+    actual concurrency model, where staleness arises from wall-clock races
+    rather than the engine's deterministic staggering;
+  * **DCN-scale fallback / job control**: coordination between hosts that
+    do not share an ICI domain, where a framed-TCP round-trip per window is
+    the honest transport.
+
+Update rules are host-side numpy on flat leaf lists (cheap O(params) adds;
+the heavy math stays on device in the workers). The wire protocol is the
+reference's dict shape — ``{'action': 'pull'}`` / ``{'action': 'commit',
+'delta': ...}`` — carried over framed messages.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from distkeras_tpu.parallel import networking
+
+Pytree = Any
+
+
+def _to_leaves(tree: Pytree) -> Tuple[List[np.ndarray], Any]:
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    # np.array(copy=True): views of jax arrays are read-only; the center
+    # must be writable for in-place commits
+    return [np.array(l, copy=True) for l in leaves], treedef
+
+
+class ParameterServer:
+    """Center state + update counter + mutex (reference:
+    ``parameter_servers.py :: ParameterServer``).
+
+    Subclasses implement ``handle_commit(payload)``; ``handle_pull`` is
+    shared. The center is stored as a flat list of numpy leaves plus the
+    treedef, so commits are plain array loops with no pytree traversal.
+    """
+
+    def __init__(self, center: Pytree):
+        self._leaves, self._treedef = _to_leaves(center)
+        self._lock = threading.Lock()
+        self.num_updates = 0
+        self._server: Optional[networking.MessageServer] = None
+
+    # -- lifecycle (reference: initialize/start/stop/get_model) ------------
+    def initialize(self) -> None:  # parity no-op; state built in __init__
+        pass
+
+    def start(self, host: str = "127.0.0.1", port: int = 0) -> int:
+        """Expose this PS over TCP; returns the bound port. Without a call
+        to ``start`` the PS is in-process only (pull/commit direct calls).
+
+        Binds localhost by default — the wire format includes pickle, so
+        pass a routable ``host`` only on a trusted-cluster network (see
+        ``networking.MessageServer``)."""
+        self._server = networking.MessageServer(self._dispatch, host, port)
+        self._server.start()
+        return self._server.port
+
+    def stop(self) -> None:
+        if self._server is not None:
+            self._server.stop()
+            self._server = None
+
+    def get_model(self) -> Pytree:
+        with self._lock:
+            leaves = [l.copy() for l in self._leaves]
+        return jax.tree_util.tree_unflatten(self._treedef, leaves)
+
+    # -- protocol ----------------------------------------------------------
+    def handle_pull(self) -> Tuple[List[np.ndarray], int]:
+        with self._lock:
+            return [l.copy() for l in self._leaves], self.num_updates
+
+    def handle_commit(self, payload: Dict[str, Any]) -> None:
+        raise NotImplementedError
+
+    def _dispatch(self, msg: Dict[str, Any]):
+        action = msg.get("action")
+        if action == "pull":
+            leaves, clock = self.handle_pull()
+            return {"center": leaves, "clock": clock}
+        if action == "commit":
+            self.handle_commit(msg)
+            return {"ok": True}
+        if action == "clock":
+            with self._lock:
+                return {"clock": self.num_updates}
+        return {"error": f"unknown action {action!r}"}
+
+
+class DeltaParameterServer(ParameterServer):
+    """``center += delta`` (reference: ``parameter_servers.py ::
+    DeltaParameterServer.handle_commit``) — DOWNPOUR / EASGD commits."""
+
+    def handle_commit(self, payload):
+        delta = payload["delta"]
+        with self._lock:
+            for c, d in zip(self._leaves, delta):
+                c += d
+            self.num_updates += 1
+
+
+class ADAGParameterServer(ParameterServer):
+    """Adaptive per-parameter accumulation (reference:
+    ``parameter_servers.py :: ADAGParameterServer``): commits are scaled by
+    an adagrad-style accumulator of committed deltas — the same rule as the
+    SPMD engine's ``AdagAlgo`` so both paths converge identically."""
+
+    def __init__(self, center: Pytree, learning_rate: float = 0.05,
+                 epsilon: float = 1e-8):
+        super().__init__(center)
+        self.learning_rate = float(learning_rate)
+        self.epsilon = float(epsilon)
+        self._acc = [np.zeros_like(l) for l in self._leaves]
+
+    def handle_commit(self, payload):
+        delta = payload["delta"]
+        with self._lock:
+            for c, a, d in zip(self._leaves, self._acc, delta):
+                a += np.square(d)
+                c += self.learning_rate * d / (np.sqrt(a) + self.epsilon)
+            self.num_updates += 1
+
+
+class DynSGDParameterServer(ParameterServer):
+    """Staleness-scaled commits (reference: ``parameter_servers.py ::
+    DynSGDParameterServer``; SURVEY §3.3): each commit carries the worker's
+    last-pull clock; the delta is scaled by 1/staleness."""
+
+    def handle_commit(self, payload):
+        delta, last_pull = payload["delta"], payload["clock"]
+        with self._lock:
+            staleness = max(1, self.num_updates - int(last_pull) + 1)
+            inv = 1.0 / staleness
+            for c, d in zip(self._leaves, delta):
+                c += d * inv
+            self.num_updates += 1
+
+
+class EASGDParameterServer(DeltaParameterServer):
+    """EASGD center: accumulates elastic differences committed by workers.
+    The commit payload IS the elastic term ``alpha * (x_i - center)``
+    (computed worker-side against its last view of the center), so the
+    server rule is the plain add of ``DeltaParameterServer`` — kept as its
+    own class for reference parity and synchronous-round bookkeeping."""
+
+
+class PSClient:
+    """Worker-side handle: pull/commit against an in-process PS object or a
+    remote socket PS (reference: the socket code inside ``workers.py ::
+    NetworkWorker``). Payloads are flat numpy leaf lists."""
+
+    def __init__(self, ps: Optional[ParameterServer] = None,
+                 host: Optional[str] = None, port: Optional[int] = None):
+        if (ps is None) == (host is None):
+            raise ValueError("pass exactly one of ps= or host=/port=")
+        self._ps = ps
+        self._sock = networking.connect(host, port) if host else None
+        self._lock = threading.Lock()  # one request in flight per client
+
+    @staticmethod
+    def _checked(reply):
+        if isinstance(reply, dict) and "error" in reply:
+            raise RuntimeError(f"parameter server error: {reply['error']}")
+        return reply
+
+    def pull(self) -> Tuple[List[np.ndarray], int]:
+        if self._ps is not None:
+            return self._ps.handle_pull()
+        with self._lock:
+            reply = self._checked(
+                networking.request(self._sock, {"action": "pull"}))
+        return reply["center"], reply["clock"]
+
+    def commit(self, delta: Sequence[np.ndarray],
+               clock: Optional[int] = None) -> None:
+        msg: Dict[str, Any] = {"action": "commit", "delta": list(delta)}
+        if clock is not None:
+            msg["clock"] = int(clock)
+        if self._ps is not None:
+            self._ps.handle_commit(msg)
+            return
+        with self._lock:
+            self._checked(networking.request(self._sock, msg))
+
+    def close(self):
+        if self._sock is not None:
+            self._sock.close()
+            self._sock = None

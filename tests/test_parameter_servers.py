@@ -1,0 +1,220 @@
+"""Parameter servers, framed networking, and the true-async trainer."""
+
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distkeras_tpu.data import Dataset
+from distkeras_tpu.models import Model, zoo
+from distkeras_tpu.parallel import (
+    ADAGParameterServer, DeltaParameterServer, DynSGDParameterServer,
+    HostAsyncTrainer, PSClient)
+from distkeras_tpu.parallel import networking
+
+
+# ---------------------------------------------------------------------------
+# networking: framing
+# ---------------------------------------------------------------------------
+
+def _echo_server():
+    server = networking.MessageServer(lambda msg: msg, host="127.0.0.1")
+    server.start()
+    return server
+
+
+def test_framed_roundtrip_pickle_and_npy():
+    server = _echo_server()
+    try:
+        sock = networking.connect("127.0.0.1", server.port)
+        obj = {"action": "commit", "delta": [1, 2, 3], "s": "x"}
+        assert networking.request(sock, obj) == obj
+        arr = np.arange(12, dtype=np.float32).reshape(3, 4)
+        out = networking.request(sock, arr)
+        np.testing.assert_array_equal(out, arr)
+        sock.close()
+    finally:
+        server.stop()
+
+
+def test_frame_rejects_bad_magic():
+    server = _echo_server()
+    try:
+        sock = networking.connect("127.0.0.1", server.port)
+        sock.sendall(b"JUNKJUNKJUNKJUNK")
+        # server drops the connection instead of crashing; further requests
+        # on a NEW connection still work
+        sock2 = networking.connect("127.0.0.1", server.port)
+        assert networking.request(sock2, {"ok": 1}) == {"ok": 1}
+        sock.close()
+        sock2.close()
+    finally:
+        server.stop()
+
+
+def test_determine_host_address_returns_ip():
+    addr = networking.determine_host_address()
+    assert isinstance(addr, str) and addr.count(".") == 3
+
+
+# ---------------------------------------------------------------------------
+# parameter servers: update rules
+# ---------------------------------------------------------------------------
+
+def _center():
+    return {"w": jnp.ones((2, 2)), "b": jnp.zeros((2,))}
+
+
+def test_delta_ps_accumulates_and_counts():
+    ps = DeltaParameterServer(_center())
+    client = PSClient(ps=ps)
+    leaves, clock = client.pull()
+    assert clock == 0
+    # leaf order = tree_flatten order (dict keys sorted: b, then w)
+    delta = [np.ones((2,)), np.full((2, 2), 0.5)]
+    client.commit(delta)
+    client.commit(delta)
+    got = ps.get_model()
+    np.testing.assert_allclose(np.asarray(got["w"]), 2.0)
+    np.testing.assert_allclose(np.asarray(got["b"]), 2.0)
+    assert ps.num_updates == 2
+
+
+def test_dynsgd_ps_scales_by_staleness():
+    ps = DynSGDParameterServer({"w": jnp.zeros(())})
+    # 3 foreign commits advance the clock
+    for _ in range(3):
+        ps.handle_commit({"delta": [np.asarray(0.0)], "clock": 0})
+    # a stale commit (pulled at clock 0, now clock 3): staleness 4
+    ps.handle_commit({"delta": [np.asarray(8.0)], "clock": 0})
+    np.testing.assert_allclose(np.asarray(ps.get_model()["w"]), 2.0)
+
+
+def test_adag_ps_normalizes_commits():
+    ps = ADAGParameterServer({"w": jnp.zeros(())}, learning_rate=0.1)
+    ps.handle_commit({"delta": [np.asarray(4.0)]})
+    # acc = 16, update = 0.1 * 4/sqrt(16) = 0.1
+    np.testing.assert_allclose(np.asarray(ps.get_model()["w"]), 0.1,
+                               rtol=1e-5)
+
+
+def test_ps_socket_transport_matches_inprocess():
+    ps = DeltaParameterServer(_center())
+    port = ps.start(host="127.0.0.1")
+    client = PSClient(host="127.0.0.1", port=port)
+    leaves, clock = client.pull()
+    np.testing.assert_allclose(leaves[1], 1.0)  # leaves = [b, w]
+    client.commit([np.zeros((2,)), np.full((2, 2), 1.0)])
+    leaves2, clock2 = client.pull()
+    np.testing.assert_allclose(leaves2[1], 2.0)
+    assert clock2 == 1
+    client.close()
+    ps.stop()
+
+
+def test_ps_concurrent_commits_all_land():
+    ps = DeltaParameterServer({"w": jnp.zeros(())})
+    n_threads, n_commits = 8, 25
+
+    def worker():
+        c = PSClient(ps=ps)
+        for _ in range(n_commits):
+            c.commit([np.asarray(1.0)])
+
+    ts = [threading.Thread(target=worker) for _ in range(n_threads)]
+    [t.start() for t in ts]
+    [t.join() for t in ts]
+    assert ps.num_updates == n_threads * n_commits
+    np.testing.assert_allclose(np.asarray(ps.get_model()["w"]),
+                               n_threads * n_commits)
+
+
+# ---------------------------------------------------------------------------
+# true-async trainer
+# ---------------------------------------------------------------------------
+
+def _toy_problem(n=512, d=10, c=3, seed=0):
+    rs = np.random.RandomState(seed)
+    w = rs.randn(d, c)
+    X = rs.randn(n, d).astype(np.float32)
+    Y = (X @ w).argmax(-1)
+    return Dataset({"features": X, "label": Y}), X, Y, d, c
+
+
+@pytest.mark.parametrize("algorithm", ["downpour", "easgd", "dynsgd", "adag"])
+def test_host_async_trainer_converges(algorithm):
+    ds, X, Y, d, c = _toy_problem()
+    model = Model.build(zoo.mlp((32,), num_classes=c), (d,), seed=1)
+    tr = HostAsyncTrainer(
+        model, algorithm=algorithm, num_workers=4, batch_size=16,
+        communication_window=4, num_epoch=4 if algorithm != "easgd" else 10,
+        worker_optimizer="sgd", optimizer_kwargs={"learning_rate": 0.1},
+        loss="sparse_categorical_crossentropy_from_logits")
+    trained = tr.train(ds)
+    losses = tr.get_history().losses()
+    assert np.isfinite(losses).all()
+    acc = (trained.predict(X).argmax(-1) == Y).mean()
+    assert acc > 0.6, (algorithm, acc)
+    assert tr.parameter_server.num_updates > 0
+
+
+def test_host_async_socket_transport_converges():
+    ds, X, Y, d, c = _toy_problem(seed=3)
+    model = Model.build(zoo.mlp((16,), num_classes=c), (d,), seed=1)
+    tr = HostAsyncTrainer(
+        model, algorithm="downpour", num_workers=2, batch_size=32,
+        communication_window=2, num_epoch=3, transport="socket",
+        worker_optimizer="sgd", optimizer_kwargs={"learning_rate": 0.1},
+        loss="sparse_categorical_crossentropy_from_logits")
+    trained = tr.train(ds)
+    acc = (trained.predict(X).argmax(-1) == Y).mean()
+    assert acc > 0.6, acc
+
+
+def test_host_async_heterogeneous_windows():
+    ds, X, Y, d, c = _toy_problem(seed=4)
+    model = Model.build(zoo.mlp((16,), num_classes=c), (d,), seed=1)
+    tr = HostAsyncTrainer(
+        model, algorithm="dynsgd", num_workers=4, batch_size=16,
+        communication_window=[1, 2, 4, 8], num_epoch=3,
+        worker_optimizer="sgd", optimizer_kwargs={"learning_rate": 0.1},
+        loss="sparse_categorical_crossentropy_from_logits")
+    trained = tr.train(ds)
+    assert np.isfinite(tr.get_history().losses()).all()
+    acc = (trained.predict(X).argmax(-1) == Y).mean()
+    assert acc > 0.6, acc
+
+
+def test_host_async_rejects_unknown_algorithm():
+    model = Model.build(zoo.mlp((8,), num_classes=2), (4,), seed=0)
+    with pytest.raises(ValueError, match="algorithm"):
+        HostAsyncTrainer(model, algorithm="sparkle")
+
+
+def test_ps_socket_handler_error_propagates():
+    ps = DeltaParameterServer({"w": jnp.zeros(())})
+    port = ps.start()
+    client = PSClient(host="127.0.0.1", port=port)
+    with pytest.raises(RuntimeError, match="parameter server error"):
+        # malformed commit: missing 'delta'
+        networking_reply = client._checked(
+            networking.request(client._sock, {"action": "commit"}))
+    client.close()
+    ps.stop()
+
+
+def test_host_async_window_longer_than_epoch_still_learns():
+    # window(8) > steps-per-epoch(4): progress lands via the per-epoch
+    # residual flush rather than in-window commits
+    ds, X, Y, d, c = _toy_problem(seed=5)
+    model = Model.build(zoo.mlp((16,), num_classes=c), (d,), seed=1)
+    tr = HostAsyncTrainer(
+        model, algorithm="downpour", num_workers=4, batch_size=32,
+        communication_window=8, num_epoch=4,
+        worker_optimizer="sgd", optimizer_kwargs={"learning_rate": 0.1},
+        loss="sparse_categorical_crossentropy_from_logits")
+    trained = tr.train(ds)
+    acc = (trained.predict(X).argmax(-1) == Y).mean()
+    assert acc > 0.6, acc
