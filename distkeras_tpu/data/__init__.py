@@ -6,3 +6,4 @@ from distkeras_tpu.data.transformers import (  # noqa: F401
     DenseTransformer, LabelIndexTransformer, MinMaxTransformer,
     OneHotTransformer, ReshapeTransformer, StandardScaleTransformer,
     Transformer)
+from distkeras_tpu.data import native  # noqa: F401

@@ -48,6 +48,24 @@ class Dataset:
         keys = records[0].keys()
         return cls({k: np.asarray([r[k] for r in records]) for k in keys})
 
+    @classmethod
+    def from_csv(cls, path, *, label_col_index: Optional[int] = None,
+                 sep: str = ",", skip_header: bool = False,
+                 features_col: str = "features",
+                 label_col: str = "label") -> "Dataset":
+        """Numeric CSV ingest (native strtof parser when available) — the
+        reference examples' ``spark.read.csv`` equivalent. When
+        ``label_col_index`` is given, that column becomes an integer label
+        column and the rest become the features matrix."""
+        from distkeras_tpu.data import native
+        data = native.read_csv(path, sep=sep, skip_header=skip_header)
+        if label_col_index is None:
+            return cls({features_col: data})
+        y = data[:, label_col_index].astype(np.int64)
+        X = np.ascontiguousarray(
+            np.delete(data, label_col_index, axis=1), dtype=np.float32)
+        return cls({features_col: X, label_col: y})
+
     # -- introspection ----------------------------------------------------
     @property
     def columns(self) -> List[str]:
@@ -88,9 +106,12 @@ class Dataset:
 
     def shuffle(self, seed: int = 0) -> "Dataset":
         """Reference parity: ``utils.shuffle(df)`` (rand column + sort).
-        Columnar equivalent: one permutation applied to every column."""
+        Columnar equivalent: one permutation applied to every column
+        (multithreaded native gather on large columns)."""
+        from distkeras_tpu.data import native
         perm = np.random.RandomState(seed).permutation(len(self))
-        return Dataset({k: v[perm] for k, v in self._columns.items()})
+        return Dataset({k: native.gather(v, perm)
+                        for k, v in self._columns.items()})
 
     def take(self, n: int) -> "Dataset":
         return Dataset({k: v[:n] for k, v in self._columns.items()})

@@ -41,14 +41,15 @@ class OneHotTransformer(Transformer):
         self.output_col = output_col
 
     def transform(self, dataset: Dataset) -> Dataset:
+        from distkeras_tpu.data import native
         labels = dataset[self.input_col].astype(np.int64).reshape(-1)
         if labels.size and (labels.min() < 0 or
                             labels.max() >= self.output_dim):
             raise ValueError(
                 f"labels out of range [0, {self.output_dim}): "
                 f"min={labels.min()}, max={labels.max()}")
-        eye = np.eye(self.output_dim, dtype=np.float32)
-        return dataset.with_column(self.output_col, eye[labels])
+        return dataset.with_column(
+            self.output_col, native.one_hot(labels, self.output_dim))
 
 
 class LabelIndexTransformer(Transformer):
@@ -93,16 +94,22 @@ class MinMaxTransformer(Transformer):
         self.output_col = output_col
 
     def transform(self, dataset: Dataset) -> Dataset:
+        from distkeras_tpu.data import native
         x = dataset[self.input_col].astype(np.float32)
-        i_min = np.float32(self.i_min if self.i_min is not None else x.min())
-        i_max = np.float32(self.i_max if self.i_max is not None else x.max())
-        span = i_max - i_min
-        if span == 0:
-            scaled = np.zeros_like(x)
-        else:
-            scaled = (x - i_min) / span
-        out = scaled * (self.o_max - self.o_min) + self.o_min
-        return dataset.with_column(self.output_col, out)
+        x2d = np.ascontiguousarray(x.reshape(len(x), -1))
+        if self.i_min is None or self.i_max is None:
+            mins, maxs = native.minmax_fit(x2d)
+        i_min = np.float32(self.i_min if self.i_min is not None
+                           else mins.min())
+        i_max = np.float32(self.i_max if self.i_max is not None
+                           else maxs.max())
+        # global-scalar range (reference semantics): broadcast the scalar
+        # over the per-column native rescale kernel
+        d = x2d.shape[1]
+        out = native.minmax_scale(
+            x2d, np.full((d,), i_min, np.float32),
+            np.full((d,), i_max, np.float32), self.o_min, self.o_max)
+        return dataset.with_column(self.output_col, out.reshape(x.shape))
 
 
 class ReshapeTransformer(Transformer):

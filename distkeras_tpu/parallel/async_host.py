@@ -161,12 +161,27 @@ class HostAsyncTrainer(Trainer):
         finally:
             client.close()
 
+    def _mean_state(self, out, n):
+        """Average non-differentiated model state over workers (float leaves
+        only; integer counters keep worker 0's value)."""
+        return jax.tree_util.tree_map(
+            lambda *xs: np.mean(np.stack(xs), axis=0)
+            if np.asarray(xs[0]).dtype.kind == "f" else xs[0],
+            *[out[i]["state"] for i in range(n)])
+
     def train(self, dataset: Dataset) -> Model:
         model = self.master_model
         X, y = self._training_arrays(dataset)
         n = self.num_workers
         Ks = self._windows()
         devices = jax.devices()
+
+        # resume restores the CENTER; workers restart from it (same
+        # semantics as DistributedTrainer / the reference's PS retry)
+        manager = self._checkpoint_manager()
+        tree, start_epoch = self._maybe_resume(
+            manager, {"params": model.params, "state": model.state})
+        model = model.replace(params=tree["params"], state=tree["state"])
 
         self.parameter_server = self.allocate_parameter_server(model.params)
         self.parameter_server.initialize()
@@ -179,7 +194,7 @@ class HostAsyncTrainer(Trainer):
 
         self.record_training_start()
         try:
-            for epoch in range(0, self.num_epoch):
+            for epoch in range(start_epoch, self.num_epoch):
                 perm = self._epoch_perm(epoch, len(X))
                 Xs, Ys, S = shard_epoch_data(X, y, n, self.batch_size, perm)
                 out: Dict[int, Any] = {}
@@ -217,15 +232,17 @@ class HostAsyncTrainer(Trainer):
                             self.parameter_server.handle_commit(
                                 {"delta": delta,
                                  "clock": self.parameter_server.num_updates})
+                if manager is not None and self._should_checkpoint(epoch):
+                    manager.save(
+                        epoch,
+                        {"params": self.parameter_server.get_model(),
+                         "state": self._mean_state(out, n)},
+                        metadata={"epoch": epoch})
         finally:
             self.record_training_stop()
             self.parameter_server.stop()
 
         center = self.parameter_server.get_model()
-        mstate = jax.tree_util.tree_map(
-            lambda *xs: np.mean(np.stack(xs), axis=0)
-            if np.asarray(xs[0]).dtype.kind == "f" else xs[0],
-            *[out[i]["state"] for i in range(n)])
-        trained = model.replace(params=center, state=mstate)
+        trained = model.replace(params=center, state=self._mean_state(out, n))
         self.master_model = trained
         return trained

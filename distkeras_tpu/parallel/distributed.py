@@ -84,12 +84,16 @@ class DistributedTrainer(Trainer):
                                   jax.random.PRNGKey(self.seed))
         state = jax.device_put(state, engine.shardings())
 
+        from distkeras_tpu.utils.prefetch import Prefetcher
+        assemble = lambda epoch: shard_epoch_data(
+            X, y, self.num_workers, self.batch_size,
+            self._epoch_perm(epoch, len(X)))
         self.record_training_start()
         extracted = None  # (params, state) pulled on the final-epoch save
-        for epoch in range(start_epoch, self.num_epoch):
-            perm = self._epoch_perm(epoch, len(X))
-            Xs, Ys, S = shard_epoch_data(X, y, self.num_workers,
-                                         self.batch_size, perm)
+        # next epoch's shuffle gather + [S, W, B, ...] stacking overlaps
+        # with this epoch's device step (utils/prefetch.py)
+        for epoch, (Xs, Ys, S) in Prefetcher(
+                assemble, range(start_epoch, self.num_epoch)):
             state, losses = engine.run_epoch(state, Xs, Ys)
             self.history.append_epoch(loss=jax.device_get(losses))
             # cadence check BEFORE extract_model: the full-state device->host

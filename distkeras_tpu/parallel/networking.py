@@ -139,11 +139,18 @@ class MessageServer:
         return self
 
     def _accept_loop(self):
+        import time
         while self._running:
             try:
                 conn, _ = self._listener.accept()
             except OSError:
-                return  # listener closed by stop()
+                if not self._running:
+                    return  # listener closed by stop()
+                # transient accept failure (ECONNABORTED, EMFILE under fd
+                # pressure, ...): keep serving — exiting here would leave a
+                # bound-but-unserved port and hang every future client
+                time.sleep(0.05)
+                continue
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             # per-connection threads are daemonized and self-terminating;
             # holding references would only accumulate dead Thread objects

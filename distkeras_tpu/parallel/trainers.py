@@ -162,10 +162,14 @@ class SingleTrainer(Trainer):
         carry = TrainCarry(params=tree["params"], state=tree["state"],
                            opt_state=tree["opt"], rng=tree["rng"])
 
+        from distkeras_tpu.utils.prefetch import Prefetcher
+        assemble = lambda epoch: stack_batches(
+            X, y, self.batch_size, self._epoch_perm(epoch, len(X)))
         self.record_training_start()
-        for epoch in range(start_epoch, self.num_epoch):
-            perm = self._epoch_perm(epoch, len(X))
-            Xs, Ys, n_steps = stack_batches(X, y, self.batch_size, perm)
+        # epoch e+1's shuffle gather + stacking runs while the device
+        # trains epoch e (utils/prefetch.py)
+        for epoch, (Xs, Ys, n_steps) in Prefetcher(
+                assemble, range(start_epoch, self.num_epoch)):
             carry, losses = runner(carry, Xs, Ys)
             self.history.append_epoch(loss=jax.device_get(losses))
             if manager is not None and self._should_checkpoint(epoch):

@@ -83,7 +83,8 @@ def shard_epoch_data(X, Y, num_workers: int, batch_size: int, perm=None):
     ``num_workers=1`` (see ``stack_batches``).
     """
     if perm is not None:
-        X, Y = X[perm], Y[perm]
+        from distkeras_tpu.data import native
+        X, Y = native.gather(X, perm), native.gather(Y, perm)
     per_step = num_workers * batch_size
     S = len(X) // per_step
     n = S * per_step

@@ -1,0 +1,98 @@
+"""Background epoch prefetching: overlap host data assembly with device
+compute.
+
+The reference gets pipelining for free from Spark (executors assemble the
+next partition while others train). Here the per-epoch host work — the
+permutation gather (``data/native.py``) and the ``[S, W, B, ...]`` stacking
+— runs on a worker thread one epoch ahead, so the accelerator never waits
+on the host between epochs.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, Iterable, Iterator, Tuple, TypeVar
+
+T = TypeVar("T")
+U = TypeVar("U")
+
+_SENTINEL = object()
+
+
+class Prefetcher:
+    """Iterate ``fn(item)`` over ``items`` with ``depth`` results computed
+    ahead on a background thread. Exceptions in ``fn`` re-raise (original
+    type) at the consuming ``next()`` call.
+
+    The producer thread is cleaned up on EVERY exit path: normal
+    exhaustion, consumer ``break``/exception (via ``GeneratorExit`` in the
+    iterator), explicit ``close()``, or context-manager exit. The producer
+    never blocks indefinitely on a full queue — its puts time out and
+    re-check the stop flag, so ``close()`` cannot deadlock.
+    """
+
+    def __init__(self, fn: Callable[[T], U], items: Iterable[T],
+                 depth: int = 1):
+        if depth < 1:
+            raise ValueError(f"depth must be >= 1, got {depth}")
+        self._fn = fn
+        self._items = list(items)
+        self._q: "queue.Queue" = queue.Queue(maxsize=depth)
+        self._stopped = threading.Event()
+        self._thread = threading.Thread(target=self._produce, daemon=True)
+        self._thread.start()
+
+    def _put(self, out) -> bool:
+        """Put with stop-flag polling; False means shutdown requested."""
+        while not self._stopped.is_set():
+            try:
+                self._q.put(out, timeout=0.05)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _produce(self):
+        for item in self._items:
+            if self._stopped.is_set():
+                return
+            try:
+                out = (item, self._fn(item), None)
+            except Exception as e:  # re-raised consumer-side
+                self._put((item, None, e))
+                return
+            if not self._put(out):
+                return
+        self._put(_SENTINEL)
+
+    def __iter__(self) -> Iterator[Tuple[T, U]]:
+        try:
+            while True:
+                got = self._q.get()
+                if got is _SENTINEL:
+                    return
+                item, value, err = got
+                if err is not None:
+                    raise err  # original type — callers match on it
+                yield item, value
+        finally:
+            # covers consumer break/exception (GeneratorExit) and normal end
+            self.close()
+
+    def __enter__(self) -> "Prefetcher":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def close(self):
+        """Stop the producer and reap its thread; idempotent, never blocks
+        indefinitely (the producer's puts poll the stop flag)."""
+        self._stopped.set()
+        while self._thread.is_alive():
+            try:  # drain so a blocked put can finish and observe the flag
+                self._q.get_nowait()
+            except queue.Empty:
+                pass
+            self._thread.join(timeout=0.05)

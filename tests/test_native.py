@@ -1,0 +1,164 @@
+"""Native C++ data kernels vs numpy fallback: identical results.
+
+The native path only engages above a size threshold, so these tests build
+arrays big enough to cross it (and also check the small-array fallback).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from distkeras_tpu.data import Dataset, native
+from distkeras_tpu.data.transformers import OneHotTransformer
+
+pytestmark = pytest.mark.skipif(
+    not native.native_available(),
+    reason=f"native library unavailable: {native.native_status()}")
+
+
+def test_native_builds_and_reports():
+    assert native.native_available()
+    assert "native" in native.native_status()
+
+
+def test_gather_matches_numpy_large_and_small():
+    rs = np.random.RandomState(0)
+    for n, d in ((50_000, 32), (64, 4)):  # above and below the threshold
+        src = rs.randn(n, d).astype(np.float32)
+        perm = rs.permutation(n)
+        np.testing.assert_array_equal(native.gather(src, perm), src[perm])
+
+
+def test_gather_multidim_and_integer_dtypes():
+    rs = np.random.RandomState(1)
+    src = rs.randint(0, 255, (30_000, 8, 8, 2)).astype(np.uint8)
+    perm = rs.permutation(len(src))
+    np.testing.assert_array_equal(native.gather(src, perm), src[perm])
+    src64 = rs.randint(0, 10, (40_000, 17)).astype(np.int64)
+    np.testing.assert_array_equal(native.gather(src64, perm[:40_000 // 2]),
+                                  src64[perm[:40_000 // 2]])
+
+
+def test_gather_rejects_out_of_range_perm():
+    src = np.zeros((50_000, 32), np.float32)
+    perm = np.arange(50_000)
+    perm[-1] = 50_000  # out of range
+    with pytest.raises(IndexError):
+        native.gather(src, perm)
+
+
+def test_one_hot_matches_numpy():
+    rs = np.random.RandomState(2)
+    labels = rs.randint(0, 100, (200_000,))
+    got = native.one_hot(labels, 100)
+    assert got.shape == (200_000, 100)
+    np.testing.assert_array_equal(got.argmax(-1), labels)
+    np.testing.assert_array_equal(got.sum(-1), 1.0)
+
+
+def test_minmax_fit_scale_matches_numpy():
+    rs = np.random.RandomState(3)
+    x = (rs.randn(60_000, 24) * 7 + 3).astype(np.float32)
+    x[:, 5] = 2.5  # degenerate column
+    mins, maxs = native.minmax_fit(x)
+    np.testing.assert_allclose(mins, x.min(0), rtol=1e-6)
+    np.testing.assert_allclose(maxs, x.max(0), rtol=1e-6)
+    out = native.minmax_scale(x, mins, maxs, 0.0, 1.0)
+    rng = x.max(0) - x.min(0)
+    rng[rng == 0] = 1
+    expect = (x - x.min(0)) / rng
+    expect[:, 5] = 0.0
+    np.testing.assert_allclose(out, expect, atol=1e-5)
+    assert out.min() >= -1e-6 and out.max() <= 1 + 1e-6
+
+
+def test_read_csv_native_and_header(tmp_path):
+    p = tmp_path / "data.csv"
+    rows = ["a,b,c", "1.5,2,3", "4,-5.25,6e1", "7,8,9"]
+    p.write_text("\n".join(rows) + "\n")
+    arr = native.read_csv(p, skip_header=True)
+    np.testing.assert_allclose(
+        arr, [[1.5, 2, 3], [4, -5.25, 60], [7, 8, 9]])
+
+
+def test_read_csv_rejects_garbage(tmp_path):
+    p = tmp_path / "bad.csv"
+    p.write_text("1,2,3\n4,x,6\n")
+    with pytest.raises(ValueError):
+        native.read_csv(p)
+
+
+def test_dataset_from_csv_with_label(tmp_path):
+    p = tmp_path / "ds.csv"
+    p.write_text("0,1.0,2.0\n1,3.0,4.0\n0,5.0,6.0\n")
+    ds = Dataset.from_csv(p, label_col_index=0)
+    np.testing.assert_array_equal(ds["label"], [0, 1, 0])
+    np.testing.assert_allclose(ds["features"],
+                               [[1, 2], [3, 4], [5, 6]])
+
+
+def test_dataset_shuffle_uses_gather_and_is_consistent():
+    rs = np.random.RandomState(4)
+    ds = Dataset({"features": rs.randn(30_000, 40).astype(np.float32),
+                  "label": rs.randint(0, 5, 30_000)})
+    sh = ds.shuffle(seed=7)
+    # same permutation applied to every column
+    perm = np.random.RandomState(7).permutation(len(ds))
+    np.testing.assert_array_equal(sh["label"], ds["label"][perm])
+    np.testing.assert_array_equal(sh["features"], ds["features"][perm])
+
+
+def test_onehot_transformer_native_path():
+    labels = np.random.RandomState(5).randint(0, 10, (150_000,))
+    ds = Dataset({"label": labels})
+    out = OneHotTransformer(10).transform(ds)
+    np.testing.assert_array_equal(out["label_encoded"].argmax(-1), labels)
+
+
+def test_prefetcher_orders_and_propagates_errors():
+    from distkeras_tpu.utils.prefetch import Prefetcher
+
+    got = list(Prefetcher(lambda i: i * i, range(6)))
+    assert got == [(i, i * i) for i in range(6)]
+
+    def boom(i):
+        if i == 2:
+            raise ValueError("boom")
+        return i
+
+    items = []
+    with pytest.raises(ValueError, match="boom"):
+        for item, val in Prefetcher(boom, range(5)):
+            items.append(item)
+    assert items == [0, 1]
+
+
+def test_prefetcher_cleans_up_on_break_and_close():
+    import threading
+    from distkeras_tpu.utils.prefetch import Prefetcher
+
+    before = threading.active_count()
+    pf = Prefetcher(lambda i: i, range(100))
+    for item, val in pf:
+        if item == 3:
+            break  # GeneratorExit path must reap the producer
+    pf.close()  # and explicit close is idempotent, never deadlocks
+    deadline = 50
+    while threading.active_count() > before and deadline:
+        import time; time.sleep(0.02); deadline -= 1
+    assert threading.active_count() <= before
+
+
+def test_minmax_transformer_matches_reference_semantics():
+    from distkeras_tpu.data.transformers import MinMaxTransformer
+    rs = np.random.RandomState(6)
+    x = (rs.rand(2000, 7) * 255).astype(np.float32)
+    ds = Dataset({"features": x})
+    out = MinMaxTransformer(0.0, 1.0).transform(ds)["features_normalized"]
+    expect = (x - x.min()) / (x.max() - x.min())
+    np.testing.assert_allclose(out, expect, atol=1e-5)
+    # explicit range (the MNIST 0..255 usage)
+    out2 = MinMaxTransformer(0.0, 1.0, i_min=0.0, i_max=255.0) \
+        .transform(ds)["features_normalized"]
+    np.testing.assert_allclose(out2, x / 255.0, atol=1e-5)

@@ -218,3 +218,24 @@ def test_host_async_window_longer_than_epoch_still_learns():
     trained = tr.train(ds)
     acc = (trained.predict(X).argmax(-1) == Y).mean()
     assert acc > 0.6, acc
+
+
+def test_host_async_checkpoint_and_resume(tmp_path):
+    ds, X, Y, d, c = _toy_problem(seed=6)
+    model = Model.build(zoo.mlp((16,), num_classes=c), (d,), seed=1)
+    kwargs = dict(
+        algorithm="downpour", num_workers=2, batch_size=32,
+        communication_window=2, worker_optimizer="sgd",
+        optimizer_kwargs={"learning_rate": 0.1},
+        loss="sparse_categorical_crossentropy_from_logits",
+        checkpoint_dir=str(tmp_path))
+    tr = HostAsyncTrainer(model, num_epoch=2, **kwargs)
+    tr.train(ds)
+
+    # resume continues from epoch 2 (history only has the remaining epochs)
+    model2 = Model.build(zoo.mlp((16,), num_classes=c), (d,), seed=1)
+    tr2 = HostAsyncTrainer(model2, num_epoch=4, resume=True, **kwargs)
+    trained = tr2.train(ds)
+    assert tr2.get_history().losses().shape[0] > 0
+    acc = (trained.predict(X).argmax(-1) == Y).mean()
+    assert acc > 0.6, acc
