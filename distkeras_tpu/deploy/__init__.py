@@ -1,0 +1,7 @@
+"""Job deployment layer (reference: ``distkeras/job_deployment.py`` +
+``distkeras/punchcard.py``, SURVEY §2.1 L0)."""
+
+from distkeras_tpu.deploy.job import (  # noqa: F401
+    Job, JobResult, JobSpec, initialize_from_env, ssh_commands)
+from distkeras_tpu.deploy.punchcard import (  # noqa: F401
+    Punchcard, PunchcardClient)

@@ -1,0 +1,187 @@
+"""Multi-host job deployment.
+
+Reference parity: ``distkeras/job_deployment.py :: Job`` packages a training
+script and submits it to a remote Spark cluster over SSH + ``spark-submit``
+(SURVEY §2.1 L0). The TPU-native equivalent launches one Python process per
+host participating in a ``jax.distributed`` coordination domain:
+
+  * ``Job.run()`` — LOCAL multi-process launch: N worker processes on this
+    machine, each a JAX process in the same coordination service (the
+    test/dev analogue of the reference's ``local[*]`` Spark master, and the
+    pattern SURVEY §4 prescribes for exercising multi-host behavior without
+    a pod).
+  * ``ssh_commands(spec, hosts)`` — the per-host command lines for a real
+    TPU pod slice, where host i runs the same script under its own
+    ``DKT_PROCESS_ID``. Execution transport (ssh loop, k8s, gcloud) is the
+    operator's; the reference's embedded SSH client is deliberately not
+    reproduced (no credentials handling inside the framework).
+
+Worker processes bootstrap with ``initialize_from_env()``, which reads the
+``DKT_*`` variables this module sets and calls
+``jax.distributed.initialize`` — XLA's coordination service (Gloo/DCN)
+plays the role Spark's driver-executor RPC played in the reference.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+ENV_COORD = "DKT_COORDINATOR"
+ENV_NUM_PROCS = "DKT_NUM_PROCESSES"
+ENV_PROC_ID = "DKT_PROCESS_ID"
+ENV_DEVICES_PER_PROC = "DKT_DEVICES_PER_PROCESS"
+
+
+def initialize_from_env() -> Dict[str, int]:
+    """Bootstrap a worker process from ``DKT_*`` env (call FIRST, before
+    any other jax use). On CPU hosts, honors ``DKT_DEVICES_PER_PROCESS``
+    virtual devices. Returns ``{"process_id": ..., "num_processes": ...}``.
+
+    No-op (single-process) when the env is absent, so the same training
+    script runs standalone and deployed.
+    """
+    coord = os.environ.get(ENV_COORD)
+    if coord is None:
+        return {"process_id": 0, "num_processes": 1}
+    n = int(os.environ[ENV_NUM_PROCS])
+    pid = int(os.environ[ENV_PROC_ID])
+    dev = os.environ.get(ENV_DEVICES_PER_PROC)
+    if dev:
+        # the spec is explicit: REPLACE any inherited device-count flag
+        # (e.g. leaked from a parent test process) rather than defer to it
+        import re
+        flags = os.environ.get("XLA_FLAGS", "")
+        flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                       flags)
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={dev}"
+        ).strip()
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    else:
+        import jax
+    jax.distributed.initialize(coordinator_address=coord,
+                               num_processes=n, process_id=pid)
+    return {"process_id": pid, "num_processes": n}
+
+
+@dataclass
+class JobSpec:
+    """A deployable training job (reference: the ``Job`` constructor args —
+    script, cluster params, resources)."""
+    script: str                       # path to the python entry script
+    args: Sequence[str] = ()
+    num_processes: int = 1
+    devices_per_process: Optional[int] = None  # CPU-virtual; None = real
+    coordinator_port: int = 0         # 0 = pick a free port
+    env: Dict[str, str] = field(default_factory=dict)
+    name: str = "dkt-job"
+    timeout: Optional[float] = None   # seconds; None = no limit
+
+    def to_dict(self) -> Dict:
+        return {"script": self.script, "args": list(self.args),
+                "num_processes": self.num_processes,
+                "devices_per_process": self.devices_per_process,
+                "coordinator_port": self.coordinator_port,
+                "env": dict(self.env), "name": self.name,
+                "timeout": self.timeout}
+
+    @classmethod
+    def from_dict(cls, d: Dict) -> "JobSpec":
+        return cls(**d)
+
+
+@dataclass
+class JobResult:
+    name: str
+    returncodes: List[int]
+    logs: List[str]          # per-process combined stdout/stderr
+    wall_seconds: float
+
+    @property
+    def ok(self) -> bool:
+        return all(rc == 0 for rc in self.returncodes)
+
+
+def _free_port() -> int:
+    import socket
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _worker_env(spec: JobSpec, coord: str, pid: int) -> Dict[str, str]:
+    env = dict(os.environ)
+    env.update(spec.env)
+    env[ENV_COORD] = coord
+    env[ENV_NUM_PROCS] = str(spec.num_processes)
+    env[ENV_PROC_ID] = str(pid)
+    if spec.devices_per_process:
+        env[ENV_DEVICES_PER_PROC] = str(spec.devices_per_process)
+    return env
+
+
+class Job:
+    """Run a ``JobSpec`` as N local worker processes (reference:
+    ``job_deployment.py :: Job.run``, with the Spark cluster replaced by a
+    ``jax.distributed`` coordination domain on this host)."""
+
+    def __init__(self, spec: JobSpec):
+        self.spec = spec
+
+    def run(self) -> JobResult:
+        spec = self.spec
+        port = spec.coordinator_port or _free_port()
+        coord = f"127.0.0.1:{port}"
+        t0 = time.perf_counter()
+        procs = []
+        for pid in range(spec.num_processes):
+            procs.append(subprocess.Popen(
+                [sys.executable, spec.script, *spec.args],
+                env=_worker_env(spec, coord, pid),
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True))
+        logs, rcs = [], []
+        deadline = (time.perf_counter() + spec.timeout
+                    if spec.timeout else None)
+        for p in procs:
+            remain = (max(0.1, deadline - time.perf_counter())
+                      if deadline else None)
+            try:
+                out, _ = p.communicate(timeout=remain)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                out, _ = p.communicate()
+                out = (out or "") + "\n[killed: job timeout]"
+            logs.append(out or "")
+            rcs.append(p.returncode)
+        return JobResult(spec.name, rcs, logs,
+                         time.perf_counter() - t0)
+
+
+def ssh_commands(spec: JobSpec, hosts: Sequence[str],
+                 coordinator_host: Optional[str] = None,
+                 python: str = "python3") -> List[str]:
+    """Per-host launch lines for a real multi-host deployment (one JAX
+    process per host). The operator runs line i on ``hosts[i]`` (ssh, k8s
+    exec, gcloud compute tpus ... ssh); the framework stays out of the
+    credential path, unlike the reference's embedded SSH submission."""
+    if not hosts:
+        raise ValueError("need at least one host")
+    coord_host = coordinator_host or hosts[0]
+    port = spec.coordinator_port or 29500
+    cmds = []
+    for pid, host in enumerate(hosts):
+        envs = {**spec.env,
+                ENV_COORD: f"{coord_host}:{port}",
+                ENV_NUM_PROCS: str(len(hosts)),
+                ENV_PROC_ID: str(pid)}
+        env_str = " ".join(f"{k}={v}" for k, v in sorted(envs.items()))
+        arg_str = " ".join([spec.script, *spec.args])
+        cmds.append(f"{env_str} {python} {arg_str}")
+    return cmds

@@ -28,7 +28,7 @@ from distkeras_tpu.data.dataset import Dataset
 from distkeras_tpu.models.core import Model
 from distkeras_tpu.parallel.engine import (
     AdagAlgo, AveragingAlgo, DistAlgorithm, DistributedEngine, DownpourAlgo,
-    DynSGDAlgo, ElasticAlgo, EngineConfig, shard_epoch_data)
+    DynSGDAlgo, ElasticAlgo, EngineConfig, host_fetch, shard_epoch_data)
 from distkeras_tpu.parallel.mesh import make_mesh
 from distkeras_tpu.parallel.trainers import Trainer
 
@@ -95,15 +95,16 @@ class DistributedTrainer(Trainer):
         for epoch, (Xs, Ys, S) in Prefetcher(
                 assemble, range(start_epoch, self.num_epoch)):
             state, losses = engine.run_epoch(state, Xs, Ys)
-            self.history.append_epoch(loss=jax.device_get(losses))
+            self.history.append_epoch(loss=host_fetch(losses))
             # cadence check BEFORE extract_model: the full-state device->host
             # transfer is expensive and must only happen on save epochs
             extracted = None
             if manager is not None and self._should_checkpoint(epoch):
                 extracted = engine.extract_model(state)
-                manager.save(epoch, {"params": extracted[0],
-                                     "state": extracted[1]},
-                             metadata={"epoch": epoch})
+                if jax.process_index() == 0:  # one writer per checkpoint
+                    manager.save(epoch, {"params": extracted[0],
+                                         "state": extracted[1]},
+                                 metadata={"epoch": epoch})
         self.record_training_stop()
 
         # the forced last-epoch save already pulled the final state

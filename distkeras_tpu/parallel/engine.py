@@ -61,6 +61,24 @@ def _tmap(f, *trees):
     return jax.tree_util.tree_map(f, *trees)
 
 
+def host_fetch(tree: Pytree) -> Pytree:
+    """``device_get`` that also works under multi-process ``jax.distributed``
+    (deploy.Job): leaves whose shards live on other hosts are allgathered to
+    every process (DCN), replicated/addressable leaves fetch directly."""
+    if jax.process_count() == 1:
+        return jax.device_get(tree)
+    from jax.experimental import multihost_utils
+
+    def fetch(x):
+        if not isinstance(x, jax.Array):
+            return np.asarray(x)
+        if x.is_fully_addressable:
+            return np.asarray(jax.device_get(x))
+        return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+
+    return _tmap(fetch, tree)
+
+
 def _select(mask, a, b):
     """Pytree-wise ``where(mask, a, b)`` with a scalar bool mask."""
     return _tmap(lambda x, y: jnp.where(mask, x, y), a, b)
@@ -423,7 +441,7 @@ class DistributedEngine:
     def extract_model(self, state: Dict) -> Tuple[Pytree, Pytree]:
         """Final (params, model_state): algorithm-flushed center params +
         worker-averaged model state (BN stats etc.)."""
-        host = jax.device_get(state)
+        host = host_fetch(state)
         center = self.algo.finalize(
             host["center"]["params"], host["worker"]["params"],
             host["worker"]["pull"], self.config.num_workers)

@@ -81,9 +81,27 @@ class Trainer:
     def _maybe_resume(self, manager, template):
         """Restore the checkpointed tree (same structure as ``template``).
         Returns ``(tree, start_epoch)``; the step is fixed once so weights
-        and metadata always come from the SAME checkpoint."""
+        and metadata always come from the SAME checkpoint.
+
+        Multi-process: only process 0 reads (it is also the only writer —
+        see the save path), and the restored tree + start epoch broadcast
+        to every process, so resume stays consistent even when
+        ``checkpoint_dir`` is host-local disk."""
         if manager is None or not self.resume:
             return template, 0
+        if jax.process_count() > 1:
+            tree, start = template, 0
+            if jax.process_index() == 0:
+                tree, start = self._restore_local(manager, template)
+            from jax.experimental import multihost_utils
+            tree = multihost_utils.broadcast_one_to_all(tree)
+            start = int(multihost_utils.broadcast_one_to_all(
+                np.int32(start)))
+            return jax.device_get(tree), start
+        return self._restore_local(manager, template)
+
+    @staticmethod
+    def _restore_local(manager, template):
         latest = manager.latest_step()
         if latest is None:
             return template, 0

@@ -1,0 +1,202 @@
+"""Job deployment + punchcard daemon tests (multi-process jax.distributed
+on virtual CPU devices — the SURVEY §4 'local[*]'-style pattern)."""
+
+import os
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+from distkeras_tpu.deploy import (Job, JobSpec, Punchcard, PunchcardClient,
+                                  initialize_from_env, ssh_commands)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _write(tmp_path, name, body):
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(body))
+    return str(p)
+
+
+def test_initialize_from_env_noop_without_env():
+    assert initialize_from_env() == {"process_id": 0, "num_processes": 1}
+
+
+def test_job_runs_multiprocess_psum(tmp_path):
+    script = _write(tmp_path, "worker.py", """
+        from distkeras_tpu.deploy import initialize_from_env
+        info = initialize_from_env()
+        import jax, jax.numpy as jnp, numpy as np
+        from jax.sharding import Mesh, PartitionSpec as P
+        mesh = Mesh(np.array(jax.devices()).reshape(-1), ("w",))
+        total = jax.shard_map(lambda a: jax.lax.psum(a, "w"), mesh=mesh,
+                              in_specs=P("w"), out_specs=P())(
+            jnp.arange(float(jax.device_count())))
+        print(f"RESULT {info['process_id']} {float(total[0])}")
+    """)
+    spec = JobSpec(script=script, num_processes=2, devices_per_process=2,
+                   env={"PYTHONPATH": REPO}, timeout=240)
+    result = Job(spec).run()
+    assert result.ok, result.logs
+    # 4 global devices -> psum(0+1+2+3) = 6 on every process
+    for pid, log in enumerate(result.logs):
+        assert f"RESULT {pid} 6.0" in log, log
+
+
+def test_job_timeout_kills(tmp_path):
+    script = _write(tmp_path, "hang.py", """
+        import time
+        time.sleep(60)
+    """)
+    result = Job(JobSpec(script=script, num_processes=1, timeout=2)).run()
+    assert not result.ok
+    assert "killed: job timeout" in result.logs[0]
+
+
+def test_ssh_commands_one_line_per_host():
+    spec = JobSpec(script="train.py", args=["--epochs", "3"],
+                   coordinator_port=29500)
+    cmds = ssh_commands(spec, ["tpu-a", "tpu-b", "tpu-c"])
+    assert len(cmds) == 3
+    for pid, cmd in enumerate(cmds):
+        assert f"DKT_PROCESS_ID={pid}" in cmd
+        assert "DKT_COORDINATOR=tpu-a:29500" in cmd
+        assert "DKT_NUM_PROCESSES=3" in cmd
+        assert cmd.endswith("python3 train.py --epochs 3")
+    with pytest.raises(ValueError):
+        ssh_commands(spec, [])
+
+
+def test_punchcard_submit_wait_status(tmp_path):
+    script = _write(tmp_path, "ok.py", """
+        print("hello from job")
+    """)
+    daemon = Punchcard(secret="s3cret")
+    port = daemon.start()
+    try:
+        client = PunchcardClient("127.0.0.1", port, "s3cret")
+        job_id = client.submit(JobSpec(script=script, name="hello",
+                                       timeout=60))
+        st = client.wait(job_id, timeout=60)
+        assert st["state"] == "done", st
+        assert "hello from job" in st["result"]["logs"][0]
+        jobs = client.list_jobs()
+        assert jobs == [{"job_id": job_id, "name": "hello", "state": "done"}]
+    finally:
+        daemon.stop()
+
+
+def test_punchcard_rejects_bad_secret():
+    daemon = Punchcard(secret="right")
+    port = daemon.start()
+    try:
+        bad = PunchcardClient("127.0.0.1", port, "wrong")
+        with pytest.raises(RuntimeError, match="authentication"):
+            bad.list_jobs()
+    finally:
+        daemon.stop()
+
+
+def test_punchcard_records_failed_job(tmp_path):
+    script = _write(tmp_path, "boom.py", """
+        raise SystemExit(3)
+    """)
+    daemon = Punchcard(secret="s")
+    port = daemon.start()
+    try:
+        client = PunchcardClient("127.0.0.1", port, "s")
+        job_id = client.submit(JobSpec(script=script, timeout=60))
+        st = client.wait(job_id, timeout=60)
+        assert st["state"] == "failed"
+        assert st["result"]["returncodes"] == [3]
+    finally:
+        daemon.stop()
+
+
+def test_job_runs_distributed_trainer_across_processes(tmp_path):
+    # the flagship integration: AEASGD over a 4-device mesh spanning TWO
+    # jax processes (DCN-style), producing the same center on every host
+    script = _write(tmp_path, "train_mp.py", """
+        from distkeras_tpu.deploy import initialize_from_env
+        info = initialize_from_env()
+        import numpy as np
+        from distkeras_tpu.data import Dataset
+        from distkeras_tpu.models import Model, zoo
+        from distkeras_tpu.parallel import AEASGD, make_mesh
+
+        rs = np.random.RandomState(0)
+        n, d, c = 256, 8, 3
+        w = rs.randn(d, c)
+        X = rs.randn(n, d).astype(np.float32)
+        Y = (X @ w).argmax(-1)
+        model = Model.build(zoo.mlp((16,), num_classes=c), (d,), seed=0)
+        tr = AEASGD(model, num_workers=4, mesh=make_mesh(4), batch_size=8,
+                    communication_window=2, num_epoch=3,
+                    worker_optimizer="sgd",
+                    optimizer_kwargs={"learning_rate": 0.1},
+                    loss="sparse_categorical_crossentropy_from_logits")
+        trained = tr.train(Dataset({"features": X, "label": Y}))
+        losses = tr.get_history().losses()
+        assert np.isfinite(losses).all()
+        digest = float(np.asarray(trained.predict(X[:16])).sum())
+        print(f"MPDIGEST {info['process_id']} {digest:.6f}")
+    """)
+    spec = JobSpec(script=script, num_processes=2, devices_per_process=2,
+                   env={"PYTHONPATH": REPO}, timeout=300)
+    result = Job(spec).run()
+    assert result.ok, result.logs
+    digests = []
+    for pid, log in enumerate(result.logs):
+        line = [l for l in log.splitlines() if l.startswith("MPDIGEST")]
+        assert line, log
+        digests.append(line[0].split()[2])
+    # every process extracted the SAME final center
+    assert digests[0] == digests[1], digests
+
+
+def test_multiprocess_checkpoint_resume_consistent(tmp_path):
+    # process 0 writes checkpoints; resume broadcasts its restored center
+    # to all processes even though the checkpoint dir is "host-local"
+    ckpt = tmp_path / "ckpt"
+    script = _write(tmp_path, "resume_mp.py", f"""
+        from distkeras_tpu.deploy import initialize_from_env
+        info = initialize_from_env()
+        import sys, numpy as np, jax
+        from distkeras_tpu.data import Dataset
+        from distkeras_tpu.models import Model, zoo
+        from distkeras_tpu.parallel import ADAG, make_mesh
+
+        resume = sys.argv[1] == "resume"
+        rs = np.random.RandomState(0)
+        X = rs.randn(256, 8).astype(np.float32)
+        Y = (X @ rs.randn(8, 3)).argmax(-1)
+        model = Model.build(zoo.mlp((16,), num_classes=3), (8,), seed=0)
+        # only process 0 sees the checkpoint dir (host-local semantics)
+        cdir = {str(ckpt)!r} if (jax.process_index() == 0 or resume) \\
+            else {str(ckpt)!r}
+        tr = ADAG(model, num_workers=4, mesh=make_mesh(4), batch_size=8,
+                  num_epoch=4 if resume else 2, communication_window=2,
+                  worker_optimizer="sgd",
+                  optimizer_kwargs={{"learning_rate": 0.1}},
+                  loss="sparse_categorical_crossentropy_from_logits",
+                  checkpoint_dir=cdir, resume=resume)
+        t = tr.train(Dataset({{"features": X, "label": Y}}))
+        n_epochs = tr.get_history().losses().shape[0] // 8
+        digest = float(np.asarray(t.predict(X[:16])).sum())
+        print(f"RESUME {{info['process_id']}} {{n_epochs}} {{digest:.6f}}")
+    """)
+    env = {"PYTHONPATH": REPO}
+    r1 = Job(JobSpec(script=script, args=["fresh"], num_processes=2,
+                     devices_per_process=2, env=env, timeout=300)).run()
+    assert r1.ok, r1.logs
+    r2 = Job(JobSpec(script=script, args=["resume"], num_processes=2,
+                     devices_per_process=2, env=env, timeout=300)).run()
+    assert r2.ok, r2.logs
+    lines = [l for log in r2.logs for l in log.splitlines()
+             if l.startswith("RESUME")]
+    assert len(lines) == 2
+    # resumed run trained only the REMAINING epochs, identically on both
+    # processes
+    assert lines[0].split()[2:] == lines[1].split()[2:], lines
