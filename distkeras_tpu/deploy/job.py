@@ -146,20 +146,35 @@ class Job:
                 env=_worker_env(spec, coord, pid),
                 stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
                 text=True))
-        logs, rcs = [], []
+        # drain every pipe CONCURRENTLY: a worker that fills its 64KB stdout
+        # pipe would otherwise block mid-collective and hang the whole
+        # coordination domain while run() sat in an earlier communicate()
+        import threading
+
+        logs = [""] * len(procs)
+
+        def drain(i, p):
+            out, _ = p.communicate()
+            logs[i] = out or ""
+
+        threads = [threading.Thread(target=drain, args=(i, p), daemon=True)
+                   for i, p in enumerate(procs)]
+        for t in threads:
+            t.start()
         deadline = (time.perf_counter() + spec.timeout
                     if spec.timeout else None)
-        for p in procs:
-            remain = (max(0.1, deadline - time.perf_counter())
-                      if deadline else None)
-            try:
-                out, _ = p.communicate(timeout=remain)
-            except subprocess.TimeoutExpired:
+        for t in threads:
+            t.join(max(0.1, deadline - time.perf_counter())
+                   if deadline else None)
+        killed = [p.poll() is None for p in procs]
+        for p, k in zip(procs, killed):
+            if k:
                 p.kill()
-                out, _ = p.communicate()
-                out = (out or "") + "\n[killed: job timeout]"
-            logs.append(out or "")
-            rcs.append(p.returncode)
+        for t in threads:
+            t.join()
+        logs = [log + "\n[killed: job timeout]" if k else log
+                for log, k in zip(logs, killed)]
+        rcs = [p.returncode for p in procs]
         return JobResult(spec.name, rcs, logs,
                          time.perf_counter() - t0)
 
@@ -181,7 +196,10 @@ def ssh_commands(spec: JobSpec, hosts: Sequence[str],
                 ENV_COORD: f"{coord_host}:{port}",
                 ENV_NUM_PROCS: str(len(hosts)),
                 ENV_PROC_ID: str(pid)}
-        env_str = " ".join(f"{k}={v}" for k, v in sorted(envs.items()))
-        arg_str = " ".join([spec.script, *spec.args])
+        import shlex
+        env_str = " ".join(f"{k}={shlex.quote(str(v))}"
+                           for k, v in sorted(envs.items()))
+        arg_str = " ".join(shlex.quote(a)
+                           for a in [spec.script, *spec.args])
         cmds.append(f"{env_str} {python} {arg_str}")
     return cmds

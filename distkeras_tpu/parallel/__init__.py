@@ -8,6 +8,11 @@ from distkeras_tpu.parallel.mesh import make_mesh, make_mesh_2d  # noqa: F401
 from distkeras_tpu.parallel.trainers import (  # noqa: F401
     EnsembleTrainer, SingleTrainer, Trainer)
 from distkeras_tpu.parallel.async_host import HostAsyncTrainer  # noqa: F401
+from distkeras_tpu.parallel.sharding import (  # noqa: F401
+    ShardingRules, named_shardings, param_specs, shard_params)
+from distkeras_tpu.parallel.spmd import SPMDTrainer  # noqa: F401
+from distkeras_tpu.parallel.pipeline import (  # noqa: F401
+    PipelinedLM, PipelineTrainer, init_stacked_blocks, make_pipeline_fn)
 from distkeras_tpu.parallel.parameter_servers import (  # noqa: F401
     ADAGParameterServer, DeltaParameterServer, DynSGDParameterServer,
     EASGDParameterServer, ParameterServer, PSClient)

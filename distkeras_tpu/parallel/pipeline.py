@@ -1,0 +1,360 @@
+"""Pipeline parallelism: GPipe microbatch schedule over a ``pp`` mesh axis.
+
+No reference equivalent (SURVEY §2.3: PP is "absent in the reference" — a
+dist-keras worker always holds the whole model). This is the TPU-native
+capability ADD for models deeper than one chip: the repeated trunk of a
+network (N identical transformer blocks) is stacked into one
+``[num_layers, ...]`` params pytree and sharded over the ``pp`` axis, so
+each device owns ``num_layers / pp`` consecutive layers. Microbatches flow
+through the stages on a ``ppermute`` ring under ``shard_map``:
+
+  tick t:  device 0 injects microbatch t; device i processes the activation
+           it received at tick t-1 through its local layers (a ``lax.scan``
+           over the stacked params); every device then permutes its output
+           to device i+1. After ``M + P - 1`` ticks all M microbatches have
+           drained; the last stage's outputs are psum-broadcast to the ring.
+
+Everything — schedule, stage compute, collectives — is ONE jitted program;
+the schedule is a ``lax.scan`` over ticks, so there is no per-tick Python.
+The whole pipeline is differentiable (``ppermute``'s transpose is the
+reverse permute), so the same function serves forward and backward; XLA
+overlaps the permute with stage compute where possible.
+
+Composes with the other axes: batch sharded over ``workers`` (dp), sequence
+sharded over ``sp`` with ring attention inside the blocks, giving dp×pp×sp
+in one program (see ``PipelinedLM.make_train_step`` and
+``__graft_entry__.dryrun_multichip``).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from distkeras_tpu.models.core import Layer
+from distkeras_tpu.ops.optimizers import Optimizer, apply_updates
+
+Pytree = Any
+
+
+def init_stacked_blocks(block: Layer, rng: jax.Array,
+                        input_shape: Tuple[int, ...], num_layers: int):
+    """Init ``num_layers`` copies of ``block`` and stack the params along a
+    leading layer axis. Blocks must be shape-preserving and stateless (no
+    BatchNorm-style running stats) — the pipeline scan carries activations
+    only."""
+    ps, state = [], {}
+    for k in jax.random.split(rng, num_layers):
+        p, s, out_shape = block.init(k, tuple(input_shape))
+        if tuple(out_shape) != tuple(input_shape):
+            raise ValueError(
+                f"pipeline blocks must preserve shape: {input_shape} -> "
+                f"{out_shape}")
+        if jax.tree_util.tree_leaves(s):
+            raise ValueError(
+                "pipeline blocks must be stateless (found non-empty state; "
+                "BatchNorm-style layers are unsupported in the pipelined "
+                "trunk — use LayerNorm/RMSNorm)")
+        ps.append(p)
+        state = s  # leafless structure template, passed back into apply
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *ps), state
+
+
+def make_pipeline_fn(block: Layer, axis_name: str = "pp",
+                     state: Optional[Pytree] = None) -> Callable:
+    """Returns ``fn(stacked_local_params, x_mb) -> y_mb`` for use under
+    ``shard_map``: ``x_mb`` is ``[M, mb, ...]`` microbatched input
+    (replicated over the pp axis), result likewise. ``state`` is the block's
+    (leafless) state-structure template from ``init_stacked_blocks``."""
+    state = {} if state is None else state
+
+    def stage(local_params, h):
+        def body(h, p):
+            y, _ = block.apply(p, state, h, training=False)
+            return y, None
+        h, _ = lax.scan(body, h, local_params)
+        return h
+
+    def fn(local_params, x_mb):
+        nstages = lax.axis_size(axis_name)
+        idx = lax.axis_index(axis_name)
+        M = x_mb.shape[0]
+        ticks = M + nstages - 1
+        ring = [(j, (j + 1) % nstages) for j in range(nstages)]
+
+        def tick(carry, t):
+            buf, outs = carry
+            # stage 0 injects microbatch t (clamped; garbage ticks beyond
+            # M-1 never reach a valid output slot)
+            inp = jnp.where(idx == 0, x_mb[jnp.clip(t, 0, M - 1)], buf)
+            h = stage(local_params, inp)
+            # last stage drains microbatch t-(P-1)
+            oidx = t - (nstages - 1)
+            cidx = jnp.clip(oidx, 0, M - 1)
+            valid = (oidx >= 0) & (idx == nstages - 1)
+            outs = outs.at[cidx].set(jnp.where(valid, h, outs[cidx]))
+            buf = lax.ppermute(h, axis_name, ring)
+            return (buf, outs), None
+
+        buf = jnp.zeros_like(x_mb[0])
+        outs = jnp.zeros_like(x_mb)
+        (buf, outs), _ = lax.scan(tick, (buf, outs), jnp.arange(ticks))
+        # broadcast the drained outputs from the last stage to the ring
+        outs = lax.psum(jnp.where(idx == nstages - 1, outs, 0.), axis_name)
+        return outs
+
+    return fn
+
+
+class PipelinedLM:
+    """Embed -> pp-sharded block stack -> head, with a dp×pp(×sp) train step.
+
+    ``embed``/``head`` are replicated (their grads psum over the pp axis —
+    contributions are zero except on the inject/drain stages); the trunk is
+    ``num_layers`` copies of ``block`` sharded over ``pp``.
+    """
+
+    def __init__(self, embed: Layer, block: Layer, head: Layer,
+                 num_layers: int, num_microbatches: int = 2):
+        self.embed = embed
+        self.block = block
+        self.head = head
+        self.num_layers = int(num_layers)
+        self.num_microbatches = int(num_microbatches)
+        self._estate = self._bstate = self._hstate = {}  # set by init()
+
+    # -- init ---------------------------------------------------------------
+    def init(self, rng: jax.Array, input_shape: Tuple[int, ...]):
+        k1, k2, k3 = jax.random.split(rng, 3)
+        pe, se, shape = self.embed.init(k1, tuple(input_shape))
+        if jax.tree_util.tree_leaves(se):
+            raise ValueError("embed must be stateless")
+        blocks, bstate = init_stacked_blocks(self.block, k2, shape,
+                                             self.num_layers)
+        ph, sh, out_shape = self.head.init(k3, shape)
+        if jax.tree_util.tree_leaves(sh):
+            raise ValueError("head must be stateless")
+        # leafless state-structure templates for the pure applies
+        self._estate, self._bstate, self._hstate = se, bstate, sh
+        return {"embed": pe, "blocks": blocks, "head": ph}, out_shape
+
+    # -- unsharded reference forward (host inference / tests) ---------------
+    def apply(self, params, x):
+        h, _ = self.embed.apply(params["embed"], self._estate, x,
+                                training=False)
+
+        def body(h, p):
+            y, _ = self.block.apply(p, self._bstate, h, training=False)
+            return y, None
+
+        h, _ = lax.scan(body, h, params["blocks"])
+        y, _ = self.head.apply(params["head"], self._hstate, h,
+                               training=False)
+        return y
+
+    # -- sharded step -------------------------------------------------------
+    def make_train_step(self, loss_fn: Callable, optimizer: Optimizer,
+                        mesh: Mesh, data_axes: Sequence[str] = ("workers",),
+                        pp_axis: str = "pp",
+                        seq_axis: Optional[str] = None) -> Callable:
+        """Build ``step((params, opt_state), (x, y)) -> ((params, opt), loss)``.
+
+        ``data_axes``: mesh axes the batch dim is sharded over (dp).
+        ``seq_axis``: mesh axis the sequence dim is sharded over (sp, ring
+        attention inside the blocks); None for no sequence parallelism.
+        """
+        M = self.num_microbatches
+        if self.num_layers % mesh.shape[pp_axis]:
+            raise ValueError(
+                f"num_layers {self.num_layers} must divide evenly over "
+                f"pp axis {pp_axis!r} (size {mesh.shape[pp_axis]})")
+        pipeline = make_pipeline_fn(self.block, pp_axis, self._bstate)
+        embed, head = self.embed, self.head
+        estate, hstate = self._estate, self._hstate
+        d_axes = tuple(data_axes)
+        loss_div_axes = d_axes + ((seq_axis,) if seq_axis else ())
+        div = int(np.prod([mesh.shape[a] for a in loss_div_axes])) or 1
+
+        def local_grads(params, x, y):
+            def obj(params):
+                h, _ = embed.apply(params["embed"], estate, x,
+                                   training=False)
+                mb = h.reshape((M, h.shape[0] // M) + h.shape[1:])
+                out = pipeline(params["blocks"], mb)
+                out = out.reshape(h.shape[:-1] + out.shape[-1:])
+                logits, _ = head.apply(params["head"], hstate, out,
+                                       training=False)
+                # The pipeline broadcast the outputs to every pp rank, so
+                # every rank computes the same loss; count it ONCE (last
+                # stage) or replicated-param grads would be pp-times too
+                # large after the psum. Cross-rank grad flow (last rank's
+                # loss -> ring -> stage params -> first rank's embed) is
+                # handled by the collective transposes inside jax.grad.
+                is_last = (lax.axis_index(pp_axis)
+                           == lax.axis_size(pp_axis) - 1)
+                # scaled so that psum over data+pp axes == global mean loss
+                return loss_fn(y, logits) * is_last / div
+
+            loss, grads = jax.value_and_grad(obj)(params)
+            all_axes = loss_div_axes + (pp_axis,)
+            grads = {
+                # replicated components: nonzero on one rank; sum everywhere
+                "embed": lax.psum(grads["embed"], all_axes),
+                "head": lax.psum(grads["head"], all_axes),
+                # pp-sharded trunk: each rank already holds the full grad of
+                # its own stage; reduce over data axes only
+                "blocks": lax.psum(grads["blocks"], loss_div_axes),
+            }
+            return grads, lax.psum(loss, all_axes)
+
+        # x/y: [B, S] -> batch over dp axes, sequence over sp
+        seq_entry = (seq_axis,) if seq_axis else (None,)
+        data_spec = P(d_axes, *seq_entry)
+        pspecs = {"embed": P(), "blocks": P(pp_axis), "head": P()}
+        grads_fn = jax.shard_map(
+            local_grads, mesh=mesh,
+            in_specs=(pspecs, data_spec, data_spec),
+            out_specs=(pspecs, P()),
+            check_vma=False)
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def step(carry, batch):
+            params, opt_state = carry
+            x, y = batch
+            grads, loss = grads_fn(params, x, y)
+            updates, opt_state = optimizer.update(grads, opt_state, params)
+            params = apply_updates(params, updates)
+            return (params, opt_state), loss
+
+        return step
+
+    def shard_variables(self, params: Pytree, mesh: Mesh,
+                        pp_axis: str = "pp") -> Pytree:
+        """device_put the params tree: trunk layer-sharded over pp, embed and
+        head replicated."""
+        repl = NamedSharding(mesh, P())
+        blk = NamedSharding(mesh, P(pp_axis))
+        put = jax.tree_util.tree_map
+        return {"embed": put(lambda x: jax.device_put(x, repl),
+                             params["embed"]),
+                "blocks": put(lambda x: jax.device_put(x, blk),
+                              params["blocks"]),
+                "head": put(lambda x: jax.device_put(x, repl),
+                            params["head"])}
+
+
+class PipelineTrainer:
+    """Trainer-style wrapper: epoch loop + history over a ``PipelinedLM``.
+
+    Mirrors the ``Trainer.train(dataset)`` ergonomics of the rest of the
+    family (reference: ``distkeras/trainers.py`` constructor-kwargs style)
+    for the language-model shape: ``features_col`` holds token ids
+    ``[N, S]``, ``label_col`` the per-token targets ``[N, S]``.
+    """
+
+    def __init__(self, lm: PipelinedLM, mesh: Mesh,
+                 data_axes: Sequence[str] = ("workers",),
+                 pp_axis: str = "pp", seq_axis: Optional[str] = None,
+                 worker_optimizer="sgd", optimizer_kwargs=None,
+                 loss="sparse_categorical_crossentropy_from_logits",
+                 batch_size: int = 32, num_epoch: int = 1,
+                 features_col: str = "features", label_col: str = "label",
+                 seed: int = 0, shuffle_each_epoch: bool = True):
+        from distkeras_tpu.ops.losses import get_loss
+        from distkeras_tpu.ops.optimizers import get_optimizer
+        from distkeras_tpu.utils.history import History
+
+        self.lm = lm
+        self.mesh = mesh
+        self.data_axes = tuple(data_axes)
+        self.pp_axis = pp_axis
+        self.seq_axis = seq_axis
+        self.optimizer = get_optimizer(worker_optimizer,
+                                       **(optimizer_kwargs or {}))
+        self.loss = get_loss(loss)
+        self.batch_size = int(batch_size)
+        self.num_epoch = int(num_epoch)
+        self.features_col = features_col
+        self.label_col = label_col
+        self.seed = int(seed)
+        self.shuffle_each_epoch = bool(shuffle_each_epoch)
+        self.history = History()
+        self.params_ = None
+
+    def get_history(self):
+        return self.history
+
+    def _validate(self, X, Y):
+        """Fail fast with microbatch/sharding-aware messages instead of a
+        reshape error from deep inside shard_map tracing."""
+        dp = int(np.prod([self.mesh.shape[a] for a in self.data_axes])) or 1
+        if self.batch_size % dp:
+            raise ValueError(
+                f"batch_size {self.batch_size} must divide evenly over "
+                f"data axes {self.data_axes} (size {dp})")
+        local_b = self.batch_size // dp
+        if local_b % self.lm.num_microbatches:
+            raise ValueError(
+                f"per-worker batch {local_b} (batch_size {self.batch_size} "
+                f"/ dp {dp}) must divide into num_microbatches="
+                f"{self.lm.num_microbatches}")
+        if self.seq_axis:
+            sp = self.mesh.shape[self.seq_axis]
+            if X.shape[1] % sp:
+                raise ValueError(
+                    f"sequence length {X.shape[1]} must divide over "
+                    f"seq axis {self.seq_axis!r} (size {sp})")
+        if len(X) < self.batch_size:
+            raise ValueError(f"dataset ({len(X)}) smaller than one batch")
+
+    def train(self, dataset) -> Pytree:
+        X = np.asarray(dataset[self.features_col])
+        Y = np.asarray(dataset[self.label_col])
+        lm = self.lm
+        self._validate(X, Y)
+
+        params, _ = lm.init(jax.random.PRNGKey(self.seed), X.shape[1:])
+        params = lm.shard_variables(params, self.mesh, self.pp_axis)
+        opt_state = jax.jit(self.optimizer.init)(params)
+        step = lm.make_train_step(self.loss, self.optimizer, self.mesh,
+                                  data_axes=self.data_axes,
+                                  pp_axis=self.pp_axis,
+                                  seq_axis=self.seq_axis)
+
+        seq_entry = (self.seq_axis,) if self.seq_axis else (None,)
+        data_sh = NamedSharding(self.mesh, P(self.data_axes, *seq_entry))
+
+        from distkeras_tpu.parallel.worker import stack_batches
+
+        carry = (params, opt_state)
+        self.history.record_training_start()
+        for epoch in range(self.num_epoch):
+            # same shuffle-seed convention as Trainer._epoch_perm
+            perm = (np.random.RandomState(self.seed + 1000 * epoch)
+                    .permutation(len(X)) if self.shuffle_each_epoch
+                    else None)
+            Xs, Ys, nsteps = stack_batches(X, Y, self.batch_size, perm)
+            losses = []
+            for i in range(nsteps):
+                xb = jax.device_put(jnp.asarray(Xs[i]), data_sh)
+                yb = jax.device_put(jnp.asarray(Ys[i]), data_sh)
+                carry, loss = step(carry, (xb, yb))
+                losses.append(loss)
+            self.history.append_epoch(
+                loss=np.asarray(jax.device_get(losses)))
+        self.history.record_training_stop()
+
+        self.params_ = jax.device_get(carry[0])
+        return self.params_
+
+    def predict(self, x) -> np.ndarray:
+        if self.params_ is None:
+            raise RuntimeError("call train() first")
+        fwd = jax.jit(self.lm.apply)
+        return np.asarray(fwd(self.params_, jnp.asarray(x)))

@@ -173,9 +173,12 @@ def test_multiprocess_checkpoint_resume_consistent(tmp_path):
         X = rs.randn(256, 8).astype(np.float32)
         Y = (X @ rs.randn(8, 3)).argmax(-1)
         model = Model.build(zoo.mlp((16,), num_classes=3), (8,), seed=0)
-        # only process 0 sees the checkpoint dir (host-local semantics)
-        cdir = {str(ckpt)!r} if (jax.process_index() == 0 or resume) \\
-            else {str(ckpt)!r}
+        # only process 0 sees the real checkpoint dir (host-local
+        # semantics); other processes get their own empty dir, so a
+        # regression that reads/writes the manager off process 0 would
+        # restore nothing there and diverge (caught by the digest compare)
+        cdir = {str(ckpt)!r} if jax.process_index() == 0 \\
+            else {str(ckpt)!r} + f"-local{{jax.process_index()}}"
         tr = ADAG(model, num_workers=4, mesh=make_mesh(4), batch_size=8,
                   num_epoch=4 if resume else 2, communication_window=2,
                   worker_optimizer="sgd",

@@ -1,0 +1,132 @@
+"""Pipeline parallelism (GPipe ppermute ring) on the 8-device virtual mesh.
+
+Correctness bar: the pipelined program is the SAME math as the unsharded
+layer stack — forward outputs match, and one full dp×pp training step
+produces the same loss trajectory as a hand-rolled single-device reference.
+The sp composition runs ring attention inside pipelined blocks.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from distkeras_tpu.data import Dataset
+from distkeras_tpu.models.attention import TransformerBlock
+from distkeras_tpu.models.layers import Dense, Embedding
+from distkeras_tpu.ops.losses import get_loss
+from distkeras_tpu.ops.optimizers import apply_updates, get_optimizer
+from distkeras_tpu.parallel.mesh import make_mesh_2d
+from distkeras_tpu.parallel.pipeline import (PipelinedLM, PipelineTrainer,
+                                             init_stacked_blocks,
+                                             make_pipeline_fn)
+
+V, D, S = 16, 16, 8
+
+
+def lm(num_layers=4, num_microbatches=2, attn_impl="xla", seq_axis=None):
+    return PipelinedLM(
+        embed=Embedding(V, D),
+        block=TransformerBlock(num_heads=4, mlp_ratio=2, causal=True,
+                               attn_impl=attn_impl, seq_axis_name=seq_axis),
+        head=Dense(V, use_bias=False),
+        num_layers=num_layers, num_microbatches=num_microbatches)
+
+
+def test_pipeline_forward_matches_sequential():
+    mesh = make_mesh_2d({"pp": 4})
+    block = TransformerBlock(num_heads=4, mlp_ratio=2, causal=True)
+    _, _, shape = Embedding(V, D).init(jax.random.PRNGKey(0), (S,))
+    stacked, bstate = init_stacked_blocks(block, jax.random.PRNGKey(1),
+                                          shape, 8)
+    x = jax.random.normal(jax.random.PRNGKey(2), (4, 4, S, D))  # [M, mb,...]
+
+    # sequential reference
+    def seq_apply(h):
+        def body(h, p):
+            y, _ = block.apply(p, bstate, h, training=False)
+            return y, None
+        return lax.scan(body, h, stacked)[0]
+
+    y_ref = np.asarray(jax.vmap(seq_apply)(x))
+
+    pipe = make_pipeline_fn(block, "pp", bstate)
+    fn = jax.jit(jax.shard_map(
+        pipe, mesh=mesh, in_specs=(P("pp"), P()), out_specs=P(),
+        check_vma=False))
+    y_pipe = np.asarray(fn(stacked, x))
+    np.testing.assert_allclose(y_ref, y_pipe, rtol=2e-5, atol=2e-5)
+
+
+def test_pipeline_train_step_matches_reference():
+    """One dp×pp train step == single-device step on the same global batch."""
+    mesh = make_mesh_2d({"workers": 2, "pp": 4})
+    model = lm(num_layers=4, num_microbatches=2)
+    params, _ = model.init(jax.random.PRNGKey(0), (S,))
+    loss_fn = get_loss("sparse_categorical_crossentropy_from_logits")
+    opt = get_optimizer("sgd", learning_rate=0.1)
+
+    rs = np.random.RandomState(0)
+    x = jnp.asarray(rs.randint(0, V, (8, S)))
+    y = jnp.asarray(rs.randint(0, V, (8, S)))
+
+    # reference: grad through the unsharded forward
+    def ref_obj(p):
+        return loss_fn(y, model.apply(p, x))
+
+    ref_loss, ref_grads = jax.value_and_grad(ref_obj)(params)
+    ref_updates, _ = opt.update(ref_grads, opt.init(params), params)
+    ref_params = apply_updates(params, ref_updates)
+
+    step = model.make_train_step(loss_fn, opt, mesh)
+    sharded = model.shard_variables(params, mesh)
+    (new_params, _), loss = step((sharded, jax.jit(opt.init)(sharded)),
+                                 (x, y))
+    assert np.allclose(float(loss), float(ref_loss), rtol=1e-5)
+    for ref_leaf, leaf in zip(jax.tree_util.tree_leaves(ref_params),
+                              jax.tree_util.tree_leaves(
+                                  jax.device_get(new_params))):
+        np.testing.assert_allclose(ref_leaf, leaf, rtol=1e-4, atol=1e-5)
+
+
+def test_pipeline_trainer_learns():
+    """Copy-task LM over dp×pp: predict the current token (easy), loss must
+    collapse."""
+    rs = np.random.RandomState(0)
+    X = rs.randint(0, V, (512, S))
+    ds = Dataset({"features": X, "label": X})
+
+    mesh = make_mesh_2d({"workers": 2, "pp": 4})
+    trainer = PipelineTrainer(
+        lm(num_layers=4, num_microbatches=2), mesh,
+        worker_optimizer="adam", optimizer_kwargs={"learning_rate": 0.01},
+        batch_size=64, num_epoch=6)
+    trainer.train(ds)
+    losses = trainer.get_history().losses()
+    assert np.isfinite(losses).all()
+    assert losses[-4:].mean() < 0.3 * losses[:4].mean(), losses
+
+    # predictions actually copy
+    logits = trainer.predict(X[:16])
+    acc = (logits.argmax(-1) == X[:16]).mean()
+    assert acc > 0.9, acc
+
+
+def test_pipeline_with_ring_attention_sp():
+    """dp×pp×sp: ring attention inside pipelined blocks, sequence sharded."""
+    mesh = make_mesh_2d({"workers": 2, "pp": 2, "sp": 2})
+    rs = np.random.RandomState(1)
+    X = rs.randint(0, V, (256, S))
+    ds = Dataset({"features": X, "label": X})
+
+    trainer = PipelineTrainer(
+        lm(num_layers=2, num_microbatches=2, attn_impl="ring",
+           seq_axis="sp"),
+        mesh, seq_axis="sp",
+        worker_optimizer="adam", optimizer_kwargs={"learning_rate": 0.01},
+        batch_size=64, num_epoch=6)
+    trainer.train(ds)
+    losses = trainer.get_history().losses()
+    assert np.isfinite(losses).all()
+    assert losses[-4:].mean() < 0.5 * losses[:4].mean(), losses

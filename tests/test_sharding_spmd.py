@@ -1,0 +1,197 @@
+"""Tensor/expert-parallel sharding rules + SPMDTrainer on the 8-device mesh.
+
+Covers the capability-ADD parallelism rows of SURVEY §2.3 (TP/EP/FSDP — all
+absent in the reference): spec generation over the layer tree, GSPMD forward
+parity between replicated and sharded placements, and end-to-end dp×tp
+training that actually learns.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from distkeras_tpu.data import Dataset
+from distkeras_tpu.models import Dense, Model, Sequential, zoo
+from distkeras_tpu.models.attention import TransformerBlock
+from distkeras_tpu.models.layers import Embedding
+from distkeras_tpu.models.moe import MoE
+from distkeras_tpu.ops.metrics import accuracy
+from distkeras_tpu.parallel import (SPMDTrainer, make_mesh_2d, param_specs,
+                                    shard_params)
+
+
+def tiny_lm(vocab=32, d=16, heads=4, blocks=2, mlp_layer=None):
+    layers = [Embedding(vocab, d)]
+    for _ in range(blocks):
+        layers.append(TransformerBlock(num_heads=heads, mlp_ratio=2,
+                                       causal=True,
+                                       mlp_layer=mlp_layer))
+    layers.append(Dense(vocab, use_bias=False))
+    return Sequential(layers)
+
+
+# ---------------------------------------------------------------------------
+# spec generation
+# ---------------------------------------------------------------------------
+
+def test_param_specs_transformer_megatron_split():
+    mesh = make_mesh_2d({"workers": 2, "tp": 4})
+    module = tiny_lm()
+    model = Model.build(module, (8,), seed=0)
+    specs = param_specs(module, model.params, mesh, tp_axis="tp")
+    # structure mirrors params
+    assert jax.tree_util.tree_structure(specs) == \
+        jax.tree_util.tree_structure(
+            jax.tree_util.tree_map(lambda x: P(), model.params))
+    blk = specs[1]
+    assert blk["attn"]["wq"] == P(None, "tp", None)
+    assert blk["attn"]["wo"] == P("tp", None, None)
+    assert blk["mlp"]["w1"] == P(None, "tp")
+    assert blk["mlp"]["w2"] == P("tp", None)
+    assert blk["norm1"]["scale"] == P()
+    assert specs[0]["embeddings"] == P(None, "tp")  # embed dim sharded
+    assert specs[-1]["kernel"] == P(None, "tp")     # vocab head sharded
+
+
+def test_param_specs_indivisible_falls_back_replicated():
+    mesh = make_mesh_2d({"tp": 8})
+    module = Sequential([Dense(6), Dense(3)])  # 6, 3 not divisible by 8
+    model = Model.build(module, (5,), seed=0)
+    specs = param_specs(module, model.params, mesh, tp_axis="tp")
+    assert specs[0]["kernel"] == P(None, None)
+    assert specs[1]["bias"] == P(None)
+
+
+def test_param_specs_moe_expert_parallel():
+    mesh = make_mesh_2d({"ep": 4, "tp": 2})
+    moe = MoE(num_experts=8, hidden_dim=32, top_k=2)
+    module = tiny_lm(mlp_layer=moe)
+    model = Model.build(module, (8,), seed=0)
+    specs = param_specs(module, model.params, mesh, tp_axis="tp",
+                        ep_axis="ep")
+    m = specs[1]["mlp"]
+    assert m["gate"] == P()
+    assert m["w1"] == P("ep", None, "tp")
+    assert m["w2"] == P("ep", "tp", None)
+
+
+def test_fsdp_shards_large_replicated_kernels():
+    mesh = make_mesh_2d({"workers": 8})
+    module = Sequential([Dense(512), Dense(10)])
+    model = Model.build(module, (256,), seed=0)
+    specs = param_specs(module, model.params, mesh, tp_axis=None,
+                        fsdp_axis="workers")
+    # 256x512 kernel: biggest divisible dim gets the fsdp axis
+    assert "workers" in tuple(specs[0]["kernel"])
+    # 512x10 kernel (5120 < min_fsdp_size) stays fully replicated
+    assert tuple(specs[1]["kernel"]) in ((None, None), ())
+
+
+# ---------------------------------------------------------------------------
+# GSPMD numerical parity
+# ---------------------------------------------------------------------------
+
+def test_tp_sharded_forward_matches_replicated():
+    mesh = make_mesh_2d({"workers": 2, "tp": 4})
+    module = tiny_lm()
+    model = Model.build(module, (8,), seed=3)
+    x = np.random.RandomState(0).randint(0, 32, (4, 8))
+
+    fwd = jax.jit(lambda p, s, b: module.apply(p, s, b, training=False)[0])
+    y_ref = np.asarray(fwd(model.params, model.state, x))
+
+    specs = param_specs(module, model.params, mesh, tp_axis="tp")
+    sharded = shard_params(model.params, specs, mesh)
+    xb = jax.device_put(jnp.asarray(x),
+                        NamedSharding(mesh, P("workers")))
+    y_tp = np.asarray(fwd(sharded, model.state, xb))
+    np.testing.assert_allclose(y_ref, y_tp, rtol=2e-5, atol=2e-5)
+
+
+# ---------------------------------------------------------------------------
+# end-to-end training
+# ---------------------------------------------------------------------------
+
+def test_spmd_trainer_learns_dp_tp():
+    rs = np.random.RandomState(0)
+    N, D, C = 2048, 16, 4
+    X = rs.randn(N, D).astype(np.float32)
+    W = rs.randn(D, C)
+    y = np.argmax(X @ W, axis=1)
+    ds = Dataset({"features": X, "label": y})
+
+    mesh = make_mesh_2d({"workers": 2, "tp": 4})
+    model = Model.build(Sequential([Dense(64, activation="relu"),
+                                    Dense(C)]), (D,), seed=0)
+    trainer = SPMDTrainer(
+        model, mesh=mesh, data_axes=("workers",), tp_axis="tp",
+        batch_size=128, num_epoch=6, worker_optimizer="momentum",
+        optimizer_kwargs={"learning_rate": 0.1},
+        loss="sparse_categorical_crossentropy_from_logits")
+    trained = trainer.train(ds)
+    acc = float(accuracy(y, trained.predict(X)))
+    assert acc > 0.85, acc
+    losses = trainer.get_history().losses()
+    assert np.isfinite(losses).all()
+    assert losses[-8:].mean() < losses[:8].mean() * 0.7
+
+
+def test_spmd_trainer_matches_single_device_sgd():
+    """dp×tp sharding must not change the math: same data order, no
+    shuffling, plain SGD ⇒ losses match an unsharded run step-for-step."""
+    rs = np.random.RandomState(1)
+    N, D, C = 512, 8, 3
+    X = rs.randn(N, D).astype(np.float32)
+    y = rs.randint(0, C, N)
+    ds = Dataset({"features": X, "label": y})
+    kwargs = dict(batch_size=64, num_epoch=2, worker_optimizer="sgd",
+                  optimizer_kwargs={"learning_rate": 0.05},
+                  loss="sparse_categorical_crossentropy_from_logits",
+                  shuffle_each_epoch=False)
+
+    from distkeras_tpu.parallel import SingleTrainer
+    m1 = Model.build(Sequential([Dense(32, activation="tanh"), Dense(C)]),
+                     (D,), seed=7)
+    single = SingleTrainer(m1, **kwargs)
+    single.train(ds)
+    ref_losses = single.get_history().losses()
+
+    mesh = make_mesh_2d({"workers": 4, "tp": 2})
+    m2 = Model.build(Sequential([Dense(32, activation="tanh"), Dense(C)]),
+                     (D,), seed=7)
+    spmd = SPMDTrainer(m2, mesh=mesh, tp_axis="tp", **kwargs)
+    spmd.train(ds)
+    np.testing.assert_allclose(ref_losses, spmd.get_history().losses(),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_spmd_trainer_moe_ep():
+    """MoE classification over dp×ep×tp axes (expert parallelism)."""
+    rs = np.random.RandomState(2)
+    N, D, C = 1024, 12, 3
+    X = rs.randn(N, D).astype(np.float32)
+    W = rs.randn(D, C)
+    y = np.argmax(X @ W, axis=1)
+    ds = Dataset({"features": X, "label": y})
+
+    # MoE operates on [B, S, d]; reshape features to a length-3 sequence
+    from distkeras_tpu.models.layers import Reshape, Flatten
+    module = Sequential([
+        Reshape((3, 4)),
+        MoE(num_experts=4, hidden_dim=16, top_k=2),
+        Flatten(),
+        Dense(C),
+    ])
+    model = Model.build(module, (D,), seed=0)
+
+    mesh = make_mesh_2d({"workers": 2, "ep": 2, "tp": 2})
+    trainer = SPMDTrainer(
+        model, mesh=mesh, data_axes=("workers",), tp_axis="tp", ep_axis="ep",
+        batch_size=128, num_epoch=8, worker_optimizer="adam",
+        optimizer_kwargs={"learning_rate": 0.01},
+        loss="sparse_categorical_crossentropy_from_logits")
+    trained = trainer.train(ds)
+    acc = float(accuracy(y, trained.predict(X)))
+    assert acc > 0.8, acc
