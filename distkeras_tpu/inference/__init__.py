@@ -3,4 +3,4 @@
 from distkeras_tpu.inference.evaluators import (  # noqa: F401
     AccuracyEvaluator, Evaluator)
 from distkeras_tpu.inference.predictors import (  # noqa: F401
-    ModelPredictor, Predictor)
+    ModelPredictor, Predictor, StreamingPredictor)

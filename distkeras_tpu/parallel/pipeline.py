@@ -286,6 +286,7 @@ class PipelineTrainer:
         self.shuffle_each_epoch = bool(shuffle_each_epoch)
         self.history = History()
         self.params_ = None
+        self._fwd = None  # cached jitted forward for predict()
 
     def get_history(self):
         return self.history
@@ -356,5 +357,6 @@ class PipelineTrainer:
     def predict(self, x) -> np.ndarray:
         if self.params_ is None:
             raise RuntimeError("call train() first")
-        fwd = jax.jit(self.lm.apply)
-        return np.asarray(fwd(self.params_, jnp.asarray(x)))
+        if self._fwd is None:  # built once; params are a traced argument
+            self._fwd = jax.jit(self.lm.apply)
+        return np.asarray(self._fwd(self.params_, jnp.asarray(x)))

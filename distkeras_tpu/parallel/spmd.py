@@ -23,6 +23,7 @@ from functools import partial
 from typing import Optional, Sequence, Union
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -56,7 +57,14 @@ class SPMDTrainer(Trainer):
         self.mesh = mesh
         if isinstance(data_axes, str):
             data_axes = (data_axes,)
-        self.data_axes = tuple(a for a in data_axes if a in mesh.shape)
+        unknown = [a for a in data_axes if a not in mesh.shape]
+        if unknown:
+            # unlike tp/ep (where replicated fallback is documented), a
+            # missing data axis silently disables data parallelism — fail
+            raise ValueError(
+                f"data_axes {unknown} not in mesh axes "
+                f"{tuple(mesh.shape)}")
+        self.data_axes = tuple(data_axes)
         self.tp_axis = tp_axis
         self.ep_axis = ep_axis
         self.fsdp_axis = fsdp_axis
@@ -91,17 +99,25 @@ class SPMDTrainer(Trainer):
         X, y = self._training_arrays(dataset)
         param_sh, repl, data_sh = self._placements(model)
 
+        # full-carry checkpoint (params + model state + optimizer moments +
+        # rng) so a resumed run is bitwise-identical to an uninterrupted
+        # one — same contract as SingleTrainer
         manager = self._checkpoint_manager()
         tree, start_epoch = self._maybe_resume(
-            manager, {"params": model.params, "state": model.state})
+            manager, {"params": model.params, "state": model.state,
+                      "opt": self.worker_optimizer.init(model.params),
+                      "rng": jax.random.PRNGKey(self.seed)})
 
         # committed placements: GSPMD keeps these layouts through the scan
         params = jax.tree_util.tree_map(jax.device_put, tree["params"],
                                         param_sh)
         state = jax.device_put(tree["state"], repl)
-        # optimizer state inherits each param's sharding via propagation
-        opt_state = jax.jit(self.worker_optimizer.init)(params)
-        rng = jax.device_put(jax.random.PRNGKey(self.seed), repl)
+        # optimizer state: keep leaves UNCOMMITTED (plain asarray, no
+        # device_put) so the first run_epoch call reshards them onto
+        # whatever layout GSPMD propagates from the params — committing
+        # them here would conflict with that placement
+        opt_state = jax.tree_util.tree_map(jnp.asarray, tree["opt"])
+        rng = jax.device_put(tree["rng"], repl)
         carry = TrainCarry(params, state, opt_state, rng)
 
         step = make_train_step(model.module, self.loss, self.worker_optimizer)
@@ -125,7 +141,9 @@ class SPMDTrainer(Trainer):
                 # of non-addressable shards) — every process must enter it;
                 # only the write is gated on process 0
                 snapshot = host_fetch({"params": carry.params,
-                                       "state": carry.state})
+                                       "state": carry.state,
+                                       "opt": carry.opt_state,
+                                       "rng": carry.rng})
                 if jax.process_index() == 0:
                     manager.save(epoch, snapshot, metadata={"epoch": epoch})
         self.record_training_stop()

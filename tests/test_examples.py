@@ -1,0 +1,46 @@
+"""Examples as integration tests — the reference's de-facto test strategy
+(SURVEY §4: example notebooks exercised the full pipeline). Each example is
+run in-process on tiny configurations so the suite keeps them green."""
+
+import sys
+
+import numpy as np
+import pytest
+
+
+def run_example(module, argv=("x",)):
+    old = sys.argv
+    sys.argv = list(argv)
+    try:
+        import importlib
+        return importlib.import_module(module).main()
+    finally:
+        sys.argv = old
+
+
+@pytest.mark.parametrize("trainer", ["single", "ensemble", "averaging",
+                                     "downpour", "easgd", "aeasgd", "adag",
+                                     "dynsgd"])
+def test_mnist_workflow(trainer):
+    acc = run_example("examples.mnist_workflow",
+                      ("x", "--trainer", trainer, "--epochs", "2",
+                       "--n", "2048"))
+    assert acc > 0.75, (trainer, acc)
+
+
+def test_streaming_inference_example(capsys):
+    run_example("examples.streaming_inference")
+    out = capsys.readouterr().out
+    assert "streamed 10624 rows" in out
+
+
+def test_large_model_spmd_example(capsys):
+    run_example("examples.large_model_spmd")
+    out = capsys.readouterr().out
+    assert "next-token accuracy: 1.000" in out
+
+
+def test_long_context_pipeline_example(capsys):
+    run_example("examples.long_context_pipeline",
+                ("x", "--seq", "64", "--epochs", "2"))
+    assert "loss" in capsys.readouterr().out

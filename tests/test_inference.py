@@ -136,3 +136,44 @@ def test_wide_and_deep_forward_and_roundtrip():
 def test_wide_and_deep_rejects_bad_dims():
     with pytest.raises(ValueError, match="exceed wide_dim"):
         Model.build(zoo.wide_and_deep(wide_dim=50), (50,))
+
+
+def test_streaming_predictor_ragged_and_early_break():
+    import threading
+
+    from distkeras_tpu.inference import StreamingPredictor
+    from distkeras_tpu.models import Dense, Model, Sequential
+
+    model = Model.build(Sequential([Dense(3)]), (8,), seed=0)
+    pred = StreamingPredictor(model, batch_size=16)
+    rs = np.random.RandomState(0)
+
+    # ragged batches come back with their own lengths, in order
+    batches = [rs.randn(16, 8), rs.randn(7, 8), rs.randn(16, 8)]
+    outs = list(pred.predict_stream(iter(batches)))
+    assert [len(o) for o in outs] == [16, 7, 16]
+    np.testing.assert_allclose(outs[1], model.predict(batches[1]),
+                               rtol=1e-5)
+
+    # early consumer break must reap the staging thread (no leak)
+    before = threading.active_count()
+
+    def endless():
+        while True:
+            yield rs.randn(16, 8)
+
+    gen = pred.predict_stream(endless())
+    next(gen)
+    gen.close()
+    import time
+    deadline = time.time() + 5
+    while threading.active_count() > before and time.time() < deadline:
+        time.sleep(0.05)
+    assert threading.active_count() <= before
+
+    # stream errors surface to the consumer
+    def bad():
+        yield rs.randn(32, 8)  # exceeds batch_size
+
+    with pytest.raises(ValueError, match="exceeds"):
+        list(pred.predict_stream(bad()))
