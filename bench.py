@@ -84,18 +84,36 @@ def main():
     steps = 20 if on_accel else 2
     batch_candidates = [128, 64, 32] if on_accel else [8]
 
+    import sys
+    import traceback
+
     imgs_per_sec, last_loss = None, None
+    transient_retry = 1  # the tunnel backend occasionally drops a call
+    last_err = None
     for bs in batch_candidates:
         try:
             imgs_per_sec, last_loss = bench_resnet50(bs, steps)
             break
-        except Exception as e:  # OOM etc. — try smaller batch
+        except Exception as e:  # OOM -> smaller batch; transient -> retry
+            last_err = e
             msg = str(e).lower()
             if "resource" in msg or "memory" in msg or "oom" in msg:
                 continue
+            if transient_retry > 0:
+                transient_retry -= 1
+                traceback.print_exc(file=sys.stderr)
+                print(f"transient failure at batch {bs}; retrying once",
+                      file=sys.stderr, flush=True)
+                try:
+                    imgs_per_sec, last_loss = bench_resnet50(bs, steps)
+                    break
+                except Exception as e2:
+                    last_err = e2
+                    traceback.print_exc(file=sys.stderr)
+                    continue
             raise
     if imgs_per_sec is None:
-        raise RuntimeError("all batch sizes failed")
+        raise RuntimeError("all batch sizes failed") from last_err
 
     print(json.dumps({
         "metric": "resnet50_train_imgs_per_sec_per_chip",

@@ -61,14 +61,28 @@ class Predictor:
         self._in_sharding = sharded
         self._rep = replicated
 
+    @staticmethod
+    def _coerce(X: np.ndarray) -> np.ndarray:
+        """Contiguous host array; floats normalized to f32 (int feature
+        columns — token ids — pass through)."""
+        if np.issubdtype(np.asarray(X).dtype, np.integer):
+            return np.ascontiguousarray(X)
+        return np.ascontiguousarray(X, dtype=np.float32)
+
+    @staticmethod
+    def _pad_to(xb: np.ndarray, size: int):
+        """Zero-pad the batch dim to ``size`` (the ONE compiled shape);
+        returns ``(padded, pad)``."""
+        pad = size - len(xb)
+        if pad:
+            xb = np.concatenate(
+                [xb, np.zeros((pad,) + xb.shape[1:], xb.dtype)])
+        return xb, pad
+
     def predict(self, dataset: Dataset) -> Dataset:
         if self._fn is None:
             self._build()
-        X = dataset[self.features_col]
-        if np.issubdtype(X.dtype, np.integer):
-            X = np.ascontiguousarray(X)
-        else:
-            X = np.ascontiguousarray(X, dtype=np.float32)
+        X = self._coerce(dataset[self.features_col])
         n = len(X)
         n_dev = self.mesh.devices.size
         global_batch = n_dev * self.batch_size_per_device
@@ -78,11 +92,7 @@ class Predictor:
 
         outs = []
         for i in range(0, n, global_batch):
-            xb = X[i:i + global_batch]
-            pad = global_batch - len(xb)
-            if pad:  # pad to the one compiled shape
-                xb = np.concatenate(
-                    [xb, np.zeros((pad,) + xb.shape[1:], xb.dtype)])
+            xb, pad = self._pad_to(X[i:i + global_batch], global_batch)
             xb = jax.device_put(jnp.asarray(xb), self._in_sharding)
             yb = np.asarray(self._fn(params, state, xb))
             outs.append(yb[:global_batch - pad] if pad else yb)
@@ -160,15 +170,12 @@ class StreamingPredictor(Predictor):
         def stage():
             try:
                 for batch in source:
-                    xb = np.asarray(batch)
+                    xb = self._coerce(batch)
                     if len(xb) > self.batch_size:
                         raise ValueError(
                             f"stream batch of {len(xb)} exceeds "
                             f"batch_size {self.batch_size}")
-                    pad = self.batch_size - len(xb)
-                    if pad:
-                        xb = np.concatenate(
-                            [xb, np.zeros((pad,) + xb.shape[1:], xb.dtype)])
+                    xb, pad = self._pad_to(xb, self.batch_size)
                     dev = jax.device_put(jnp.asarray(xb), self._in_sharding)
                     if not put((dev, pad)):
                         return  # consumer gone; release source and exit

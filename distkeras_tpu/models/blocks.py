@@ -121,3 +121,33 @@ class WideAndDeep(Layer):
                 "deep_hidden": list(self.deep_hidden),
                 "num_classes": self.num_classes,
                 "activation": self.activation, "dtype": self.dtype}
+
+
+@register_layer
+class Remat(Layer):
+    """Rematerialization wrapper: recompute ``inner``'s activations during
+    the backward pass instead of storing them (``jax.checkpoint``).
+
+    No reference equivalent — this is the TPU HBM-for-FLOPs trade that makes
+    long-context/deep models fit: wrap each transformer block (or any
+    expensive sub-stack) and the peak activation footprint drops from
+    O(layers) to O(1) per wrapped unit at the cost of one extra forward.
+    """
+
+    def __init__(self, inner: Layer = None, inner_spec=None):
+        self.inner = inner if inner is not None else \
+            layer_from_spec(inner_spec)
+        if self.inner is None:
+            raise ValueError("Remat needs an inner layer")
+
+    def init(self, rng, input_shape):
+        return self.inner.init(rng, input_shape)
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        def f(p, s, xb, r):
+            return self.inner.apply(p, s, xb, training=training, rng=r)
+
+        return jax.checkpoint(f)(params, state, x, rng)
+
+    def get_config(self):
+        return {"inner_spec": layer_spec(self.inner)}

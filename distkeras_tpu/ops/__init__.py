@@ -16,3 +16,4 @@ from distkeras_tpu.ops.losses import LOSSES, get_loss  # noqa: F401
 from distkeras_tpu.ops.metrics import METRICS, get_metric  # noqa: F401
 from distkeras_tpu.ops.optimizers import (  # noqa: F401
     OPTIMIZERS, Optimizer, apply_updates, get_optimizer)
+from distkeras_tpu.ops.schedules import SCHEDULES, get_schedule  # noqa: F401

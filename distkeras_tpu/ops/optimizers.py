@@ -38,14 +38,42 @@ def _zeros_like(params):
     return jax.tree_util.tree_map(jnp.zeros_like, params)
 
 
+def _lr_resolver(learning_rate):
+    """``learning_rate`` may be a float or a schedule (``step -> lr``,
+    see ``ops.schedules``). Returns ``(scheduled, lr_fn)``: when scheduled,
+    the optimizer carries a step counter ``"t"`` in its state and evaluates
+    the schedule each update."""
+    if callable(learning_rate):
+        return True, learning_rate
+    v = float(learning_rate)
+    return False, lambda t: v
+
+
+def _with_step(scheduled: bool, state: dict) -> dict:
+    if scheduled:
+        state["t"] = jnp.zeros((), jnp.int32)
+    return state
+
+
+def _step_lr(scheduled, lr_fn, state):
+    """Advance the step counter and evaluate the (possibly scheduled) lr."""
+    if not scheduled:
+        return lr_fn(None), state
+    t = state["t"] + 1
+    return lr_fn(t - 1), {**state, "t": t}
+
+
 def sgd(learning_rate: float = 0.01, momentum: float = 0.0,
         nesterov: bool = False) -> Optimizer:
-    lr, mu = float(learning_rate), float(momentum)
+    scheduled, lrf = _lr_resolver(learning_rate)
+    mu = float(momentum)
 
     def init(params):
-        return {"velocity": _zeros_like(params)} if mu else {}
+        return _with_step(scheduled,
+                          {"velocity": _zeros_like(params)} if mu else {})
 
     def update(grads, state, params=None):
+        lr, state = _step_lr(scheduled, lrf, state)
         if not mu:
             return jax.tree_util.tree_map(lambda g: -lr * g, grads), state
         vel = jax.tree_util.tree_map(lambda v, g: mu * v - lr * g,
@@ -55,55 +83,60 @@ def sgd(learning_rate: float = 0.01, momentum: float = 0.0,
                                          vel, grads)
         else:
             upd = vel
-        return upd, {"velocity": vel}
+        return upd, {**state, "velocity": vel}
 
     return Optimizer(init, update, "sgd")
 
 
 def adagrad(learning_rate: float = 0.01, epsilon: float = 1e-7) -> Optimizer:
-    lr, eps = float(learning_rate), float(epsilon)
+    scheduled, lrf = _lr_resolver(learning_rate)
+    eps = float(epsilon)
 
     def init(params):
-        return {"accum": _zeros_like(params)}
+        return _with_step(scheduled, {"accum": _zeros_like(params)})
 
     def update(grads, state, params=None):
+        lr, state = _step_lr(scheduled, lrf, state)
         accum = jax.tree_util.tree_map(lambda a, g: a + jnp.square(g),
                                        state["accum"], grads)
         upd = jax.tree_util.tree_map(
             lambda g, a: -lr * g / (jnp.sqrt(a) + eps), grads, accum)
-        return upd, {"accum": accum}
+        return upd, {**state, "accum": accum}
 
     return Optimizer(init, update, "adagrad")
 
 
 def rmsprop(learning_rate: float = 0.001, rho: float = 0.9,
             epsilon: float = 1e-7) -> Optimizer:
-    lr, r, eps = float(learning_rate), float(rho), float(epsilon)
+    scheduled, lrf = _lr_resolver(learning_rate)
+    r, eps = float(rho), float(epsilon)
 
     def init(params):
-        return {"ms": _zeros_like(params)}
+        return _with_step(scheduled, {"ms": _zeros_like(params)})
 
     def update(grads, state, params=None):
+        lr, state = _step_lr(scheduled, lrf, state)
         ms = jax.tree_util.tree_map(
             lambda m, g: r * m + (1 - r) * jnp.square(g), state["ms"], grads)
         upd = jax.tree_util.tree_map(
             lambda g, m: -lr * g / (jnp.sqrt(m) + eps), grads, ms)
-        return upd, {"ms": ms}
+        return upd, {**state, "ms": ms}
 
     return Optimizer(init, update, "rmsprop")
 
 
 def adam(learning_rate: float = 0.001, beta1: float = 0.9,
          beta2: float = 0.999, epsilon: float = 1e-7) -> Optimizer:
-    lr, b1, b2, eps = (float(learning_rate), float(beta1), float(beta2),
-                       float(epsilon))
+    scheduled, lrf = _lr_resolver(learning_rate)
+    b1, b2, eps = float(beta1), float(beta2), float(epsilon)
 
     def init(params):
         return {"m": _zeros_like(params), "v": _zeros_like(params),
-                "t": jnp.zeros((), jnp.int32)}
+                "t": jnp.zeros((), jnp.int32)}  # adam always counts steps
 
     def update(grads, state, params=None):
         t = state["t"] + 1
+        lr = lrf(t - 1) if scheduled else lrf(None)
         m = jax.tree_util.tree_map(lambda m_, g: b1 * m_ + (1 - b1) * g,
                                    state["m"], grads)
         v = jax.tree_util.tree_map(
@@ -121,12 +154,15 @@ def adam(learning_rate: float = 0.001, beta1: float = 0.9,
 
 def adadelta(learning_rate: float = 1.0, rho: float = 0.95,
              epsilon: float = 1e-7) -> Optimizer:
-    lr, r, eps = float(learning_rate), float(rho), float(epsilon)
+    scheduled, lrf = _lr_resolver(learning_rate)
+    r, eps = float(rho), float(epsilon)
 
     def init(params):
-        return {"acc_g": _zeros_like(params), "acc_u": _zeros_like(params)}
+        return _with_step(scheduled, {"acc_g": _zeros_like(params),
+                                      "acc_u": _zeros_like(params)})
 
     def update(grads, state, params=None):
+        lr, state = _step_lr(scheduled, lrf, state)
         acc_g = jax.tree_util.tree_map(
             lambda a, g: r * a + (1 - r) * jnp.square(g), state["acc_g"],
             grads)
@@ -135,7 +171,7 @@ def adadelta(learning_rate: float = 1.0, rho: float = 0.95,
             jnp.sqrt(ag + eps), grads, acc_g, state["acc_u"])
         acc_u = jax.tree_util.tree_map(
             lambda a, u: r * a + (1 - r) * jnp.square(u), state["acc_u"], upd)
-        return upd, {"acc_g": acc_g, "acc_u": acc_u}
+        return upd, {**state, "acc_g": acc_g, "acc_u": acc_u}
 
     return Optimizer(init, update, "adadelta")
 

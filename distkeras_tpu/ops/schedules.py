@@ -1,0 +1,91 @@
+"""Learning-rate schedules: pure ``step -> lr`` functions.
+
+No reference equivalent (dist-keras forwards a fixed Keras optimizer config
+to every worker). Schedules are jit-traceable scalar functions of the
+optimizer's step counter, accepted anywhere a ``learning_rate`` float is
+(``get_optimizer('sgd', learning_rate=cosine_decay(0.1, 10_000))``) — the
+optimizer keeps the step count in its state, so schedules work unchanged
+under vmap/shard_map/pjit and survive checkpoint/resume.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence, Union
+
+import jax.numpy as jnp
+
+Schedule = Callable[[jnp.ndarray], jnp.ndarray]  # int32 step -> f32 lr
+
+
+def constant(value: float) -> Schedule:
+    v = float(value)
+    return lambda step: jnp.float32(v)
+
+
+def exponential_decay(init_value: float, decay_steps: int,
+                      decay_rate: float, staircase: bool = False) -> Schedule:
+    v, k, r = float(init_value), int(decay_steps), float(decay_rate)
+
+    def fn(step):
+        p = step.astype(jnp.float32) / k
+        if staircase:
+            p = jnp.floor(p)
+        return jnp.float32(v) * jnp.float32(r) ** p
+
+    return fn
+
+
+def cosine_decay(init_value: float, decay_steps: int,
+                 alpha: float = 0.0, warmup_steps: int = 0) -> Schedule:
+    """Linear warmup (0 -> init) over ``warmup_steps``, then cosine decay to
+    ``alpha * init_value`` over the remaining ``decay_steps``."""
+    v, k, a, w = float(init_value), int(decay_steps), float(alpha), \
+        int(warmup_steps)
+
+    def fn(step):
+        s = step.astype(jnp.float32)
+        warm = v * s / max(w, 1)
+        t = jnp.clip((s - w) / max(k, 1), 0.0, 1.0)
+        cos = v * (a + (1 - a) * 0.5 * (1 + jnp.cos(jnp.pi * t)))
+        return jnp.where(s < w, warm, cos).astype(jnp.float32)
+
+    return fn
+
+
+def piecewise_constant(boundaries: Sequence[int],
+                       values: Sequence[float]) -> Schedule:
+    """``values[i]`` for steps in ``[boundaries[i-1], boundaries[i])``;
+    needs ``len(values) == len(boundaries) + 1``."""
+    if len(values) != len(boundaries) + 1:
+        raise ValueError(
+            f"need len(values) == len(boundaries) + 1, got "
+            f"{len(values)} values / {len(boundaries)} boundaries")
+    bs = jnp.asarray(list(boundaries), jnp.int32)
+    vs = jnp.asarray(list(values), jnp.float32)
+
+    def fn(step):
+        idx = jnp.sum(step >= bs)
+        return vs[idx]
+
+    return fn
+
+
+SCHEDULES = {
+    "constant": constant,
+    "exponential_decay": exponential_decay,
+    "cosine_decay": cosine_decay,
+    "piecewise_constant": piecewise_constant,
+}
+
+
+def get_schedule(sched: Union[str, Schedule, float], **kwargs) -> Schedule:
+    if callable(sched):
+        return sched
+    if isinstance(sched, (int, float)):
+        return constant(sched)
+    try:
+        factory = SCHEDULES[sched]
+    except KeyError:
+        raise ValueError(f"Unknown schedule {sched!r}; "
+                         f"known: {sorted(SCHEDULES)}")
+    return factory(**kwargs)

@@ -67,17 +67,25 @@ def init_stacked_blocks(block: Layer, rng: jax.Array,
 
 
 def make_pipeline_fn(block: Layer, axis_name: str = "pp",
-                     state: Optional[Pytree] = None) -> Callable:
+                     state: Optional[Pytree] = None,
+                     remat: bool = False) -> Callable:
     """Returns ``fn(stacked_local_params, x_mb) -> y_mb`` for use under
     ``shard_map``: ``x_mb`` is ``[M, mb, ...]`` microbatched input
     (replicated over the pp axis), result likewise. ``state`` is the block's
-    (leafless) state-structure template from ``init_stacked_blocks``."""
+    (leafless) state-structure template from ``init_stacked_blocks``.
+    ``remat=True`` recomputes each layer's activations in the backward pass
+    (peak memory O(1) per stage instead of O(layers/stage))."""
     state = {} if state is None else state
+
+    def layer_apply(p, h):
+        return block.apply(p, state, h, training=False)[0]
+
+    if remat:
+        layer_apply = jax.checkpoint(layer_apply)
 
     def stage(local_params, h):
         def body(h, p):
-            y, _ = block.apply(p, state, h, training=False)
-            return y, None
+            return layer_apply(p, h), None
         h, _ = lax.scan(body, h, local_params)
         return h
 
@@ -121,12 +129,14 @@ class PipelinedLM:
     """
 
     def __init__(self, embed: Layer, block: Layer, head: Layer,
-                 num_layers: int, num_microbatches: int = 2):
+                 num_layers: int, num_microbatches: int = 2,
+                 remat: bool = False):
         self.embed = embed
         self.block = block
         self.head = head
         self.num_layers = int(num_layers)
         self.num_microbatches = int(num_microbatches)
+        self.remat = bool(remat)
         self._estate = self._bstate = self._hstate = {}  # set by init()
 
     # -- init ---------------------------------------------------------------
@@ -174,7 +184,8 @@ class PipelinedLM:
             raise ValueError(
                 f"num_layers {self.num_layers} must divide evenly over "
                 f"pp axis {pp_axis!r} (size {mesh.shape[pp_axis]})")
-        pipeline = make_pipeline_fn(self.block, pp_axis, self._bstate)
+        pipeline = make_pipeline_fn(self.block, pp_axis, self._bstate,
+                                    remat=self.remat)
         embed, head = self.embed, self.head
         estate, hstate = self._estate, self._hstate
         d_axes = tuple(data_axes)

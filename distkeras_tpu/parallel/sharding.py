@@ -200,6 +200,10 @@ class ShardingRules:
             "b2": P(ep, None),
         }
 
+    # Remat is a transparent wrapper: its params ARE the inner layer's
+    def _rule_Remat(self, layer, params):
+        return self.specs_for(layer.inner, params)
+
     # LSTM/GRU: wx [in, G*units], wh [units, G*units] — gate blocks make
     # naive column sharding wrong across the gate boundary UNLESS units is
     # divisible: [*, G*units] with units % tp == 0 shards each gate block

@@ -93,6 +93,52 @@ class SPMDTrainer(Trainer):
                            tp_axis=self.tp_axis, ep_axis=self.ep_axis,
                            fsdp_axis=self.fsdp_axis)
 
+    # -- resume plumbing ----------------------------------------------------
+    def _restore_full_carry(self, manager, model: Model):
+        """Returns ``(restored_host_tree | None, start_epoch)``.
+
+        The restore template's optimizer slot is host-numpy zeros built from
+        ``jax.eval_shape`` — nothing touches a device until placement. Old
+        checkpoints written before the full-carry format (params/state only)
+        restore with a warning and fresh optimizer moments.
+        """
+        if manager is None or not self.resume:
+            return None, 0
+        host_zeros = jax.tree_util.tree_map(
+            lambda s: np.zeros(s.shape, s.dtype),
+            jax.eval_shape(self.worker_optimizer.init, model.params))
+        template = {"params": model.params, "state": model.state,
+                    "opt": host_zeros,
+                    "rng": np.asarray(jax.random.PRNGKey(self.seed))}
+        try:
+            tree, start_epoch = self._maybe_resume(manager, template)
+        except KeyError:
+            import warnings
+            warnings.warn(
+                "checkpoint predates the full-carry format; restoring "
+                "params/state only (optimizer moments and rng restart "
+                "fresh)", stacklevel=2)
+            sub, start_epoch = self._maybe_resume(
+                manager, {"params": model.params, "state": model.state})
+            tree = {**template, **sub}
+        return (tree if start_epoch > 0 else None), start_epoch
+
+    def _place_opt(self, opt_host, host_params, param_sh):
+        """Place restored optimizer state: subtrees that mirror the params
+        structure (momentum/adam moments) are device_put shard-by-shard with
+        the params' shardings; anything else (step counters) goes up as
+        uncommitted scalars."""
+        pstruct = jax.tree_util.tree_structure(host_params)
+
+        def place(sub):
+            if jax.tree_util.tree_structure(sub) == pstruct:
+                return jax.tree_util.tree_map(jax.device_put, sub, param_sh)
+            return jax.tree_util.tree_map(jnp.asarray, sub)
+
+        if isinstance(opt_host, dict):
+            return {k: place(v) for k, v in opt_host.items()}
+        return jax.tree_util.tree_map(jnp.asarray, opt_host)
+
     # -- training -----------------------------------------------------------
     def train(self, dataset: Dataset) -> Model:
         model = self.master_model
@@ -103,21 +149,24 @@ class SPMDTrainer(Trainer):
         # rng) so a resumed run is bitwise-identical to an uninterrupted
         # one — same contract as SingleTrainer
         manager = self._checkpoint_manager()
-        tree, start_epoch = self._maybe_resume(
-            manager, {"params": model.params, "state": model.state,
-                      "opt": self.worker_optimizer.init(model.params),
-                      "rng": jax.random.PRNGKey(self.seed)})
+        restored, start_epoch = self._restore_full_carry(manager, model)
 
-        # committed placements: GSPMD keeps these layouts through the scan
-        params = jax.tree_util.tree_map(jax.device_put, tree["params"],
+        if restored is None:
+            # fresh start: shard params first, then init the optimizer
+            # UNDER jit so the moments are created already sharded/lazy —
+            # never materialized whole on one device
+            params = jax.tree_util.tree_map(jax.device_put, model.params,
+                                            param_sh)
+            state = jax.device_put(model.state, repl)
+            opt_state = jax.jit(self.worker_optimizer.init)(params)
+            rng = jax.device_put(jax.random.PRNGKey(self.seed), repl)
+        else:
+            params = jax.tree_util.tree_map(jax.device_put,
+                                            restored["params"], param_sh)
+            state = jax.device_put(restored["state"], repl)
+            opt_state = self._place_opt(restored["opt"], model.params,
                                         param_sh)
-        state = jax.device_put(tree["state"], repl)
-        # optimizer state: keep leaves UNCOMMITTED (plain asarray, no
-        # device_put) so the first run_epoch call reshards them onto
-        # whatever layout GSPMD propagates from the params — committing
-        # them here would conflict with that placement
-        opt_state = jax.tree_util.tree_map(jnp.asarray, tree["opt"])
-        rng = jax.device_put(tree["rng"], repl)
+            rng = jax.device_put(jnp.asarray(restored["rng"]), repl)
         carry = TrainCarry(params, state, opt_state, rng)
 
         step = make_train_step(model.module, self.loss, self.worker_optimizer)

@@ -239,3 +239,32 @@ def test_spmd_trainer_rejects_unknown_data_axis():
     model = Model.build(Sequential([Dense(4)]), (8,), seed=0)
     with pytest.raises(ValueError, match="data_axes"):
         SPMDTrainer(model, mesh=mesh, data_axes=("worker",), batch_size=8)
+
+
+def test_spmd_trainer_resumes_old_format_checkpoint(tmp_path):
+    """Checkpoints written before the full-carry format (params/state only)
+    must restore with a warning, not a KeyError."""
+    from distkeras_tpu.utils.checkpoint import CheckpointManager
+
+    rs = np.random.RandomState(4)
+    X = rs.randn(256, 8).astype(np.float32)
+    y = rs.randint(0, 3, 256)
+    ds = Dataset({"features": X, "label": y})
+    model = Model.build(Sequential([Dense(16, activation="relu"),
+                                    Dense(3)]), (8,), seed=0)
+
+    cdir = str(tmp_path / "old")
+    CheckpointManager(cdir).save(
+        0, {"params": model.params, "state": model.state},
+        metadata={"epoch": 0})
+
+    mesh = make_mesh_2d({"workers": 2, "tp": 2})
+    trainer = SPMDTrainer(
+        model, mesh=mesh, tp_axis="tp", batch_size=64, num_epoch=3,
+        checkpoint_dir=cdir, resume=True, worker_optimizer="adam",
+        optimizer_kwargs={"learning_rate": 0.01},
+        loss="sparse_categorical_crossentropy_from_logits")
+    with pytest.warns(UserWarning, match="full-carry"):
+        trainer.train(ds)
+    # resumed at epoch 1, trained the remaining 2
+    assert trainer.get_history().losses().shape[0] == 2 * (256 // 64)
