@@ -66,14 +66,18 @@ def bench_resnet50(batch_size: int, steps: int, image_size: int = 224):
     carry = TrainCarry(model.params, model.state,
                        optimizer.init(model.params), jax.random.PRNGKey(0))
 
-    # compile + warmup
+    # compile + warmup; fetch the VALUE — on tunneled backends
+    # block_until_ready returns before execution finishes, so only a
+    # device->host read proves the step ran
     carry, loss = train_step(carry, xb, yb)
-    jax.block_until_ready(loss)
+    _ = float(loss)
 
     t0 = time.perf_counter()
     for _ in range(steps):
         carry, loss = train_step(carry, xb, yb)
-    jax.block_until_ready(loss)
+    # fetching one updated param element bounds the whole timed region —
+    # it chains through every step INCLUDING the final optimizer update
+    _ = float(jax.tree_util.tree_leaves(carry.params)[0].ravel()[0])
     dt = time.perf_counter() - t0
     return batch_size * steps / dt, float(loss)
 
@@ -81,8 +85,8 @@ def bench_resnet50(batch_size: int, steps: int, image_size: int = 224):
 def main():
     platform = jax.default_backend()
     on_accel = platform not in ("cpu",)
-    steps = 20 if on_accel else 2
-    batch_candidates = [128, 64, 32] if on_accel else [8]
+    steps = 50 if on_accel else 2
+    batch_candidates = [256, 128, 64, 32] if on_accel else [8]
 
     import sys
     import traceback

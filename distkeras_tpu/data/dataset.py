@@ -20,6 +20,18 @@ from typing import Callable, Dict, Iterator, List, Optional, Sequence, Tuple
 import numpy as np
 
 
+def coerce_column(X: np.ndarray) -> np.ndarray:
+    """Contiguous host array with the framework dtype policy: integer
+    columns (token ids / class labels) keep exact integers — a float32 cast
+    would corrupt ids above 2^24 — everything else becomes float32. The ONE
+    coercion rule shared by training (``Dataset.arrays``) and inference
+    (``inference.predictors``)."""
+    X = np.asarray(X)
+    if np.issubdtype(X.dtype, np.integer):
+        return np.ascontiguousarray(X)
+    return np.ascontiguousarray(X, dtype=np.float32)
+
+
 class Dataset:
     """Immutable columnar dataset: named numpy columns of equal length."""
 
@@ -138,21 +150,10 @@ class Dataset:
     # -- training views ---------------------------------------------------
     def arrays(self, features_col: str = "features",
                label_col: Optional[str] = "label"):
-        X = self[features_col]
-        if np.issubdtype(X.dtype, np.integer):
-            # token-id features (Embedding models): keep exact integers —
-            # a float32 cast would corrupt ids above 2^24
-            X = np.ascontiguousarray(X)
-        else:
-            X = np.ascontiguousarray(X, dtype=np.float32)
+        X = coerce_column(self[features_col])
         if label_col is None or label_col not in self:
             return X, None
-        y = self[label_col]
-        if np.issubdtype(y.dtype, np.integer):
-            y = np.ascontiguousarray(y)
-        else:
-            y = np.ascontiguousarray(y, dtype=np.float32)
-        return X, y
+        return X, coerce_column(self[label_col])
 
     def batches(self, batch_size: int, features_col: str = "features",
                 label_col: Optional[str] = "label",

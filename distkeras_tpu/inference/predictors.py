@@ -21,7 +21,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from distkeras_tpu.data.dataset import Dataset
+from distkeras_tpu.data.dataset import Dataset, coerce_column
 from distkeras_tpu.models.core import Model
 from distkeras_tpu.parallel.mesh import make_mesh
 
@@ -61,13 +61,8 @@ class Predictor:
         self._in_sharding = sharded
         self._rep = replicated
 
-    @staticmethod
-    def _coerce(X: np.ndarray) -> np.ndarray:
-        """Contiguous host array; floats normalized to f32 (int feature
-        columns — token ids — pass through)."""
-        if np.issubdtype(np.asarray(X).dtype, np.integer):
-            return np.ascontiguousarray(X)
-        return np.ascontiguousarray(X, dtype=np.float32)
+    # the one shared dtype policy (training and inference must agree)
+    _coerce = staticmethod(coerce_column)
 
     @staticmethod
     def _pad_to(xb: np.ndarray, size: int):

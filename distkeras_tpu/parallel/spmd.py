@@ -94,33 +94,57 @@ class SPMDTrainer(Trainer):
                            fsdp_axis=self.fsdp_axis)
 
     # -- resume plumbing ----------------------------------------------------
+    def _ckpt_format(self, manager) -> int:
+        """0: no checkpoint; 1: old params/state-only; 2: full carry."""
+        latest = manager.latest_step()
+        if latest is None:
+            return 0
+        ks = manager.keys(latest) or []
+        return 2 if any(k == "opt" or k.startswith("opt/") for k in ks) \
+            else 1
+
     def _restore_full_carry(self, manager, model: Model):
         """Returns ``(restored_host_tree | None, start_epoch)``.
 
         The restore template's optimizer slot is host-numpy zeros built from
         ``jax.eval_shape`` — nothing touches a device until placement. Old
         checkpoints written before the full-carry format (params/state only)
-        restore with a warning and fresh optimizer moments.
+        restore with a warning and fresh optimizer moments. The format is
+        detected from the manifest and broadcast BEFORE the collective
+        restore, so every process enters ``_maybe_resume`` with the SAME
+        template structure (detecting via try/except on process 0 alone
+        would desynchronize the broadcast).
         """
         if manager is None or not self.resume:
             return None, 0
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+            flag = np.int32(self._ckpt_format(manager)
+                            if jax.process_index() == 0 else 0)
+            flag = int(multihost_utils.broadcast_one_to_all(flag))
+        else:
+            flag = self._ckpt_format(manager)
+        if flag == 0:
+            return None, 0
+
         host_zeros = jax.tree_util.tree_map(
             lambda s: np.zeros(s.shape, s.dtype),
             jax.eval_shape(self.worker_optimizer.init, model.params))
-        template = {"params": model.params, "state": model.state,
-                    "opt": host_zeros,
-                    "rng": np.asarray(jax.random.PRNGKey(self.seed))}
-        try:
-            tree, start_epoch = self._maybe_resume(manager, template)
-        except KeyError:
+        fresh_rng = np.asarray(jax.random.PRNGKey(self.seed))
+        template = {"params": model.params, "state": model.state}
+        if flag == 2:
+            template.update(opt=host_zeros, rng=fresh_rng)
+        else:
             import warnings
             warnings.warn(
                 "checkpoint predates the full-carry format; restoring "
                 "params/state only (optimizer moments and rng restart "
                 "fresh)", stacklevel=2)
-            sub, start_epoch = self._maybe_resume(
-                manager, {"params": model.params, "state": model.state})
-            tree = {**template, **sub}
+        tree, start_epoch = self._maybe_resume(manager, template)
+        if flag == 1:
+            # fresh moments are zeros for every optimizer in the registry,
+            # so the host-zeros stand-in IS the fresh state
+            tree = {**tree, "opt": host_zeros, "rng": fresh_rng}
         return (tree if start_epoch > 0 else None), start_epoch
 
     def _place_opt(self, opt_host, host_params, param_sh):

@@ -94,6 +94,17 @@ class CheckpointManager:
         flat = {k: arrays[k] for k in arrays.files}
         return _unflatten_like(template, flat)
 
+    def keys(self, step: Optional[int] = None) -> Optional[List[str]]:
+        """Flat array keys stored in a checkpoint (format introspection —
+        e.g. distinguishing params-only snapshots from full-carry ones)."""
+        if step is None:
+            step = self.latest_step()
+        if step is None:
+            return None
+        path = os.path.join(self.directory, f"step_{step}")
+        arrays = np.load(os.path.join(path, ARRAYS))
+        return list(arrays.files)
+
     def metadata(self, step: Optional[int] = None) -> Dict:
         if step is None:
             step = self.latest_step()
