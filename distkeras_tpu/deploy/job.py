@@ -82,6 +82,12 @@ class JobSpec:
     env: Dict[str, str] = field(default_factory=dict)
     name: str = "dkt-job"
     timeout: Optional[float] = None   # seconds; None = no limit
+    #: whole-job relaunch count on failure — the analogue of Spark's task
+    #: retry (SURVEY §5.3): the reference's failed executor re-trains its
+    #: partition from the current PS center; here the relaunched job resumes
+    #: from the last checkpoint when the script passes
+    #: ``checkpoint_dir=..., resume=True``
+    max_retries: int = 0
 
     def to_dict(self) -> Dict:
         return {"script": self.script, "args": list(self.args),
@@ -89,7 +95,7 @@ class JobSpec:
                 "devices_per_process": self.devices_per_process,
                 "coordinator_port": self.coordinator_port,
                 "env": dict(self.env), "name": self.name,
-                "timeout": self.timeout}
+                "timeout": self.timeout, "max_retries": self.max_retries}
 
     @classmethod
     def from_dict(cls, d: Dict) -> "JobSpec":
@@ -102,6 +108,7 @@ class JobResult:
     returncodes: List[int]
     logs: List[str]          # per-process combined stdout/stderr
     wall_seconds: float
+    attempts: int = 1        # launches used (1 = no retry needed)
 
     @property
     def ok(self) -> bool:
@@ -135,8 +142,24 @@ class Job:
         self.spec = spec
 
     def run(self) -> JobResult:
+        """Launch; on failure relaunch up to ``max_retries`` times (each
+        attempt gets a fresh coordinator port). Returns the last attempt's
+        result with ``attempts`` filled in."""
+        attempts = max(1, self.spec.max_retries + 1)
+        for attempt in range(attempts):
+            result = self._run_once(force_free_port=attempt > 0)
+            result.attempts = attempt + 1
+            if result.ok or attempt == attempts - 1:
+                return result
+        return result  # pragma: no cover
+
+    def _run_once(self, force_free_port: bool = False) -> JobResult:
         spec = self.spec
-        port = spec.coordinator_port or _free_port()
+        # retries always re-pick: a pinned port can still be held by a
+        # not-yet-reaped child of the failed attempt
+        port = (spec.coordinator_port
+                if spec.coordinator_port and not force_free_port
+                else _free_port())
         coord = f"127.0.0.1:{port}"
         t0 = time.perf_counter()
         procs = []

@@ -116,8 +116,13 @@ def _flash_forward(q, k, v, scale: float, causal: bool, block_q: int,
                    block_k: int, interpret: bool):
     b, sq, h, d = q.shape
     sk = k.shape[1]
-    block_q = min(block_q, sq)
-    block_k = min(block_k, sk)
+    # clamp to the (8-rounded) sequence length: Mosaic requires the block's
+    # second-to-last dim % 8 == 0, so a raw min(block, seq) would fail to
+    # lower for seq in (block, 8k) that isn't a multiple of 8 — the padder
+    # below then pads seq up to the rounded block
+    round8 = lambda n: max(8, -(-n // 8) * 8)
+    block_q = min(block_q, round8(sq))
+    block_k = min(block_k, round8(sk))
     qp, kp, vp = _pad_seq(q, block_q), _pad_seq(k, block_k), _pad_seq(v,
                                                                       block_k)
     sq_p, sk_p = qp.shape[1], kp.shape[1]

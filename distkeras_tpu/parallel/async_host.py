@@ -124,12 +124,12 @@ class HostAsyncTrainer(Trainer):
                     jax.random.PRNGKey(self.seed + 7919 * (widx + 1)),
                     device))
             pull_leaves = leaves0
-            losses = []
+            step_outs = []
             for s in range(Xw.shape[0]):
                 xb = jax.device_put(Xw[s], device)
                 yb = jax.device_put(Yw[s], device)
-                carry, loss = step_fn(carry, (xb, yb))
-                losses.append(loss)
+                carry, sout = step_fn(carry, (xb, yb))
+                step_outs.append(sout)
                 if (s + 1) % K != 0:
                     continue
                 w_leaves = [np.asarray(l)
@@ -148,8 +148,16 @@ class HostAsyncTrainer(Trainer):
                     pull_leaves, clock = client.pull()
                     carry = carry._replace(
                         params=jax.device_put(unflat(pull_leaves), device))
+            fetched = jax.device_get(step_outs)
+            if fetched and isinstance(fetched[0], tuple):  # (loss, metrics)
+                losses = np.asarray([f[0] for f in fetched])
+                metrics = {nm: np.asarray([f[1][nm] for f in fetched])
+                           for nm in fetched[0][1]}
+            else:
+                losses, metrics = np.asarray(fetched), {}
             out[widx] = {
-                "losses": np.asarray(jax.device_get(losses)),
+                "losses": losses,
+                "metrics": metrics,
                 "state": jax.device_get(carry.state),
                 # uncommitted residual, flushed into the center post-join
                 "params": [np.asarray(l) for l in
@@ -190,7 +198,8 @@ class HostAsyncTrainer(Trainer):
             port = self.parameter_server.start(host="127.0.0.1")
 
         step_fn = jax.jit(make_train_step(model.module, self.loss,
-                                          self.worker_optimizer))
+                                          self.worker_optimizer,
+                                          self._metric_fns()))
 
         self.record_training_start()
         try:
@@ -218,7 +227,11 @@ class HostAsyncTrainer(Trainer):
                     raise errors[0][1]
                 losses = np.stack([out[i]["losses"] for i in range(n)],
                                   axis=1)
-                self.history.append_epoch(loss=losses)
+                self.history.append_epoch(
+                    loss=losses,
+                    **{nm: np.stack([out[i]["metrics"][nm]
+                                     for i in range(n)], axis=1)
+                       for nm in out[0]["metrics"]})
 
                 # flush uncommitted partial-window residuals EVERY epoch —
                 # workers re-pull the center at the next epoch start, which

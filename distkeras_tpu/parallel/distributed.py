@@ -72,7 +72,8 @@ class DistributedTrainer(Trainer):
             model.module, self.loss, self.worker_optimizer,
             self.allocate_algorithm(), mesh,
             EngineConfig(num_workers=self.num_workers,
-                         window=self._window(S)))
+                         window=self._window(S)),
+            metric_fns=self._metric_fns())
 
         # resume restores the CENTER; workers restart from it — the same
         # semantic as the reference's Spark task retry, which re-trains a
@@ -94,8 +95,10 @@ class DistributedTrainer(Trainer):
         # with this epoch's device step (utils/prefetch.py)
         for epoch, (Xs, Ys, S) in Prefetcher(
                 assemble, range(start_epoch, self.num_epoch)):
-            state, losses = engine.run_epoch(state, Xs, Ys)
-            self.history.append_epoch(loss=host_fetch(losses))
+            state, outs = engine.run_epoch(state, Xs, Ys)
+            losses, mets = self._split_outs(outs)
+            self.history.append_epoch(loss=host_fetch(losses),
+                                      **host_fetch(mets))
             # cadence check BEFORE extract_model: the full-state device->host
             # transfer is expensive and must only happen on save epochs
             extracted = None

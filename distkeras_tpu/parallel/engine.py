@@ -318,13 +318,15 @@ class DistributedEngine:
     """Compiles and runs the per-epoch SPMD program for one algorithm."""
 
     def __init__(self, module, loss_fn: Callable, optimizer: Optimizer,
-                 algo: DistAlgorithm, mesh: Mesh, config: EngineConfig):
+                 algo: DistAlgorithm, mesh: Mesh, config: EngineConfig,
+                 metric_fns: Optional[Dict[str, Callable]] = None):
         self.module = module
         self.loss_fn = loss_fn
         self.optimizer = optimizer
         self.algo = algo
         self.mesh = mesh
         self.config = config
+        self.metric_fns = metric_fns
 
         n = config.num_workers
         K = config.window
@@ -373,7 +375,7 @@ class DistributedEngine:
     def _build(self):
         axis = self.config.axis_name
         train_step = make_train_step(self.module, self.loss_fn,
-                                     self.optimizer)
+                                     self.optimizer, self.metric_fns)
         algo = self.algo
         Ks, offsets = self._Ks, self._offsets
 
@@ -391,7 +393,7 @@ class DistributedEngine:
                 w, center, server_aux, gt = carry
                 xb, yb = batch
                 tc = TrainCarry(w["params"], w["state"], w["opt"], w["rng"])
-                tc, loss = train_step(tc, (xb, yb))
+                tc, outs = train_step(tc, (xb, yb))
                 w = {**w, "params": tc.params, "state": tc.state,
                      "opt": tc.opt_state, "rng": tc.rng}
 
@@ -411,9 +413,9 @@ class DistributedEngine:
                 w = {**w, "params": new_params, "pull": new_pull,
                      "extras": new_extras}
                 center2 = {**center, "params": new_cparams}
-                return (w, center2, new_aux, gt + 1), loss
+                return (w, center2, new_aux, gt + 1), outs
 
-            (w, center, server_aux, gt), losses = lax.scan(
+            (w, center, server_aux, gt), outs = lax.scan(
                 body, (w, center, server_aux, gt0), (X[:, 0], Y[:, 0]))
 
             new_state = {
@@ -421,7 +423,9 @@ class DistributedEngine:
                 "center": center,
                 "server": {"aux": server_aux, "t": gt},
             }
-            return new_state, losses[:, None]
+            # per-step scalars ([S] loss, and metric values when enabled)
+            # gain the worker axis back: [S] -> [S, 1]
+            return new_state, _tmap(lambda a: a[:, None], outs)
 
         state_specs = {"worker": P(axis), "center": P(), "server": P()}
         mapped = jax.shard_map(

@@ -95,13 +95,17 @@ class SPMDTrainer(Trainer):
 
     # -- resume plumbing ----------------------------------------------------
     def _ckpt_format(self, manager) -> int:
-        """0: no checkpoint; 1: old params/state-only; 2: full carry."""
+        """0: no checkpoint; 1: old params/state-only; 2: full carry.
+
+        Detected by the rng key, not the opt keys: an EMPTY optimizer state
+        (plain sgd) flattens to no ``opt/`` entries at all, but every
+        full-carry snapshot stores ``rng``."""
         latest = manager.latest_step()
         if latest is None:
             return 0
         ks = manager.keys(latest) or []
-        return 2 if any(k == "opt" or k.startswith("opt/") for k in ks) \
-            else 1
+        return 2 if any(k == "rng" or k.startswith("rng/") or k == "opt"
+                        or k.startswith("opt/") for k in ks) else 1
 
     def _restore_full_carry(self, manager, model: Model):
         """Returns ``(restored_host_tree | None, start_epoch)``.
@@ -193,7 +197,8 @@ class SPMDTrainer(Trainer):
             rng = jax.device_put(jnp.asarray(restored["rng"]), repl)
         carry = TrainCarry(params, state, opt_state, rng)
 
-        step = make_train_step(model.module, self.loss, self.worker_optimizer)
+        step = make_train_step(model.module, self.loss, self.worker_optimizer,
+                               self._metric_fns())
 
         @partial(jax.jit, donate_argnums=(0,))
         def run_epoch(carry, Xs, Ys):
@@ -207,8 +212,10 @@ class SPMDTrainer(Trainer):
                 assemble, range(start_epoch, self.num_epoch)):
             Xs = jax.device_put(Xs, data_sh)
             Ys = jax.device_put(Ys, data_sh)
-            carry, losses = run_epoch(carry, Xs, Ys)
-            self.history.append_epoch(loss=host_fetch(losses))
+            carry, outs = run_epoch(carry, Xs, Ys)
+            losses, mets = self._split_outs(outs)
+            self.history.append_epoch(loss=host_fetch(losses),
+                                      **host_fetch(mets))
             if manager is not None and self._should_checkpoint(epoch):
                 # host_fetch is a COLLECTIVE under multi-process (allgather
                 # of non-addressable shards) — every process must enter it;

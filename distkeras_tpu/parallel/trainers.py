@@ -135,6 +135,22 @@ class Trainer:
         """Reference: ``Trainer.serialize`` — serialized master model."""
         return serialize_model(self.master_model)
 
+    def _metric_fns(self):
+        """{name: fn} for the constructor's ``metrics`` list (reference:
+        Keras ``model.compile(metrics=...)`` per worker), or None."""
+        if not self.metrics:
+            return None
+        from distkeras_tpu.ops.metrics import get_metric
+        return {(m if isinstance(m, str) else getattr(m, "__name__", "metric")
+                 ): get_metric(m) for m in self.metrics}
+
+    @staticmethod
+    def _split_outs(outs):
+        """Scan outputs -> (losses, metrics_dict) for either step shape."""
+        if isinstance(outs, tuple):
+            return outs[0], outs[1]
+        return outs, {}
+
     # -- data plumbing -----------------------------------------------------
     def _training_arrays(self, dataset: Dataset):
         X, y = dataset.arrays(self.features_col, self.label_col)
@@ -165,7 +181,8 @@ class SingleTrainer(Trainer):
     def train(self, dataset: Dataset) -> Model:
         model = self.master_model
         X, y = self._training_arrays(dataset)
-        step = make_train_step(model.module, self.loss, self.worker_optimizer)
+        step = make_train_step(model.module, self.loss, self.worker_optimizer,
+                               self._metric_fns())
         runner = make_epoch_runner(step)
 
         # SingleTrainer checkpoints the FULL carry (params + model state +
@@ -188,8 +205,10 @@ class SingleTrainer(Trainer):
         # trains epoch e (utils/prefetch.py)
         for epoch, (Xs, Ys, n_steps) in Prefetcher(
                 assemble, range(start_epoch, self.num_epoch)):
-            carry, losses = runner(carry, Xs, Ys)
-            self.history.append_epoch(loss=jax.device_get(losses))
+            carry, outs = runner(carry, Xs, Ys)
+            losses, mets = self._split_outs(outs)
+            self.history.append_epoch(loss=jax.device_get(losses),
+                                      **jax.device_get(mets))
             if manager is not None and self._should_checkpoint(epoch):
                 manager.save(epoch,
                              {"params": carry.params, "state": carry.state,
@@ -233,7 +252,8 @@ class EnsembleTrainer(Trainer):
         opt_state = jax.vmap(self.worker_optimizer.init)(params)
         rngs = jax.random.split(jax.random.PRNGKey(self.seed), k)
 
-        step = make_train_step(base.module, self.loss, self.worker_optimizer)
+        step = make_train_step(base.module, self.loss, self.worker_optimizer,
+                               self._metric_fns())
 
         @jax.jit
         def run_epoch(carry, Xk, Yk):
@@ -251,9 +271,12 @@ class EnsembleTrainer(Trainer):
                 for i in range(k)]
             Xk = np.stack([s[0] for s in stacked])  # [k, steps, bs, ...]
             Yk = np.stack([s[1] for s in stacked])
-            carry, losses = run_epoch(carry, Xk, Yk)
-            # losses: [k, steps] -> record as [steps, k]
-            self.history.append_epoch(loss=jax.device_get(losses).T)
+            carry, outs = run_epoch(carry, Xk, Yk)
+            losses, mets = self._split_outs(outs)
+            # [k, steps] -> record as [steps, k]
+            self.history.append_epoch(
+                loss=jax.device_get(losses).T,
+                **{n: jax.device_get(v).T for n, v in mets.items()})
         self.record_training_stop()
 
         params_h = jax.device_get(carry.params)

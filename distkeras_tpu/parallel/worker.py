@@ -35,12 +35,17 @@ class TrainCarry(NamedTuple):
     rng: jax.Array
 
 
-def make_train_step(module, loss_fn: Callable,
-                    optimizer: Optimizer) -> Callable:
+def make_train_step(module, loss_fn: Callable, optimizer: Optimizer,
+                    metric_fns: Optional[dict] = None) -> Callable:
     """Build the per-minibatch step: grad -> optimizer update -> new carry.
 
     Equivalent role to one ``model.train_on_batch`` call in the reference
     worker loop, as a pure function usable under scan/vmap/shard_map.
+
+    With ``metric_fns`` ({name: fn(y_true, y_pred)}), the step returns
+    ``(carry, (loss, {name: value}))`` — the reference's per-batch Keras
+    metrics, computed on-device from the training forward's outputs at
+    negligible cost (XLA fuses them into the existing graph).
     """
 
     def train_step(carry: TrainCarry, batch) -> Tuple[TrainCarry, jax.Array]:
@@ -50,14 +55,18 @@ def make_train_step(module, loss_fn: Callable,
         def objective(params):
             out, new_state = module.apply(params, carry.state, xb,
                                           training=True, rng=sub)
-            return loss_fn(yb, out), new_state
+            return loss_fn(yb, out), (new_state, out)
 
-        (loss, new_state), grads = jax.value_and_grad(
+        (loss, (new_state, out)), grads = jax.value_and_grad(
             objective, has_aux=True)(carry.params)
         updates, new_opt_state = optimizer.update(grads, carry.opt_state,
                                                   carry.params)
         new_params = apply_updates(carry.params, updates)
-        return TrainCarry(new_params, new_state, new_opt_state, rng), loss
+        new_carry = TrainCarry(new_params, new_state, new_opt_state, rng)
+        if metric_fns:
+            return new_carry, (loss, {name: fn(yb, out)
+                                      for name, fn in metric_fns.items()})
+        return new_carry, loss
 
     return train_step
 

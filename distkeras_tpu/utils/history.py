@@ -48,6 +48,22 @@ class History:
             return np.array([])
         return np.concatenate([e["loss"] for e in self.epochs], axis=0)
 
+    def metric(self, name: str) -> np.ndarray:
+        """Per-step values of a named training metric (constructor
+        ``metrics=[...]``), concatenated across epochs — same shape contract
+        as ``losses()``."""
+        if not self.epochs:
+            return np.array([])
+        missing = [i for i, e in enumerate(self.epochs) if name not in e]
+        if missing:
+            raise KeyError(
+                f"metric {name!r} not recorded (have: "
+                f"{sorted(self.epochs[0])})")
+        return np.concatenate([e[name] for e in self.epochs], axis=0)
+
+    def metric_names(self) -> List[str]:
+        return sorted(self.epochs[0]) if self.epochs else []
+
     def final_loss(self) -> float:
         losses = self.losses()
         if losses.size == 0:

@@ -203,3 +203,39 @@ def test_multiprocess_checkpoint_resume_consistent(tmp_path):
     # resumed run trained only the REMAINING epochs, identically on both
     # processes
     assert lines[0].split()[2:] == lines[1].split()[2:], lines
+
+
+def test_job_retry_recovers(tmp_path):
+    """Whole-job relaunch (the Spark-task-retry analogue): first attempt
+    crashes after leaving a sentinel; the retry finds it and succeeds."""
+    sentinel = tmp_path / "attempted"
+    script = _write(tmp_path, "flaky.py", f"""
+        import os, sys
+        from distkeras_tpu.deploy import initialize_from_env
+        info = initialize_from_env()
+        if not os.path.exists({str(sentinel)!r}):
+            if info["process_id"] == 0:
+                open({str(sentinel)!r}, "w").close()
+            sys.exit(1)  # simulated worker crash on attempt 1
+        print(f"RECOVERED {{info['process_id']}}")
+    """)
+    spec = JobSpec(script=script, num_processes=2, devices_per_process=2,
+                   env={"PYTHONPATH": REPO}, timeout=240, max_retries=2)
+    result = Job(spec).run()
+    assert result.ok, result.logs
+    assert result.attempts == 2
+    assert any("RECOVERED" in log for log in result.logs)
+    assert "max_retries" in spec.to_dict()
+
+
+def test_job_no_retry_reports_failure(tmp_path):
+    script = _write(tmp_path, "fail.py", """
+        import sys
+        from distkeras_tpu.deploy import initialize_from_env
+        initialize_from_env()
+        sys.exit(3)
+    """)
+    result = Job(JobSpec(script=script, num_processes=2,
+                         devices_per_process=2, env={"PYTHONPATH": REPO},
+                         timeout=240)).run()
+    assert not result.ok and result.attempts == 1

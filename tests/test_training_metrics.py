@@ -1,0 +1,111 @@
+"""Per-batch training metrics (reference: Keras history objects collected
+from every worker — SURVEY §5.1). Metrics are computed on-device inside the
+jitted train step and recorded per step in History."""
+
+import numpy as np
+import pytest
+
+from distkeras_tpu.data import Dataset
+from distkeras_tpu.models import Dense, Model, Sequential
+from distkeras_tpu.parallel import (AEASGD, DOWNPOUR, SingleTrainer,
+                                    SPMDTrainer, make_mesh_2d)
+
+
+def make_problem(seed=0, N=1024, D=8, C=3):
+    rs = np.random.RandomState(seed)
+    X = rs.randn(N, D).astype(np.float32)
+    y = (X @ rs.randn(D, C)).argmax(-1)
+    return Dataset({"features": X, "label": y}), D, C
+
+
+COMMON = dict(worker_optimizer="momentum",
+              optimizer_kwargs={"learning_rate": 0.05},
+              loss="sparse_categorical_crossentropy_from_logits",
+              metrics=["accuracy"], batch_size=64, num_epoch=4)
+
+
+def check(trainer, ds, workers=None):
+    trainer.train(ds)
+    h = trainer.get_history()
+    acc = h.metric("accuracy")
+    losses = h.losses()
+    assert acc.shape == losses.shape
+    assert np.isfinite(acc).all() and (0 <= acc).all() and (acc <= 1).all()
+    # training accuracy on a separable problem must improve
+    assert acc[-4:].mean() > acc[:4].mean()
+    assert acc[-4:].mean() > 0.7, acc[-4:].mean()
+    assert "accuracy" in h.metric_names()
+
+
+def test_single_trainer_metrics():
+    ds, D, C = make_problem()
+    model = Model.build(Sequential([Dense(32, activation="relu"),
+                                    Dense(C)]), (D,), seed=0)
+    check(SingleTrainer(model, **COMMON), ds)
+
+
+def test_distributed_trainer_metrics():
+    ds, D, C = make_problem(1, N=4096)
+    model = Model.build(Sequential([Dense(32, activation="relu"),
+                                    Dense(C)]), (D,), seed=0)
+    kwargs = {**COMMON, "num_epoch": 8, "batch_size": 32}
+    tr = AEASGD(model, num_workers=8, communication_window=4, rho=5.0,
+                learning_rate=0.02, **kwargs)
+    tr.train(ds)
+    acc = tr.get_history().metric("accuracy")
+    assert acc.shape == tr.get_history().losses().shape  # [steps, workers]
+    assert acc.shape[1] == 8
+    assert acc[-8:].mean() > 0.7, acc[-8:].mean()
+
+
+def test_spmd_trainer_metrics():
+    ds, D, C = make_problem(2)
+    model = Model.build(Sequential([Dense(32, activation="relu"),
+                                    Dense(C)]), (D,), seed=0)
+    tr = SPMDTrainer(model, mesh=make_mesh_2d({"workers": 2, "tp": 4}),
+                     tp_axis="tp", **COMMON)
+    check(tr, ds)
+
+
+def test_metric_missing_raises():
+    ds, D, C = make_problem()
+    model = Model.build(Sequential([Dense(C)]), (D,), seed=0)
+    kwargs = {**COMMON, "metrics": None}
+    tr = SingleTrainer(model, **kwargs)
+    tr.train(ds)
+    with pytest.raises(KeyError, match="not recorded"):
+        tr.get_history().metric("accuracy")
+
+
+def test_unknown_metric_name():
+    ds, D, C = make_problem()
+    model = Model.build(Sequential([Dense(C)]), (D,), seed=0)
+    kwargs = {**COMMON, "metrics": ["nope"]}
+    with pytest.raises(ValueError, match="Unknown metric"):
+        SingleTrainer(model, **kwargs).train(ds)
+
+
+def test_ensemble_trainer_metrics():
+    from distkeras_tpu.parallel import EnsembleTrainer
+    ds, D, C = make_problem(3)
+    model = Model.build(Sequential([Dense(16, activation="relu"),
+                                    Dense(C)]), (D,), seed=0)
+    tr = EnsembleTrainer(model, num_models=2, **COMMON)
+    tr.train(ds)
+    acc = tr.get_history().metric("accuracy")
+    assert acc.shape == tr.get_history().losses().shape  # [steps, k]
+    assert acc.shape[1] == 2
+    assert acc[-4:].mean() > 0.7
+
+
+def test_host_async_trainer_metrics():
+    from distkeras_tpu.parallel import HostAsyncTrainer
+    ds, D, C = make_problem(4, N=2048)
+    model = Model.build(Sequential([Dense(16, activation="relu"),
+                                    Dense(C)]), (D,), seed=0)
+    tr = HostAsyncTrainer(model, num_workers=4, communication_window=4,
+                          **{**COMMON, "num_epoch": 6})
+    tr.train(ds)
+    acc = tr.get_history().metric("accuracy")
+    assert acc.shape == tr.get_history().losses().shape
+    assert acc[-8:].mean() > 0.6, acc[-8:].mean()
