@@ -1,7 +1,8 @@
 """Data plane: columnar Dataset + feature transformers (Spark-DataFrame
 ingest replacement)."""
 
-from distkeras_tpu.data.dataset import Dataset  # noqa: F401
+from distkeras_tpu.data.dataset import Dataset, coerce_column  # noqa: F401
+from distkeras_tpu.data.adapters import from_iterable, from_torch  # noqa: F401,E501
 from distkeras_tpu.data.transformers import (  # noqa: F401
     DenseTransformer, LabelIndexTransformer, MinMaxTransformer,
     OneHotTransformer, ReshapeTransformer, StandardScaleTransformer,

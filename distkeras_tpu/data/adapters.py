@@ -1,0 +1,105 @@
+"""Dataset adapters: build the columnar ``Dataset`` from external sources.
+
+Reference parity: dist-keras ingests whatever Spark can read (CSV through a
+DataFrame, with examples also covering Kafka streams). The columnar core
+here already reads CSV natively (``Dataset.from_csv``); these adapters
+cover the other ingestion routes a reference user expects:
+
+  * ``from_iterable`` — any iterable of (features, label) pairs or dicts;
+  * ``from_torch`` — a ``torch.utils.data.Dataset`` or ``DataLoader``
+    (torch stays a host-side feeder; tensors are converted to numpy
+    columns, never touching the TPU path).
+
+All adapters MATERIALIZE to contiguous columns — the trainers' jitted epoch
+scans want ``[steps, batch, ...]`` stacks, not per-row iterators (the
+reference's per-row marshalling is the bottleneck SURVEY §3.1 flags).
+For unbounded streams use ``inference.StreamingPredictor`` (inference) or
+feed epoch-sized slices.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterable, Optional
+
+import numpy as np
+
+from distkeras_tpu.data.dataset import Dataset
+
+
+def _to_numpy(x) -> np.ndarray:
+    if hasattr(x, "detach"):      # torch tensor
+        x = x.detach().cpu().numpy()
+    return np.asarray(x)
+
+
+def from_iterable(rows: Iterable[Any], features_col: str = "features",
+                  label_col: str = "label") -> Dataset:
+    """Iterable of ``(features, label)`` pairs, ``features`` only, or
+    ``{col: value}`` dicts -> columnar Dataset."""
+    feats, labels, dicts = [], [], None
+    for row in rows:
+        if isinstance(row, dict):
+            if dicts is None:
+                dicts = {k: [] for k in row}
+            for k, v in row.items():
+                dicts[k].append(_to_numpy(v))
+        elif isinstance(row, (tuple, list)) and len(row) == 2:
+            feats.append(_to_numpy(row[0]))
+            labels.append(_to_numpy(row[1]))
+        else:
+            feats.append(_to_numpy(row))
+    if dicts is not None:
+        return Dataset({k: np.stack(v) for k, v in dicts.items()})
+    if not feats:
+        raise ValueError("empty iterable")
+    cols = {features_col: np.stack(feats)}
+    if labels:
+        cols[label_col] = np.stack(labels)
+    return Dataset(cols)
+
+
+def from_torch(source, features_col: str = "features",
+               label_col: str = "label",
+               limit: Optional[int] = None) -> Dataset:
+    """``torch.utils.data.Dataset`` / ``DataLoader`` -> columnar Dataset.
+
+    DataLoader batches are concatenated back into flat columns (so the
+    loader's own batch size is irrelevant — trainers re-batch). ``limit``
+    caps the number of EXAMPLES taken (useful for huge map-style datasets).
+    """
+    feats, labels, n = [], [], 0
+
+    def push(f, l=None):
+        nonlocal n
+        f = _to_numpy(f)
+        batched = f.ndim > 0 and _looks_batched(source)
+        if batched:
+            feats.append(f)
+            n += len(f)
+        else:
+            feats.append(f[None])
+            n += 1
+        if l is not None:
+            l = _to_numpy(l)
+            labels.append(l if batched else l[None])
+
+    for item in source:
+        if isinstance(item, (tuple, list)) and len(item) == 2:
+            push(item[0], item[1])
+        else:
+            push(item)
+        if limit is not None and n >= limit:
+            break
+
+    if not feats:
+        raise ValueError("empty torch source")
+    cols = {features_col: np.concatenate(feats)[:limit]}
+    if labels:
+        cols[label_col] = np.concatenate(labels)[:limit]
+    return Dataset(cols)
+
+
+def _looks_batched(source) -> bool:
+    """DataLoaders yield batches; map-style Datasets yield single rows."""
+    t = type(source).__mro__
+    return any(c.__name__ == "DataLoader" for c in t)

@@ -121,7 +121,8 @@ class PositionalEmbedding(Layer):
                 "seq_axis_name": self.seq_axis_name}
 
 
-def _attention_compute(q, k, v, *, causal, impl, axis_name=None):
+def _attention_compute(q, k, v, *, causal, impl, axis_name=None,
+                       ring_block_size=None):
     """Dispatch on attention implementation. q/k/v are BSHD."""
     if impl == "flash":
         from distkeras_tpu.ops.flash_attention import flash_attention
@@ -134,7 +135,8 @@ def _attention_compute(q, k, v, *, causal, impl, axis_name=None):
                 "without it RoPE positions and causal masks would silently "
                 "use shard-local coordinates")
         from distkeras_tpu.ops.ring_attention import ring_attention
-        return ring_attention(q, k, v, axis_name=axis_name, causal=causal)
+        return ring_attention(q, k, v, axis_name=axis_name, causal=causal,
+                              block_size=ring_block_size)
     return dot_product_attention(q, k, v, causal=causal)
 
 
@@ -150,7 +152,8 @@ class MultiHeadAttention(Layer):
                  causal: bool = True, use_rope: bool = True,
                  dtype: str = "float32", attn_impl: str = "xla",
                  seq_axis_name: Optional[str] = None,
-                 kernel_init: str = "glorot_uniform"):
+                 kernel_init: str = "glorot_uniform",
+                 ring_block_size: Optional[int] = None):
         self.num_heads = int(num_heads)
         self.head_dim = head_dim if head_dim is None else int(head_dim)
         self.causal = bool(causal)
@@ -159,6 +162,7 @@ class MultiHeadAttention(Layer):
         self.attn_impl = attn_impl
         self.seq_axis_name = seq_axis_name
         self.kernel_init = kernel_init
+        self.ring_block_size = ring_block_size  # inner k-blocking (memory)
 
     def init(self, rng, input_shape):
         d_model = input_shape[-1]
@@ -192,7 +196,8 @@ class MultiHeadAttention(Layer):
             k = apply_rope(k, positions)
         out = _attention_compute(q, k, v, causal=self.causal,
                                  impl=self.attn_impl,
-                                 axis_name=self.seq_axis_name)
+                                 axis_name=self.seq_axis_name,
+                                 ring_block_size=self.ring_block_size)
         y = jnp.einsum("bshe,hed->bsd", out, params["wo"].astype(dt))
         return y.astype(x.dtype), state
 
@@ -201,7 +206,8 @@ class MultiHeadAttention(Layer):
                 "causal": self.causal, "use_rope": self.use_rope,
                 "dtype": self.dtype, "attn_impl": self.attn_impl,
                 "seq_axis_name": self.seq_axis_name,
-                "kernel_init": self.kernel_init}
+                "kernel_init": self.kernel_init,
+                "ring_block_size": self.ring_block_size}
 
 
 @register_layer
@@ -255,7 +261,8 @@ class TransformerBlock(Layer):
                  attn_impl: str = "xla",
                  seq_axis_name: Optional[str] = None,
                  mlp_layer: Optional[Layer] = None,
-                 dropout_rate: float = 0.0):
+                 dropout_rate: float = 0.0,
+                 ring_block_size: Optional[int] = None):
         self.num_heads = int(num_heads)
         self.mlp_ratio = int(mlp_ratio)
         self.head_dim = head_dim
@@ -267,6 +274,7 @@ class TransformerBlock(Layer):
         self.attn_impl = attn_impl
         self.seq_axis_name = seq_axis_name
         self.dropout_rate = float(dropout_rate)
+        self.ring_block_size = ring_block_size
         self._mlp_override = mlp_layer
 
         norm_cls = RMSNorm if norm == "rmsnorm" else LayerNorm
@@ -275,7 +283,8 @@ class TransformerBlock(Layer):
         self._dropout = Dropout(self.dropout_rate)
         self.attn = MultiHeadAttention(
             num_heads, head_dim=head_dim, causal=causal, use_rope=use_rope,
-            dtype=dtype, attn_impl=attn_impl, seq_axis_name=seq_axis_name)
+            dtype=dtype, attn_impl=attn_impl, seq_axis_name=seq_axis_name,
+            ring_block_size=ring_block_size)
         self.mlp = mlp_layer  # resolved in init once d_model is known
 
     def init(self, rng, input_shape):
@@ -331,7 +340,8 @@ class TransformerBlock(Layer):
                "norm": self.norm, "dtype": self.dtype,
                "attn_impl": self.attn_impl,
                "seq_axis_name": self.seq_axis_name,
-               "dropout_rate": self.dropout_rate}
+               "dropout_rate": self.dropout_rate,
+               "ring_block_size": self.ring_block_size}
         if self._mlp_override is not None:
             cfg["mlp_layer"] = layer_spec(self._mlp_override)
         return cfg

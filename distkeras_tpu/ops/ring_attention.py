@@ -4,10 +4,15 @@ Absent from the reference (SURVEY §5.7: no sequence dimension sharding of
 any kind) — this is the TPU build's long-context core. Each device holds a
 sequence shard of Q/K/V; K/V blocks rotate around the ring via
 ``lax.ppermute`` (ICI neighbor exchange) while each device accumulates its
-queries' attention with the online-softmax recurrence. Memory per device is
-O(S_local²) scores; the full [S, S] matrix never exists anywhere, and the
-K/V transfer overlaps with the block computation under XLA's latency-hiding
-scheduler.
+queries' attention with the online-softmax recurrence. The full [S, S]
+matrix never exists anywhere, and the K/V transfer overlaps with the block
+computation under XLA's latency-hiding scheduler.
+
+Peak score memory per device is O(S_local * block) when ``block_size`` is
+set (an inner ``lax.scan`` over sub-blocks of the received shard with the
+same online-softmax merge), or O(S_local²) when it is None — set it once
+local shards get long enough that the block-pair score tile no longer fits
+comfortably in VMEM/HBM.
 
 ``ring_attention`` must be called **inside** a ``shard_map`` whose
 ``axis_name`` axis shards the sequence dimension (the trainer and
@@ -22,11 +27,36 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from distkeras_tpu.ops.attention import NEG_INF, causal_mask
+from distkeras_tpu.ops.attention import NEG_INF
+
+
+def _merge_block(m, l, acc, qf, ks, vs, q_pos, k_pos, causal):
+    """One online-softmax merge of a K/V block into the (m, l, acc) carry.
+
+    q_pos: [Sl] global query positions; k_pos: [bk] global key positions
+    (shards are equal-length by construction, so there are no padding keys
+    to mask — only the causal constraint). Shapes: qf [B, Sl, H, D]
+    (pre-scaled f32), ks/vs [B, bk, H, D], m/l [B, H, Sl, 1],
+    acc [B, Sl, H, D].
+    """
+    s = jnp.einsum("bqhd,bkhd->bhqk", qf, ks.astype(jnp.float32),
+                   preferred_element_type=jnp.float32)
+    if causal:
+        valid = q_pos[:, None] >= k_pos[None, :]
+        s = jnp.where(valid[None, None], s, NEG_INF)
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+    alpha = jnp.exp(m - m_new)
+    p = jnp.exp(s - m_new)
+    l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+    acc_new = acc * alpha.transpose(0, 2, 1, 3) + jnp.einsum(
+        "bhqk,bkhd->bqhd", p, vs.astype(jnp.float32),
+        preferred_element_type=jnp.float32)
+    return m_new, l_new, acc_new
 
 
 def ring_attention(q, k, v, *, axis_name: str, causal: bool = False,
-                   scale: Optional[float] = None) -> jnp.ndarray:
+                   scale: Optional[float] = None,
+                   block_size: Optional[int] = None) -> jnp.ndarray:
     """BSHD sequence-sharded attention. q/k/v: local shards [B, Sl, H, D]."""
     if scale is None:
         scale = q.shape[-1] ** -0.5
@@ -36,29 +66,45 @@ def ring_attention(q, k, v, *, axis_name: str, causal: bool = False,
     perm = [(j, (j + 1) % n) for j in range(n)]
 
     qf = q.astype(jnp.float32) * scale
+    q_pos = idx * s_local + jnp.arange(s_local)
+
+    if block_size is not None:
+        if block_size < 1:
+            raise ValueError(f"block_size must be >= 1, got {block_size}")
+        if block_size < s_local and s_local % block_size:
+            raise ValueError(
+                f"block_size {block_size} must divide the local shard "
+                f"length {s_local}")
+    if block_size is not None and block_size < s_local:
+        nblk = s_local // block_size
+    else:
+        block_size, nblk = s_local, 1
 
     def body(t, carry):
         m, l, acc, kc, vc = carry
         src = (idx - t) % n                                  # block owner
-        s = jnp.einsum("bqhd,bkhd->bhqk", qf, kc.astype(jnp.float32),
-                       preferred_element_type=jnp.float32)
-        if causal:
-            allowed = causal_mask(s_local, s_local,
-                                  q_offset=idx * s_local,
-                                  k_offset=src * s_local)    # [Sl, Sl]
-            s = jnp.where(allowed[None, None], s, NEG_INF)
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
-        alpha = jnp.exp(m - m_new)
-        p = jnp.exp(s - m_new)
-        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        acc_new = acc * alpha.transpose(0, 2, 1, 3) + jnp.einsum(
-            "bhqk,bkhd->bqhd", p, vc.astype(jnp.float32),
-            preferred_element_type=jnp.float32)
+        shard_pos0 = src * s_local
+
+        def inner(inner_carry, kb):
+            m, l, acc = inner_carry
+            ks = lax.dynamic_slice_in_dim(kc, kb * block_size, block_size,
+                                          axis=1)
+            vs = lax.dynamic_slice_in_dim(vc, kb * block_size, block_size,
+                                          axis=1)
+            k_pos = shard_pos0 + kb * block_size + jnp.arange(block_size)
+            return _merge_block(m, l, acc, qf, ks, vs, q_pos, k_pos,
+                                causal), None
+
+        if nblk == 1:
+            (m, l, acc), _ = inner((m, l, acc), 0)
+        else:
+            (m, l, acc), _ = lax.scan(inner, (m, l, acc),
+                                      jnp.arange(nblk))
         # rotate K/V to the next device (wasted on the final step, but the
         # loop stays uniform — XLA overlaps it with the block compute)
         kc = lax.ppermute(kc, axis_name, perm)
         vc = lax.ppermute(vc, axis_name, perm)
-        return m_new, l_new, acc_new, kc, vc
+        return m, l, acc, kc, vc
 
     # initial accumulators must carry the same varying-axes type as the
     # loop body's outputs (jax >= 0.7 shard_map vma check)

@@ -58,11 +58,15 @@ class History:
         if missing:
             raise KeyError(
                 f"metric {name!r} not recorded (have: "
-                f"{sorted(self.epochs[0])})")
+                f"{self.metric_names()})")
         return np.concatenate([e[name] for e in self.epochs], axis=0)
 
     def metric_names(self) -> List[str]:
-        return sorted(self.epochs[0]) if self.epochs else []
+        """Recorded training METRICS (loss is tracked separately via
+        ``losses()``)."""
+        if not self.epochs:
+            return []
+        return sorted(k for k in self.epochs[0] if k != "loss")
 
     def final_loss(self) -> float:
         losses = self.losses()
