@@ -34,26 +34,42 @@ def _to_numpy(x) -> np.ndarray:
 
 def from_iterable(rows: Iterable[Any], features_col: str = "features",
                   label_col: str = "label") -> Dataset:
-    """Iterable of ``(features, label)`` pairs, ``features`` only, or
-    ``{col: value}`` dicts -> columnar Dataset."""
-    feats, labels, dicts = [], [], None
+    """Iterable of rows -> columnar Dataset. Row forms (must be uniform):
+
+      * TUPLE ``(features, label)`` — a labeled example;
+      * ``{col: value}`` dict — arbitrary named columns
+        (``Dataset.from_records`` semantics);
+      * anything else (ndarray, list, torch tensor, scalar) — one feature
+        row. A 2-element LIST is a 2-feature row, not a pair — only tuples
+        are treated as (features, label), so feature vectors are never
+        silently split into a bogus label column.
+    """
+    feats, labels, records = [], [], []
     for row in rows:
         if isinstance(row, dict):
-            if dicts is None:
-                dicts = {k: [] for k in row}
-            for k, v in row.items():
-                dicts[k].append(_to_numpy(v))
-        elif isinstance(row, (tuple, list)) and len(row) == 2:
+            records.append({k: _to_numpy(v) for k, v in row.items()})
+        elif isinstance(row, tuple):
+            if len(row) != 2:
+                raise ValueError(
+                    f"tuple rows must be (features, label) pairs, got a "
+                    f"{len(row)}-tuple")
             feats.append(_to_numpy(row[0]))
             labels.append(_to_numpy(row[1]))
         else:
             feats.append(_to_numpy(row))
-    if dicts is not None:
-        return Dataset({k: np.stack(v) for k, v in dicts.items()})
+        if records and (feats or labels):
+            raise ValueError(
+                "mixed dict and non-dict rows — use one row form for the "
+                "whole iterable")
+    if records:
+        return Dataset.from_records(records)
     if not feats:
         raise ValueError("empty iterable")
     cols = {features_col: np.stack(feats)}
     if labels:
+        if len(labels) != len(feats):
+            raise ValueError(
+                "mixed (features, label) pairs and bare feature rows")
         cols[label_col] = np.stack(labels)
     return Dataset(cols)
 
@@ -68,11 +84,11 @@ def from_torch(source, features_col: str = "features",
     caps the number of EXAMPLES taken (useful for huge map-style datasets).
     """
     feats, labels, n = [], [], 0
+    batched = _looks_batched(source)
 
     def push(f, l=None):
         nonlocal n
         f = _to_numpy(f)
-        batched = f.ndim > 0 and _looks_batched(source)
         if batched:
             feats.append(f)
             n += len(f)
@@ -100,6 +116,8 @@ def from_torch(source, features_col: str = "features",
 
 
 def _looks_batched(source) -> bool:
-    """DataLoaders yield batches; map-style Datasets yield single rows."""
-    t = type(source).__mro__
-    return any(c.__name__ == "DataLoader" for c in t)
+    """DataLoaders yield batches — unless constructed with
+    ``batch_size=None`` (sample mode); map-style Datasets yield rows."""
+    if any(c.__name__ == "DataLoader" for c in type(source).__mro__):
+        return getattr(source, "batch_size", None) is not None
+    return False

@@ -10,27 +10,45 @@ from __future__ import annotations
 
 from typing import Callable, Union
 
+import jax
 import jax.numpy as jnp
 
 from distkeras_tpu.ops import losses
 
 
-def accuracy(y_true, y_pred):
-    """Classification accuracy. Handles one-hot or integer ``y_true`` and
-    probability/logit vectors, sigmoid scores, or integer predictions in
-    ``y_pred``. Binary float scores are thresholded at 0.5 when they look
-    like probabilities (all values in [0, 1]) and at 0.0 otherwise (logits);
-    the check is a traced scalar select, so it stays jit-compatible."""
+def _class_vectors(y_true, y_pred):
+    """Normalize (labels, predictions) to flat integer class vectors.
+
+    Handles one-hot or integer ``y_true`` and probability/logit vectors,
+    sigmoid scores, or integer predictions in ``y_pred``. Binary float
+    scores are thresholded at 0.5 when they look like probabilities (all
+    values in [0, 1]) and at 0.0 otherwise (logits); the check is a traced
+    scalar select, so it stays jit-compatible. Returns ``(t, p, k)`` where
+    ``k`` is the class count implied by a vector width, or None when both
+    inputs are plain class vectors.
+    """
+    k = None
+    y_true = jnp.asarray(y_true)
+    y_pred = jnp.asarray(y_pred)
     if y_pred.ndim > 1 and y_pred.shape[-1] > 1:
+        k = y_pred.shape[-1]
         y_pred = jnp.argmax(y_pred, axis=-1)
     elif jnp.issubdtype(y_pred.dtype, jnp.floating):
+        k = 2
         is_prob = jnp.all((y_pred >= 0.0) & (y_pred <= 1.0))
         y_pred = y_pred >= jnp.where(is_prob, 0.5, 0.0)
     if y_true.ndim > 1 and y_true.shape[-1] > 1:
+        k = max(k or 0, y_true.shape[-1])
         y_true = jnp.argmax(y_true, axis=-1)
-    return jnp.mean((y_pred.reshape(-1).astype(jnp.int32) ==
-                     y_true.reshape(-1).astype(jnp.int32))
-                    .astype(jnp.float32))
+    return (y_true.reshape(-1).astype(jnp.int32),
+            y_pred.reshape(-1).astype(jnp.int32), k)
+
+
+def accuracy(y_true, y_pred):
+    """Classification accuracy (see ``_class_vectors`` for accepted
+    shapes/encodings)."""
+    t, p, _ = _class_vectors(y_true, y_pred)
+    return jnp.mean((p == t).astype(jnp.float32))
 
 
 def top_k_accuracy(y_true, y_pred, k: int = 5):
@@ -41,10 +59,77 @@ def top_k_accuracy(y_true, y_pred, k: int = 5):
     return jnp.mean(hit.astype(jnp.float32))
 
 
+def _concrete_max(x):
+    """max(x)+1 when x is a concrete array; None under jit tracing (class
+    count must then come from a vector dimension)."""
+    import numpy as np
+    try:
+        return int(np.max(np.asarray(x))) + 1
+    except Exception:  # tracer — no concrete value available
+        return None
+
+
+def _prf(y_true, y_pred):
+    """Per-class (precision, recall) via a confusion count, jit-friendly.
+
+    The class count k comes from the prediction/label VECTOR width when one
+    is present (always the case for in-training metrics on logits — static
+    under jit); for plain integer class vectors it is inferred from the
+    concrete data, which requires host-side (non-traced) inputs. Concrete
+    labels OUTSIDE the k implied by the scores raise rather than silently
+    dropping out of the macro average.
+    """
+    t, p, k = _class_vectors(y_true, y_pred)
+    kt = _concrete_max(t)
+    if k is None:  # both plain int vectors: infer from the data
+        kp = _concrete_max(p)
+        if kt is None or kp is None:
+            raise ValueError(
+                "precision/recall/f1 on two integer class VECTORS under "
+                "jit cannot infer the class count; pass logits/one-hot, or "
+                "call on concrete (host) arrays")
+        k = max(kt, kp, 2)
+    elif kt is not None and kt > k:
+        raise ValueError(
+            f"labels contain class {kt - 1} but the predictions only "
+            f"cover {k} classes")
+    t1 = jax.nn.one_hot(t, k, dtype=jnp.float32)
+    p1 = jax.nn.one_hot(p, k, dtype=jnp.float32)
+    tp = jnp.sum(t1 * p1, axis=0)
+    pred_k = jnp.sum(p1, axis=0)
+    true_k = jnp.sum(t1, axis=0)
+    prec = tp / jnp.maximum(pred_k, 1.0)
+    rec = tp / jnp.maximum(true_k, 1.0)
+    # macro-average over classes PRESENT in y_true (absent classes would
+    # drag the mean down with zeros)
+    present = (true_k > 0).astype(jnp.float32)
+    denom = jnp.maximum(present.sum(), 1.0)
+    return (prec * present).sum() / denom, (rec * present).sum() / denom
+
+
+def precision(y_true, y_pred):
+    """Macro-averaged precision over the classes present in ``y_true``."""
+    return _prf(y_true, y_pred)[0]
+
+
+def recall(y_true, y_pred):
+    """Macro-averaged recall over the classes present in ``y_true``."""
+    return _prf(y_true, y_pred)[1]
+
+
+def f1(y_true, y_pred):
+    """Macro F1 (harmonic mean of the macro precision/recall)."""
+    p, r = _prf(y_true, y_pred)
+    return 2.0 * p * r / jnp.maximum(p + r, 1e-12)
+
+
 METRICS = {
     "accuracy": accuracy,
     "top_5_accuracy": lambda t, p: top_k_accuracy(t, p, 5),
     "mse": losses.mean_squared_error,
+    "precision": precision,
+    "recall": recall,
+    "f1": f1,
 }
 
 

@@ -50,6 +50,11 @@ def test_from_torch_dataset_and_loader():
     ds3 = from_torch(tds, limit=7)
     assert len(ds3["features"]) == 7
 
+    # batch_size=None DataLoader yields SAMPLES, not batches
+    ds4 = from_torch(DataLoader(tds, batch_size=None))
+    assert ds4["features"].shape == (32, 6)
+    np.testing.assert_allclose(ds4["label"], y.numpy())
+
     # adapters feed trainers directly
     from distkeras_tpu.models import Dense, Model, Sequential
     from distkeras_tpu.parallel import SingleTrainer

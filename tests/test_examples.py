@@ -44,3 +44,8 @@ def test_long_context_pipeline_example(capsys):
     run_example("examples.long_context_pipeline",
                 ("x", "--seq", "64", "--epochs", "2"))
     assert "loss" in capsys.readouterr().out
+
+
+def test_criteo_wide_deep_example():
+    acc = run_example("examples.criteo_wide_deep")
+    assert acc > 0.85, acc

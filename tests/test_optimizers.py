@@ -134,3 +134,70 @@ def test_top_k_accuracy():
     y_pred = jnp.array([[0.1, 0.3, 0.2, 0.4], [0.9, 0.05, 0.03, 0.02]])
     assert float(top_k_accuracy(y_true, y_pred, k=2)) == pytest.approx(0.5)
     assert float(top_k_accuracy(y_true, y_pred, k=3)) == pytest.approx(1.0)
+
+
+# ---------------------------------------------------------------------------
+# precision / recall / f1 (macro)
+# ---------------------------------------------------------------------------
+
+def test_precision_recall_f1_macro_hand_computed():
+    import numpy as np
+
+    from distkeras_tpu.ops.metrics import f1, precision, recall
+    y_true = np.array([0, 0, 1, 1, 2, 2])
+    y_pred = np.array([0, 1, 1, 1, 2, 0])
+    # class 0: tp=1, pred=2, true=2 -> p=0.5, r=0.5
+    # class 1: tp=2, pred=3, true=2 -> p=2/3, r=1.0
+    # class 2: tp=1, pred=1, true=2 -> p=1.0, r=0.5
+    p_macro = (0.5 + 2 / 3 + 1.0) / 3
+    r_macro = (0.5 + 1.0 + 0.5) / 3
+    assert abs(float(precision(y_true, y_pred)) - p_macro) < 1e-6
+    assert abs(float(recall(y_true, y_pred)) - r_macro) < 1e-6
+    f = 2 * p_macro * r_macro / (p_macro + r_macro)
+    assert abs(float(f1(y_true, y_pred)) - f) < 1e-6
+
+
+def test_precision_handles_logits_and_absent_classes():
+    import numpy as np
+
+    from distkeras_tpu.ops.metrics import precision, recall
+    # logits [n, k]; class 2 never appears in y_true -> excluded from macro
+    y_true = np.array([0, 1, 0, 1])
+    logits = np.array([[2.0, 0.0, -1], [0.0, 2.0, -1],
+                       [2.0, 0.0, -1], [2.0, 0.0, -1]])
+    # preds: 0, 1, 0, 0; class 0: tp=2, pred=3, true=2; class 1: tp=1,
+    # pred=1, true=2
+    assert abs(float(precision(y_true, logits)) - (2 / 3 + 1.0) / 2) < 1e-6
+    assert abs(float(recall(y_true, logits)) - (1.0 + 0.5) / 2) < 1e-6
+
+
+def test_metrics_work_under_jit():
+    import jax
+    import numpy as np
+
+    from distkeras_tpu.ops.metrics import f1
+    y = np.array([0, 1, 1, 0])
+    p = np.array([[1.0, 0], [0, 1.0], [1.0, 0], [1.0, 0]])
+    assert np.isfinite(float(jax.jit(f1)(y, p)))
+
+
+def test_precision_rejects_out_of_range_labels_for_binary_scores():
+    import numpy as np
+
+    from distkeras_tpu.ops.metrics import precision
+    with pytest.raises(ValueError, match="only\\s+cover"):
+        precision(np.array([0, 1, 2, 2]), np.array([0.9, 0.2, 0.8, 0.1]))
+
+
+def test_from_iterable_list_rows_are_features_not_pairs():
+    import numpy as np
+
+    from distkeras_tpu.data import from_iterable
+    ds = from_iterable([[1.0, 2.0], [3.0, 4.0]])
+    assert ds["features"].shape == (2, 2)
+    assert "label" not in ds.columns
+
+    with pytest.raises(ValueError, match="mixed dict"):
+        from_iterable([{"a": 1}, (np.zeros(2), 0)])
+    with pytest.raises(ValueError, match="3-tuple"):
+        from_iterable([(1, 2, 3)])
