@@ -117,7 +117,10 @@ def from_torch(source, features_col: str = "features",
 
 def _looks_batched(source) -> bool:
     """DataLoaders yield batches — unless constructed with
-    ``batch_size=None`` (sample mode); map-style Datasets yield rows."""
+    ``batch_size=None`` (sample mode); map-style Datasets yield rows.
+    The check is on ``batch_sampler``: PyTorch creates one for any batched
+    loader (including explicit ``batch_sampler=...``, whose ``.batch_size``
+    attribute is None) and leaves it None only in sample mode."""
     if any(c.__name__ == "DataLoader" for c in type(source).__mro__):
-        return getattr(source, "batch_size", None) is not None
+        return getattr(source, "batch_sampler", None) is not None
     return False

@@ -80,19 +80,22 @@ def _prf(y_true, y_pred):
     dropping out of the macro average.
     """
     t, p, k = _class_vectors(y_true, y_pred)
-    kt = _concrete_max(t)
+    kt, kp = _concrete_max(t), _concrete_max(p)
     if k is None:  # both plain int vectors: infer from the data
-        kp = _concrete_max(p)
         if kt is None or kp is None:
             raise ValueError(
                 "precision/recall/f1 on two integer class VECTORS under "
                 "jit cannot infer the class count; pass logits/one-hot, or "
                 "call on concrete (host) arrays")
         k = max(kt, kp, 2)
-    elif kt is not None and kt > k:
-        raise ValueError(
-            f"labels contain class {kt - 1} but the predictions only "
-            f"cover {k} classes")
+    else:
+        # concrete classes OUTSIDE k would one-hot to all-zero rows and
+        # silently vanish from the confusion counts
+        for nm, kk in (("labels", kt), ("predictions", kp)):
+            if kk is not None and kk > k:
+                raise ValueError(
+                    f"{nm} contain class {kk - 1} but the vector-encoded "
+                    f"side only covers {k} classes")
     t1 = jax.nn.one_hot(t, k, dtype=jnp.float32)
     p1 = jax.nn.one_hot(p, k, dtype=jnp.float32)
     tp = jnp.sum(t1 * p1, axis=0)

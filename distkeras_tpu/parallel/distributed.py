@@ -93,21 +93,23 @@ class DistributedTrainer(Trainer):
         extracted = None  # (params, state) pulled on the final-epoch save
         # next epoch's shuffle gather + [S, W, B, ...] stacking overlaps
         # with this epoch's device step (utils/prefetch.py)
-        for epoch, (Xs, Ys, S) in Prefetcher(
-                assemble, range(start_epoch, self.num_epoch)):
-            state, outs = engine.run_epoch(state, Xs, Ys)
-            losses, mets = self._split_outs(outs)
-            self.history.append_epoch(loss=host_fetch(losses),
-                                      **host_fetch(mets))
-            # cadence check BEFORE extract_model: the full-state device->host
-            # transfer is expensive and must only happen on save epochs
-            extracted = None
-            if manager is not None and self._should_checkpoint(epoch):
-                extracted = engine.extract_model(state)
-                if jax.process_index() == 0:  # one writer per checkpoint
-                    manager.save(epoch, {"params": extracted[0],
-                                         "state": extracted[1]},
-                                 metadata={"epoch": epoch})
+        with self._profile_ctx():
+            for epoch, (Xs, Ys, S) in Prefetcher(
+                    assemble, range(start_epoch, self.num_epoch)):
+                state, outs = engine.run_epoch(state, Xs, Ys)
+                losses, mets = self._split_outs(outs)
+                self.history.append_epoch(loss=host_fetch(losses),
+                                          **host_fetch(mets))
+                # cadence check BEFORE extract_model: the full-state
+                # device->host transfer is expensive and must only happen
+                # on save epochs
+                extracted = None
+                if manager is not None and self._should_checkpoint(epoch):
+                    extracted = engine.extract_model(state)
+                    if jax.process_index() == 0:  # one writer per ckpt
+                        manager.save(epoch, {"params": extracted[0],
+                                             "state": extracted[1]},
+                                     metadata={"epoch": epoch})
         self.record_training_stop()
 
         # the forced last-epoch save already pulled the final state

@@ -47,7 +47,8 @@ class Trainer:
                  shuffle_each_epoch: bool = True,
                  optimizer_kwargs: Optional[dict] = None,
                  checkpoint_dir: Optional[str] = None,
-                 checkpoint_every: int = 1, resume: bool = False):
+                 checkpoint_every: int = 1, resume: bool = False,
+                 profile_dir: Optional[str] = None):
         self.master_model = keras_model
         opt_kwargs = dict(optimizer_kwargs or {})
         if learning_rate is not None and not isinstance(worker_optimizer,
@@ -71,6 +72,9 @@ class Trainer:
             raise ValueError(
                 f"checkpoint_every must be >= 1, got {checkpoint_every}")
         self.resume = bool(resume)
+        # XLA/device trace of the whole run, viewable in XProf/TensorBoard
+        # (SURVEY §5.1: the reference has wall-clock bookkeeping only)
+        self.profile_dir = profile_dir
 
     def _checkpoint_manager(self):
         if self.checkpoint_dir is None:
@@ -112,6 +116,13 @@ class Trainer:
     def _should_checkpoint(self, epoch: int) -> bool:
         return ((epoch + 1) % self.checkpoint_every == 0
                 or epoch == self.num_epoch - 1)
+
+    def _profile_ctx(self):
+        if self.profile_dir is None:
+            import contextlib
+            return contextlib.nullcontext()
+        from distkeras_tpu.utils.profiling import trace
+        return trace(self.profile_dir)
 
     # -- reference-parity bookkeeping -------------------------------------
     def record_training_start(self):
@@ -203,17 +214,19 @@ class SingleTrainer(Trainer):
         self.record_training_start()
         # epoch e+1's shuffle gather + stacking runs while the device
         # trains epoch e (utils/prefetch.py)
-        for epoch, (Xs, Ys, n_steps) in Prefetcher(
-                assemble, range(start_epoch, self.num_epoch)):
-            carry, outs = runner(carry, Xs, Ys)
-            losses, mets = self._split_outs(outs)
-            self.history.append_epoch(loss=jax.device_get(losses),
-                                      **jax.device_get(mets))
-            if manager is not None and self._should_checkpoint(epoch):
-                manager.save(epoch,
-                             {"params": carry.params, "state": carry.state,
-                              "opt": carry.opt_state, "rng": carry.rng},
-                             metadata={"epoch": epoch})
+        with self._profile_ctx():
+            for epoch, (Xs, Ys, n_steps) in Prefetcher(
+                    assemble, range(start_epoch, self.num_epoch)):
+                carry, outs = runner(carry, Xs, Ys)
+                losses, mets = self._split_outs(outs)
+                self.history.append_epoch(loss=jax.device_get(losses),
+                                          **jax.device_get(mets))
+                if manager is not None and self._should_checkpoint(epoch):
+                    manager.save(
+                        epoch,
+                        {"params": carry.params, "state": carry.state,
+                         "opt": carry.opt_state, "rng": carry.rng},
+                        metadata={"epoch": epoch})
         self.record_training_stop()
 
         trained = model.replace(params=jax.device_get(carry.params),

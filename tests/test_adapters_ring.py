@@ -116,3 +116,18 @@ def test_ring_attention_rejects_nonpositive_block_size():
     for bad in (0, -4):
         with pytest.raises(ValueError, match=">= 1"):
             ring_out(q, q, q, False, bad)
+
+
+def test_from_torch_batch_sampler_loader():
+    torch = pytest.importorskip("torch")
+    from torch.utils.data import (BatchSampler, DataLoader,
+                                  SequentialSampler, TensorDataset)
+
+    X = torch.randn(32, 6)
+    y = torch.randint(0, 3, (32,))
+    tds = TensorDataset(X, y)
+    loader = DataLoader(tds, batch_sampler=BatchSampler(
+        SequentialSampler(tds), 4, False))
+    ds = from_torch(loader)
+    assert ds["features"].shape == (32, 6)
+    np.testing.assert_allclose(ds["label"], y.numpy())

@@ -201,3 +201,11 @@ def test_from_iterable_list_rows_are_features_not_pairs():
         from_iterable([{"a": 1}, (np.zeros(2), 0)])
     with pytest.raises(ValueError, match="3-tuple"):
         from_iterable([(1, 2, 3)])
+
+
+def test_precision_rejects_out_of_range_predictions():
+    import numpy as np
+
+    from distkeras_tpu.ops.metrics import precision
+    with pytest.raises(ValueError, match="predictions contain class 7"):
+        precision(np.eye(2)[[0, 0, 1]], np.array([0, 7, 1]))

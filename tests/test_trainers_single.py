@@ -92,3 +92,26 @@ def test_ensemble_trainer_trains_independent_models():
     assert losses.shape == (3 * (2048 // 128), 3)
     # averaged history is scalar per step
     assert trainer.get_averaged_history().shape == (3 * (2048 // 128),)
+
+
+def test_profile_dir_writes_trace(tmp_path):
+    import os
+
+    import numpy as np
+
+    from distkeras_tpu.data import Dataset
+    from distkeras_tpu.models import Dense, Model, Sequential
+    from distkeras_tpu.parallel import SingleTrainer
+
+    rs = np.random.RandomState(0)
+    X = rs.randn(128, 4).astype(np.float32)
+    y = rs.randint(0, 2, 128)
+    model = Model.build(Sequential([Dense(2)]), (4,), seed=0)
+    pdir = str(tmp_path / "xprof")
+    tr = SingleTrainer(model, batch_size=32, num_epoch=1,
+                       loss="sparse_categorical_crossentropy_from_logits",
+                       profile_dir=pdir)
+    tr.train(Dataset({"features": X, "label": y}))
+    # a plugin/profile directory with at least one trace artifact appears
+    found = [os.path.join(r, f) for r, _, fs in os.walk(pdir) for f in fs]
+    assert found, f"no trace files under {pdir}"
