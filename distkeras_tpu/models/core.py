@@ -199,6 +199,29 @@ class Model:
         return sum(int(np.prod(p.shape))
                    for p in jax.tree_util.tree_leaves(self.params))
 
+    def summary(self) -> str:
+        """Keras-style per-layer table (layer, config, params). Printed
+        AND returned."""
+        rows = []
+        if isinstance(self.module, Sequential):
+            for layer, p in zip(self.module.layers, self.params):
+                n = sum(int(np.prod(l.shape))
+                        for l in jax.tree_util.tree_leaves(p))
+                rows.append((repr(layer), n))
+        else:
+            rows.append((repr(self.module), self.num_params()))
+        name_w = min(72, max([len(r[0]) for r in rows] + [10]))
+        lines = [f"Model: in={self.input_shape} out={self.output_shape}",
+                 "-" * (name_w + 14)]
+        for name, n in rows:
+            disp = name if len(name) <= name_w else name[:name_w - 1] + "…"
+            lines.append(f"{disp:<{name_w}}  {n:>12,}")
+        lines.append("-" * (name_w + 14))
+        lines.append(f"{'total':<{name_w}}  {self.num_params():>12,}")
+        out = "\n".join(lines)
+        print(out)
+        return out
+
     def replace(self, params=None, state=None) -> "Model":
         return Model(self.module,
                      params if params is not None else self.params,

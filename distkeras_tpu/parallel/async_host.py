@@ -178,6 +178,7 @@ class HostAsyncTrainer(Trainer):
             *[out[i]["state"] for i in range(n)])
 
     def train(self, dataset: Dataset) -> Model:
+        self._reject_grad_accum()
         model = self.master_model
         X, y = self._training_arrays(dataset)
         n = self.num_workers
@@ -202,6 +203,8 @@ class HostAsyncTrainer(Trainer):
                                           self._metric_fns()))
 
         self.record_training_start()
+        profile = self._profile_ctx()  # enter/exit by hand: the epoch loop
+        profile.__enter__()            # already sits inside a try/finally
         try:
             for epoch in range(start_epoch, self.num_epoch):
                 perm = self._epoch_perm(epoch, len(X))
@@ -252,7 +255,10 @@ class HostAsyncTrainer(Trainer):
                          "state": self._mean_state(out, n)},
                         metadata={"epoch": epoch})
         finally:
+            profile.__exit__(None, None, None)
             self.record_training_stop()
+            if manager is not None:
+                manager.wait()  # async snapshots durable before return
             self.parameter_server.stop()
 
         center = self.parameter_server.get_model()

@@ -61,6 +61,7 @@ class DistributedTrainer(Trainer):
         return self.communication_window
 
     def train(self, dataset: Dataset) -> Model:
+        self._reject_grad_accum()
         model = self.master_model
         X, y = self._training_arrays(dataset)
 
@@ -111,6 +112,8 @@ class DistributedTrainer(Trainer):
                                              "state": extracted[1]},
                                      metadata={"epoch": epoch})
         self.record_training_stop()
+        if manager is not None:
+            manager.wait()  # async snapshots durable before return
 
         # the forced last-epoch save already pulled the final state
         params, mstate = extracted if extracted is not None \

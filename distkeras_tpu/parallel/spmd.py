@@ -198,7 +198,7 @@ class SPMDTrainer(Trainer):
         carry = TrainCarry(params, state, opt_state, rng)
 
         step = make_train_step(model.module, self.loss, self.worker_optimizer,
-                               self._metric_fns())
+                               self._metric_fns(), self.grad_accum_steps)
 
         @partial(jax.jit, donate_argnums=(0,))
         def run_epoch(carry, Xs, Ys):
@@ -208,25 +208,29 @@ class SPMDTrainer(Trainer):
         assemble = lambda epoch: stack_batches(
             X, y, self.batch_size, self._epoch_perm(epoch, len(X)))
         self.record_training_start()
-        for epoch, (Xs, Ys, S) in Prefetcher(
-                assemble, range(start_epoch, self.num_epoch)):
-            Xs = jax.device_put(Xs, data_sh)
-            Ys = jax.device_put(Ys, data_sh)
-            carry, outs = run_epoch(carry, Xs, Ys)
-            losses, mets = self._split_outs(outs)
-            self.history.append_epoch(loss=host_fetch(losses),
-                                      **host_fetch(mets))
-            if manager is not None and self._should_checkpoint(epoch):
-                # host_fetch is a COLLECTIVE under multi-process (allgather
-                # of non-addressable shards) — every process must enter it;
-                # only the write is gated on process 0
-                snapshot = host_fetch({"params": carry.params,
-                                       "state": carry.state,
-                                       "opt": carry.opt_state,
-                                       "rng": carry.rng})
-                if jax.process_index() == 0:
-                    manager.save(epoch, snapshot, metadata={"epoch": epoch})
+        with self._profile_ctx():
+            for epoch, (Xs, Ys, S) in Prefetcher(
+                    assemble, range(start_epoch, self.num_epoch)):
+                Xs = jax.device_put(Xs, data_sh)
+                Ys = jax.device_put(Ys, data_sh)
+                carry, outs = run_epoch(carry, Xs, Ys)
+                losses, mets = self._split_outs(outs)
+                self.history.append_epoch(loss=host_fetch(losses),
+                                          **host_fetch(mets))
+                if manager is not None and self._should_checkpoint(epoch):
+                    # host_fetch is a COLLECTIVE under multi-process
+                    # (allgather of non-addressable shards) — every process
+                    # must enter it; only the write is gated on process 0
+                    snapshot = host_fetch({"params": carry.params,
+                                           "state": carry.state,
+                                           "opt": carry.opt_state,
+                                           "rng": carry.rng})
+                    if jax.process_index() == 0:
+                        manager.save(epoch, snapshot,
+                                     metadata={"epoch": epoch})
         self.record_training_stop()
+        if manager is not None:
+            manager.wait()  # async snapshots durable before return
 
         trained = model.replace(params=host_fetch(carry.params),
                                 state=host_fetch(carry.state))

@@ -48,7 +48,9 @@ class Trainer:
                  optimizer_kwargs: Optional[dict] = None,
                  checkpoint_dir: Optional[str] = None,
                  checkpoint_every: int = 1, resume: bool = False,
-                 profile_dir: Optional[str] = None):
+                 checkpoint_async: bool = False,
+                 profile_dir: Optional[str] = None,
+                 grad_accum_steps: int = 1):
         self.master_model = keras_model
         opt_kwargs = dict(optimizer_kwargs or {})
         if learning_rate is not None and not isinstance(worker_optimizer,
@@ -72,15 +74,31 @@ class Trainer:
             raise ValueError(
                 f"checkpoint_every must be >= 1, got {checkpoint_every}")
         self.resume = bool(resume)
+        # background-thread checkpoint writes (big snapshots stop stalling
+        # the step loop); the final wait() happens at train() end
+        self.checkpoint_async = bool(checkpoint_async)
         # XLA/device trace of the whole run, viewable in XProf/TensorBoard
         # (SURVEY §5.1: the reference has wall-clock bookkeeping only)
         self.profile_dir = profile_dir
+        # microbatch gradient accumulation inside each step (memory lever;
+        # honored by SingleTrainer and SPMDTrainer)
+        self.grad_accum_steps = int(grad_accum_steps)
+
+    def _reject_grad_accum(self):
+        """Trainers whose step semantics don't compose with accumulation
+        (the engine family counts WINDOW steps; ensembles/host-async have
+        their own loops) must fail loudly rather than silently ignore it."""
+        if self.grad_accum_steps != 1:
+            raise ValueError(
+                f"{type(self).__name__} does not support grad_accum_steps "
+                "(only SingleTrainer and SPMDTrainer do)")
 
     def _checkpoint_manager(self):
         if self.checkpoint_dir is None:
             return None
         from distkeras_tpu.utils.checkpoint import CheckpointManager
-        return CheckpointManager(self.checkpoint_dir)
+        return CheckpointManager(self.checkpoint_dir,
+                                 async_writes=self.checkpoint_async)
 
     def _maybe_resume(self, manager, template):
         """Restore the checkpointed tree (same structure as ``template``).
@@ -193,7 +211,7 @@ class SingleTrainer(Trainer):
         model = self.master_model
         X, y = self._training_arrays(dataset)
         step = make_train_step(model.module, self.loss, self.worker_optimizer,
-                               self._metric_fns())
+                               self._metric_fns(), self.grad_accum_steps)
         runner = make_epoch_runner(step)
 
         # SingleTrainer checkpoints the FULL carry (params + model state +
@@ -228,6 +246,8 @@ class SingleTrainer(Trainer):
                          "opt": carry.opt_state, "rng": carry.rng},
                         metadata={"epoch": epoch})
         self.record_training_stop()
+        if manager is not None:
+            manager.wait()  # async snapshots durable before return
 
         trained = model.replace(params=jax.device_get(carry.params),
                                 state=jax.device_get(carry.state))
@@ -251,6 +271,7 @@ class EnsembleTrainer(Trainer):
         self.models_: List[Model] = []
 
     def train(self, dataset: Dataset) -> List[Model]:
+        self._reject_grad_accum()
         base = self.master_model
         X, y = self._training_arrays(dataset)
         k = self.num_models

@@ -36,7 +36,8 @@ class TrainCarry(NamedTuple):
 
 
 def make_train_step(module, loss_fn: Callable, optimizer: Optimizer,
-                    metric_fns: Optional[dict] = None) -> Callable:
+                    metric_fns: Optional[dict] = None,
+                    accum_steps: int = 1) -> Callable:
     """Build the per-minibatch step: grad -> optimizer update -> new carry.
 
     Equivalent role to one ``model.train_on_batch`` call in the reference
@@ -46,26 +47,74 @@ def make_train_step(module, loss_fn: Callable, optimizer: Optimizer,
     ``(carry, (loss, {name: value}))`` — the reference's per-batch Keras
     metrics, computed on-device from the training forward's outputs at
     negligible cost (XLA fuses them into the existing graph).
+
+    ``accum_steps > 1`` splits the batch into that many microbatches and
+    accumulates gradients over an inner ``lax.scan`` before ONE optimizer
+    update — the standard memory lever for batches whose activations do
+    not fit HBM. Identical math to the full-batch step (the mean of equal
+    microbatch means is the batch mean); model state (BN stats) threads
+    through the microbatches in order.
     """
+    accum_steps = int(accum_steps)
+    if accum_steps < 1:
+        raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
+
+    def grad_of(params, state, xb, yb, sub):
+        def objective(params):
+            out, new_state = module.apply(params, state, xb,
+                                          training=True, rng=sub)
+            return loss_fn(yb, out), (new_state, out)
+
+        (loss, (new_state, out)), grads = jax.value_and_grad(
+            objective, has_aux=True)(params)
+        mets = ({name: fn(yb, out) for name, fn in metric_fns.items()}
+                if metric_fns else {})
+        return loss, grads, new_state, mets
 
     def train_step(carry: TrainCarry, batch) -> Tuple[TrainCarry, jax.Array]:
         xb, yb = batch
         rng, sub = jax.random.split(carry.rng)
 
-        def objective(params):
-            out, new_state = module.apply(params, carry.state, xb,
-                                          training=True, rng=sub)
-            return loss_fn(yb, out), (new_state, out)
+        if accum_steps == 1:
+            loss, grads, new_state, mets = grad_of(
+                carry.params, carry.state, xb, yb, sub)
+        else:
+            if xb.shape[0] % accum_steps:
+                raise ValueError(
+                    f"batch of {xb.shape[0]} must divide into "
+                    f"accum_steps={accum_steps} microbatches")
+            micro = xb.shape[0] // accum_steps
+            # STRIDED split (microbatch j = rows j, j+accum, ...): under a
+            # data-parallel batch sharding each microbatch then still spans
+            # every dp shard — a contiguous split would concentrate each
+            # microbatch on a shard subset and serialize the dp axis
+            xs = xb.reshape((micro, accum_steps) + xb.shape[1:]) \
+                .swapaxes(0, 1)
+            ys = yb.reshape((micro, accum_steps) + yb.shape[1:]) \
+                .swapaxes(0, 1)
+            subs = jax.random.split(sub, accum_steps)
 
-        (loss, (new_state, out)), grads = jax.value_and_grad(
-            objective, has_aux=True)(carry.params)
+            def body(c, inp):
+                state, gacc = c
+                x_, y_, r_ = inp
+                loss, grads, state, mets = grad_of(carry.params, state,
+                                                   x_, y_, r_)
+                gacc = jax.tree_util.tree_map(jnp.add, gacc, grads)
+                return (state, gacc), (loss, mets)
+
+            zeros = jax.tree_util.tree_map(jnp.zeros_like, carry.params)
+            (new_state, gsum), (losses, mets_s) = lax.scan(
+                body, (carry.state, zeros), (xs, ys, subs))
+            grads = jax.tree_util.tree_map(lambda g: g / accum_steps, gsum)
+            loss = losses.mean()
+            mets = jax.tree_util.tree_map(lambda m: m.mean(), mets_s)
+
         updates, new_opt_state = optimizer.update(grads, carry.opt_state,
                                                   carry.params)
         new_params = apply_updates(carry.params, updates)
         new_carry = TrainCarry(new_params, new_state, new_opt_state, rng)
         if metric_fns:
-            return new_carry, (loss, {name: fn(yb, out)
-                                      for name, fn in metric_fns.items()})
+            return new_carry, (loss, mets)
         return new_carry, loss
 
     return train_step

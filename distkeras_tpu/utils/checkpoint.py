@@ -29,23 +29,65 @@ ARRAYS = "arrays.npz"
 
 
 class CheckpointManager:
-    """Step-indexed atomic checkpoints of arbitrary pytrees."""
+    """Step-indexed atomic checkpoints of arbitrary pytrees.
 
-    def __init__(self, directory: str, max_to_keep: int = 3):
+    ``async_writes=True`` moves the disk write (npz serialize + atomic
+    rename) to a background thread so a large snapshot does not stall the
+    training loop — the device->host fetch still happens synchronously at
+    ``save()`` time (the arrays must be a consistent cut of training
+    state). Writes are serialized through one worker thread; ``wait()``
+    blocks until all queued snapshots are durable (called automatically on
+    the next ``save``/``restore``/``latest_step`` to keep ordering simple).
+    """
+
+    def __init__(self, directory: str, max_to_keep: int = 3,
+                 async_writes: bool = False):
         self.directory = directory
         self.max_to_keep = int(max_to_keep)
         if self.max_to_keep < 1:
             raise ValueError(
                 f"max_to_keep must be >= 1, got {max_to_keep}")
         os.makedirs(directory, exist_ok=True)
+        self.async_writes = bool(async_writes)
+        self._thread = None
+        self._write_error: Optional[BaseException] = None
 
     # -- write ------------------------------------------------------------
     def save(self, step: int, tree: Any,
              metadata: Optional[Dict] = None) -> str:
         """Snapshot ``tree`` (any pytree of arrays) at ``step``."""
+        self.wait()  # one in-flight write at a time; surfaces prior errors
         tree = jax.device_get(tree)
         flat = _flatten_with_paths(tree)
         final = os.path.join(self.directory, f"step_{step}")
+        if not self.async_writes:
+            self._write(step, flat, metadata, final)
+            return final
+
+        import threading
+        self._thread = threading.Thread(
+            target=self._write_guarded, args=(step, flat, metadata, final),
+            daemon=True)
+        self._thread.start()
+        return final
+
+    def wait(self) -> None:
+        """Block until the in-flight async write (if any) is durable; re-
+        raise its error in the caller."""
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+        if self._write_error is not None:
+            err, self._write_error = self._write_error, None
+            raise err
+
+    def _write_guarded(self, step, flat, metadata, final):
+        try:
+            self._write(step, flat, metadata, final)
+        except BaseException as e:  # surfaced on the next wait()/save()
+            self._write_error = e
+
+    def _write(self, step, flat, metadata, final):
         tmp = final + ".tmp"
         if os.path.exists(tmp):
             shutil.rmtree(tmp)
@@ -59,7 +101,6 @@ class CheckpointManager:
             shutil.rmtree(final)
         os.rename(tmp, final)  # atomic publish
         self._gc()
-        return final
 
     def _gc(self):
         steps = self.all_steps()
@@ -79,11 +120,13 @@ class CheckpointManager:
         return sorted(steps)
 
     def latest_step(self) -> Optional[int]:
+        self.wait()  # reads observe every queued async write
         steps = self.all_steps()
         return steps[-1] if steps else None
 
     def restore(self, template: Any, step: Optional[int] = None) -> Any:
         """Restore into the structure of ``template`` (shapes validated)."""
+        self.wait()
         if step is None:
             step = self.latest_step()
         if step is None:

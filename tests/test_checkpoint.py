@@ -143,3 +143,57 @@ def test_step_timer():
 
 def test_device_memory_stats_no_crash():
     device_memory_stats()  # None on virtual CPU devices; must not raise
+
+
+def test_async_writes_durable_and_ordered(tmp_path):
+    from distkeras_tpu.utils.checkpoint import CheckpointManager
+
+    m = CheckpointManager(str(tmp_path), async_writes=True)
+    tree = {"w": np.arange(1000, dtype=np.float32)}
+    for step in range(3):
+        m.save(step, {"w": tree["w"] + step}, metadata={"epoch": step})
+    assert m.latest_step() == 2  # wait() inside makes queued writes visible
+    got = m.restore({"w": np.zeros(1000, np.float32)})
+    np.testing.assert_allclose(got["w"], tree["w"] + 2)
+
+
+def test_async_write_error_surfaces(tmp_path):
+    import os
+
+    from distkeras_tpu.utils.checkpoint import CheckpointManager
+
+    m = CheckpointManager(str(tmp_path / "c"), async_writes=True)
+    m.save(0, {"w": np.zeros(4, np.float32)})
+    m.wait()
+    # break the directory so the next background write fails
+    import shutil
+    shutil.rmtree(str(tmp_path / "c"))
+    os.mknod(str(tmp_path / "c"))  # a FILE where the dir should be
+    m.save(1, {"w": np.zeros(4, np.float32)})
+    with pytest.raises(Exception):
+        m.wait()
+
+
+def test_trainer_checkpoint_async_roundtrip(tmp_path):
+    import numpy as np
+
+    from distkeras_tpu.data import Dataset
+    from distkeras_tpu.models import Dense, Model, Sequential
+    from distkeras_tpu.parallel import SingleTrainer
+
+    rs = np.random.RandomState(0)
+    X = rs.randn(256, 4).astype(np.float32)
+    y = rs.randint(0, 2, 256)
+    ds = Dataset({"features": X, "label": y})
+    cdir = str(tmp_path / "ck")
+    kwargs = dict(batch_size=32, checkpoint_dir=cdir, checkpoint_async=True,
+                  loss="sparse_categorical_crossentropy_from_logits",
+                  worker_optimizer="sgd",
+                  optimizer_kwargs={"learning_rate": 0.1})
+    SingleTrainer(Model.build(Sequential([Dense(2)]), (4,), seed=0),
+                  num_epoch=2, **kwargs).train(ds)
+    resumed = SingleTrainer(Model.build(Sequential([Dense(2)]), (4,),
+                                        seed=0),
+                            num_epoch=4, resume=True, **kwargs)
+    resumed.train(ds)
+    assert resumed.get_history().losses().shape[0] == 2 * (256 // 32)

@@ -1,0 +1,72 @@
+"""Gradient accumulation: identical math to the full-batch step."""
+
+import numpy as np
+import pytest
+
+from distkeras_tpu.data import Dataset
+from distkeras_tpu.models import Dense, Model, Sequential
+from distkeras_tpu.parallel import SingleTrainer, SPMDTrainer, make_mesh_2d
+
+
+def problem(seed=0, N=512, D=8, C=3):
+    rs = np.random.RandomState(seed)
+    X = rs.randn(N, D).astype(np.float32)
+    y = (X @ rs.randn(D, C)).argmax(-1)
+    return Dataset({"features": X, "label": y}), D, C
+
+
+KW = dict(batch_size=64, num_epoch=2, worker_optimizer="sgd",
+          optimizer_kwargs={"learning_rate": 0.05},
+          loss="sparse_categorical_crossentropy_from_logits",
+          shuffle_each_epoch=False, metrics=["accuracy"])
+
+
+def losses_for(accum):
+    ds, D, C = problem()
+    model = Model.build(Sequential([Dense(32, activation="tanh"),
+                                    Dense(C)]), (D,), seed=7)
+    tr = SingleTrainer(model, grad_accum_steps=accum, **KW)
+    tr.train(ds)
+    return tr.get_history().losses(), tr.get_history().metric("accuracy")
+
+
+def test_accum_matches_full_batch_exactly():
+    l1, a1 = losses_for(1)
+    l4, a4 = losses_for(4)
+    np.testing.assert_allclose(l1, l4, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(a1, a4, rtol=1e-5, atol=1e-6)
+
+
+def test_accum_in_spmd_trainer():
+    ds, D, C = problem(1, N=1024)
+    model = Model.build(Sequential([Dense(32, activation="relu"),
+                                    Dense(C)]), (D,), seed=0)
+    tr = SPMDTrainer(model, mesh=make_mesh_2d({"workers": 2, "tp": 4}),
+                     tp_axis="tp", grad_accum_steps=2,
+                     **{**KW, "num_epoch": 6, "shuffle_each_epoch": True})
+    trained = tr.train(ds)
+    from distkeras_tpu.ops.metrics import accuracy
+    assert float(accuracy(ds["label"],
+                          trained.predict(ds["features"]))) > 0.8
+
+
+def test_accum_validation():
+    ds, D, C = problem()
+    model = Model.build(Sequential([Dense(C)]), (D,), seed=0)
+    with pytest.raises(ValueError, match=">= 1"):
+        SingleTrainer(model, grad_accum_steps=0, **KW).train(ds)
+    with pytest.raises(ValueError, match="divide into"):
+        SingleTrainer(model, grad_accum_steps=7, **KW).train(ds)
+
+
+def test_unsupported_trainers_reject_grad_accum():
+    from distkeras_tpu.parallel import (AEASGD, EnsembleTrainer,
+                                        HostAsyncTrainer)
+    ds, D, C = problem()
+    model = Model.build(Sequential([Dense(C)]), (D,), seed=0)
+    for cls, kw in ((AEASGD, {"num_workers": 4}),
+                    (EnsembleTrainer, {"num_models": 2}),
+                    (HostAsyncTrainer, {"num_workers": 2})):
+        tr = cls(model, grad_accum_steps=2, **{**KW, **kw})
+        with pytest.raises(ValueError, match="does not support"):
+            tr.train(ds)
