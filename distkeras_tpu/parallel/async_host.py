@@ -202,6 +202,7 @@ class HostAsyncTrainer(Trainer):
                                           self.worker_optimizer,
                                           self._metric_fns()))
 
+        validator = self._make_validator(model.module)
         self.record_training_start()
         profile = self._profile_ctx()  # enter/exit by hand: the epoch loop
         profile.__enter__()            # already sits inside a try/finally
@@ -248,6 +249,13 @@ class HostAsyncTrainer(Trainer):
                             self.parameter_server.handle_commit(
                                 {"delta": delta,
                                  "clock": self.parameter_server.num_updates})
+                if validator is not None:
+                    vres = {k: np.asarray([float(v)]) for k, v in
+                            jax.device_get(validator(
+                                self.parameter_server.get_model(),
+                                self._mean_state(out, n))).items()}
+                    # merge into the epoch just recorded
+                    self.history.epochs[-1].update(vres)
                 if manager is not None and self._should_checkpoint(epoch):
                     manager.save(
                         epoch,
@@ -255,11 +263,12 @@ class HostAsyncTrainer(Trainer):
                          "state": self._mean_state(out, n)},
                         metadata={"epoch": epoch})
         finally:
-            profile.__exit__(None, None, None)
+            import sys
+            profile.__exit__(*sys.exc_info())
             self.record_training_stop()
+            self.parameter_server.stop()
             if manager is not None:
                 manager.wait()  # async snapshots durable before return
-            self.parameter_server.stop()
 
         center = self.parameter_server.get_model()
         trained = model.replace(params=center, state=self._mean_state(out, n))

@@ -94,13 +94,20 @@ class DistributedTrainer(Trainer):
         extracted = None  # (params, state) pulled on the final-epoch save
         # next epoch's shuffle gather + [S, W, B, ...] stacking overlaps
         # with this epoch's device step (utils/prefetch.py)
+        validator = self._make_validator(model.module)
         with self._profile_ctx():
             for epoch, (Xs, Ys, S) in Prefetcher(
                     assemble, range(start_epoch, self.num_epoch)):
                 state, outs = engine.run_epoch(state, Xs, Ys)
                 losses, mets = self._split_outs(outs)
+                extra = {}
+                if validator is not None:
+                    # evaluate the CENTER (the PS model a user would ship)
+                    extra = {k: np.asarray([float(v)]) for k, v in host_fetch(
+                        validator(state["center"]["params"],
+                                  state["center"]["state"])).items()}
                 self.history.append_epoch(loss=host_fetch(losses),
-                                          **host_fetch(mets))
+                                          **host_fetch(mets), **extra)
                 # cadence check BEFORE extract_model: the full-state
                 # device->host transfer is expensive and must only happen
                 # on save epochs

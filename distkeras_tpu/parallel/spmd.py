@@ -207,6 +207,7 @@ class SPMDTrainer(Trainer):
         from distkeras_tpu.utils.prefetch import Prefetcher
         assemble = lambda epoch: stack_batches(
             X, y, self.batch_size, self._epoch_perm(epoch, len(X)))
+        validator = self._make_validator(model.module)
         self.record_training_start()
         with self._profile_ctx():
             for epoch, (Xs, Ys, S) in Prefetcher(
@@ -215,8 +216,13 @@ class SPMDTrainer(Trainer):
                 Ys = jax.device_put(Ys, data_sh)
                 carry, outs = run_epoch(carry, Xs, Ys)
                 losses, mets = self._split_outs(outs)
+                extra = {}
+                if validator is not None:
+                    extra = {k: np.asarray([float(v)]) for k, v in
+                             host_fetch(validator(carry.params,
+                                                  carry.state)).items()}
                 self.history.append_epoch(loss=host_fetch(losses),
-                                          **host_fetch(mets))
+                                          **host_fetch(mets), **extra)
                 if manager is not None and self._should_checkpoint(epoch):
                     # host_fetch is a COLLECTIVE under multi-process
                     # (allgather of non-addressable shards) — every process

@@ -50,7 +50,8 @@ class Trainer:
                  checkpoint_every: int = 1, resume: bool = False,
                  checkpoint_async: bool = False,
                  profile_dir: Optional[str] = None,
-                 grad_accum_steps: int = 1):
+                 grad_accum_steps: int = 1,
+                 validation_data=None):
         self.master_model = keras_model
         opt_kwargs = dict(optimizer_kwargs or {})
         if learning_rate is not None and not isinstance(worker_optimizer,
@@ -83,6 +84,10 @@ class Trainer:
         # microbatch gradient accumulation inside each step (memory lever;
         # honored by SingleTrainer and SPMDTrainer)
         self.grad_accum_steps = int(grad_accum_steps)
+        # per-epoch held-out evaluation: a Dataset (features/label cols as
+        # configured) or an (X, y) pair; records val_loss / val_<metric>
+        # scalars per epoch in History
+        self.validation_data = validation_data
 
     def _reject_grad_accum(self):
         """Trainers whose step semantics don't compose with accumulation
@@ -180,6 +185,41 @@ class Trainer:
             return outs[0], outs[1]
         return outs, {}
 
+    # -- validation ---------------------------------------------------------
+    def _validation_arrays(self):
+        if self.validation_data is None:
+            return None
+        vd = self.validation_data
+        if isinstance(vd, Dataset):
+            return vd.arrays(self.features_col, self.label_col)
+        X, y = vd
+        from distkeras_tpu.data.dataset import coerce_column
+        return coerce_column(X), coerce_column(y)
+
+    def _make_validator(self, module):
+        """Jitted full-set eval: ``validator(params, state) ->
+        {"val_loss": ..., "val_<metric>": ...}`` (scalars). Built once; the
+        validation set must fit device memory (use a subsample otherwise).
+        """
+        val = self._validation_arrays()
+        if val is None:
+            return None
+        Xv, yv = val
+        loss_fn = self.loss
+        metric_fns = self._metric_fns() or {}
+
+        # the arrays are jit ARGUMENTS (not closure captures) so the whole
+        # validation set is not constant-folded into the executable
+        @jax.jit
+        def evalf(params, state, Xv, yv):
+            out, _ = module.apply(params, state, Xv, training=False)
+            res = {"val_loss": loss_fn(yv, out)}
+            for name, fn in metric_fns.items():
+                res[f"val_{name}"] = fn(yv, out)
+            return res
+
+        return lambda params, state: evalf(params, state, Xv, yv)
+
     # -- data plumbing -----------------------------------------------------
     def _training_arrays(self, dataset: Dataset):
         X, y = dataset.arrays(self.features_col, self.label_col)
@@ -229,6 +269,7 @@ class SingleTrainer(Trainer):
         from distkeras_tpu.utils.prefetch import Prefetcher
         assemble = lambda epoch: stack_batches(
             X, y, self.batch_size, self._epoch_perm(epoch, len(X)))
+        validator = self._make_validator(model.module)
         self.record_training_start()
         # epoch e+1's shuffle gather + stacking runs while the device
         # trains epoch e (utils/prefetch.py)
@@ -237,8 +278,13 @@ class SingleTrainer(Trainer):
                     assemble, range(start_epoch, self.num_epoch)):
                 carry, outs = runner(carry, Xs, Ys)
                 losses, mets = self._split_outs(outs)
+                extra = {}
+                if validator is not None:
+                    extra = {k: np.asarray([float(v)]) for k, v in
+                             jax.device_get(validator(carry.params,
+                                                      carry.state)).items()}
                 self.history.append_epoch(loss=jax.device_get(losses),
-                                          **jax.device_get(mets))
+                                          **jax.device_get(mets), **extra)
                 if manager is not None and self._should_checkpoint(epoch):
                     manager.save(
                         epoch,
@@ -272,6 +318,11 @@ class EnsembleTrainer(Trainer):
 
     def train(self, dataset: Dataset) -> List[Model]:
         self._reject_grad_accum()
+        if self.validation_data is not None:
+            raise ValueError(
+                "EnsembleTrainer does not support validation_data (k "
+                "independent members have no single validation score); "
+                "evaluate members individually after train()")
         base = self.master_model
         X, y = self._training_arrays(dataset)
         k = self.num_models

@@ -1,0 +1,100 @@
+"""North-star config: ResNet-50/ImageNet-style training over a TPU mesh.
+
+BASELINE config 3: data-parallel ResNet training at GPU-EASGD top-1 parity
+with zero socket-PS traffic. This script is the complete recipe — bf16
+ResNet from the zoo, cosine-with-warmup schedule, data-parallel (+optional
+ZeRO/FSDP) sharding via SPMDTrainer, gradient accumulation, async
+checkpointing, per-epoch validation — on synthetic ImageNet-shaped data
+(no dataset download in this environment; swap ``synthetic_imagenet`` for a
+real input pipeline via ``data.from_torch`` or ``Dataset.from_csv``).
+
+Defaults are sized for the 8-virtual-device CPU mesh so the script doubles
+as an integration test; scale ``--image-size/--classes/--variant`` up on
+real hardware (``--variant resnet50 --image-size 224`` is the BASELINE
+shape).
+
+Run:
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+        python examples/imagenet_resnet_spmd.py
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+
+
+def synthetic_imagenet(n, image_size, classes, seed=0):
+    """Class-conditional blob images: learnable, ImageNet-shaped."""
+    rs = np.random.RandomState(seed)
+    protos = rs.rand(classes, 8, 8, 3).astype(np.float32)
+    y = rs.randint(0, classes, n)
+    small = protos[y] + 0.15 * rs.randn(n, 8, 8, 3).astype(np.float32)
+    reps = image_size // 8
+    X = np.clip(np.tile(small, (1, reps, reps, 1)), 0.0, 1.0)
+    return X, y
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--variant", default="resnet18_thin",
+                    choices=["resnet18_thin", "resnet50"])
+    ap.add_argument("--image-size", type=int, default=32)
+    ap.add_argument("--classes", type=int, default=8)
+    ap.add_argument("--n", type=int, default=4096)
+    ap.add_argument("--epochs", type=int, default=4)
+    ap.add_argument("--batch", type=int, default=64)
+    ap.add_argument("--accum", type=int, default=1)
+    ap.add_argument("--fsdp", action="store_true",
+                    help="ZeRO-shard large kernels over the data axis")
+    ap.add_argument("--checkpoint-dir", default=None)
+    args = ap.parse_args()
+
+    import jax
+
+    from distkeras_tpu.data import Dataset
+    from distkeras_tpu.models import Model, zoo
+    from distkeras_tpu.ops import schedules
+    from distkeras_tpu.parallel import SPMDTrainer, make_mesh_2d
+
+    X, y = synthetic_imagenet(args.n, args.image_size, args.classes)
+    n_val = max(args.batch, args.n // 10)
+    ds = Dataset({"features": X[n_val:], "label": y[n_val:]})
+    val = Dataset({"features": X[:n_val], "label": y[:n_val]})
+
+    if args.variant == "resnet50":
+        module = zoo.resnet50(num_classes=args.classes, dtype="bfloat16")
+    else:
+        module = zoo.resnet18_thin(num_classes=args.classes, width=16)
+    model = Model.build(module, (args.image_size, args.image_size, 3),
+                        seed=0)
+    print(f"{args.variant}: {model.num_params():,} params on "
+          f"{len(jax.devices())} devices")
+
+    steps_per_epoch = len(ds["features"]) // args.batch
+    mesh = make_mesh_2d({"workers": len(jax.devices())})
+    trainer = SPMDTrainer(
+        model, mesh=mesh, data_axes=("workers",), tp_axis=None,
+        fsdp_axis="workers" if args.fsdp else None,
+        batch_size=args.batch, num_epoch=args.epochs,
+        grad_accum_steps=args.accum,
+        worker_optimizer="momentum",
+        optimizer_kwargs={"learning_rate": schedules.cosine_decay(
+            0.1, steps_per_epoch * args.epochs,
+            warmup_steps=steps_per_epoch)},
+        loss="sparse_categorical_crossentropy_from_logits",
+        metrics=["accuracy"], validation_data=val,
+        checkpoint_dir=args.checkpoint_dir,
+        checkpoint_async=args.checkpoint_dir is not None)
+    trainer.train(ds)
+
+    h = trainer.get_history()
+    va = h.metric("val_accuracy")
+    print(f"steps/sec {h.steps_per_second():.2f}; "
+          f"val accuracy per epoch: {np.round(va, 3).tolist()}")
+    return float(va[-1])
+
+
+if __name__ == "__main__":
+    main()

@@ -49,3 +49,10 @@ def test_long_context_pipeline_example(capsys):
 def test_criteo_wide_deep_example():
     acc = run_example("examples.criteo_wide_deep")
     assert acc > 0.85, acc
+
+
+def test_imagenet_resnet_spmd_example():
+    acc = run_example("examples.imagenet_resnet_spmd",
+                      ("x", "--n", "2048", "--epochs", "4", "--batch",
+                       "32", "--fsdp"))
+    assert acc > 0.9, acc

@@ -239,3 +239,42 @@ def test_host_async_checkpoint_and_resume(tmp_path):
     assert tr2.get_history().losses().shape[0] > 0
     acc = (trained.predict(X).argmax(-1) == Y).mean()
     assert acc > 0.6, acc
+
+
+def test_ps_socket_stress_interleaved_pull_commit():
+    """Race harness (SURVEY §5.2 role): many socket clients interleave
+    pulls and distinct commits; the mutex must serialize them so the final
+    center equals the exact sum and every pull observes a consistent
+    (never torn) value."""
+    ps = DeltaParameterServer({"w": jnp.zeros((32,))})
+    ps.initialize()
+    port = ps.start(host="127.0.0.1")
+    n_threads, n_commits = 12, 40
+    torn = []
+
+    def worker(widx):
+        c = PSClient(host="127.0.0.1", port=port)
+        try:
+            for i in range(n_commits):
+                delta = np.full((32,), float(widx * n_commits + i))
+                c.commit([delta])
+                pulled, _ = c.pull()
+                # a torn read would mix elements from different commits;
+                # every committed delta is CONSTANT across the vector, so
+                # any consistent sum is also constant across the vector
+                if not np.allclose(pulled[0], pulled[0][0]):
+                    torn.append(pulled[0])
+        finally:
+            c.close()
+
+    ts = [threading.Thread(target=worker, args=(i,))
+          for i in range(n_threads)]
+    [t.start() for t in ts]
+    [t.join() for t in ts]
+    ps.stop()
+    assert not torn, f"torn reads observed: {torn[:2]}"
+    assert ps.num_updates == n_threads * n_commits
+    total = sum(float(w * n_commits + i)
+                for w in range(n_threads) for i in range(n_commits))
+    np.testing.assert_allclose(np.asarray(ps.get_model()["w"]),
+                               np.full((32,), total))

@@ -1,0 +1,114 @@
+"""validation_data support + the golden-metric convergence test (SURVEY §4
+calls for an MNIST-MLP golden metric as BASELINE config 1's stand-in)."""
+
+import numpy as np
+import pytest
+
+from distkeras_tpu.data import Dataset
+from distkeras_tpu.models import Dense, Model, Sequential
+from distkeras_tpu.parallel import (AEASGD, SingleTrainer, SPMDTrainer,
+                                    make_mesh_2d)
+
+
+def split_problem(seed=0, N=2048, D=16, C=4):
+    rs = np.random.RandomState(seed)
+    X = rs.randn(N, D).astype(np.float32)
+    y = (X @ rs.randn(D, C)).argmax(-1)
+    n_tr = int(N * 0.8)
+    return (Dataset({"features": X[:n_tr], "label": y[:n_tr]}),
+            Dataset({"features": X[n_tr:], "label": y[n_tr:]}), D, C)
+
+
+KW = dict(worker_optimizer="momentum",
+          optimizer_kwargs={"learning_rate": 0.05},
+          loss="sparse_categorical_crossentropy_from_logits",
+          metrics=["accuracy"], batch_size=64, num_epoch=5)
+
+
+def check_val(trainer, expect_epochs):
+    h = trainer.get_history()
+    vl = h.metric("val_loss")
+    va = h.metric("val_accuracy")
+    assert vl.shape == (expect_epochs,) and va.shape == (expect_epochs,)
+    assert np.isfinite(vl).all()
+    assert vl[-1] < vl[0]          # held-out loss improves
+    assert va[-1] > 0.8, va        # and generalizes
+
+
+def test_single_trainer_validation():
+    tr_ds, va_ds, D, C = split_problem()
+    model = Model.build(Sequential([Dense(64, activation="relu"),
+                                    Dense(C)]), (D,), seed=0)
+    tr = SingleTrainer(model, validation_data=va_ds, **KW)
+    tr.train(tr_ds)
+    check_val(tr, KW["num_epoch"])
+
+
+def test_spmd_trainer_validation_xy_pair():
+    tr_ds, va_ds, D, C = split_problem(1)
+    model = Model.build(Sequential([Dense(64, activation="relu"),
+                                    Dense(C)]), (D,), seed=0)
+    tr = SPMDTrainer(model, mesh=make_mesh_2d({"workers": 2, "tp": 4}),
+                     tp_axis="tp",
+                     validation_data=(va_ds["features"], va_ds["label"]),
+                     **KW)
+    tr.train(tr_ds)
+    check_val(tr, KW["num_epoch"])
+
+
+def test_distributed_trainer_validation_on_center():
+    tr_ds, va_ds, D, C = split_problem(2)
+    model = Model.build(Sequential([Dense(64, activation="relu"),
+                                    Dense(C)]), (D,), seed=0)
+    kw = {**KW, "num_epoch": 10}
+    tr = AEASGD(model, num_workers=8, communication_window=4, rho=5.0,
+                learning_rate=0.02, validation_data=va_ds, **kw)
+    tr.train(tr_ds)
+    check_val(tr, kw["num_epoch"])
+
+
+def test_golden_mnist_mlp_convergence():
+    """Golden metric (BASELINE config 1 stand-in): the synthetic-MNIST MLP
+    pipeline must reach >= 0.97 train accuracy in 3 epochs with the default
+    example settings. A regression in layers/optimizers/trainers shows up
+    here as a hard number, not a vague slowdown."""
+    from examples.mnist_workflow import build_model, make_synthetic_mnist
+    from distkeras_tpu.data import MinMaxTransformer
+    from distkeras_tpu.ops.metrics import accuracy
+
+    X, y = make_synthetic_mnist(4096)
+    ds = Dataset({"features": X, "label": y})
+    ds = MinMaxTransformer(0.0, 1.0, 0.0, 255.0, "features",
+                           "features_norm")(ds)
+    model = build_model((784,), conv=False)
+    tr = SingleTrainer(model, worker_optimizer="momentum",
+                       optimizer_kwargs={"learning_rate": 0.05},
+                       loss="sparse_categorical_crossentropy_from_logits",
+                       features_col="features_norm",
+                       batch_size=64, num_epoch=3, seed=0)
+    trained = tr.train(ds)
+    acc = float(accuracy(y, trained.predict(ds["features_norm"],
+                                            batch_size=1024)))
+    assert acc >= 0.97, f"golden MNIST-MLP accuracy regressed: {acc:.4f}"
+
+
+def test_host_async_trainer_validation():
+    from distkeras_tpu.parallel import HostAsyncTrainer
+    tr_ds, va_ds, D, C = split_problem(3, N=1024)
+    model = Model.build(Sequential([Dense(32, activation="relu"),
+                                    Dense(C)]), (D,), seed=0)
+    kw = {**KW, "num_epoch": 6, "batch_size": 16}
+    tr = HostAsyncTrainer(model, num_workers=4, communication_window=4,
+                          validation_data=va_ds, **kw)
+    tr.train(tr_ds)
+    vl = tr.get_history().metric("val_loss")
+    assert vl.shape == (6,) and vl[-1] < vl[0]
+
+
+def test_ensemble_trainer_rejects_validation_data():
+    from distkeras_tpu.parallel import EnsembleTrainer
+    tr_ds, va_ds, D, C = split_problem()
+    model = Model.build(Sequential([Dense(C)]), (D,), seed=0)
+    tr = EnsembleTrainer(model, num_models=2, validation_data=va_ds, **KW)
+    with pytest.raises(ValueError, match="does not support validation"):
+        tr.train(tr_ds)
