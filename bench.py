@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import json
 import time
+from functools import partial
 
 import jax
 import jax.numpy as jnp
@@ -40,7 +41,7 @@ def build_train_step(module, optimizer, loss_fn):
 
     step = make_train_step(module, loss_fn, optimizer)
 
-    @jax.jit
+    @partial(jax.jit, donate_argnums=(0,))
     def train_step(carry, xb, yb):
         carry, loss = step(carry, (xb, yb))
         return carry, loss

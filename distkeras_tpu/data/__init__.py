@@ -5,6 +5,7 @@ from distkeras_tpu.data.dataset import Dataset, coerce_column  # noqa: F401
 from distkeras_tpu.data.adapters import from_iterable, from_torch  # noqa: F401,E501
 from distkeras_tpu.data.transformers import (  # noqa: F401
     DenseTransformer, LabelIndexTransformer, MinMaxTransformer,
-    OneHotTransformer, ReshapeTransformer, StandardScaleTransformer,
+    HashingTransformer, OneHotTransformer, ReshapeTransformer,
+    StandardScaleTransformer,
     Transformer)
 from distkeras_tpu.data import native  # noqa: F401

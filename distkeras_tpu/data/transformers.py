@@ -174,3 +174,40 @@ class StandardScaleTransformer(Transformer):
         std = x.std(axis=0, keepdims=True)
         return dataset.with_column(self.output_col,
                                    (x - mean) / (std + self.epsilon))
+
+
+class HashingTransformer(Transformer):
+    """Categorical column(s) -> multi-hot hashed indicator vector.
+
+    The hashing trick for Criteo-style high-cardinality categoricals
+    (BASELINE config 4's wide features): each (column, value) pair maps to
+    ``crc32(f"{col}={value}") % num_buckets`` — a STABLE hash (unlike
+    Python's salted ``hash``), so train- and serve-time encodings agree
+    across processes. Works on string or integer columns; the output is a
+    float32 ``[n, num_buckets]`` multi-hot matrix suitable as the wide half
+    of ``models.blocks.WideAndDeep``.
+    """
+
+    def __init__(self, num_buckets: int, input_cols: Sequence[str],
+                 output_col: str = "features_hashed"):
+        if num_buckets < 1:
+            raise ValueError(f"num_buckets must be >= 1, got {num_buckets}")
+        self.num_buckets = int(num_buckets)
+        self.input_cols = list(input_cols)
+        self.output_col = output_col
+
+    def transform(self, dataset: Dataset) -> Dataset:
+        import zlib
+
+        n = len(dataset)
+        out = np.zeros((n, self.num_buckets), np.float32)
+        rows = np.arange(n)
+        for col in self.input_cols:
+            values = dataset[col]
+            prefix = f"{col}=".encode()
+            idx = np.fromiter(
+                (zlib.crc32(prefix + str(v).encode()) % self.num_buckets
+                 for v in values),
+                dtype=np.int64, count=n)
+            out[rows, idx] = 1.0
+        return dataset.with_column(self.output_col, out)

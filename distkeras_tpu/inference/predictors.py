@@ -37,12 +37,20 @@ class Predictor:
     def __init__(self, keras_model: Model, features_col: str = "features",
                  output_col: str = "prediction",
                  batch_size_per_device: int = 128,
-                 mesh: Optional[Mesh] = None):
+                 mesh: Optional[Mesh] = None,
+                 tp_axis: Optional[str] = None,
+                 ep_axis: Optional[str] = None):
+        """``tp_axis``/``ep_axis``: shard the model's params over those mesh
+        axes (same Megatron/expert rules as SPMDTrainer) instead of
+        replicating — inference for models bigger than one chip. The batch
+        is sharded over the mesh's FIRST axis either way."""
         self.model = keras_model
         self.features_col = features_col
         self.output_col = output_col
         self.batch_size_per_device = int(batch_size_per_device)
         self.mesh = mesh if mesh is not None else make_mesh()
+        self.tp_axis = tp_axis
+        self.ep_axis = ep_axis
         self._fn = None
 
     def _build(self):
@@ -60,6 +68,19 @@ class Predictor:
         self._fn = fwd
         self._in_sharding = sharded
         self._rep = replicated
+        if self.tp_axis or self.ep_axis:
+            from distkeras_tpu.parallel.sharding import (named_shardings,
+                                                         param_specs)
+            specs = param_specs(model.module, model.params, mesh,
+                                tp_axis=self.tp_axis, ep_axis=self.ep_axis)
+            self._param_sh = named_shardings(specs, mesh)
+        else:
+            self._param_sh = None
+
+    def _place_params(self):
+        sh = self._param_sh if self._param_sh is not None else self._rep
+        return (jax.device_put(self.model.params, sh),
+                jax.device_put(self.model.state, self._rep))
 
     # the one shared dtype policy (training and inference must agree)
     _coerce = staticmethod(coerce_column)
@@ -79,11 +100,10 @@ class Predictor:
             self._build()
         X = self._coerce(dataset[self.features_col])
         n = len(X)
-        n_dev = self.mesh.devices.size
-        global_batch = n_dev * self.batch_size_per_device
+        n_batch = self.mesh.shape[self.mesh.axis_names[0]]
+        global_batch = n_batch * self.batch_size_per_device
 
-        params = jax.device_put(self.model.params, self._rep)
-        state = jax.device_put(self.model.state, self._rep)
+        params, state = self._place_params()
 
         outs = []
         for i in range(0, n, global_batch):
@@ -126,13 +146,17 @@ class StreamingPredictor(Predictor):
 
     def __init__(self, keras_model: Model, batch_size: int = 256,
                  mesh: Optional[Mesh] = None, **kwargs):
-        n_dev = (mesh.devices.size if mesh is not None
-                 else len(jax.devices()))
-        if batch_size % n_dev:
-            raise ValueError(f"batch_size {batch_size} must divide over "
-                             f"{n_dev} devices")
+        mesh = mesh if mesh is not None else make_mesh()
+        # batch shards over the FIRST mesh axis only (same semantics as
+        # Predictor.predict); other axes hold tp/ep shards
+        n_batch = mesh.shape[mesh.axis_names[0]]
+        if batch_size % n_batch:
+            raise ValueError(
+                f"batch_size {batch_size} must divide over the "
+                f"{mesh.axis_names[0]!r} axis ({n_batch})")
         super().__init__(keras_model, mesh=mesh,
-                         batch_size_per_device=batch_size // n_dev, **kwargs)
+                         batch_size_per_device=batch_size // n_batch,
+                         **kwargs)
         self.batch_size = int(batch_size)
 
     def predict_stream(self, source):
@@ -140,8 +164,7 @@ class StreamingPredictor(Predictor):
         batch_size). Yields ``[n_i, ...]`` prediction arrays in order."""
         if self._fn is None:
             self._build()
-        params = jax.device_put(self.model.params, self._rep)
-        state = jax.device_put(self.model.state, self._rep)
+        params, state = self._place_params()
 
         import queue
         import threading

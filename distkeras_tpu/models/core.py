@@ -194,6 +194,61 @@ class Model:
             outs.append(yb[:batch_size - pad] if pad else yb)
         return np.concatenate(outs, axis=0)
 
+    # -- Keras-style conveniences ----------------------------------------
+    def fit(self, x, y=None, *, optimizer="sgd", loss="mean_squared_error",
+            batch_size: int = 32, epochs: int = 1, metrics=None,
+            validation_data=None, seed: int = 0, **trainer_kwargs):
+        """Keras-style ``model.fit`` — a thin wrapper over SingleTrainer
+        (use the trainer classes directly for distributed training).
+
+        ``x`` may be a ``data.Dataset`` (with the default feature/label
+        columns) or a feature array with ``y`` labels. Trains IN PLACE
+        (this model's params/state are updated) and returns the History.
+        """
+        from distkeras_tpu.data.dataset import Dataset
+        from distkeras_tpu.parallel.trainers import SingleTrainer
+
+        if isinstance(x, Dataset):
+            ds = x
+        else:
+            if y is None:
+                raise ValueError("fit(x, y): y is required for array input")
+            ds = Dataset({"features": np.asarray(x), "label": np.asarray(y)})
+        trainer = SingleTrainer(
+            self, worker_optimizer=optimizer, loss=loss,
+            batch_size=batch_size, num_epoch=epochs, metrics=metrics,
+            validation_data=validation_data, seed=seed, **trainer_kwargs)
+        trained = trainer.train(ds)
+        self.params, self.state = trained.params, trained.state
+        self._jit_fwd = None  # old closure captured nothing, but be tidy
+        return trainer.get_history()
+
+    def evaluate(self, x, y=None, *, loss="mean_squared_error",
+                 metrics=("accuracy",), batch_size: int = 1024,
+                 features_col: str = "features", label_col: str = "label"):
+        """Keras-style ``model.evaluate``: ``{"loss": ..., metric: ...}``
+        over the full set (batched host-side forward)."""
+        from distkeras_tpu.data.dataset import Dataset, coerce_column
+        from distkeras_tpu.ops.losses import get_loss
+        from distkeras_tpu.ops.metrics import get_metric
+
+        if isinstance(x, Dataset):
+            X, yv = x.arrays(features_col, label_col)
+            if yv is None:
+                raise ValueError(
+                    f"evaluate(dataset): label column {label_col!r} not in "
+                    f"dataset (columns: {x.columns})")
+        else:
+            if y is None:
+                raise ValueError("evaluate(x, y): y is required")
+            X, yv = coerce_column(x), coerce_column(y)
+        preds = self.predict(X, batch_size=batch_size)
+        res = {"loss": float(get_loss(loss)(yv, jnp.asarray(preds)))}
+        for m in (metrics or ()):
+            name = m if isinstance(m, str) else getattr(m, "__name__", "m")
+            res[name] = float(get_metric(m)(yv, preds))
+        return res
+
     # -- bookkeeping ------------------------------------------------------
     def num_params(self) -> int:
         return sum(int(np.prod(p.shape))

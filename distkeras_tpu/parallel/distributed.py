@@ -22,6 +22,7 @@ from __future__ import annotations
 from typing import Optional, Sequence, Union
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from distkeras_tpu.data.dataset import Dataset
@@ -95,6 +96,17 @@ class DistributedTrainer(Trainer):
         # next epoch's shuffle gather + [S, W, B, ...] stacking overlaps
         # with this epoch's device step (utils/prefetch.py)
         validator = self._make_validator(model.module)
+        if validator is not None:
+            # center model STATE never advances in the engine (only params
+            # do); validate with the worker-averaged state, the same thing
+            # extract_model ships (float leaves averaged, counters from
+            # worker 0)
+            @jax.jit
+            def _val_state(wstate):
+                return jax.tree_util.tree_map(
+                    lambda s: s.mean(axis=0)
+                    if jnp.issubdtype(s.dtype, jnp.floating) else s[0],
+                    wstate)
         with self._profile_ctx():
             for epoch, (Xs, Ys, S) in Prefetcher(
                     assemble, range(start_epoch, self.num_epoch)):
@@ -105,7 +117,8 @@ class DistributedTrainer(Trainer):
                     # evaluate the CENTER (the PS model a user would ship)
                     extra = {k: np.asarray([float(v)]) for k, v in host_fetch(
                         validator(state["center"]["params"],
-                                  state["center"]["state"])).items()}
+                                  _val_state(state["worker"]["state"]))
+                    ).items()}
                 self.history.append_epoch(loss=host_fetch(losses),
                                           **host_fetch(mets), **extra)
                 # cadence check BEFORE extract_model: the full-state

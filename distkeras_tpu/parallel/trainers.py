@@ -209,7 +209,11 @@ class Trainer:
         metric_fns = self._metric_fns() or {}
 
         # the arrays are jit ARGUMENTS (not closure captures) so the whole
-        # validation set is not constant-folded into the executable
+        # validation set is not constant-folded into the executable; they
+        # are device_put ONCE so epochs don't re-pay the host->device copy
+        Xv = jax.device_put(jnp.asarray(Xv))
+        yv = jax.device_put(jnp.asarray(yv))
+
         @jax.jit
         def evalf(params, state, Xv, yv):
             out, _ = module.apply(params, state, Xv, training=False)

@@ -133,3 +133,32 @@ def test_pipeline_chain_mnist_style():
     assert ds["matrix"].shape == (8, 28, 28, 1)
     assert ds["label_encoded"].shape == (8, 10)
     assert float(ds["matrix"].max()) <= 1.0
+
+
+def test_hashing_transformer_stable_multi_hot():
+    from distkeras_tpu.data import Dataset, HashingTransformer
+
+    ds = Dataset({"cat_a": np.array(["x", "y", "x", "z"]),
+                  "cat_b": np.array([10, 10, 20, 30]),
+                  "label": np.zeros(4)})
+    t = HashingTransformer(64, ["cat_a", "cat_b"], output_col="wide")
+    out = t(ds)
+    w = out["wide"]
+    assert w.shape == (4, 64) and w.dtype == np.float32
+    # each row sets (at most) one bucket per column
+    assert (w.sum(axis=1) <= 2).all() and (w.sum(axis=1) >= 1).all()
+    # same value -> same bucket: rows 0 and 2 share cat_a="x"
+    wa = HashingTransformer(64, ["cat_a"])(ds)["features_hashed"]
+    np.testing.assert_array_equal(wa[0], wa[2])
+    assert not np.array_equal(wa[0], wa[1])  # "x" vs "y" (64 buckets)
+    # determinism across instances (stable crc32, not salted hash())
+    w2 = HashingTransformer(64, ["cat_a", "cat_b"],
+                            output_col="wide")(ds)["wide"]
+    np.testing.assert_array_equal(w, w2)
+    # rows with equal values hash identically
+    np.testing.assert_array_equal(
+        HashingTransformer(64, ["cat_b"])(ds)["features_hashed"][0],
+        HashingTransformer(64, ["cat_b"])(ds)["features_hashed"][1])
+
+    with pytest.raises(ValueError, match=">= 1"):
+        HashingTransformer(0, ["cat_a"])

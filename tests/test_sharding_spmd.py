@@ -268,3 +268,20 @@ def test_spmd_trainer_resumes_old_format_checkpoint(tmp_path):
         trainer.train(ds)
     # resumed at epoch 1, trained the remaining 2
     assert trainer.get_history().losses().shape[0] == 2 * (256 // 64)
+
+
+def test_predictor_tp_sharded_params():
+    """Sharded inference: tp-sharded placement == replicated numerics."""
+    from distkeras_tpu.inference import Predictor
+
+    mesh = make_mesh_2d({"workers": 2, "tp": 4})
+    module = tiny_lm()
+    model = Model.build(module, (8,), seed=3)
+    X = np.random.RandomState(0).randint(0, 32, (40, 8))
+    ds = Dataset({"features": X})
+
+    ref = Predictor(model, batch_size_per_device=8).predict(ds)["prediction"]
+    tp = Predictor(model, mesh=mesh, tp_axis="tp",
+                   batch_size_per_device=8).predict(ds)["prediction"]
+    assert tp.shape == (40, 8, 32)  # [rows, seq, vocab]
+    np.testing.assert_allclose(ref, tp, rtol=2e-5, atol=2e-5)

@@ -115,3 +115,24 @@ def test_profile_dir_writes_trace(tmp_path):
     # a plugin/profile directory with at least one trace artifact appears
     found = [os.path.join(r, f) for r, _, fs in os.walk(pdir) for f in fs]
     assert found, f"no trace files under {pdir}"
+
+
+def test_model_fit_evaluate_keras_style():
+    import numpy as np
+
+    from distkeras_tpu.models import Dense, Model, Sequential
+
+    rs = np.random.RandomState(0)
+    X = rs.randn(1024, 8).astype(np.float32)
+    y = (X @ rs.randn(8, 3)).argmax(-1)
+
+    model = Model.build(Sequential([Dense(32, activation="relu"),
+                                    Dense(3)]), (8,), seed=0)
+    hist = model.fit(X, y, optimizer="momentum",
+                     loss="sparse_categorical_crossentropy_from_logits",
+                     optimizer_kwargs={"learning_rate": 0.1},
+                     batch_size=64, epochs=4, metrics=["accuracy"])
+    assert hist.losses().shape[0] == 4 * (1024 // 64)
+    res = model.evaluate(
+        X, y, loss="sparse_categorical_crossentropy_from_logits")
+    assert res["accuracy"] > 0.9 and np.isfinite(res["loss"])

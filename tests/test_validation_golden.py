@@ -112,3 +112,26 @@ def test_ensemble_trainer_rejects_validation_data():
     tr = EnsembleTrainer(model, num_models=2, validation_data=va_ds, **KW)
     with pytest.raises(ValueError, match="does not support validation"):
         tr.train(tr_ds)
+
+
+def test_distributed_validation_uses_trained_bn_state():
+    """Regression: center model STATE never advances in the engine, so
+    validation must use the worker-averaged BatchNorm stats."""
+    from distkeras_tpu.models.layers import BatchNorm
+    from distkeras_tpu.parallel import DOWNPOUR
+
+    tr_ds, va_ds, D, C = split_problem(5, N=4096)
+    # scale features so init BN stats (mean 0 / var 1) are WRONG
+    big_tr = Dataset({"features": tr_ds["features"] * 10.0 + 3.0,
+                      "label": tr_ds["label"]})
+    big_va = Dataset({"features": va_ds["features"] * 10.0 + 3.0,
+                      "label": va_ds["label"]})
+    model = Model.build(Sequential([BatchNorm(),
+                                    Dense(32, activation="relu"),
+                                    Dense(C)]), (D,), seed=0)
+    kw = {**KW, "num_epoch": 8, "batch_size": 32}
+    tr = DOWNPOUR(model, num_workers=8, communication_window=4,
+                  commit_scale=1 / 8, validation_data=big_va, **kw)
+    tr.train(big_tr)
+    va = tr.get_history().metric("val_accuracy")
+    assert va[-1] > 0.75, va
