@@ -205,9 +205,12 @@ class HashingTransformer(Transformer):
         for col in self.input_cols:
             values = dataset[col]
             prefix = f"{col}=".encode()
-            idx = np.fromiter(
+            # hash each DISTINCT value once; categorical columns repeat
+            # heavily, so this turns O(n) crc32 calls into O(n_unique)
+            uniq, inverse = np.unique(values, return_inverse=True)
+            buckets = np.fromiter(
                 (zlib.crc32(prefix + str(v).encode()) % self.num_buckets
-                 for v in values),
-                dtype=np.int64, count=n)
-            out[rows, idx] = 1.0
+                 for v in uniq),
+                dtype=np.int64, count=len(uniq))
+            out[rows, buckets[inverse]] = 1.0
         return dataset.with_column(self.output_col, out)

@@ -230,7 +230,7 @@ class Model:
         over the full set (batched host-side forward)."""
         from distkeras_tpu.data.dataset import Dataset, coerce_column
         from distkeras_tpu.ops.losses import get_loss
-        from distkeras_tpu.ops.metrics import get_metric
+        from distkeras_tpu.ops.metrics import get_metric, metric_name
 
         if isinstance(x, Dataset):
             X, yv = x.arrays(features_col, label_col)
@@ -245,8 +245,7 @@ class Model:
         preds = self.predict(X, batch_size=batch_size)
         res = {"loss": float(get_loss(loss)(yv, jnp.asarray(preds)))}
         for m in (metrics or ()):
-            name = m if isinstance(m, str) else getattr(m, "__name__", "m")
-            res[name] = float(get_metric(m)(yv, preds))
+            res[metric_name(m)] = float(get_metric(m)(yv, preds))
         return res
 
     # -- bookkeeping ------------------------------------------------------

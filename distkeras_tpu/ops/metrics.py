@@ -136,6 +136,14 @@ METRICS = {
 }
 
 
+def metric_name(metric: Union[str, Callable]) -> str:
+    """Display/history key for a metric spec (shared by trainer histories
+    and ``Model.evaluate`` so the two report under the same names)."""
+    if isinstance(metric, str):
+        return metric
+    return getattr(metric, "__name__", "metric")
+
+
 def get_metric(metric: Union[str, Callable]):
     if callable(metric):
         return metric

@@ -449,8 +449,14 @@ class DistributedEngine:
         center = self.algo.finalize(
             host["center"]["params"], host["worker"]["params"],
             host["worker"]["pull"], self.config.num_workers)
-        mstate = _tmap(lambda s: s.mean(axis=0) if hasattr(s, "mean") else s,
-                       host["worker"]["state"])
+        # float leaves (BN stats) average over workers; integer leaves
+        # (step counters) keep worker 0's value — averaging would silently
+        # turn them into float64
+        mstate = _tmap(
+            lambda s: s.mean(axis=0)
+            if (hasattr(s, "dtype") and np.issubdtype(s.dtype, np.floating))
+            else (s[0] if hasattr(s, "__getitem__") else s),
+            host["worker"]["state"])
         return center, mstate
 
 

@@ -174,9 +174,8 @@ class Trainer:
         Keras ``model.compile(metrics=...)`` per worker), or None."""
         if not self.metrics:
             return None
-        from distkeras_tpu.ops.metrics import get_metric
-        return {(m if isinstance(m, str) else getattr(m, "__name__", "metric")
-                 ): get_metric(m) for m in self.metrics}
+        from distkeras_tpu.ops.metrics import get_metric, metric_name
+        return {metric_name(m): get_metric(m) for m in self.metrics}
 
     @staticmethod
     def _split_outs(outs):
@@ -209,10 +208,11 @@ class Trainer:
         metric_fns = self._metric_fns() or {}
 
         # the arrays are jit ARGUMENTS (not closure captures) so the whole
-        # validation set is not constant-folded into the executable; they
-        # are device_put ONCE so epochs don't re-pay the host->device copy
-        Xv = jax.device_put(jnp.asarray(Xv))
-        yv = jax.device_put(jnp.asarray(yv))
+        # validation set is not constant-folded into the executable; the
+        # asarray places them on device ONCE so epochs don't re-pay the
+        # host->device copy
+        Xv = jnp.asarray(Xv)
+        yv = jnp.asarray(yv)
 
         @jax.jit
         def evalf(params, state, Xv, yv):

@@ -285,3 +285,34 @@ def test_predictor_tp_sharded_params():
                    batch_size_per_device=8).predict(ds)["prediction"]
     assert tp.shape == (40, 8, 32)  # [rows, seq, vocab]
     np.testing.assert_allclose(ref, tp, rtol=2e-5, atol=2e-5)
+
+
+def test_distributed_resume_with_different_worker_count(tmp_path):
+    """Elastic recovery: the center checkpoint restores under a DIFFERENT
+    worker count (workers restart from the center, so the mesh shape is
+    free to change between runs — the hardware-failure/resize story)."""
+    from distkeras_tpu.parallel import ADAG
+
+    rs = np.random.RandomState(0)
+    X = rs.randn(512, 8).astype(np.float32)
+    y = (X @ rs.randn(8, 3)).argmax(-1)
+    ds = Dataset({"features": X, "label": y})
+    cdir = str(tmp_path / "ck")
+    kwargs = dict(batch_size=16, communication_window=2,
+                  worker_optimizer="sgd",
+                  optimizer_kwargs={"learning_rate": 0.1},
+                  loss="sparse_categorical_crossentropy_from_logits",
+                  checkpoint_dir=cdir)
+
+    def fresh():
+        return Model.build(Sequential([Dense(16, activation="relu"),
+                                       Dense(3)]), (8,), seed=0)
+
+    ADAG(fresh(), num_workers=8, num_epoch=2, **kwargs).train(ds)
+    resumed = ADAG(fresh(), num_workers=4, num_epoch=5, resume=True,
+                   **kwargs)
+    m = resumed.train(ds)
+    losses = resumed.get_history().losses()
+    assert losses.shape == (3 * (512 // (4 * 16)), 4)  # 3 epochs, 4 workers
+    from distkeras_tpu.ops.metrics import accuracy
+    assert float(accuracy(y, m.predict(X))) > 0.8
