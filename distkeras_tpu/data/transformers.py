@@ -203,14 +203,27 @@ class HashingTransformer(Transformer):
         out = np.zeros((n, self.num_buckets), np.float32)
         rows = np.arange(n)
         for col in self.input_cols:
-            values = dataset[col]
+            values = np.asarray(dataset[col])
             prefix = f"{col}=".encode()
+
+            def _hash(v):
+                return zlib.crc32(prefix + str(v).encode()) % self.num_buckets
+
             # hash each DISTINCT value once; categorical columns repeat
-            # heavily, so this turns O(n) crc32 calls into O(n_unique)
-            uniq, inverse = np.unique(values, return_inverse=True)
-            buckets = np.fromiter(
-                (zlib.crc32(prefix + str(v).encode()) % self.num_buckets
-                 for v in uniq),
-                dtype=np.int64, count=len(uniq))
-            out[rows, buckets[inverse]] = 1.0
+            # heavily, so this turns O(n) crc32 calls into O(n_unique).
+            # Multi-dim columns dedupe whole rows (axis=0); unsortable
+            # mixed-type object columns can't go through np.unique at all,
+            # so they fall back to the plain per-row loop.
+            try:
+                uniq, inverse = np.unique(
+                    values, return_inverse=True,
+                    axis=0 if values.ndim > 1 else None)
+            except TypeError:
+                buckets = np.fromiter((_hash(v) for v in values),
+                                      dtype=np.int64, count=n)
+            else:
+                uh = np.fromiter((_hash(v) for v in uniq),
+                                 dtype=np.int64, count=len(uniq))
+                buckets = uh[inverse.reshape(-1)]
+            out[rows, buckets] = 1.0
         return dataset.with_column(self.output_col, out)

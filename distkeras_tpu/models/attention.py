@@ -10,8 +10,10 @@ build's long-context model family, designed mesh-first:
   * The MLP keeps its two matmuls as explicit ``w1``/``w2`` for the standard
     column→row TP split.
   * ``attn_impl`` selects the compute path per layer: ``"xla"`` (fused
-    reference), ``"flash"`` (Pallas kernel), or ``"ring"`` (sequence-parallel
-    ring attention over a mesh axis — set by the SPMD trainer).
+    reference), ``"flash"`` (Pallas kernel), ``"ring"`` (sequence-parallel
+    ring attention over a mesh axis — set by the SPMD trainer), or
+    ``"ulysses"``/``"ulysses_flash"`` (all-to-all head-scatter sequence
+    parallelism, ``ops.ulysses``).
 """
 
 from __future__ import annotations
@@ -137,6 +139,17 @@ def _attention_compute(q, k, v, *, causal, impl, axis_name=None,
         from distkeras_tpu.ops.ring_attention import ring_attention
         return ring_attention(q, k, v, axis_name=axis_name, causal=causal,
                               block_size=ring_block_size)
+    if impl in ("ulysses", "ulysses_flash"):
+        if not axis_name:
+            raise ValueError(
+                "attn_impl='ulysses' requires seq_axis_name (the mesh axis "
+                "the sequence is sharded over); without it RoPE positions "
+                "and causal masks would silently use shard-local "
+                "coordinates")
+        from distkeras_tpu.ops.ulysses import ulysses_attention
+        return ulysses_attention(
+            q, k, v, axis_name=axis_name, causal=causal,
+            impl="flash" if impl == "ulysses_flash" else "xla")
     return dot_product_attention(q, k, v, causal=causal)
 
 
@@ -188,7 +201,8 @@ class MultiHeadAttention(Layer):
         v = jnp.einsum("bsd,dhe->bshe", xc, params["wv"].astype(dt))
         if self.use_rope:
             positions = None
-            if self.attn_impl == "ring" and self.seq_axis_name:
+            if (self.attn_impl in ("ring", "ulysses", "ulysses_flash")
+                    and self.seq_axis_name):
                 # global positions for this sequence shard
                 idx = jax.lax.axis_index(self.seq_axis_name)
                 positions = idx * x.shape[1] + jnp.arange(x.shape[1])

@@ -3,6 +3,7 @@
 from distkeras_tpu.ops.attention import (  # noqa: F401
     apply_rope, causal_mask, dot_product_attention)
 from distkeras_tpu.ops.ring_attention import ring_attention  # noqa: F401
+from distkeras_tpu.ops.ulysses import ulysses_attention  # noqa: F401
 
 
 def __getattr__(name):

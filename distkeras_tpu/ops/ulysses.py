@@ -1,0 +1,84 @@
+"""Ulysses attention: all-to-all sequence/context parallelism.
+
+Absent from the reference (SURVEY §5.7: dist-keras has no sequence
+sharding of any kind) — this is the second of the TPU build's two
+long-context strategies, complementing ``ops.ring_attention``:
+
+  * **Ring** keeps the sequence sharded end-to-end and rotates K/V shards
+    around the mesh axis with ``ppermute`` — N-1 neighbor hops, each
+    overlapped with block compute. Communication volume per device scales
+    with the FULL K/V (every shard visits every device).
+  * **Ulysses** (DeepSpeed-Ulysses style) re-shards with two
+    ``all_to_all``s: sequence-sharded → head-sharded before attention and
+    back after. Each device then computes EXACT attention over the whole
+    sequence for ``H / N`` heads, so any single-device kernel (fused XLA or
+    the Pallas flash kernel) is reused unchanged. Communication is two
+    all-to-alls of the activations — O(B·S·H·D / N) per device, cheaper
+    than the ring's rotating K/V when heads are plentiful, but it requires
+    ``num_heads % axis_size == 0`` and peak score memory is that of the
+    inner kernel at full sequence length (use ``impl="flash"`` for long S).
+
+Like ``ring_attention`` this must run **inside** a ``shard_map`` whose
+``axis_name`` axis shards the sequence dimension of q/k/v
+(``MultiHeadAttention(attn_impl="ulysses")`` arranges this).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+from jax import lax
+
+from distkeras_tpu.ops.attention import dot_product_attention
+
+
+def _seq_to_heads(x, axis_name):
+    """[B, S/N, H, D] sequence-sharded -> [B, S, H/N, D] head-sharded.
+
+    ``tiled`` all-to-all splits the local heads into N chunks and
+    concatenates the received sequence shards in device order — device
+    order IS global sequence order, so the result holds the full sequence
+    contiguously.
+    """
+    return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                          tiled=True)
+
+
+def _heads_to_seq(x, axis_name):
+    """[B, S, H/N, D] head-sharded -> [B, S/N, H, D] sequence-sharded."""
+    return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                          tiled=True)
+
+
+def ulysses_attention(q, k, v, *, axis_name: str, causal: bool = False,
+                      scale: Optional[float] = None, impl: str = "xla",
+                      block_q: int = 128, block_k: int = 128) -> jnp.ndarray:
+    """BSHD sequence-sharded exact attention via head-scatter all-to-all.
+
+    q/k/v: local sequence shards ``[B, S/N, H, D]`` with ``H % N == 0``.
+    ``impl`` picks the per-device kernel on the gathered sequence:
+    ``"xla"`` (fused reference attention) or ``"flash"`` (Pallas kernel;
+    ``block_q``/``block_k`` are its tile sizes). Returns the local
+    ``[B, S/N, H, D]`` output shard.
+    """
+    n = lax.psum(1, axis_name)
+    h = q.shape[2]
+    if h % n != 0:
+        raise ValueError(
+            f"ulysses_attention needs num_heads ({h}) divisible by the "
+            f"'{axis_name}' axis size ({n}); use attn_impl='ring' when "
+            "heads don't split evenly")
+
+    qg = _seq_to_heads(q, axis_name)
+    kg = _seq_to_heads(k, axis_name)
+    vg = _seq_to_heads(v, axis_name)
+
+    if impl == "flash":
+        from distkeras_tpu.ops.flash_attention import flash_attention
+        out = flash_attention(qg, kg, vg, causal=causal, scale=scale,
+                              block_q=block_q, block_k=block_k)
+    else:
+        out = dot_product_attention(qg, kg, vg, causal=causal, scale=scale)
+
+    return _heads_to_seq(out, axis_name)

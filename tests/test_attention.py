@@ -111,6 +111,80 @@ def test_ring_attention_matches_full(causal, devices):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
 
 
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_attention_matches_full(causal, devices):
+    from distkeras_tpu.ops.ulysses import ulysses_attention
+    n = len(devices)
+    mesh = Mesh(np.array(devices), ("seq",))
+    b, s, h, d = 2, 4 * n, n, 8  # h must divide over the axis
+    q, k, v = _rand_qkv(jax.random.PRNGKey(13), b=b, s=s, h=h, d=d)
+
+    uly = shard_map(
+        functools.partial(ulysses_attention, axis_name="seq", causal=causal),
+        mesh=mesh, in_specs=(P(None, "seq"),) * 3,
+        out_specs=P(None, "seq"))
+    out = jax.jit(uly)(q, k, v)
+    ref = dot_product_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_ulysses_grad_matches_full(devices):
+    from distkeras_tpu.ops.ulysses import ulysses_attention
+    n = len(devices)
+    mesh = Mesh(np.array(devices), ("seq",))
+    q, k, v = _rand_qkv(jax.random.PRNGKey(14), b=1, s=2 * n, h=n, d=4)
+
+    uly = shard_map(
+        functools.partial(ulysses_attention, axis_name="seq", causal=True),
+        mesh=mesh, in_specs=(P(None, "seq"),) * 3,
+        out_specs=P(None, "seq"))
+    g1 = jax.jit(jax.grad(
+        lambda q, k, v: jnp.sum(jnp.square(uly(q, k, v))),
+        argnums=(0, 1, 2)))(q, k, v)
+    g2 = jax.grad(
+        lambda q, k, v: jnp.sum(jnp.square(
+            dot_product_attention(q, k, v, causal=True))),
+        argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+def test_ulysses_rejects_indivisible_heads(devices):
+    from distkeras_tpu.ops.ulysses import ulysses_attention
+    n = len(devices)
+    mesh = Mesh(np.array(devices), ("seq",))
+    q, k, v = _rand_qkv(jax.random.PRNGKey(15), b=1, s=2 * n, h=n + 1, d=4)
+    uly = shard_map(
+        functools.partial(ulysses_attention, axis_name="seq"),
+        mesh=mesh, in_specs=(P(None, "seq"),) * 3,
+        out_specs=P(None, "seq"))
+    with pytest.raises(ValueError, match="divisible"):
+        jax.jit(uly)(q, k, v)
+
+
+def test_mha_ulysses_layer_matches_xla(devices):
+    """MultiHeadAttention(attn_impl='ulysses') under shard_map matches the
+    single-device xla path, including global RoPE positions."""
+    n = len(devices)
+    mesh = Mesh(np.array(devices), ("sp",))
+    d_model, h, s, b = 16, n, 2 * n, 2
+    x = jax.random.normal(jax.random.PRNGKey(16), (b, s, d_model))
+
+    ref_layer = MultiHeadAttention(num_heads=h, causal=True, use_rope=True)
+    params, state, _ = ref_layer.init(jax.random.PRNGKey(17),
+                                      (b, s, d_model))
+    ref, _ = ref_layer.apply(params, state, x)
+
+    sp_layer = MultiHeadAttention(num_heads=h, causal=True, use_rope=True,
+                                  attn_impl="ulysses", seq_axis_name="sp")
+    fn = shard_map(
+        lambda p, xx: sp_layer.apply(p, {}, xx)[0],
+        mesh=mesh, in_specs=(P(), P(None, "sp")),
+        out_specs=P(None, "sp"))
+    out = jax.jit(fn)(params, x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
 def test_rope_preserves_norm_and_relative_phase():
     x = jax.random.normal(jax.random.PRNGKey(4), (1, 8, 2, 16))
     y = apply_rope(x)

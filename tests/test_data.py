@@ -162,3 +162,22 @@ def test_hashing_transformer_stable_multi_hot():
 
     with pytest.raises(ValueError, match=">= 1"):
         HashingTransformer(0, ["cat_a"])
+
+
+def test_hashing_transformer_multidim_and_object_columns():
+    from distkeras_tpu.data import Dataset, HashingTransformer
+
+    # non-1-D column: whole rows are the categorical values
+    ds = Dataset({"c": np.array([[1, 2], [3, 4], [1, 2]]),
+                  "label": np.zeros(3)})
+    w = HashingTransformer(16, ["c"])(ds)["features_hashed"]
+    assert w.shape == (3, 16)
+    assert (w.sum(axis=1) == 1).all()
+    np.testing.assert_array_equal(w[0], w[2])      # equal rows, same bucket
+
+    # unsortable mixed-type object column falls back to the per-row path
+    ds2 = Dataset({"c": np.array(["x", 3, "x"], dtype=object),
+                   "label": np.zeros(3)})
+    w2 = HashingTransformer(16, ["c"])(ds2)["features_hashed"]
+    assert (w2.sum(axis=1) == 1).all()
+    np.testing.assert_array_equal(w2[0], w2[2])
