@@ -207,7 +207,13 @@ class HashingTransformer(Transformer):
             prefix = f"{col}=".encode()
 
             def _hash(v):
-                return zlib.crc32(prefix + str(v).encode()) % self.num_buckets
+                # array-valued rows hash their canonical bytes — str() of an
+                # ndarray elides the middle of wide rows ("[0. ... 0.]"), so
+                # distinct rows would collide and buckets would depend on
+                # numpy print options
+                data = (v.tobytes() if isinstance(v, np.ndarray)
+                        else str(v).encode())
+                return zlib.crc32(prefix + data) % self.num_buckets
 
             # hash each DISTINCT value once; categorical columns repeat
             # heavily, so this turns O(n) crc32 calls into O(n_unique).

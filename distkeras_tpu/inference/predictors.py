@@ -22,7 +22,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from distkeras_tpu.data.dataset import Dataset, coerce_column
-from distkeras_tpu.models.core import Model
+from distkeras_tpu.models.core import Model, user_float
 from distkeras_tpu.parallel.mesh import make_mesh
 
 
@@ -63,7 +63,7 @@ class Predictor:
         @jax.jit
         def fwd(params, state, xb):
             y, _ = model.module.apply(params, state, xb, training=False)
-            return y
+            return user_float(y)
 
         self._fn = fwd
         self._in_sharding = sharded

@@ -30,6 +30,15 @@ import numpy as np
 LAYER_REGISTRY: Dict[str, type] = {}
 
 
+def user_float(y: jax.Array) -> jax.Array:
+    """User-facing output dtype policy: low-precision compute dtypes
+    (bf16/f16) stay internal — predictions handed back to the host are f32.
+    Non-float outputs (int predictions, bools) pass through untouched."""
+    if jnp.issubdtype(y.dtype, jnp.floating) and y.dtype != jnp.float32:
+        return y.astype(jnp.float32)
+    return y
+
+
 def register_layer(cls: type) -> type:
     """Class decorator adding a Layer subclass to the serialization registry."""
     LAYER_REGISTRY[cls.__name__] = cls
@@ -177,8 +186,8 @@ class Model:
         sharded/batched path the reference's Predictor corresponds to)."""
         x = jnp.asarray(x)
         if self._jit_fwd is None:
-            self._jit_fwd = jax.jit(
-                lambda p, s, b: self.module.apply(p, s, b, training=False)[0])
+            self._jit_fwd = jax.jit(lambda p, s, b: user_float(
+                self.module.apply(p, s, b, training=False)[0]))
         fn = self._jit_fwd
         if batch_size is None:
             return np.asarray(fn(self.params, self.state, x))

@@ -82,7 +82,7 @@ class LSTM(Layer):
         else:
             # for a reversed pass the "final" state is still the scan carry
             out = h
-        return out.astype(jnp.float32) if dt != jnp.float32 else out, state
+        return out, state  # stays in compute dtype (layers.Dense policy)
 
     def get_config(self):
         return {"units": self.units, "return_sequences": self.return_sequences,
@@ -135,7 +135,7 @@ class GRU(Layer):
         h0 = jnp.zeros((b, u), dt)
         h, hs = lax.scan(step, h0, xproj, reverse=self.reverse)
         out = jnp.swapaxes(hs, 0, 1) if self.return_sequences else h
-        return out.astype(jnp.float32) if dt != jnp.float32 else out, state
+        return out, state  # stays in compute dtype (layers.Dense policy)
 
     def get_config(self):
         return {"units": self.units, "return_sequences": self.return_sequences,

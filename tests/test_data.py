@@ -181,3 +181,12 @@ def test_hashing_transformer_multidim_and_object_columns():
     w2 = HashingTransformer(16, ["c"])(ds2)["features_hashed"]
     assert (w2.sum(axis=1) == 1).all()
     np.testing.assert_array_equal(w2[0], w2[2])
+
+    # wide rows hash their full bytes, not numpy's elided str() repr: rows
+    # differing only in the (print-summarized) middle must get distinct
+    # buckets
+    wide = np.zeros((2, 2000), np.float32)
+    wide[1, 500] = 1.0
+    ds3 = Dataset({"c": wide, "label": np.zeros(2)})
+    w3 = HashingTransformer(4096, ["c"])(ds3)["features_hashed"]
+    assert not np.array_equal(w3[0], w3[1])

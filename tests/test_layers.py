@@ -161,3 +161,44 @@ def test_model_predict_batched():
     out = m.predict(np.ones((10, 8)), batch_size=3)
     assert out.shape == (10, 4)
     np.testing.assert_allclose(out, m.predict(np.ones((10, 8))), rtol=1e-6)
+
+
+def test_mixed_precision_bf16_activation_flow():
+    """bf16 layers emit bf16 (activations stay low-precision between
+    layers — the HBM-bandwidth policy); norm stats and user-facing
+    predictions are f32."""
+    x = jnp.ones((2, 5, 5, 3))
+
+    m = build([Conv2D(4, 3, dtype="bfloat16")], (5, 5, 3))
+    y, _ = m.apply(m.params, m.state, x)
+    assert y.dtype == jnp.bfloat16
+
+    m = build([Dense(4, dtype="bfloat16")], (8,))
+    y, _ = m.apply(m.params, m.state, jnp.ones((2, 8)))
+    assert y.dtype == jnp.bfloat16
+    # params themselves stay f32 (master copies)
+    assert m.params[0]["kernel"].dtype == jnp.float32
+
+    # BatchNorm preserves its input dtype; running stats stay f32
+    m = build([Conv2D(4, 3, dtype="bfloat16"), BatchNorm()], (5, 5, 3))
+    y, new_state = m.apply(m.params, m.state, x, training=True)
+    assert y.dtype == jnp.bfloat16
+    assert new_state[1]["mean"].dtype == jnp.float32
+    assert new_state[1]["var"].dtype == jnp.float32
+
+    # user-facing predict() is always f32
+    out = m.predict(np.ones((2, 5, 5, 3), np.float32))
+    assert out.dtype == np.float32
+
+
+def test_bf16_mlp_trains():
+    """End-to-end fit with bf16 compute converges on a separable problem."""
+    rs = np.random.RandomState(0)
+    X = rs.randn(256, 8).astype(np.float32)
+    y = (X @ rs.randn(8) > 0).astype(np.int32)
+    m = build([Dense(16, activation="relu", dtype="bfloat16"),
+               Dense(2, dtype="bfloat16")], (8,))
+    m.fit(X, y, optimizer="adam", epochs=60, batch_size=64,
+          loss="sparse_categorical_crossentropy_from_logits")
+    acc = float((m.predict(X).argmax(-1) == y).mean())
+    assert acc > 0.9, acc

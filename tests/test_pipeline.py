@@ -130,3 +130,22 @@ def test_pipeline_with_ring_attention_sp():
     losses = trainer.get_history().losses()
     assert np.isfinite(losses).all()
     assert losses[-4:].mean() < 0.5 * losses[:4].mean(), losses
+
+
+def test_pipeline_with_ulysses_attention_sp():
+    """dp×pp×sp with the all-to-all (Ulysses) sequence-parallel path."""
+    mesh = make_mesh_2d({"workers": 2, "pp": 2, "sp": 2})
+    rs = np.random.RandomState(2)
+    X = rs.randint(0, V, (256, S))
+    ds = Dataset({"features": X, "label": X})
+
+    trainer = PipelineTrainer(
+        lm(num_layers=2, num_microbatches=2, attn_impl="ulysses",
+           seq_axis="sp"),
+        mesh, seq_axis="sp",
+        worker_optimizer="adam", optimizer_kwargs={"learning_rate": 0.01},
+        batch_size=64, num_epoch=6)
+    trainer.train(ds)
+    losses = trainer.get_history().losses()
+    assert np.isfinite(losses).all()
+    assert losses[-4:].mean() < 0.5 * losses[:4].mean(), losses
