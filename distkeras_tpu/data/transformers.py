@@ -210,9 +210,17 @@ class HashingTransformer(Transformer):
                 # array-valued rows hash their canonical bytes — str() of an
                 # ndarray elides the middle of wide rows ("[0. ... 0.]"), so
                 # distinct rows would collide and buckets would depend on
-                # numpy print options
-                data = (v.tobytes() if isinstance(v, np.ndarray)
-                        else str(v).encode())
+                # numpy print options. Widen to f64/i64 first so the bucket
+                # depends on VALUES, not on the column's storage width
+                # (train-f32 vs serve-f64 must agree — the class contract).
+                if isinstance(v, np.ndarray):
+                    if v.dtype.kind == "f":
+                        v = v.astype(np.float64)
+                    elif v.dtype.kind in "iub":
+                        v = v.astype(np.int64)
+                    data = np.ascontiguousarray(v).tobytes()
+                else:
+                    data = str(v).encode()
                 return zlib.crc32(prefix + data) % self.num_buckets
 
             # hash each DISTINCT value once; categorical columns repeat

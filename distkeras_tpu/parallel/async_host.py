@@ -203,6 +203,10 @@ class HostAsyncTrainer(Trainer):
                                           self._metric_fns()))
 
         validator = self._make_validator(model.module)
+        out: Dict[int, Any] = {}  # latest epoch's worker outputs
+        cbs = self._cb_list(
+            lambda: (self.parameter_server.get_model(),
+                     self._mean_state(out, n) if out else model.state))
         self.record_training_start()
         profile = self._profile_ctx()  # enter/exit by hand: the epoch loop
         profile.__enter__()            # already sits inside a try/finally
@@ -262,6 +266,12 @@ class HostAsyncTrainer(Trainer):
                         {"params": self.parameter_server.get_model(),
                          "state": self._mean_state(out, n)},
                         metadata={"epoch": epoch})
+                epoch_rec = self.history.epochs[-1]
+                cbs.epoch_end(epoch, self._epoch_logs(
+                    epoch_rec["loss"],
+                    {k: v for k, v in epoch_rec.items() if k != "loss"}, {}))
+                if self.stop_training:
+                    break
         finally:
             import sys
             profile.__exit__(*sys.exc_info())
@@ -270,7 +280,9 @@ class HostAsyncTrainer(Trainer):
             if manager is not None:
                 manager.wait()  # async snapshots durable before return
 
+        cbs.train_end()
         center = self.parameter_server.get_model()
         trained = model.replace(params=center, state=self._mean_state(out, n))
+        trained = self._apply_pending_weights(trained)
         self.master_model = trained
         return trained

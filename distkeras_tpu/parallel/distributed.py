@@ -107,6 +107,7 @@ class DistributedTrainer(Trainer):
                     lambda s: s.mean(axis=0)
                     if jnp.issubdtype(s.dtype, jnp.floating) else s[0],
                     wstate)
+        cbs = self._cb_list(lambda: engine.extract_model(state))
         with self._profile_ctx():
             for epoch, (Xs, Ys, S) in Prefetcher(
                     assemble, range(start_epoch, self.num_epoch)):
@@ -119,8 +120,8 @@ class DistributedTrainer(Trainer):
                         validator(state["center"]["params"],
                                   _val_state(state["worker"]["state"]))
                     ).items()}
-                self.history.append_epoch(loss=host_fetch(losses),
-                                          **host_fetch(mets), **extra)
+                losses, mets = host_fetch(losses), host_fetch(mets)
+                self.history.append_epoch(loss=losses, **mets, **extra)
                 # cadence check BEFORE extract_model: the full-state
                 # device->host transfer is expensive and must only happen
                 # on save epochs
@@ -131,7 +132,13 @@ class DistributedTrainer(Trainer):
                         manager.save(epoch, {"params": extracted[0],
                                              "state": extracted[1]},
                                      metadata={"epoch": epoch})
+                cbs.epoch_end(epoch, self._epoch_logs(losses, mets, extra))
+                if self.stop_training:
+                    # stops ALL workers: the center is shared — there is no
+                    # per-worker early stop in the engine protocol
+                    break
         self.record_training_stop()
+        cbs.train_end()
         if manager is not None:
             manager.wait()  # async snapshots durable before return
 
@@ -139,6 +146,7 @@ class DistributedTrainer(Trainer):
         params, mstate = extracted if extracted is not None \
             else engine.extract_model(state)
         trained = model.replace(params=params, state=mstate)
+        trained = self._apply_pending_weights(trained)
         self.master_model = trained
         return trained
 

@@ -208,6 +208,8 @@ class SPMDTrainer(Trainer):
         assemble = lambda epoch: stack_batches(
             X, y, self.batch_size, self._epoch_perm(epoch, len(X)))
         validator = self._make_validator(model.module)
+        cbs = self._cb_list(
+            lambda: host_fetch((carry.params, carry.state)))
         self.record_training_start()
         with self._profile_ctx():
             for epoch, (Xs, Ys, S) in Prefetcher(
@@ -221,8 +223,8 @@ class SPMDTrainer(Trainer):
                     extra = {k: np.asarray([float(v)]) for k, v in
                              host_fetch(validator(carry.params,
                                                   carry.state)).items()}
-                self.history.append_epoch(loss=host_fetch(losses),
-                                          **host_fetch(mets), **extra)
+                losses, mets = host_fetch(losses), host_fetch(mets)
+                self.history.append_epoch(loss=losses, **mets, **extra)
                 if manager is not None and self._should_checkpoint(epoch):
                     # host_fetch is a COLLECTIVE under multi-process
                     # (allgather of non-addressable shards) — every process
@@ -234,11 +236,19 @@ class SPMDTrainer(Trainer):
                     if jax.process_index() == 0:
                         manager.save(epoch, snapshot,
                                      metadata={"epoch": epoch})
+                # logs derive from replicated values, so every process
+                # sees identical callback decisions (incl. stop_training
+                # and any collective get_weights fetch inside a callback)
+                cbs.epoch_end(epoch, self._epoch_logs(losses, mets, extra))
+                if self.stop_training:
+                    break
         self.record_training_stop()
+        cbs.train_end()
         if manager is not None:
             manager.wait()  # async snapshots durable before return
 
         trained = model.replace(params=host_fetch(carry.params),
                                 state=host_fetch(carry.state))
+        trained = self._apply_pending_weights(trained)
         self.master_model = trained
         return trained

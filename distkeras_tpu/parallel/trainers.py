@@ -14,7 +14,7 @@ label_col, ...)`` and ``trainer.train(dataset) -> Model``.
 
 from __future__ import annotations
 
-from typing import Callable, List, Optional, Union
+from typing import Callable, List, Optional, Sequence, Union
 
 import jax
 import jax.numpy as jnp
@@ -51,7 +51,8 @@ class Trainer:
                  checkpoint_async: bool = False,
                  profile_dir: Optional[str] = None,
                  grad_accum_steps: int = 1,
-                 validation_data=None):
+                 validation_data=None,
+                 callbacks: Optional[Sequence] = None):
         self.master_model = keras_model
         opt_kwargs = dict(optimizer_kwargs or {})
         if learning_rate is not None and not isinstance(worker_optimizer,
@@ -88,6 +89,13 @@ class Trainer:
         # configured) or an (X, y) pair; records val_loss / val_<metric>
         # scalars per epoch in History
         self.validation_data = validation_data
+        # Keras-style per-epoch callbacks (utils/callbacks.py) — a
+        # capability ADD; the reference leaves all of this to Keras, which
+        # its bare train_on_batch worker loop never invokes
+        self.callbacks = list(callbacks or [])
+        self.stop_training = False
+        self._weights_fn = None       # bound by trainers during train()
+        self._pending_weights = None  # set via set_weights()
 
     def _reject_grad_accum(self):
         """Trainers whose step semantics don't compose with accumulation
@@ -184,6 +192,61 @@ class Trainer:
             return outs[0], outs[1]
         return outs, {}
 
+    # -- callbacks ----------------------------------------------------------
+    def _cb_list(self, weights_fn: Optional[Callable] = None):
+        """Bind callbacks for a fresh train() run. ``weights_fn`` returns
+        host-side ``(params, state)`` of the CURRENT training weights (each
+        trainer supplies its own view — carry, engine center, ...)."""
+        from distkeras_tpu.utils.callbacks import CallbackList
+        self.stop_training = False
+        self._pending_weights = None
+        self._weights_fn = weights_fn
+        cbs = CallbackList(self.callbacks, self)
+        cbs.train_begin()
+        return cbs
+
+    def _epoch_logs(self, losses, mets, extra) -> dict:
+        """Per-epoch scalar logs for callbacks: epoch-mean loss/metrics +
+        validation scalars. Inputs are host arrays (already fetched)."""
+        logs = {"loss": float(np.mean(np.asarray(losses)))}
+        for k, v in mets.items():
+            logs[k] = float(np.mean(np.asarray(v)))
+        for k, v in extra.items():
+            logs[k] = float(np.asarray(v).ravel()[0])
+        return logs
+
+    def get_weights(self):
+        """Host-side ``(params, state)`` of the in-progress training weights
+        (callback API; only valid while train() is running)."""
+        if self._weights_fn is None:
+            raise RuntimeError(
+                "get_weights() is only available to callbacks while "
+                "train() is running")
+        return self._weights_fn()
+
+    def set_weights(self, params, state) -> None:
+        """Replace the weights the trainer will return (callback API —
+        e.g. EarlyStopping(restore_best_weights=True))."""
+        self._pending_weights = (params, state)
+
+    def snapshot_model(self) -> Model:
+        """A Model carrying the current training weights (callback API)."""
+        params, state = self.get_weights()
+        m = self.master_model
+        return Model(m.module, params, state, m.input_shape, m.output_shape)
+
+    def _apply_pending_weights(self, trained: Model) -> Model:
+        if self._pending_weights is None:
+            return trained
+        params, state = self._pending_weights
+        return trained.replace(params=params, state=state)
+
+    def _reject_callbacks(self):
+        if self.callbacks:
+            raise ValueError(
+                f"{type(self).__name__} does not support callbacks (no "
+                "single evolving model to monitor)")
+
     # -- validation ---------------------------------------------------------
     def _validation_arrays(self):
         if self.validation_data is None:
@@ -274,6 +337,8 @@ class SingleTrainer(Trainer):
         assemble = lambda epoch: stack_batches(
             X, y, self.batch_size, self._epoch_perm(epoch, len(X)))
         validator = self._make_validator(model.module)
+        cbs = self._cb_list(
+            lambda: jax.device_get((carry.params, carry.state)))
         self.record_training_start()
         # epoch e+1's shuffle gather + stacking runs while the device
         # trains epoch e (utils/prefetch.py)
@@ -287,20 +352,25 @@ class SingleTrainer(Trainer):
                     extra = {k: np.asarray([float(v)]) for k, v in
                              jax.device_get(validator(carry.params,
                                                       carry.state)).items()}
-                self.history.append_epoch(loss=jax.device_get(losses),
-                                          **jax.device_get(mets), **extra)
+                losses, mets = jax.device_get(losses), jax.device_get(mets)
+                self.history.append_epoch(loss=losses, **mets, **extra)
                 if manager is not None and self._should_checkpoint(epoch):
                     manager.save(
                         epoch,
                         {"params": carry.params, "state": carry.state,
                          "opt": carry.opt_state, "rng": carry.rng},
                         metadata={"epoch": epoch})
+                cbs.epoch_end(epoch, self._epoch_logs(losses, mets, extra))
+                if self.stop_training:
+                    break
         self.record_training_stop()
+        cbs.train_end()
         if manager is not None:
             manager.wait()  # async snapshots durable before return
 
         trained = model.replace(params=jax.device_get(carry.params),
                                 state=jax.device_get(carry.state))
+        trained = self._apply_pending_weights(trained)
         self.master_model = trained
         return trained
 
@@ -322,6 +392,7 @@ class EnsembleTrainer(Trainer):
 
     def train(self, dataset: Dataset) -> List[Model]:
         self._reject_grad_accum()
+        self._reject_callbacks()
         if self.validation_data is not None:
             raise ValueError(
                 "EnsembleTrainer does not support validation_data (k "

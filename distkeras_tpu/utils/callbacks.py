@@ -1,0 +1,280 @@
+"""Keras-style training callbacks (epoch granularity).
+
+The reference delegates its entire callback story to Keras — dist-keras
+workers call ``model.train_on_batch`` in a bare loop and ship histories
+home (``workers.py :: Worker.train``), so per-epoch control (early
+stopping, best-weights checkpointing) simply doesn't exist there. Here
+callbacks are first-class on every epoch-loop trainer (Single / SPMD /
+engine-distributed / host-async).
+
+Granularity is deliberately per-EPOCH, not per-batch: a trainer's epoch is
+ONE compiled ``lax.scan`` on device — a per-batch host callback would force
+a device→host sync every step and destroy throughput. Anything that needs
+per-step behavior belongs inside the jitted step (see ``ops.schedules``
+for per-step learning-rate control).
+
+Contract:
+  * ``logs`` passed to ``on_epoch_end`` holds python floats: ``loss``
+    (epoch mean), each configured metric's epoch mean, and ``val_*``
+    entries when the trainer has ``validation_data``.
+  * Callbacks may read/replace weights through the ``trainer`` handle:
+    ``trainer.get_weights() -> (params, state)`` (host pytrees) and
+    ``trainer.set_weights(params, state)`` (applied to the model the
+    trainer returns).
+  * Setting ``trainer.stop_training = True`` ends training after the
+    current epoch (the engine-distributed trainers stop ALL workers — the
+    center model is shared, there is no per-worker stop).
+"""
+
+from __future__ import annotations
+
+import csv
+import math
+import os
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+
+class Callback:
+    """Base class. Subclasses override any subset of the hooks."""
+
+    trainer = None
+
+    def set_trainer(self, trainer) -> None:
+        self.trainer = trainer
+
+    def on_train_begin(self, logs: Optional[Dict] = None) -> None:
+        pass
+
+    def on_epoch_end(self, epoch: int, logs: Optional[Dict] = None) -> None:
+        pass
+
+    def on_train_end(self, logs: Optional[Dict] = None) -> None:
+        pass
+
+
+class CallbackList:
+    """Internal dispatcher the trainers drive. Not user-facing."""
+
+    def __init__(self, callbacks: Sequence[Callback], trainer):
+        self.callbacks = list(callbacks)
+        for cb in self.callbacks:
+            if not isinstance(cb, Callback):
+                raise TypeError(
+                    f"callbacks must be utils.callbacks.Callback instances, "
+                    f"got {type(cb).__name__}")
+            cb.set_trainer(trainer)
+
+    def train_begin(self) -> None:
+        for cb in self.callbacks:
+            cb.on_train_begin({})
+
+    def epoch_end(self, epoch: int, logs: Dict) -> None:
+        for cb in self.callbacks:
+            cb.on_epoch_end(epoch, dict(logs))
+
+    def train_end(self, logs: Optional[Dict] = None) -> None:
+        for cb in self.callbacks:
+            cb.on_train_end(dict(logs or {}))
+
+
+def _monitor_value(logs: Dict, monitor: str) -> Optional[float]:
+    if monitor in logs:
+        return float(logs[monitor])
+    return None
+
+
+def _improved(value: float, best: float, mode: str, min_delta: float) -> bool:
+    if mode == "min":
+        return value < best - min_delta
+    return value > best + min_delta
+
+
+def _infer_mode(monitor: str, mode: str) -> str:
+    if mode in ("min", "max"):
+        return mode
+    if mode != "auto":
+        raise ValueError(f"mode must be 'auto', 'min' or 'max', got {mode!r}")
+    # accuracy-like monitors go up; losses/errors go down
+    up = ("acc", "accuracy", "auc", "precision", "recall", "f1", "top")
+    name = monitor.rsplit("val_", 1)[-1]
+    return "max" if any(k in name for k in up) else "min"
+
+
+class EarlyStopping(Callback):
+    """Stop when ``monitor`` hasn't improved for ``patience`` epochs.
+
+    ``restore_best_weights`` puts the best epoch's weights back on the
+    trainer at train end (host-side copies — snapshot cost is one
+    device→host fetch per improving epoch).
+    """
+
+    def __init__(self, monitor: str = "val_loss", min_delta: float = 0.0,
+                 patience: int = 0, mode: str = "auto",
+                 restore_best_weights: bool = False, verbose: bool = False):
+        self.monitor = monitor
+        self.min_delta = abs(float(min_delta))
+        self.patience = int(patience)
+        self.mode = _infer_mode(monitor, mode)
+        self.restore_best_weights = bool(restore_best_weights)
+        self.verbose = bool(verbose)
+
+    def on_train_begin(self, logs=None):
+        self.best = math.inf if self.mode == "min" else -math.inf
+        self.wait = 0
+        self.best_epoch = -1
+        self.best_weights = None
+        self.stopped_epoch = -1
+
+    def on_epoch_end(self, epoch, logs=None):
+        value = _monitor_value(logs or {}, self.monitor)
+        if value is None:
+            raise KeyError(
+                f"EarlyStopping monitor {self.monitor!r} not in epoch logs "
+                f"{sorted((logs or {}))}; configure the trainer's metrics/"
+                "validation_data to produce it")
+        if _improved(value, self.best, self.mode, self.min_delta):
+            self.best, self.best_epoch, self.wait = value, epoch, 0
+            if self.restore_best_weights:
+                self.best_weights = self.trainer.get_weights()
+        else:
+            self.wait += 1
+            if self.wait >= self.patience:  # Keras semantics: patience
+                self.stopped_epoch = epoch  # non-improving epochs, then stop
+                self.trainer.stop_training = True
+
+    def on_train_end(self, logs=None):
+        if self.restore_best_weights and self.best_weights is not None:
+            self.trainer.set_weights(*self.best_weights)
+        if self.verbose and self.stopped_epoch >= 0:
+            print(f"EarlyStopping: stopped at epoch {self.stopped_epoch} "
+                  f"(best {self.monitor}={self.best:.6g} "
+                  f"@ epoch {self.best_epoch})")
+
+
+class ModelCheckpoint(Callback):
+    """Save the model to ``filepath`` each epoch (or only on improvement).
+
+    ``filepath`` may contain ``{epoch}`` and any logs key, e.g.
+    ``"ckpt-{epoch:03d}-{val_loss:.3f}.dkt"``. Files are written with
+    ``models.serialization.save_model`` — loadable by ``load_model``.
+    (Distinct from the trainers' own ``checkpoint_dir``, which snapshots
+    raw training state for crash RESUME; this one exports serving models.)
+    """
+
+    def __init__(self, filepath: str, monitor: str = "val_loss",
+                 save_best_only: bool = False, mode: str = "auto",
+                 verbose: bool = False):
+        self.filepath = str(filepath)
+        self.monitor = monitor
+        self.save_best_only = bool(save_best_only)
+        self.mode = _infer_mode(monitor, mode)
+        self.verbose = bool(verbose)
+
+    def on_train_begin(self, logs=None):
+        self.best = math.inf if self.mode == "min" else -math.inf
+
+    def on_epoch_end(self, epoch, logs=None):
+        logs = logs or {}
+        if self.save_best_only:
+            value = _monitor_value(logs, self.monitor)
+            if value is None:
+                raise KeyError(
+                    f"ModelCheckpoint monitor {self.monitor!r} not in epoch "
+                    f"logs {sorted(logs)}")
+            if not _improved(value, self.best, self.mode, 0.0):
+                return
+            self.best = value
+        # snapshot on EVERY process — the weight fetch is a collective
+        # under multi-process sharding; only the file write is process-0's
+        # (same invariant as the trainers' own checkpoint_dir saves)
+        model = self.trainer.snapshot_model()
+        import jax
+        if jax.process_index() != 0:
+            return
+        path = self.filepath.format(epoch=epoch, **logs)
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        from distkeras_tpu.models.serialization import save_model
+        save_model(model, path)
+        if self.verbose:
+            print(f"ModelCheckpoint: wrote {path}")
+
+
+class CSVLogger(Callback):
+    """Append one row per epoch (``epoch`` + sorted logs keys) to a CSV."""
+
+    def __init__(self, filename: str, append: bool = False):
+        self.filename = str(filename)
+        self.append = bool(append)
+        self._file = None
+        self._writer = None
+
+    def on_train_begin(self, logs=None):
+        import jax
+        if jax.process_index() != 0:  # one writer under multi-process
+            return
+        d = os.path.dirname(self.filename)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        # appending to a file that already has content ⇒ its header is
+        # already there; don't write a second one mid-file
+        self._has_header = (self.append and os.path.exists(self.filename)
+                            and os.path.getsize(self.filename) > 0)
+        self._file = open(self.filename, "a" if self.append else "w",
+                          newline="")
+        self._writer = None  # header keys fixed on first epoch
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self._file is None:
+            return  # non-zero process
+        logs = logs or {}
+        if self._writer is None:
+            self._keys = sorted(logs)
+            self._writer = csv.writer(self._file)
+            if not self._has_header:
+                self._writer.writerow(["epoch"] + self._keys)
+        self._writer.writerow(
+            [epoch] + [logs.get(k, "") for k in self._keys])
+        self._file.flush()
+
+    def on_train_end(self, logs=None):
+        if self._file is not None:
+            self._file.close()
+            self._file = None
+
+
+class TerminateOnNaN(Callback):
+    """Stop training as soon as the epoch loss is NaN/inf."""
+
+    def on_epoch_end(self, epoch, logs=None):
+        loss = (logs or {}).get("loss")
+        if loss is not None and not np.isfinite(loss):
+            print(f"TerminateOnNaN: non-finite loss {loss} at epoch {epoch}")
+            self.trainer.stop_training = True
+
+
+class LambdaCallback(Callback):
+    """Ad-hoc hooks: ``LambdaCallback(on_epoch_end=lambda e, logs: ...)``."""
+
+    def __init__(self,
+                 on_train_begin: Optional[Callable] = None,
+                 on_epoch_end: Optional[Callable] = None,
+                 on_train_end: Optional[Callable] = None):
+        self._begin = on_train_begin
+        self._epoch = on_epoch_end
+        self._end = on_train_end
+
+    def on_train_begin(self, logs=None):
+        if self._begin:
+            self._begin(logs)
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self._epoch:
+            self._epoch(epoch, logs)
+
+    def on_train_end(self, logs=None):
+        if self._end:
+            self._end(logs)

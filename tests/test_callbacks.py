@@ -1,0 +1,168 @@
+"""Keras-style callbacks: early stopping (+ best-weight restore), model
+checkpoint export, CSV logging, NaN termination — on single and
+distributed trainers (capability ADD; the reference's bare
+train_on_batch worker loop has no callback story at all)."""
+
+import csv
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from distkeras_tpu.data import Dataset
+from distkeras_tpu.models import Dense, Model, Sequential
+from distkeras_tpu.models.serialization import load_model
+from distkeras_tpu.parallel import DOWNPOUR, EnsembleTrainer, SingleTrainer
+from distkeras_tpu.utils import (CSVLogger, EarlyStopping, LambdaCallback,
+                                 ModelCheckpoint, TerminateOnNaN)
+
+D, C = 8, 3
+
+
+def make_data(n=256, seed=0):
+    rs = np.random.RandomState(seed)
+    X = rs.randn(n, D).astype(np.float32)
+    y = np.argmax(X @ rs.randn(D, C), axis=1)
+    return Dataset({"features": X, "label": y})
+
+
+def mlp(seed=0):
+    return Model.build(Sequential([Dense(32, activation="relu"), Dense(C)]),
+                       (D,), seed=seed)
+
+
+def trainer(model, callbacks, num_epoch=10, **kw):
+    kw.setdefault("worker_optimizer", "sgd")
+    kw.setdefault("learning_rate", 0.05)
+    kw.setdefault("loss", "sparse_categorical_crossentropy_from_logits")
+    return SingleTrainer(model, batch_size=32, num_epoch=num_epoch,
+                         callbacks=callbacks, **kw)
+
+
+def test_early_stopping_stops_and_restores_best():
+    ds = make_data()
+    # min_delta so large nothing ever counts as improvement: best = epoch 0,
+    # stop deterministically once wait exceeds patience
+    es = EarlyStopping(monitor="loss", min_delta=1e9, patience=2,
+                       restore_best_weights=True)
+    first_weights = {}
+    grab = LambdaCallback(on_epoch_end=lambda e, logs: first_weights
+                          .setdefault("w", jax.tree_util.tree_map(
+                              np.copy, es.trainer.get_weights())))
+    tr = trainer(mlp(), [es, grab], num_epoch=50)
+    trained = tr.train(ds)
+
+    n_epochs = len(tr.get_history().epochs)
+    # Keras semantics: epoch 0 best, then `patience` non-improving epochs
+    assert n_epochs == 3, n_epochs
+    assert es.stopped_epoch == 2 and es.best_epoch == 0
+    # restored weights == the weights captured at the end of epoch 0
+    for a, b in zip(jax.tree_util.tree_leaves(trained.params),
+                    jax.tree_util.tree_leaves(first_weights["w"][0])):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_early_stopping_monitors_validation(tmp_path):
+    ds = make_data()
+    val = make_data(64, seed=1)
+    es = EarlyStopping(monitor="val_accuracy", min_delta=1e9, patience=0)
+    tr = trainer(mlp(), [es], num_epoch=20, metrics=["accuracy"],
+                 validation_data=val)
+    tr.train(ds)
+    assert len(tr.get_history().epochs) == 2  # epoch 0 best, stop at 1
+    assert es.mode == "max"  # inferred from the accuracy-like name
+
+
+def test_early_stopping_unknown_monitor_raises():
+    ds = make_data()
+    tr = trainer(mlp(), [EarlyStopping(monitor="val_loss")], num_epoch=2)
+    with pytest.raises(KeyError, match="val_loss"):
+        tr.train(ds)
+
+
+def test_model_checkpoint_exports_loadable_models(tmp_path):
+    ds = make_data()
+    pat = str(tmp_path / "m-{epoch:02d}.dkt")
+    tr = trainer(mlp(), [ModelCheckpoint(pat)], num_epoch=3)
+    trained = tr.train(ds)
+    files = sorted(os.listdir(tmp_path))
+    assert files == ["m-00.dkt.json", "m-00.dkt.npz", "m-01.dkt.json",
+                     "m-01.dkt.npz", "m-02.dkt.json", "m-02.dkt.npz"]
+    last = load_model(str(tmp_path / "m-02.dkt"))
+    X = ds["features"]
+    np.testing.assert_allclose(last.predict(X), trained.predict(X),
+                               atol=1e-6)
+
+
+def test_model_checkpoint_save_best_only(tmp_path):
+    ds = make_data()
+    pat = str(tmp_path / "best.dkt")
+    mc = ModelCheckpoint(pat, monitor="loss", save_best_only=True)
+    tr = trainer(mlp(), [mc], num_epoch=5)
+    tr.train(ds)
+    assert os.path.exists(pat + ".json")  # written at least on epoch 0
+
+
+def test_csv_logger(tmp_path):
+    ds = make_data()
+    path = str(tmp_path / "log.csv")
+    tr = trainer(mlp(), [CSVLogger(path)], num_epoch=3,
+                 metrics=["accuracy"])
+    tr.train(ds)
+    with open(path) as f:
+        rows = list(csv.reader(f))
+    assert rows[0] == ["epoch", "accuracy", "loss"]
+    assert len(rows) == 4 and [r[0] for r in rows[1:]] == ["0", "1", "2"]
+    assert all(float(r[2]) > 0 for r in rows[1:])
+
+
+def test_csv_logger_append_no_duplicate_header(tmp_path):
+    ds = make_data()
+    path = str(tmp_path / "log.csv")
+    trainer(mlp(), [CSVLogger(path)], num_epoch=2).train(ds)
+    trainer(mlp(), [CSVLogger(path, append=True)], num_epoch=2).train(ds)
+    with open(path) as f:
+        rows = list(csv.reader(f))
+    assert rows[0] == ["epoch", "loss"]
+    assert sum(r[0] == "epoch" for r in rows) == 1  # ONE header
+    assert [r[0] for r in rows[1:]] == ["0", "1", "0", "1"]
+
+
+def test_terminate_on_nan():
+    ds = make_data()
+    tr = trainer(mlp(), [TerminateOnNaN()], num_epoch=30,
+                 learning_rate=1e9)  # guaranteed divergence
+    tr.train(ds)
+    assert len(tr.get_history().epochs) < 30
+
+
+def test_callbacks_on_distributed_trainer():
+    ds = make_data(512)
+    es = EarlyStopping(monitor="loss", min_delta=1e9, patience=0)
+    tr = DOWNPOUR(mlp(), num_workers=8, batch_size=32,
+                  communication_window=2, num_epoch=20,
+                  worker_optimizer="sgd", learning_rate=0.05,
+                  loss="sparse_categorical_crossentropy_from_logits",
+                  callbacks=[es])
+    tr.train(ds)
+    assert len(tr.get_history().epochs) == 2
+
+
+def test_ensemble_rejects_callbacks():
+    tr = EnsembleTrainer(mlp(), num_models=2, batch_size=32, num_epoch=1,
+                         loss="sparse_categorical_crossentropy_from_logits",
+                         callbacks=[TerminateOnNaN()])
+    with pytest.raises(ValueError, match="callbacks"):
+        tr.train(make_data())
+
+
+def test_fit_accepts_callbacks():
+    ds = make_data()
+    m = mlp()
+    hist = m.fit(ds, optimizer="sgd",
+                 loss="sparse_categorical_crossentropy_from_logits",
+                 batch_size=32, epochs=10,
+                 callbacks=[EarlyStopping(monitor="loss", min_delta=1e9,
+                                          patience=0)])
+    assert len(hist.epochs) == 2

@@ -190,3 +190,13 @@ def test_hashing_transformer_multidim_and_object_columns():
     ds3 = Dataset({"c": wide, "label": np.zeros(2)})
     w3 = HashingTransformer(4096, ["c"])(ds3)["features_hashed"]
     assert not np.array_equal(w3[0], w3[1])
+
+    # storage width must not matter (train f32 vs serve f64, int32 vs int64)
+    vals = np.array([[1.5, 2.0], [3.25, 4.0]])
+    for a, b in [(np.float32, np.float64), (np.int32, np.int64)]:
+        wa = HashingTransformer(64, ["c"])(
+            Dataset({"c": vals.astype(a), "label": np.zeros(2)}))
+        wb = HashingTransformer(64, ["c"])(
+            Dataset({"c": vals.astype(b), "label": np.zeros(2)}))
+        np.testing.assert_array_equal(wa["features_hashed"],
+                                      wb["features_hashed"])
