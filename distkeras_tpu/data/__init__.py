@@ -3,6 +3,7 @@ ingest replacement)."""
 
 from distkeras_tpu.data.dataset import Dataset, coerce_column  # noqa: F401
 from distkeras_tpu.data.adapters import from_iterable, from_torch  # noqa: F401,E501
+from distkeras_tpu.data.sharded import ShardedDataset  # noqa: F401
 from distkeras_tpu.data.transformers import (  # noqa: F401
     DenseTransformer, LabelIndexTransformer, MinMaxTransformer,
     HashingTransformer, OneHotTransformer, ReshapeTransformer,

@@ -1,0 +1,104 @@
+"""Out-of-core datasets: train on data larger than host memory.
+
+Reference parity: dist-keras inherits Spark's ability to train on a
+DataFrame that never fits on one machine — executors stream their
+partitions from HDFS (``workers.py :: Worker.train`` consumes a partition
+iterator). The columnar ``Dataset`` here is deliberately in-memory (the
+jitted epoch scan wants contiguous ``[steps, batch, ...]`` stacks); this
+module restores the bigger-than-RAM story the TPU-native way: the dataset
+is a SEQUENCE OF SHARDS (files or loader thunks), and the trainers run
+their compiled epoch scan per shard while the NEXT shard is loaded and
+stacked on a background thread (``utils.prefetch``). Peak host memory is
+~2 shards regardless of total size, and the device never waits on IO.
+
+Shard sizing: every full shard compiles ONE scan shape; keep shards
+equal-sized (the last, smaller shard adds one extra compile). Each shard
+drops its sub-batch remainder exactly like the in-memory path does.
+
+Shuffling = shard-order shuffle per epoch + row permutation within each
+shard (the classic two-level approximation of a global shuffle — Spark's
+``utils.shuffle`` did a full sort-by-random-column, which is exactly what
+out-of-core training cannot afford).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, List, Optional, Sequence, Union
+
+import numpy as np
+
+from distkeras_tpu.data.dataset import Dataset
+
+
+class ShardedDataset:
+    """A lazily-loaded sequence of ``Dataset`` shards.
+
+    ``sources`` entries may be:
+      * a ``Dataset`` (kept as-is, already in memory);
+      * a path string — ``.npz`` (columns as arrays) or ``.csv``;
+      * a zero-arg callable returning a ``Dataset`` (custom loaders —
+        parquet readers, databases, object stores).
+    """
+
+    def __init__(self, sources: Sequence[Union[Dataset, str, Callable]],
+                 csv_kwargs: Optional[dict] = None):
+        if not sources:
+            raise ValueError("ShardedDataset needs at least one shard")
+        self.sources = list(sources)
+        self.csv_kwargs = dict(csv_kwargs or {})
+
+    # -- constructors -------------------------------------------------------
+    @classmethod
+    def from_files(cls, paths: Sequence[str], **csv_kwargs):
+        """npz/csv shard files (e.g. ``sorted(glob.glob("train-*.npz"))``)."""
+        missing = [p for p in paths if not os.path.exists(p)]
+        if missing:
+            raise FileNotFoundError(f"shard files not found: {missing[:3]}")
+        return cls(list(paths), csv_kwargs=csv_kwargs)
+
+    @classmethod
+    def from_datasets(cls, datasets: Sequence[Dataset]):
+        return cls(list(datasets))
+
+    # -- access -------------------------------------------------------------
+    @property
+    def num_shards(self) -> int:
+        return len(self.sources)
+
+    def load_shard(self, i: int) -> Dataset:
+        src = self.sources[i]
+        if isinstance(src, Dataset):
+            return src
+        if callable(src):
+            out = src()
+            if not isinstance(out, Dataset):
+                raise TypeError(
+                    f"shard loader {i} returned {type(out).__name__}, "
+                    "expected Dataset")
+            return out
+        path = str(src)
+        if path.endswith(".npz"):
+            with np.load(path) as z:
+                return Dataset({k: z[k] for k in z.files})
+        if path.endswith(".csv"):
+            return Dataset.from_csv(path, **self.csv_kwargs)
+        raise ValueError(
+            f"unrecognized shard source {path!r} (expected .npz, .csv, "
+            "Dataset, or callable)")
+
+    def shard_order(self, epoch: int, seed: int,
+                    shuffle: bool) -> List[int]:
+        """Deterministic per-epoch shard visit order."""
+        if not shuffle or self.num_shards == 1:
+            return list(range(self.num_shards))
+        rs = np.random.RandomState(seed + 7919 * (epoch + 1))
+        return list(rs.permutation(self.num_shards))
+
+    def __len__(self):
+        raise TypeError(
+            "ShardedDataset has no cheap global length (shards load "
+            "lazily); iterate shards via load_shard()")
+
+    def __repr__(self):
+        return f"ShardedDataset(num_shards={self.num_shards})"

@@ -215,9 +215,10 @@ class Model:
         (this model's params/state are updated) and returns the History.
         """
         from distkeras_tpu.data.dataset import Dataset
+        from distkeras_tpu.data.sharded import ShardedDataset
         from distkeras_tpu.parallel.trainers import SingleTrainer
 
-        if isinstance(x, Dataset):
+        if isinstance(x, (Dataset, ShardedDataset)):
             ds = x
         else:
             if y is None:
@@ -238,9 +239,15 @@ class Model:
         """Keras-style ``model.evaluate``: ``{"loss": ..., metric: ...}``
         over the full set (batched host-side forward)."""
         from distkeras_tpu.data.dataset import Dataset, coerce_column
+        from distkeras_tpu.data.sharded import ShardedDataset
         from distkeras_tpu.ops.losses import get_loss
         from distkeras_tpu.ops.metrics import get_metric, metric_name
 
+        if isinstance(x, ShardedDataset):
+            raise ValueError(
+                "evaluate() needs the whole set in memory; for a "
+                "ShardedDataset evaluate shard-by-shard: "
+                "model.evaluate(sds.load_shard(i)) and average")
         if isinstance(x, Dataset):
             X, yv = x.arrays(features_col, label_col)
             if yv is None:

@@ -276,11 +276,11 @@ class HostAsyncTrainer(Trainer):
             import sys
             profile.__exit__(*sys.exc_info())
             self.record_training_stop()
+            cbs.train_end()  # closes callback resources on exceptions too
             self.parameter_server.stop()
             if manager is not None:
                 manager.wait()  # async snapshots durable before return
 
-        cbs.train_end()
         center = self.parameter_server.get_model()
         trained = model.replace(params=center, state=self._mean_state(out, n))
         trained = self._apply_pending_weights(trained)

@@ -108,37 +108,41 @@ class DistributedTrainer(Trainer):
                     if jnp.issubdtype(s.dtype, jnp.floating) else s[0],
                     wstate)
         cbs = self._cb_list(lambda: engine.extract_model(state))
-        with self._profile_ctx():
-            for epoch, (Xs, Ys, S) in Prefetcher(
-                    assemble, range(start_epoch, self.num_epoch)):
-                state, outs = engine.run_epoch(state, Xs, Ys)
-                losses, mets = self._split_outs(outs)
-                extra = {}
-                if validator is not None:
-                    # evaluate the CENTER (the PS model a user would ship)
-                    extra = {k: np.asarray([float(v)]) for k, v in host_fetch(
-                        validator(state["center"]["params"],
-                                  _val_state(state["worker"]["state"]))
-                    ).items()}
-                losses, mets = host_fetch(losses), host_fetch(mets)
-                self.history.append_epoch(loss=losses, **mets, **extra)
-                # cadence check BEFORE extract_model: the full-state
-                # device->host transfer is expensive and must only happen
-                # on save epochs
-                extracted = None
-                if manager is not None and self._should_checkpoint(epoch):
-                    extracted = engine.extract_model(state)
-                    if jax.process_index() == 0:  # one writer per ckpt
-                        manager.save(epoch, {"params": extracted[0],
-                                             "state": extracted[1]},
-                                     metadata={"epoch": epoch})
-                cbs.epoch_end(epoch, self._epoch_logs(losses, mets, extra))
-                if self.stop_training:
-                    # stops ALL workers: the center is shared — there is no
-                    # per-worker early stop in the engine protocol
-                    break
-        self.record_training_stop()
-        cbs.train_end()
+        try:
+            with self._profile_ctx():
+                for epoch, (Xs, Ys, S) in Prefetcher(
+                        assemble, range(start_epoch, self.num_epoch)):
+                    state, outs = engine.run_epoch(state, Xs, Ys)
+                    losses, mets = self._split_outs(outs)
+                    extra = {}
+                    if validator is not None:
+                        # evaluate the CENTER (the model a user would ship)
+                        extra = {k: np.asarray([float(v)]) for k, v in
+                                 host_fetch(validator(
+                                     state["center"]["params"],
+                                     _val_state(state["worker"]["state"]))
+                                 ).items()}
+                    losses, mets = host_fetch(losses), host_fetch(mets)
+                    self.history.append_epoch(loss=losses, **mets, **extra)
+                    # cadence check BEFORE extract_model: the full-state
+                    # device->host transfer is expensive and must only
+                    # happen on save epochs
+                    extracted = None
+                    if manager is not None and self._should_checkpoint(epoch):
+                        extracted = engine.extract_model(state)
+                        if jax.process_index() == 0:  # one writer per ckpt
+                            manager.save(epoch, {"params": extracted[0],
+                                                 "state": extracted[1]},
+                                         metadata={"epoch": epoch})
+                    cbs.epoch_end(epoch,
+                                  self._epoch_logs(losses, mets, extra))
+                    if self.stop_training:
+                        # stops ALL workers: the center is shared — there
+                        # is no per-worker early stop in the engine protocol
+                        break
+        finally:
+            self.record_training_stop()
+            cbs.train_end()  # closes callback resources on exceptions too
         if manager is not None:
             manager.wait()  # async snapshots durable before return
 

@@ -169,8 +169,11 @@ class SPMDTrainer(Trainer):
 
     # -- training -----------------------------------------------------------
     def train(self, dataset: Dataset) -> Model:
+        from distkeras_tpu.data.sharded import ShardedDataset
         model = self.master_model
-        X, y = self._training_arrays(dataset)
+        sharded = isinstance(dataset, ShardedDataset)
+        if not sharded:
+            X, y = self._training_arrays(dataset)
         param_sh, repl, data_sh = self._placements(model)
 
         # full-carry checkpoint (params + model state + optimizer moments +
@@ -205,45 +208,68 @@ class SPMDTrainer(Trainer):
             return jax.lax.scan(step, carry, (Xs, Ys))
 
         from distkeras_tpu.utils.prefetch import Prefetcher
-        assemble = lambda epoch: stack_batches(
-            X, y, self.batch_size, self._epoch_perm(epoch, len(X)))
         validator = self._make_validator(model.module)
         cbs = self._cb_list(
             lambda: host_fetch((carry.params, carry.state)))
+
+        if sharded:
+            # out-of-core (data.sharded.ShardedDataset): compiled scan per
+            # shard; ONE flat prefetch stream spans epoch boundaries so the
+            # loader thread never idles (Trainer._sharded_stream)
+            stream = self._sharded_stream(dataset, start_epoch)
+        else:
+            # in-memory: ONE chunk per epoch; the Prefetcher overlaps the
+            # next epoch's shuffle+stack with this epoch's device scan
+            stream = (((e, 0, True), chunk) for e, chunk in Prefetcher(
+                lambda e: stack_batches(X, y, self.batch_size,
+                                        self._epoch_perm(e, len(X))),
+                range(start_epoch, self.num_epoch)))
+
         self.record_training_start()
-        with self._profile_ctx():
-            for epoch, (Xs, Ys, S) in Prefetcher(
-                    assemble, range(start_epoch, self.num_epoch)):
-                Xs = jax.device_put(Xs, data_sh)
-                Ys = jax.device_put(Ys, data_sh)
-                carry, outs = run_epoch(carry, Xs, Ys)
-                losses, mets = self._split_outs(outs)
-                extra = {}
-                if validator is not None:
-                    extra = {k: np.asarray([float(v)]) for k, v in
-                             host_fetch(validator(carry.params,
-                                                  carry.state)).items()}
-                losses, mets = host_fetch(losses), host_fetch(mets)
-                self.history.append_epoch(loss=losses, **mets, **extra)
-                if manager is not None and self._should_checkpoint(epoch):
-                    # host_fetch is a COLLECTIVE under multi-process
-                    # (allgather of non-addressable shards) — every process
-                    # must enter it; only the write is gated on process 0
-                    snapshot = host_fetch({"params": carry.params,
-                                           "state": carry.state,
-                                           "opt": carry.opt_state,
-                                           "rng": carry.rng})
-                    if jax.process_index() == 0:
-                        manager.save(epoch, snapshot,
-                                     metadata={"epoch": epoch})
-                # logs derive from replicated values, so every process
-                # sees identical callback decisions (incl. stop_training
-                # and any collective get_weights fetch inside a callback)
-                cbs.epoch_end(epoch, self._epoch_logs(losses, mets, extra))
-                if self.stop_training:
-                    break
-        self.record_training_stop()
-        cbs.train_end()
+        try:
+            with self._profile_ctx():
+                l_acc, m_acc = [], []
+                for (epoch, _, last), (Xs, Ys, S) in stream:
+                    Xs = jax.device_put(Xs, data_sh)
+                    Ys = jax.device_put(Ys, data_sh)
+                    carry, outs = run_epoch(carry, Xs, Ys)
+                    losses, mets = self._split_outs(outs)
+                    l_acc.append(host_fetch(losses))
+                    m_acc.append(host_fetch(mets))
+                    if not last:
+                        continue
+                    losses = np.concatenate(l_acc)
+                    mets = {k: np.concatenate([m[k] for m in m_acc])
+                            for k in (m_acc[0] if m_acc else {})}
+                    l_acc, m_acc = [], []
+                    extra = {}
+                    if validator is not None:
+                        extra = {k: np.asarray([float(v)]) for k, v in
+                                 host_fetch(validator(carry.params,
+                                                      carry.state)).items()}
+                    self.history.append_epoch(loss=losses, **mets, **extra)
+                    if manager is not None and self._should_checkpoint(epoch):
+                        # host_fetch is a COLLECTIVE under multi-process
+                        # (allgather of non-addressable shards) — every
+                        # process must enter it; only the write is gated on
+                        # process 0
+                        snapshot = host_fetch({"params": carry.params,
+                                               "state": carry.state,
+                                               "opt": carry.opt_state,
+                                               "rng": carry.rng})
+                        if jax.process_index() == 0:
+                            manager.save(epoch, snapshot,
+                                         metadata={"epoch": epoch})
+                    # logs derive from replicated values, so every process
+                    # sees identical callback decisions (incl. stop_training
+                    # and any collective get_weights fetch inside a callback)
+                    cbs.epoch_end(epoch,
+                                  self._epoch_logs(losses, mets, extra))
+                    if self.stop_training:
+                        break
+        finally:
+            self.record_training_stop()
+            cbs.train_end()  # closes callback resources on exceptions too
         if manager is not None:
             manager.wait()  # async snapshots durable before return
 

@@ -287,8 +287,41 @@ class Trainer:
 
         return lambda params, state: evalf(params, state, Xv, yv)
 
+    # -- out-of-core plumbing ----------------------------------------------
+    def _sharded_stream(self, sds, start_epoch: int):
+        """ONE Prefetcher over the flattened (epoch, shard) sequence of a
+        ``ShardedDataset``: yields ``((epoch, shard_idx, is_epoch_last),
+        (Xs, Ys, n_steps))``. A single flat stream keeps the background
+        loader busy ACROSS epoch boundaries (a per-epoch prefetcher would
+        stall one shard-load at every boundary), and one definition keeps
+        the shuffle determinism formula shared by every sharded trainer."""
+        from distkeras_tpu.utils.prefetch import Prefetcher
+        items = []
+        for e in range(start_epoch, self.num_epoch):
+            order = sds.shard_order(e, self.seed, self.shuffle_each_epoch)
+            items += [(e, si, i == len(order) - 1)
+                      for i, si in enumerate(order)]
+
+        def assemble(item):
+            epoch, si, _ = item
+            Xc, yc = self._training_arrays(sds.load_shard(si))
+            perm = None
+            if self.shuffle_each_epoch:
+                perm = np.random.RandomState(
+                    self.seed + 1000 * epoch + 31 * si).permutation(len(Xc))
+            return stack_batches(Xc, yc, self.batch_size, perm)
+
+        return Prefetcher(assemble, items)
+
     # -- data plumbing -----------------------------------------------------
     def _training_arrays(self, dataset: Dataset):
+        from distkeras_tpu.data.sharded import ShardedDataset
+        if isinstance(dataset, ShardedDataset):
+            raise ValueError(
+                f"{type(self).__name__} does not support ShardedDataset "
+                "(out-of-core training is a SingleTrainer/SPMDTrainer "
+                "capability); load shards into one Dataset, or switch "
+                "trainer")
         X, y = dataset.arrays(self.features_col, self.label_col)
         if y is None:
             raise ValueError(
@@ -315,6 +348,9 @@ class SingleTrainer(Trainer):
     """
 
     def train(self, dataset: Dataset) -> Model:
+        from distkeras_tpu.data.sharded import ShardedDataset
+        if isinstance(dataset, ShardedDataset):
+            return self._train_sharded(dataset)
         model = self.master_model
         X, y = self._training_arrays(dataset)
         step = make_train_step(model.module, self.loss, self.worker_optimizer,
@@ -342,31 +378,102 @@ class SingleTrainer(Trainer):
         self.record_training_start()
         # epoch e+1's shuffle gather + stacking runs while the device
         # trains epoch e (utils/prefetch.py)
-        with self._profile_ctx():
-            for epoch, (Xs, Ys, n_steps) in Prefetcher(
-                    assemble, range(start_epoch, self.num_epoch)):
-                carry, outs = runner(carry, Xs, Ys)
-                losses, mets = self._split_outs(outs)
-                extra = {}
-                if validator is not None:
-                    extra = {k: np.asarray([float(v)]) for k, v in
-                             jax.device_get(validator(carry.params,
-                                                      carry.state)).items()}
-                losses, mets = jax.device_get(losses), jax.device_get(mets)
-                self.history.append_epoch(loss=losses, **mets, **extra)
-                if manager is not None and self._should_checkpoint(epoch):
-                    manager.save(
-                        epoch,
-                        {"params": carry.params, "state": carry.state,
-                         "opt": carry.opt_state, "rng": carry.rng},
-                        metadata={"epoch": epoch})
-                cbs.epoch_end(epoch, self._epoch_logs(losses, mets, extra))
-                if self.stop_training:
-                    break
-        self.record_training_stop()
-        cbs.train_end()
+        try:
+            with self._profile_ctx():
+                for epoch, (Xs, Ys, n_steps) in Prefetcher(
+                        assemble, range(start_epoch, self.num_epoch)):
+                    carry, outs = runner(carry, Xs, Ys)
+                    losses, mets = self._split_outs(outs)
+                    extra = {}
+                    if validator is not None:
+                        extra = {k: np.asarray([float(v)]) for k, v in
+                                 jax.device_get(validator(
+                                     carry.params, carry.state)).items()}
+                    losses = jax.device_get(losses)
+                    mets = jax.device_get(mets)
+                    self.history.append_epoch(loss=losses, **mets, **extra)
+                    if manager is not None and self._should_checkpoint(epoch):
+                        manager.save(
+                            epoch,
+                            {"params": carry.params, "state": carry.state,
+                             "opt": carry.opt_state, "rng": carry.rng},
+                            metadata={"epoch": epoch})
+                    cbs.epoch_end(epoch,
+                                  self._epoch_logs(losses, mets, extra))
+                    if self.stop_training:
+                        break
+        finally:
+            self.record_training_stop()
+            cbs.train_end()  # closes callback resources on exceptions too
         if manager is not None:
             manager.wait()  # async snapshots durable before return
+
+        trained = model.replace(params=jax.device_get(carry.params),
+                                state=jax.device_get(carry.state))
+        trained = self._apply_pending_weights(trained)
+        self.master_model = trained
+        return trained
+
+    def _train_sharded(self, sds) -> Model:
+        """Out-of-core epoch loop (``data.sharded.ShardedDataset``): the
+        compiled epoch scan runs per SHARD while the next shard loads and
+        stacks on a background thread. Host memory stays ~2 shards; the
+        device never waits on IO. Checkpoints/validation/callbacks keep
+        epoch granularity. (Reference: Spark workers stream partitions from
+        HDFS — ``workers.py :: Worker.train``'s row iterator.)"""
+        model = self.master_model
+        step = make_train_step(model.module, self.loss, self.worker_optimizer,
+                               self._metric_fns(), self.grad_accum_steps)
+        runner = make_epoch_runner(step)
+        manager = self._checkpoint_manager()
+        fresh = {"params": model.params, "state": model.state,
+                 "opt": self.worker_optimizer.init(model.params),
+                 "rng": jax.random.PRNGKey(self.seed)}
+        tree, start_epoch = self._maybe_resume(manager, fresh)
+        carry = TrainCarry(params=tree["params"], state=tree["state"],
+                           opt_state=tree["opt"], rng=tree["rng"])
+
+        validator = self._make_validator(model.module)
+        cbs = self._cb_list(
+            lambda: jax.device_get((carry.params, carry.state)))
+
+        self.record_training_start()
+        try:
+            with self._profile_ctx():
+                l_acc, m_acc = [], []
+                for (epoch, _, last), (Xs, Ys, S) in self._sharded_stream(
+                        sds, start_epoch):
+                    carry, outs = runner(carry, Xs, Ys)
+                    losses, mets = self._split_outs(outs)
+                    l_acc.append(jax.device_get(losses))
+                    m_acc.append(jax.device_get(mets))
+                    if not last:
+                        continue
+                    losses = np.concatenate(l_acc)
+                    mets = {k: np.concatenate([m[k] for m in m_acc])
+                            for k in (m_acc[0] if m_acc else {})}
+                    l_acc, m_acc = [], []
+                    extra = {}
+                    if validator is not None:
+                        extra = {k: np.asarray([float(v)]) for k, v in
+                                 jax.device_get(validator(
+                                     carry.params, carry.state)).items()}
+                    self.history.append_epoch(loss=losses, **mets, **extra)
+                    if manager is not None and self._should_checkpoint(epoch):
+                        manager.save(
+                            epoch,
+                            {"params": carry.params, "state": carry.state,
+                             "opt": carry.opt_state, "rng": carry.rng},
+                            metadata={"epoch": epoch})
+                    cbs.epoch_end(epoch,
+                                  self._epoch_logs(losses, mets, extra))
+                    if self.stop_training:
+                        break
+        finally:
+            self.record_training_stop()
+            cbs.train_end()  # also closes callback resources on exceptions
+        if manager is not None:
+            manager.wait()
 
         trained = model.replace(params=jax.device_get(carry.params),
                                 state=jax.device_get(carry.state))

@@ -59,6 +59,8 @@ class CallbackList:
 
     def __init__(self, callbacks: Sequence[Callback], trainer):
         self.callbacks = list(callbacks)
+        self.trainer = trainer
+        self._ended = False
         for cb in self.callbacks:
             if not isinstance(cb, Callback):
                 raise TypeError(
@@ -75,8 +77,19 @@ class CallbackList:
             cb.on_epoch_end(epoch, dict(logs))
 
     def train_end(self, logs: Optional[Dict] = None) -> None:
-        for cb in self.callbacks:
-            cb.on_train_end(dict(logs or {}))
+        """Idempotent (trainers call it from ``finally`` so callback
+        resources — open log files etc. — are released on the exception
+        path too). Afterwards the weight accessors go stale: clear them so
+        a post-train get_weights() fails loudly instead of fetching from a
+        dead training loop (a collective hazard under multi-process)."""
+        if self._ended:
+            return
+        self._ended = True
+        try:
+            for cb in self.callbacks:
+                cb.on_train_end(dict(logs or {}))
+        finally:
+            self.trainer._weights_fn = None
 
 
 def _monitor_value(logs: Dict, monitor: str) -> Optional[float]:

@@ -81,6 +81,25 @@ def test_early_stopping_unknown_monitor_raises():
         tr.train(ds)
 
 
+def test_weight_accessors_invalid_after_train():
+    ds = make_data()
+    tr = trainer(mlp(), [], num_epoch=1)
+    tr.train(ds)
+    with pytest.raises(RuntimeError, match="while"):
+        tr.get_weights()
+
+
+def test_callback_resources_closed_on_exception(tmp_path):
+    """An aborting callback (unknown monitor) must not leak the CSV
+    logger's open file: train_end runs on the exception path."""
+    ds = make_data()
+    logger = CSVLogger(str(tmp_path / "log.csv"))
+    tr = trainer(mlp(), [logger, EarlyStopping(monitor="nope")], num_epoch=3)
+    with pytest.raises(KeyError):
+        tr.train(ds)
+    assert logger._file is None  # closed by train_end in finally
+
+
 def test_model_checkpoint_exports_loadable_models(tmp_path):
     ds = make_data()
     pat = str(tmp_path / "m-{epoch:02d}.dkt")
