@@ -95,10 +95,9 @@ class ShardedDataset:
         rs = np.random.RandomState(seed + 7919 * (epoch + 1))
         return list(rs.permutation(self.num_shards))
 
-    def __len__(self):
-        raise TypeError(
-            "ShardedDataset has no cheap global length (shards load "
-            "lazily); iterate shards via load_shard()")
+    # NOTE deliberately no __len__: shards load lazily, so there is no
+    # cheap global length (len() raising the standard TypeError also keeps
+    # bool(sds) truthy — a __len__ that raises would break `if sds:`)
 
     def __repr__(self):
         return f"ShardedDataset(num_shards={self.num_shards})"

@@ -306,6 +306,17 @@ class GlobalAveragePooling2D(Layer):
         return jnp.mean(x, axis=(1, 2)), state
 
 
+@register_layer
+class GlobalAveragePooling1D(Layer):
+    """Mean over the sequence axis of a [B, S, D] input (ViT/BERT heads)."""
+
+    def init(self, rng, input_shape):
+        return {}, {}, (input_shape[-1],)
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        return jnp.mean(x, axis=1), state
+
+
 # ---------------------------------------------------------------------------
 # batch norm
 # ---------------------------------------------------------------------------
@@ -323,10 +334,22 @@ class BatchNorm(Layer):
     """
 
     def __init__(self, momentum: float = 0.99, epsilon: float = 1e-3,
-                 axis_name: Optional[str] = None):
+                 axis_name: Optional[str] = None,
+                 virtual_batch_size: Optional[int] = None):
         self.momentum = float(momentum)
         self.epsilon = float(epsilon)
         self.axis_name = axis_name
+        # ghost batch norm (Hoffer et al. 2017; Keras' virtual_batch_size):
+        # each sub-batch of this size normalizes by its OWN stats — a
+        # regularizer at large batch, and what per-worker BN looked like in
+        # the reference (each Spark executor normalized its local batch)
+        self.virtual_batch_size = (None if virtual_batch_size is None
+                                   else int(virtual_batch_size))
+        if self.virtual_batch_size is not None and axis_name is not None:
+            raise ValueError(
+                "virtual_batch_size (deliberately LOCAL ghost stats) and "
+                "axis_name (cross-replica stats) contradict each other; "
+                "pick one")
 
     def init(self, rng, input_shape):
         dim = input_shape[-1]
@@ -335,8 +358,28 @@ class BatchNorm(Layer):
         return params, state, tuple(input_shape)
 
     def apply(self, params, state, x, *, training=False, rng=None):
-        axes = tuple(range(x.ndim - 1))
         xf = x.astype(jnp.float32)  # stats in f32 even for bf16 activations
+        if training and self.virtual_batch_size is not None:
+            v = self.virtual_batch_size
+            if x.shape[0] % v:
+                raise ValueError(
+                    f"batch size {x.shape[0]} not divisible by "
+                    f"virtual_batch_size {v}")
+            g = x.shape[0] // v
+            xg = xf.reshape((g, v) + x.shape[1:])       # ghost groups
+            gaxes = tuple(range(1, xg.ndim - 1))        # within-group stats
+            mean_g = jnp.mean(xg, axis=gaxes)           # [g, C]
+            var_g = jnp.mean(jnp.square(xg), axis=gaxes) - jnp.square(mean_g)
+            sh = (g,) + (1,) * (xg.ndim - 2) + (-1,)
+            inv = lax.rsqrt(var_g.reshape(sh) + self.epsilon) \
+                * params["scale"]
+            y = (xg - mean_g.reshape(sh)) * inv + params["offset"]
+            m = self.momentum
+            new_state = {
+                "mean": m * state["mean"] + (1 - m) * mean_g.mean(axis=0),
+                "var": m * state["var"] + (1 - m) * var_g.mean(axis=0)}
+            return y.reshape(x.shape).astype(x.dtype), new_state
+        axes = tuple(range(x.ndim - 1))
         if training:
             mean = jnp.mean(xf, axis=axes)
             mean2 = jnp.mean(jnp.square(xf), axis=axes)
@@ -356,7 +399,43 @@ class BatchNorm(Layer):
 
     def get_config(self):
         return {"momentum": self.momentum, "epsilon": self.epsilon,
-                "axis_name": self.axis_name}
+                "axis_name": self.axis_name,
+                "virtual_batch_size": self.virtual_batch_size}
+
+
+@register_layer
+class GroupNorm(Layer):
+    """Group normalization (Wu & He 2018) over the channel axis of a
+    [B, ..., C] input: batch-size-independent (no running stats, identical
+    train/eval), the usual BN replacement when per-device batches are
+    small. Stats are computed in f32 per (sample, group) over all spatial
+    positions and the group's channels."""
+
+    def __init__(self, groups: int = 32, epsilon: float = 1e-5):
+        self.groups = int(groups)
+        self.epsilon = float(epsilon)
+
+    def init(self, rng, input_shape):
+        dim = input_shape[-1]
+        if dim % self.groups:
+            raise ValueError(
+                f"channels {dim} not divisible by groups {self.groups}")
+        params = {"scale": jnp.ones((dim,)), "offset": jnp.zeros((dim,))}
+        return params, {}, tuple(input_shape)
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        g = self.groups
+        xf = x.astype(jnp.float32)
+        xg = xf.reshape(x.shape[:-1] + (g, x.shape[-1] // g))
+        axes = tuple(range(1, x.ndim - 1)) + (x.ndim,)  # spatial + in-group
+        mean = jnp.mean(xg, axis=axes, keepdims=True)
+        var = jnp.var(xg, axis=axes, keepdims=True)
+        y = ((xg - mean) * lax.rsqrt(var + self.epsilon)).reshape(x.shape)
+        y = y * params["scale"] + params["offset"]
+        return y.astype(x.dtype), state
+
+    def get_config(self):
+        return {"groups": self.groups, "epsilon": self.epsilon}
 
 
 # ---------------------------------------------------------------------------

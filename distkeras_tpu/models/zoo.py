@@ -169,3 +169,38 @@ def transformer_lm(vocab_size: int, d_model: int = 512, num_heads: int = 8,
     layers.append(RMSNorm() if norm == "rmsnorm" else LayerNorm())
     layers.append(Dense(vocab_size, use_bias=False, dtype=dtype))
     return Sequential(layers)
+
+
+def vit(image_size: int = 224, patch_size: int = 16, d_model: int = 384,
+        num_heads: int = 6, num_layers: int = 12, mlp_ratio: int = 4,
+        num_classes: int = 1000, dtype: str = "float32",
+        dropout_rate: float = 0.0) -> Sequential:
+    """Vision Transformer (ViT; Dosovitskiy et al. 2020) — capability ADD
+    (the reference predates transformers, SURVEY §5.7). Patchify is ONE
+    strided conv (a single MXU matmul per patch grid), then mean-pooled
+    pre-norm encoder blocks; GAP head instead of a class token keeps the
+    whole model a ``Sequential``.
+    """
+    from distkeras_tpu.models.attention import (LayerNorm,
+                                                PositionalEmbedding,
+                                                TransformerBlock)
+    from distkeras_tpu.models.layers import GlobalAveragePooling1D, Reshape
+
+    if image_size % patch_size:
+        raise ValueError(
+            f"image_size {image_size} not divisible by patch_size "
+            f"{patch_size}")
+    n_patches = (image_size // patch_size) ** 2
+    layers = [
+        Conv2D(d_model, patch_size, strides=patch_size, padding="VALID",
+               dtype=dtype),
+        Reshape((n_patches, d_model)),
+        PositionalEmbedding(n_patches),
+    ]
+    for _ in range(num_layers):
+        layers.append(TransformerBlock(
+            num_heads, mlp_ratio=mlp_ratio, causal=False, use_rope=False,
+            norm="layernorm", dtype=dtype, dropout_rate=dropout_rate))
+    layers += [LayerNorm(), GlobalAveragePooling1D(),
+               Dense(num_classes, dtype=dtype)]
+    return Sequential(layers)

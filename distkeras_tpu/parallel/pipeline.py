@@ -326,6 +326,13 @@ class PipelineTrainer:
             raise ValueError(f"dataset ({len(X)}) smaller than one batch")
 
     def train(self, dataset) -> Pytree:
+        from distkeras_tpu.data.sharded import ShardedDataset
+        if isinstance(dataset, ShardedDataset):
+            raise ValueError(
+                "PipelineTrainer does not support ShardedDataset "
+                "(out-of-core training is a SingleTrainer/SPMDTrainer "
+                "capability); load shards into one Dataset, or switch "
+                "trainer")
         X = np.asarray(dataset[self.features_col])
         Y = np.asarray(dataset[self.label_col])
         lm = self.lm

@@ -349,10 +349,11 @@ class SingleTrainer(Trainer):
 
     def train(self, dataset: Dataset) -> Model:
         from distkeras_tpu.data.sharded import ShardedDataset
-        if isinstance(dataset, ShardedDataset):
-            return self._train_sharded(dataset)
+        from distkeras_tpu.utils.prefetch import Prefetcher
         model = self.master_model
-        X, y = self._training_arrays(dataset)
+        sharded = isinstance(dataset, ShardedDataset)
+        if not sharded:
+            X, y = self._training_arrays(dataset)
         step = make_train_step(model.module, self.loss, self.worker_optimizer,
                                self._metric_fns(), self.grad_accum_steps)
         runner = make_epoch_runner(step)
@@ -369,80 +370,28 @@ class SingleTrainer(Trainer):
         carry = TrainCarry(params=tree["params"], state=tree["state"],
                            opt_state=tree["opt"], rng=tree["rng"])
 
-        from distkeras_tpu.utils.prefetch import Prefetcher
-        assemble = lambda epoch: stack_batches(
-            X, y, self.batch_size, self._epoch_perm(epoch, len(X)))
-        validator = self._make_validator(model.module)
-        cbs = self._cb_list(
-            lambda: jax.device_get((carry.params, carry.state)))
-        self.record_training_start()
-        # epoch e+1's shuffle gather + stacking runs while the device
-        # trains epoch e (utils/prefetch.py)
-        try:
-            with self._profile_ctx():
-                for epoch, (Xs, Ys, n_steps) in Prefetcher(
-                        assemble, range(start_epoch, self.num_epoch)):
-                    carry, outs = runner(carry, Xs, Ys)
-                    losses, mets = self._split_outs(outs)
-                    extra = {}
-                    if validator is not None:
-                        extra = {k: np.asarray([float(v)]) for k, v in
-                                 jax.device_get(validator(
-                                     carry.params, carry.state)).items()}
-                    losses = jax.device_get(losses)
-                    mets = jax.device_get(mets)
-                    self.history.append_epoch(loss=losses, **mets, **extra)
-                    if manager is not None and self._should_checkpoint(epoch):
-                        manager.save(
-                            epoch,
-                            {"params": carry.params, "state": carry.state,
-                             "opt": carry.opt_state, "rng": carry.rng},
-                            metadata={"epoch": epoch})
-                    cbs.epoch_end(epoch,
-                                  self._epoch_logs(losses, mets, extra))
-                    if self.stop_training:
-                        break
-        finally:
-            self.record_training_stop()
-            cbs.train_end()  # closes callback resources on exceptions too
-        if manager is not None:
-            manager.wait()  # async snapshots durable before return
-
-        trained = model.replace(params=jax.device_get(carry.params),
-                                state=jax.device_get(carry.state))
-        trained = self._apply_pending_weights(trained)
-        self.master_model = trained
-        return trained
-
-    def _train_sharded(self, sds) -> Model:
-        """Out-of-core epoch loop (``data.sharded.ShardedDataset``): the
-        compiled epoch scan runs per SHARD while the next shard loads and
-        stacks on a background thread. Host memory stays ~2 shards; the
-        device never waits on IO. Checkpoints/validation/callbacks keep
-        epoch granularity. (Reference: Spark workers stream partitions from
-        HDFS — ``workers.py :: Worker.train``'s row iterator.)"""
-        model = self.master_model
-        step = make_train_step(model.module, self.loss, self.worker_optimizer,
-                               self._metric_fns(), self.grad_accum_steps)
-        runner = make_epoch_runner(step)
-        manager = self._checkpoint_manager()
-        fresh = {"params": model.params, "state": model.state,
-                 "opt": self.worker_optimizer.init(model.params),
-                 "rng": jax.random.PRNGKey(self.seed)}
-        tree, start_epoch = self._maybe_resume(manager, fresh)
-        carry = TrainCarry(params=tree["params"], state=tree["state"],
-                           opt_state=tree["opt"], rng=tree["rng"])
+        if sharded:
+            # out-of-core: compiled scan per shard; ONE flat prefetch
+            # stream spans epoch boundaries so the loader never idles
+            # (Trainer._sharded_stream; reference analogue: Spark workers
+            # iterate HDFS partition rows — workers.py :: Worker.train)
+            stream = self._sharded_stream(dataset, start_epoch)
+        else:
+            # in-memory: ONE chunk per epoch; epoch e+1's shuffle gather +
+            # stacking runs while the device trains epoch e
+            stream = (((e, 0, True), chunk) for e, chunk in Prefetcher(
+                lambda e: stack_batches(X, y, self.batch_size,
+                                        self._epoch_perm(e, len(X))),
+                range(start_epoch, self.num_epoch)))
 
         validator = self._make_validator(model.module)
         cbs = self._cb_list(
             lambda: jax.device_get((carry.params, carry.state)))
-
         self.record_training_start()
         try:
             with self._profile_ctx():
                 l_acc, m_acc = [], []
-                for (epoch, _, last), (Xs, Ys, S) in self._sharded_stream(
-                        sds, start_epoch):
+                for (epoch, _, last), (Xs, Ys, S) in stream:
                     carry, outs = runner(carry, Xs, Ys)
                     losses, mets = self._split_outs(outs)
                     l_acc.append(jax.device_get(losses))
@@ -471,9 +420,9 @@ class SingleTrainer(Trainer):
                         break
         finally:
             self.record_training_stop()
-            cbs.train_end()  # also closes callback resources on exceptions
+            cbs.train_end()  # closes callback resources on exceptions too
         if manager is not None:
-            manager.wait()
+            manager.wait()  # async snapshots durable before return
 
         trained = model.replace(params=jax.device_get(carry.params),
                                 state=jax.device_get(carry.state))

@@ -85,11 +85,16 @@ class CallbackList:
         if self._ended:
             return
         self._ended = True
-        try:
-            for cb in self.callbacks:
+        first_err = None
+        for cb in self.callbacks:  # one failing hook must not leak the rest
+            try:
                 cb.on_train_end(dict(logs or {}))
-        finally:
-            self.trainer._weights_fn = None
+            except BaseException as e:  # noqa: BLE001 — re-raised below
+                if first_err is None:
+                    first_err = e
+        self.trainer._weights_fn = None
+        if first_err is not None:
+            raise first_err
 
 
 def _monitor_value(logs: Dict, monitor: str) -> Optional[float]:

@@ -163,6 +163,55 @@ def test_model_predict_batched():
     np.testing.assert_allclose(out, m.predict(np.ones((10, 8))), rtol=1e-6)
 
 
+def test_groupnorm_normalizes_per_group():
+    from distkeras_tpu.models import GroupNorm
+    m = build([GroupNorm(groups=4)], (5, 5, 8))
+    x = jax.random.normal(jax.random.PRNGKey(6), (2, 5, 5, 8)) * 3 + 2
+    y, state = m.apply(m.params, m.state, x, training=True)
+    assert state == [{}]  # batch-independent: no running stats
+    # per-sample, per-group zero mean / unit var
+    yg = np.asarray(y).reshape(2, 5, 5, 4, 2)
+    np.testing.assert_allclose(yg.mean(axis=(1, 2, 4)), 0.0, atol=1e-4)
+    np.testing.assert_allclose(yg.std(axis=(1, 2, 4)), 1.0, atol=1e-2)
+    # train == eval (no batch dependence)
+    y2, _ = m.apply(m.params, m.state, x, training=False)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(y2), atol=1e-6)
+    with pytest.raises(ValueError, match="divisible"):
+        build([GroupNorm(groups=3)], (5, 5, 8))
+
+
+def test_ghost_batchnorm_virtual_batches():
+    m = build([BatchNorm(momentum=0.5, virtual_batch_size=4)], (8,))
+    x = jax.random.normal(jax.random.PRNGKey(7), (16, 8)) * 2 + 1
+    y, new_state = m.apply(m.params, m.state, x, training=True)
+    # each ghost group of 4 is normalized by its OWN stats
+    yv = np.asarray(y).reshape(4, 4, 8)
+    np.testing.assert_allclose(yv.mean(axis=1), 0.0, atol=1e-4)
+    # running stats advance with the mean of ghost-group stats
+    assert not np.allclose(np.asarray(new_state[0]["mean"]), 0.0)
+    # eval path ignores virtual batching (running stats)
+    ye, _ = m.apply(m.params, new_state, x, training=False)
+    assert ye.shape == x.shape
+    with pytest.raises(ValueError, match="divisible"):
+        m.apply(m.params, m.state, x[:6], training=True)
+
+
+def test_vit_builds_and_runs():
+    from distkeras_tpu.models import zoo
+    m = Model.build(zoo.vit(image_size=16, patch_size=4, d_model=32,
+                            num_heads=4, num_layers=2, num_classes=5),
+                    (16, 16, 3), rng=RNG)
+    assert m.output_shape == (5,)
+    y, _ = m.apply(m.params, m.state, jnp.ones((2, 16, 16, 3)))
+    assert y.shape == (2, 5)
+    # position embeddings make patch order matter
+    x = jax.random.normal(jax.random.PRNGKey(8), (1, 16, 16, 3))
+    xs = jnp.flip(x, axis=1)
+    ya, _ = m.apply(m.params, m.state, x)
+    yb, _ = m.apply(m.params, m.state, xs)
+    assert not np.allclose(np.asarray(ya), np.asarray(yb))
+
+
 def test_mixed_precision_bf16_activation_flow():
     """bf16 layers emit bf16 (activations stay low-precision between
     layers — the HBM-bandwidth policy); norm stats and user-facing

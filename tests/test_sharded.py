@@ -129,6 +129,30 @@ def test_sharded_rejected_by_engine_trainers():
         tr.train(as_shards(X, y, 2))
 
 
+def test_sharded_rejected_by_pipeline_trainer():
+    from distkeras_tpu.models.attention import TransformerBlock
+    from distkeras_tpu.models.layers import Dense as D_, Embedding
+    from distkeras_tpu.parallel.pipeline import (PipelinedLM,
+                                                 PipelineTrainer)
+    X, y = make_arrays(128)
+    mesh = make_mesh_2d({"workers": 2, "pp": 4})
+    lm = PipelinedLM(embed=Embedding(8, 16),
+                     block=TransformerBlock(num_heads=2, mlp_ratio=2),
+                     head=D_(8, use_bias=False), num_layers=4,
+                     num_microbatches=2)
+    tr = PipelineTrainer(lm, mesh, batch_size=16, num_epoch=1)
+    with pytest.raises(ValueError, match="ShardedDataset"):
+        tr.train(as_shards(X, y, 2))
+
+
+def test_sharded_is_truthy_and_len_raises():
+    X, y = make_arrays(64)
+    sds = as_shards(X, y, 2)
+    assert bool(sds)  # `if sds:` must work
+    with pytest.raises(TypeError):
+        len(sds)
+
+
 def test_sharded_evaluate_raises_clearly():
     X, y = make_arrays(128)
     m = mlp()
