@@ -176,6 +176,145 @@ def adadelta(learning_rate: float = 1.0, rho: float = 0.95,
     return Optimizer(init, update, "adadelta")
 
 
+def adamw(learning_rate: float = 0.001, beta1: float = 0.9,
+          beta2: float = 0.999, epsilon: float = 1e-7,
+          weight_decay: float = 0.01) -> Optimizer:
+    """Adam with DECOUPLED weight decay (Loshchilov & Hutter 2019) — the
+    transformer-era default the reference's Keras 1.x never had."""
+    scheduled, lrf = _lr_resolver(learning_rate)
+    b1, b2, eps, wd = (float(beta1), float(beta2), float(epsilon),
+                       float(weight_decay))
+
+    def init(params):
+        return {"m": _zeros_like(params), "v": _zeros_like(params),
+                "t": jnp.zeros((), jnp.int32)}
+
+    def update(grads, state, params=None):
+        if params is None:
+            raise ValueError("adamw needs params (decoupled decay); call "
+                             "opt.update(grads, state, params)")
+        t = state["t"] + 1
+        lr = lrf(t - 1) if scheduled else lrf(None)
+        m = jax.tree_util.tree_map(lambda m_, g: b1 * m_ + (1 - b1) * g,
+                                   state["m"], grads)
+        v = jax.tree_util.tree_map(
+            lambda v_, g: b2 * v_ + (1 - b2) * jnp.square(g), state["v"],
+            grads)
+        tf = t.astype(jnp.float32)
+        step = lr * jnp.sqrt(1 - b2 ** tf) / (1 - b1 ** tf)
+        upd = jax.tree_util.tree_map(
+            lambda m_, v_, p: -step * m_ / (jnp.sqrt(v_) + eps)
+            - lr * wd * p, m, v, params)
+        return upd, {"m": m, "v": v, "t": t}
+
+    return Optimizer(init, update, "adamw")
+
+
+def _l2(x) -> jnp.ndarray:
+    return jnp.sqrt(jnp.sum(jnp.square(x.astype(jnp.float32))))
+
+
+def lars(learning_rate: float = 1.0, momentum: float = 0.9,
+         weight_decay: float = 0.0, trust_coefficient: float = 1e-3,
+         epsilon: float = 1e-8) -> Optimizer:
+    """Layer-wise Adaptive Rate Scaling (You et al. 2017) — the classic
+    large-batch ResNet optimizer. Per tensor, with the decayed gradient
+    ``g' = g + wd·w``, the trust ratio ``tc·‖w‖ / (‖g'‖ + eps)`` scales the
+    momentum step so huge global batches (the natural TPU-pod regime) keep
+    SGD's convergence. (Folding the decay into the norm is the common
+    implementation variant; it differs from the paper's
+    ``‖g‖ + wd·‖w‖`` denominator only when decay is large.)"""
+    scheduled, lrf = _lr_resolver(learning_rate)
+    mu, wd, tc, eps = (float(momentum), float(weight_decay),
+                       float(trust_coefficient), float(epsilon))
+
+    def init(params):
+        return _with_step(scheduled, {"v": _zeros_like(params)})
+
+    def update(grads, state, params=None):
+        if params is None:
+            raise ValueError("lars needs params; call "
+                             "opt.update(grads, state, params)")
+        lr, state = _step_lr(scheduled, lrf, state)
+
+        def leaf(v_, g, p):
+            g = g + wd * p
+            wn, gn = _l2(p), _l2(g)
+            # trust ratio only where both norms are nonzero (biases /
+            # fresh layers fall back to the plain lr)
+            ratio = jnp.where((wn > 0) & (gn > 0),
+                              tc * wn / (gn + eps), 1.0)
+            return mu * v_ + (lr * ratio).astype(g.dtype) * g
+
+        v = jax.tree_util.tree_map(leaf, state["v"], grads, params)
+        upd = jax.tree_util.tree_map(lambda v_: -v_, v)
+        return upd, {**state, "v": v}
+
+    return Optimizer(init, update, "lars")
+
+
+def lamb(learning_rate: float = 0.001, beta1: float = 0.9,
+         beta2: float = 0.999, epsilon: float = 1e-6,
+         weight_decay: float = 0.0) -> Optimizer:
+    """LAMB (You et al. 2020): Adam direction × per-tensor trust ratio —
+    large-batch training for transformers (the BERT-in-76-minutes
+    optimizer)."""
+    scheduled, lrf = _lr_resolver(learning_rate)
+    b1, b2, eps, wd = (float(beta1), float(beta2), float(epsilon),
+                       float(weight_decay))
+
+    def init(params):
+        return {"m": _zeros_like(params), "v": _zeros_like(params),
+                "t": jnp.zeros((), jnp.int32)}
+
+    def update(grads, state, params=None):
+        if params is None:
+            raise ValueError("lamb needs params; call "
+                             "opt.update(grads, state, params)")
+        t = state["t"] + 1
+        lr = lrf(t - 1) if scheduled else lrf(None)
+        m = jax.tree_util.tree_map(lambda m_, g: b1 * m_ + (1 - b1) * g,
+                                   state["m"], grads)
+        v = jax.tree_util.tree_map(
+            lambda v_, g: b2 * v_ + (1 - b2) * jnp.square(g), state["v"],
+            grads)
+        tf = t.astype(jnp.float32)
+        c1, c2 = 1 - b1 ** tf, 1 - b2 ** tf
+
+        def leaf(m_, v_, p):
+            r = (m_ / c1) / (jnp.sqrt(v_ / c2) + eps) + wd * p
+            wn, rn = _l2(p), _l2(r)
+            ratio = jnp.where((wn > 0) & (rn > 0), wn / rn, 1.0)
+            return -(lr * ratio).astype(r.dtype) * r
+
+        upd = jax.tree_util.tree_map(leaf, m, v, params)
+        return upd, {"m": m, "v": v, "t": t}
+
+    return Optimizer(init, update, "lamb")
+
+
+def clip_by_global_norm(optimizer: Optimizer, max_norm: float) -> Optimizer:
+    """Wrap an optimizer so gradients are rescaled to a maximum GLOBAL L2
+    norm before its update (the standard transformer stabilizer; exposed on
+    every trainer as ``clip_grad_norm=``)."""
+    mx = float(max_norm)
+    if mx <= 0:
+        raise ValueError(f"max_norm must be > 0, got {max_norm}")
+
+    def update(grads, state, params=None):
+        leaves = jax.tree_util.tree_leaves(grads)
+        gn = jnp.sqrt(sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                          for g in leaves))
+        scale = mx / jnp.maximum(gn, mx)
+        grads = jax.tree_util.tree_map(
+            lambda g: (g.astype(jnp.float32) * scale).astype(g.dtype),
+            grads)
+        return optimizer.update(grads, state, params)
+
+    return Optimizer(optimizer.init, update,
+                     f"clip({optimizer.name}, {mx})")
+
+
 OPTIMIZERS = {
     "sgd": sgd,
     "momentum": lambda **kw: sgd(momentum=kw.pop("momentum", 0.9), **kw),
@@ -184,7 +323,10 @@ OPTIMIZERS = {
     "adagrad": adagrad,
     "rmsprop": rmsprop,
     "adam": adam,
+    "adamw": adamw,
     "adadelta": adadelta,
+    "lars": lars,
+    "lamb": lamb,
 }
 
 

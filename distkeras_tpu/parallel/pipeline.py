@@ -276,9 +276,11 @@ class PipelineTrainer:
                  loss="sparse_categorical_crossentropy_from_logits",
                  batch_size: int = 32, num_epoch: int = 1,
                  features_col: str = "features", label_col: str = "label",
-                 seed: int = 0, shuffle_each_epoch: bool = True):
+                 seed: int = 0, shuffle_each_epoch: bool = True,
+                 clip_grad_norm: Optional[float] = None):
         from distkeras_tpu.ops.losses import get_loss
-        from distkeras_tpu.ops.optimizers import get_optimizer
+        from distkeras_tpu.ops.optimizers import (clip_by_global_norm,
+                                                  get_optimizer)
         from distkeras_tpu.utils.history import History
 
         self.lm = lm
@@ -288,6 +290,9 @@ class PipelineTrainer:
         self.seq_axis = seq_axis
         self.optimizer = get_optimizer(worker_optimizer,
                                        **(optimizer_kwargs or {}))
+        if clip_grad_norm is not None:
+            self.optimizer = clip_by_global_norm(self.optimizer,
+                                                 clip_grad_norm)
         self.loss = get_loss(loss)
         self.batch_size = int(batch_size)
         self.num_epoch = int(num_epoch)

@@ -52,13 +52,21 @@ class Trainer:
                  profile_dir: Optional[str] = None,
                  grad_accum_steps: int = 1,
                  validation_data=None,
-                 callbacks: Optional[Sequence] = None):
+                 callbacks: Optional[Sequence] = None,
+                 clip_grad_norm: Optional[float] = None):
         self.master_model = keras_model
         opt_kwargs = dict(optimizer_kwargs or {})
         if learning_rate is not None and not isinstance(worker_optimizer,
                                                         Optimizer):
             opt_kwargs.setdefault("learning_rate", learning_rate)
         self.worker_optimizer = get_optimizer(worker_optimizer, **opt_kwargs)
+        # global-norm gradient clipping as a pure optimizer wrapper — works
+        # identically under jit/vmap/shard_map on every trainer
+        self.clip_grad_norm = clip_grad_norm
+        if clip_grad_norm is not None:
+            from distkeras_tpu.ops.optimizers import clip_by_global_norm
+            self.worker_optimizer = clip_by_global_norm(
+                self.worker_optimizer, clip_grad_norm)
         self.loss = get_loss(loss)
         self.metrics = metrics or []
         self.features_col = features_col

@@ -16,7 +16,9 @@ from distkeras_tpu.ops.metrics import accuracy, top_k_accuracy
     ("adagrad", {"learning_rate": 0.5}),
     ("rmsprop", {"learning_rate": 0.05}),
     ("adam", {"learning_rate": 0.1}),
+    ("adamw", {"learning_rate": 0.1, "weight_decay": 1e-4}),
     ("adadelta", {"learning_rate": 2.0}),
+    ("lamb", {"learning_rate": 0.05}),
 ])
 def test_optimizer_minimizes_quadratic(name, kwargs):
     opt = get_optimizer(name, **kwargs)
@@ -38,6 +40,97 @@ def test_optimizer_minimizes_quadratic(name, kwargs):
     for _ in range(300):
         params, state = step(params, state)
     assert float(loss_fn(params)) < 1e-2, f"{name} failed to converge"
+
+
+def test_lars_reduces_loss_with_schedule():
+    """LARS holds a CONSTANT relative step (lr·tc·‖w‖), so it orbits a
+    toy optimum rather than entering it — assert strong loss reduction
+    under a decaying schedule instead (its real use is large-batch
+    ResNet with cosine decay)."""
+    from distkeras_tpu.ops.schedules import get_schedule
+    sched = get_schedule("cosine_decay", init_value=0.5, decay_steps=400)
+    opt = get_optimizer("lars", learning_rate=sched,
+                        trust_coefficient=0.1, momentum=0.9)
+    params = {"w": jnp.array([3.0, -2.0])}
+    target = jnp.array([1.0, 1.0])
+    loss_fn = lambda p: jnp.sum(jnp.square(p["w"] - target))
+    state = opt.init(params)
+
+    @jax.jit
+    def step(params, state):
+        grads = jax.grad(loss_fn)(params)
+        updates, state = opt.update(grads, state, params)
+        return apply_updates(params, updates), state
+
+    first = float(loss_fn(params))
+    for _ in range(400):
+        params, state = step(params, state)
+    assert float(loss_fn(params)) < 0.02 * first
+
+
+def test_adamw_decoupled_decay_shrinks_weights():
+    """With zero gradients AdamW still decays weights toward 0 (decoupled
+    L2, unlike plain Adam)."""
+    opt = get_optimizer("adamw", learning_rate=0.1, weight_decay=0.5)
+    params = {"w": jnp.array([2.0])}
+    state = opt.init(params)
+    grads = {"w": jnp.zeros(1)}
+    for _ in range(10):
+        upd, state = opt.update(grads, state, params)
+        params = apply_updates(params, upd)
+    assert float(params["w"][0]) < 2.0 * (1 - 0.04) ** 9
+    # plain adam with zero grads moves nothing
+    opt2 = get_optimizer("adam", learning_rate=0.1)
+    s2 = opt2.init({"w": jnp.array([2.0])})
+    upd2, _ = opt2.update(grads, s2, {"w": jnp.array([2.0])})
+    np.testing.assert_allclose(np.asarray(upd2["w"]), 0.0)
+
+
+def test_lars_lamb_trust_ratio_scales_per_tensor():
+    """A tensor with tiny weights must get a proportionally tiny step,
+    regardless of its gradient magnitude."""
+    for name in ("lars", "lamb"):
+        opt = get_optimizer(name, learning_rate=0.1)
+        params = {"big": jnp.full((4,), 10.0), "small": jnp.full((4,), 0.01)}
+        state = opt.init(params)
+        grads = {"big": jnp.ones(4), "small": jnp.ones(4)}
+        upd, _ = opt.update(grads, state, params)
+        big_step = float(jnp.abs(upd["big"]).max())
+        small_step = float(jnp.abs(upd["small"]).max())
+        assert small_step < big_step / 100, (name, big_step, small_step)
+
+
+def test_clip_by_global_norm():
+    from distkeras_tpu.ops.optimizers import clip_by_global_norm
+    opt = clip_by_global_norm(get_optimizer("sgd", learning_rate=1.0), 1.0)
+    params = {"w": jnp.zeros(4)}
+    state = opt.init(params)
+    upd, _ = opt.update({"w": jnp.full((4,), 100.0)}, state, params)
+    # clipped to global norm 1 then scaled by lr=1: |upd| == 1
+    np.testing.assert_allclose(
+        float(jnp.linalg.norm(np.asarray(upd["w"]))), 1.0, rtol=1e-5)
+    # under the clip threshold: untouched
+    upd2, _ = opt.update({"w": jnp.full((4,), 0.1)}, state, params)
+    np.testing.assert_allclose(np.asarray(upd2["w"]), -0.1, rtol=1e-6)
+    with pytest.raises(ValueError, match="> 0"):
+        clip_by_global_norm(get_optimizer("sgd"), 0.0)
+
+
+def test_trainer_clip_grad_norm_kwarg():
+    from distkeras_tpu.data import Dataset
+    from distkeras_tpu.models import Dense, Model, Sequential
+    from distkeras_tpu.parallel import SingleTrainer
+    rs = np.random.RandomState(0)
+    X = rs.randn(128, 8).astype(np.float32)
+    y = (X @ rs.randn(8) > 0).astype(np.int32)
+    m = Model.build(Sequential([Dense(16, activation="relu"), Dense(2)]),
+                    (8,), seed=0)
+    tr = SingleTrainer(m, worker_optimizer="sgd", learning_rate=1e5,
+                       loss="sparse_categorical_crossentropy_from_logits",
+                       batch_size=32, num_epoch=3, clip_grad_norm=1e-6)
+    tr.train(Dataset({"features": X, "label": y}))
+    # an unclipped lr=1e5 run diverges instantly; clipped stays finite
+    assert np.isfinite(tr.get_history().losses()).all()
 
 
 def test_sgd_step_math():
