@@ -3,8 +3,8 @@
 from distkeras_tpu.models.core import (  # noqa: F401
     LAYER_REGISTRY, Layer, Model, Sequential, register_layer)
 from distkeras_tpu.models.layers import (  # noqa: F401
-    ACTIVATIONS, Activation, AveragePooling2D, BatchNorm, Conv2D, Dense,
-    Dropout, Embedding, Flatten, GlobalAveragePooling1D,
+    ACTIVATIONS, Activation, AveragePooling2D, BatchNorm, Conv1D, Conv2D,
+    Dense, Dropout, Embedding, Flatten, GlobalAveragePooling1D,
     GlobalAveragePooling2D, GroupNorm, MaxPooling2D, Reshape,
     get_activation)
 from distkeras_tpu.models.blocks import Residual, WideAndDeep  # noqa: F401

@@ -205,53 +205,74 @@ def _pair(v):
     return (v, v) if isinstance(v, int) else tuple(v)
 
 
-@register_layer
-class Conv2D(Layer):
-    """2-D convolution, NHWC/HWIO — XLA's native TPU conv layout."""
+class _ConvND(Layer):
+    """Shared N-D convolution core; subclasses fix the spatial rank and the
+    channels-last ``dimension_numbers`` (XLA's native TPU conv layout)."""
+
+    _dims: tuple  # e.g. ("NHWC", "HWIO", "NHWC")
 
     def __init__(self, filters: int, kernel_size, strides=1, padding="SAME",
                  activation=None, use_bias: bool = True,
                  kernel_init: str = "he_normal", dtype: str = "float32"):
         self.filters = int(filters)
-        self.kernel_size = _pair(kernel_size)
-        self.strides = _pair(strides)
+        self.kernel_size = self._spatial(kernel_size)
+        self.strides = self._spatial(strides)
         self.padding = padding.upper()
         self.activation = activation
         self.use_bias = use_bias
         self.kernel_init = kernel_init
         self.dtype = dtype
 
+    def _spatial(self, v) -> tuple:
+        n = len(self._dims[0]) - 2  # spatial rank from the layout string
+        return _pair(v) if n == 2 else (int(v),)
+
     def init(self, rng, input_shape):
-        h, w, c = input_shape
-        kh, kw = self.kernel_size
-        params = {"kernel": init_weights(self.kernel_init, rng,
-                                         (kh, kw, c, self.filters))}
+        c = input_shape[-1]
+        kshape = self.kernel_size + (c, self.filters)
+        params = {"kernel": init_weights(self.kernel_init, rng, kshape)}
         if self.use_bias:
             params["bias"] = jnp.zeros((self.filters,))
         out = jax.eval_shape(
             lambda x, k: lax.conv_general_dilated(
                 x, k, self.strides, self.padding,
-                dimension_numbers=("NHWC", "HWIO", "NHWC")),
-            jax.ShapeDtypeStruct((1, h, w, c), jnp.float32),
-            jax.ShapeDtypeStruct((kh, kw, c, self.filters), jnp.float32))
+                dimension_numbers=self._dims),
+            jax.ShapeDtypeStruct((1,) + tuple(input_shape), jnp.float32),
+            jax.ShapeDtypeStruct(kshape, jnp.float32))
         return params, {}, tuple(out.shape[1:])
 
     def apply(self, params, state, x, *, training=False, rng=None):
         dt = jnp.dtype(self.dtype)
         y = lax.conv_general_dilated(
             x.astype(dt), params["kernel"].astype(dt), self.strides,
-            self.padding, dimension_numbers=("NHWC", "HWIO", "NHWC"))
+            self.padding, dimension_numbers=self._dims)
         if self.use_bias:
             y = y + params["bias"].astype(dt)
         y = get_activation(self.activation)(y)
         return y, state  # stays in compute dtype (see Dense.apply)
 
     def get_config(self):
+        ks, st = self.kernel_size, self.strides
         return {"filters": self.filters,
-                "kernel_size": list(self.kernel_size),
-                "strides": list(self.strides), "padding": self.padding,
+                "kernel_size": list(ks) if len(ks) > 1 else ks[0],
+                "strides": list(st) if len(st) > 1 else st[0],
+                "padding": self.padding,
                 "activation": self.activation, "use_bias": self.use_bias,
                 "kernel_init": self.kernel_init, "dtype": self.dtype}
+
+
+@register_layer
+class Conv2D(_ConvND):
+    """2-D convolution over [B, H, W, C]."""
+
+    _dims = ("NHWC", "HWIO", "NHWC")
+
+
+@register_layer
+class Conv1D(_ConvND):
+    """1-D convolution over [B, W, C] (text-CNN / signal models)."""
+
+    _dims = ("NWC", "WIO", "NWC")
 
 
 class _Pool2D(Layer):

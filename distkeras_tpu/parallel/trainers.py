@@ -62,7 +62,6 @@ class Trainer:
         self.worker_optimizer = get_optimizer(worker_optimizer, **opt_kwargs)
         # global-norm gradient clipping as a pure optimizer wrapper — works
         # identically under jit/vmap/shard_map on every trainer
-        self.clip_grad_norm = clip_grad_norm
         if clip_grad_norm is not None:
             from distkeras_tpu.ops.optimizers import clip_by_global_norm
             self.worker_optimizer = clip_by_global_norm(

@@ -1,8 +1,8 @@
 """Utilities: history, checkpointing, profiling, callbacks."""
 
 from distkeras_tpu.utils.callbacks import (  # noqa: F401
-    Callback, CSVLogger, EarlyStopping, LambdaCallback, ModelCheckpoint,
-    TerminateOnNaN)
+    Callback, CSVLogger, EarlyStopping, EMAWeights, LambdaCallback,
+    ModelCheckpoint, TerminateOnNaN)
 from distkeras_tpu.utils.checkpoint import CheckpointManager  # noqa: F401
 from distkeras_tpu.utils.history import History  # noqa: F401
 from distkeras_tpu.utils import profiling  # noqa: F401

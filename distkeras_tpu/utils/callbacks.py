@@ -274,6 +274,62 @@ class TerminateOnNaN(Callback):
             self.trainer.stop_training = True
 
 
+class EMAWeights(Callback):
+    """Keep an exponential moving average of the weights across EPOCHS and
+    install it on the trained model at train end (Polyak averaging — the
+    eval-quality trick ResNet/EfficientNet recipes rely on).
+
+    Per-EPOCH on purpose: per-step EMA would force a device→host fetch
+    every step (see the module docstring); with E epochs an epoch-decay of
+    ``decay`` behaves like a per-step decay of ``decay**(1/steps_per_epoch)``.
+    Set ``install=False`` to keep the trained weights and only expose the
+    average on ``.ema_weights``.
+    """
+
+    def __init__(self, decay: float = 0.9, install: bool = True):
+        if not 0.0 < decay < 1.0:
+            raise ValueError(f"decay must be in (0, 1), got {decay}")
+        self.decay = float(decay)
+        self.install = bool(install)
+
+    def on_train_begin(self, logs=None):
+        self.ema_weights = None
+        if self.install:
+            clash = [cb for cb in self.trainer.callbacks
+                     if isinstance(cb, EarlyStopping)
+                     and cb.restore_best_weights]
+            if clash:
+                raise ValueError(
+                    "EMAWeights(install=True) and EarlyStopping("
+                    "restore_best_weights=True) both replace the final "
+                    "weights — whichever runs last silently wins. Pick "
+                    "one, or use EMAWeights(install=False) and read "
+                    ".ema_weights yourself")
+
+    def on_epoch_end(self, epoch, logs=None):
+        params, state = self.trainer.get_weights()
+        if self.ema_weights is None:
+            self.ema_weights = (params, state)
+            return
+        d = self.decay
+
+        def mix(a, b):
+            a = np.asarray(a)
+            if not np.issubdtype(a.dtype, np.floating):
+                return b  # counters/ints track the live value
+            return (d * a + (1 - d) * np.asarray(b)).astype(a.dtype)
+
+        import jax
+
+        ep, es = self.ema_weights
+        self.ema_weights = (jax.tree_util.tree_map(mix, ep, params),
+                            jax.tree_util.tree_map(mix, es, state))
+
+    def on_train_end(self, logs=None):
+        if self.install and self.ema_weights is not None:
+            self.trainer.set_weights(*self.ema_weights)
+
+
 class LambdaCallback(Callback):
     """Ad-hoc hooks: ``LambdaCallback(on_epoch_end=lambda e, logs: ...)``."""
 

@@ -176,6 +176,16 @@ def test_ensemble_rejects_callbacks():
         tr.train(make_data())
 
 
+def test_ema_and_restore_best_conflict_detected():
+    from distkeras_tpu.utils import EMAWeights
+    ds = make_data()
+    tr = trainer(mlp(), [EarlyStopping(monitor="loss",
+                                       restore_best_weights=True),
+                         EMAWeights()], num_epoch=3)
+    with pytest.raises(ValueError, match="whichever runs last"):
+        tr.train(ds)
+
+
 def test_fit_accepts_callbacks():
     ds = make_data()
     m = mlp()

@@ -163,6 +163,46 @@ def test_model_predict_batched():
     np.testing.assert_allclose(out, m.predict(np.ones((10, 8))), rtol=1e-6)
 
 
+def test_conv1d_shapes_and_math():
+    from distkeras_tpu.models import Conv1D
+    m = build([Conv1D(4, 3, padding="VALID", use_bias=False)], (10, 2))
+    assert m.output_shape == (8, 4)
+    x = jax.random.normal(jax.random.PRNGKey(9), (2, 10, 2))
+    y, _ = m.apply(m.params, m.state, x)
+    assert y.shape == (2, 8, 4)
+    # hand-check one output position against the kernel
+    k = np.asarray(m.params[0]["kernel"])  # [3, 2, 4]
+    expect = np.einsum("wc,wcf->f", np.asarray(x)[0, 2:5], k)
+    np.testing.assert_allclose(np.asarray(y)[0, 2], expect, atol=1e-5)
+    # strided SAME halves the length
+    m2 = build([Conv1D(4, 3, strides=2)], (10, 2))
+    assert m2.output_shape == (5, 4)
+
+
+def test_ema_weights_callback():
+    from distkeras_tpu.data import Dataset
+    from distkeras_tpu.parallel import SingleTrainer
+    from distkeras_tpu.utils import EMAWeights, LambdaCallback
+    rs = np.random.RandomState(0)
+    X = rs.randn(128, 8).astype(np.float32)
+    yv = (X @ rs.randn(8) > 0).astype(np.int32)
+    m = build([Dense(2)], (8,))
+    ema = EMAWeights(decay=0.5)
+    snaps = []
+    grab = LambdaCallback(on_epoch_end=lambda e, logs: snaps.append(
+        jax.tree_util.tree_map(np.copy, ema.trainer.get_weights())))
+    tr = SingleTrainer(m, worker_optimizer="sgd", learning_rate=0.1,
+                       loss="sparse_categorical_crossentropy_from_logits",
+                       batch_size=32, num_epoch=3, callbacks=[ema, grab])
+    trained = tr.train(Dataset({"features": X, "label": yv}))
+    # hand-roll the epoch EMA from the captured snapshots
+    e = np.asarray(snaps[0][0][0]["kernel"])
+    for s in snaps[1:]:
+        e = 0.5 * e + 0.5 * np.asarray(s[0][0]["kernel"])
+    np.testing.assert_allclose(np.asarray(trained.params[0]["kernel"]), e,
+                               atol=1e-6)
+
+
 def test_groupnorm_normalizes_per_group():
     from distkeras_tpu.models import GroupNorm
     m = build([GroupNorm(groups=4)], (5, 5, 8))
