@@ -225,7 +225,14 @@ class _ConvND(Layer):
 
     def _spatial(self, v) -> tuple:
         n = len(self._dims[0]) - 2  # spatial rank from the layout string
-        return _pair(v) if n == 2 else (int(v),)
+        if n == 2:
+            return _pair(v)
+        if isinstance(v, (tuple, list)):  # Keras accepts (3,) / [3] too
+            if len(v) != 1:
+                raise ValueError(
+                    f"{type(self).__name__} expects 1 spatial dim, got {v}")
+            v = v[0]
+        return (int(v),)
 
     def init(self, rng, input_shape):
         c = input_shape[-1]

@@ -28,6 +28,13 @@ def test_mnist_workflow(trainer):
     assert acc > 0.75, (trainer, acc)
 
 
+def test_vit_finetune_callbacks_example(capsys):
+    acc = run_example("examples.vit_finetune_callbacks")
+    out = capsys.readouterr().out
+    assert "epochs logged" in out
+    assert acc > 0.85, acc
+
+
 def test_streaming_inference_example(capsys):
     run_example("examples.streaming_inference")
     out = capsys.readouterr().out

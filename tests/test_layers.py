@@ -174,8 +174,8 @@ def test_conv1d_shapes_and_math():
     k = np.asarray(m.params[0]["kernel"])  # [3, 2, 4]
     expect = np.einsum("wc,wcf->f", np.asarray(x)[0, 2:5], k)
     np.testing.assert_allclose(np.asarray(y)[0, 2], expect, atol=1e-5)
-    # strided SAME halves the length
-    m2 = build([Conv1D(4, 3, strides=2)], (10, 2))
+    # strided SAME halves the length; sequence forms accepted like Keras
+    m2 = build([Conv1D(4, (3,), strides=[2])], (10, 2))
     assert m2.output_shape == (5, 4)
 
 
