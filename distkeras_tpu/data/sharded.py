@@ -61,6 +61,29 @@ class ShardedDataset:
     def from_datasets(cls, datasets: Sequence[Dataset]):
         return cls(list(datasets))
 
+    @classmethod
+    def write(cls, dataset: Dataset, directory: str, num_shards: int,
+              prefix: str = "shard") -> "ShardedDataset":
+        """Split an in-memory ``Dataset`` into ``num_shards`` npz files
+        under ``directory`` and return the ShardedDataset over them —
+        the round-trip utility for preparing out-of-core training data."""
+        if num_shards < 1:
+            raise ValueError(f"num_shards must be >= 1, got {num_shards}")
+        n = len(dataset)
+        if n < num_shards:
+            raise ValueError(
+                f"cannot split {n} rows into {num_shards} shards")
+        os.makedirs(directory, exist_ok=True)
+        bounds = np.linspace(0, n, num_shards + 1).astype(int)
+        paths = []
+        for i in range(num_shards):
+            sl = slice(bounds[i], bounds[i + 1])
+            path = os.path.join(directory,
+                                f"{prefix}-{i:05d}-of-{num_shards:05d}.npz")
+            np.savez(path, **{c: dataset[c][sl] for c in dataset.columns})
+            paths.append(path)
+        return cls.from_files(paths)
+
     # -- access -------------------------------------------------------------
     @property
     def num_shards(self) -> int:

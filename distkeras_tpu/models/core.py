@@ -244,10 +244,33 @@ class Model:
         from distkeras_tpu.ops.metrics import get_metric, metric_name
 
         if isinstance(x, ShardedDataset):
-            raise ValueError(
-                "evaluate() needs the whole set in memory; for a "
-                "ShardedDataset evaluate shard-by-shard: "
-                "model.evaluate(sds.load_shard(i)) and average")
+            # shard-by-shard, weighted by shard size — only one shard in
+            # host memory at a time (matches the out-of-core fit path).
+            # Only row-decomposable metrics are EXACT under size-weighted
+            # averaging; pooled metrics (macro precision/recall/f1) are
+            # not, so refuse rather than return a plausible wrong number.
+            decomposable = {"accuracy", "top_5_accuracy", "mse"}
+            bad = [metric_name(m) for m in (metrics or ())
+                   if metric_name(m) not in decomposable]
+            if bad:
+                raise ValueError(
+                    f"metrics {bad} are not decomposable across shards "
+                    "(a size-weighted mean of per-shard macro scores is "
+                    "not the pooled score); evaluate them on an in-memory "
+                    "Dataset, or use decomposable metrics "
+                    f"({sorted(decomposable)}) here")
+            totals, n_total = {}, 0
+            for i in range(x.num_shards):
+                shard = x.load_shard(i)
+                res = self.evaluate(shard, loss=loss, metrics=metrics,
+                                    batch_size=batch_size,
+                                    features_col=features_col,
+                                    label_col=label_col)
+                n = len(shard)
+                n_total += n
+                for k, v in res.items():
+                    totals[k] = totals.get(k, 0.0) + n * v
+            return {k: v / n_total for k, v in totals.items()}
         if isinstance(x, Dataset):
             X, yv = x.arrays(features_col, label_col)
             if yv is None:

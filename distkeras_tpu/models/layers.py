@@ -224,15 +224,17 @@ class _ConvND(Layer):
         self.dtype = dtype
 
     def _spatial(self, v) -> tuple:
+        """Normalize an int / sequence to the layer's spatial rank — a bare
+        int broadcasts; a sequence must match the rank exactly (a clear
+        error here beats an opaque conv shape mismatch at build time)."""
         n = len(self._dims[0]) - 2  # spatial rank from the layout string
-        if n == 2:
-            return _pair(v)
-        if isinstance(v, (tuple, list)):  # Keras accepts (3,) / [3] too
-            if len(v) != 1:
+        if isinstance(v, (tuple, list)):
+            if len(v) != n:
                 raise ValueError(
-                    f"{type(self).__name__} expects 1 spatial dim, got {v}")
-            v = v[0]
-        return (int(v),)
+                    f"{type(self).__name__} expects {n} spatial dim(s), "
+                    f"got {v}")
+            return tuple(int(e) for e in v)
+        return (int(v),) * n
 
     def init(self, rng, input_shape):
         c = input_shape[-1]

@@ -20,7 +20,6 @@ Run:
 
 from __future__ import annotations
 
-import glob
 import os
 import tempfile
 
@@ -52,13 +51,8 @@ def main():
     Xv, yv = make_freq_images(512, SIZE, seed=1)
 
     workdir = tempfile.mkdtemp(prefix="vit_example_")
-    per = N // SHARDS
-    for i in range(SHARDS):
-        sl = slice(i * per, (i + 1) * per)
-        np.savez(os.path.join(workdir, f"train-{i:02d}.npz"),
-                 features=X[sl], label=y[sl])
-    sds = ShardedDataset.from_files(
-        sorted(glob.glob(os.path.join(workdir, "train-*.npz"))))
+    sds = ShardedDataset.write(Dataset({"features": X, "label": y}),
+                               workdir, num_shards=SHARDS, prefix="train")
 
     model = Model.build(
         zoo.vit(image_size=SIZE, patch_size=4, d_model=32, num_heads=4,

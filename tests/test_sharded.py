@@ -153,11 +153,34 @@ def test_sharded_is_truthy_and_len_raises():
         len(sds)
 
 
-def test_sharded_evaluate_raises_clearly():
+def test_sharded_evaluate_matches_inmemory():
     X, y = make_arrays(128)
     m = mlp()
-    with pytest.raises(ValueError, match="shard-by-shard"):
-        m.evaluate(as_shards(X, y, 2))
+    full = m.evaluate(Dataset({"features": X, "label": y}),
+                      loss="sparse_categorical_crossentropy_from_logits",
+                      metrics=("accuracy",))
+    sharded = m.evaluate(as_shards(X, y, 4),
+                         loss="sparse_categorical_crossentropy_from_logits",
+                         metrics=("accuracy",))
+    for k in full:
+        np.testing.assert_allclose(sharded[k], full[k], rtol=1e-5,
+                                   err_msg=k)
+    with pytest.raises(ValueError, match="decomposable"):
+        m.evaluate(as_shards(X, y, 2), metrics=("precision",))
+
+
+def test_sharded_write_roundtrip(tmp_path):
+    X, y = make_arrays(100, seed=7)
+    ds = Dataset({"features": X, "label": y})
+    sds = ShardedDataset.write(ds, str(tmp_path / "out"), num_shards=3)
+    assert sds.num_shards == 3
+    back = sds.load_shard(0)
+    for i in range(1, 3):
+        back = back.concat(sds.load_shard(i))
+    np.testing.assert_array_equal(back["features"], X)  # uneven split OK
+    np.testing.assert_array_equal(back["label"], y)
+    with pytest.raises(ValueError, match="shards"):
+        ShardedDataset.write(ds, str(tmp_path / "o2"), num_shards=0)
 
 
 def test_sharded_fit_and_callbacks():
