@@ -30,6 +30,26 @@ import numpy as np
 LAYER_REGISTRY: Dict[str, type] = {}
 
 
+# Reserved state-dict key: a layer may publish a scalar auxiliary TRAINING
+# loss (e.g. the MoE router balance loss) under this key in its returned
+# state; ``collect_aux_losses`` below sums every occurrence, and
+# ``parallel.worker.make_train_step`` adds that sum to the optimized loss.
+# State is the one channel that already flows out of ``apply`` through
+# every jit/vmap/shard_map wrapper, so regularizer-style terms need no
+# signature change anywhere.
+AUX_LOSS_KEY = "__aux_loss__"
+
+
+def collect_aux_losses(state) -> jax.Array:
+    """Sum of every ``AUX_LOSS_KEY`` leaf in a state pytree (0.0 if none)."""
+    total = 0.0
+    flat, _ = jax.tree_util.tree_flatten_with_path(state)
+    for path, leaf in flat:
+        if any(getattr(k, "key", None) == AUX_LOSS_KEY for k in path):
+            total = total + leaf
+    return total
+
+
 def user_float(y: jax.Array) -> jax.Array:
     """User-facing output dtype policy: low-precision compute dtypes
     (bf16/f16) stay internal — predictions handed back to the host are f32.

@@ -36,7 +36,8 @@ class MoE(Layer):
     def __init__(self, num_experts: int, hidden_dim: int, top_k: int = 2,
                  activation: str = "gelu", dtype: str = "float32",
                  expert_axis_name: Optional[str] = None,
-                 kernel_init: str = "glorot_uniform"):
+                 kernel_init: str = "glorot_uniform",
+                 aux_loss_weight: float = 0.0):
         self.num_experts = int(num_experts)
         self.hidden_dim = int(hidden_dim)
         self.top_k = int(top_k)
@@ -44,6 +45,12 @@ class MoE(Layer):
         self.dtype = dtype
         self.expert_axis_name = expert_axis_name
         self.kernel_init = kernel_init
+        # Switch/GShard load-balancing loss coefficient: adds
+        # ``weight · E · Σ_e f_e·P_e`` to the TRAINING loss (f_e = fraction
+        # of routing slots sent to expert e, P_e = mean router prob),
+        # pushing the router away from expert collapse. Published via the
+        # AUX_LOSS_KEY state channel (parallel.worker picks it up).
+        self.aux_loss_weight = float(aux_loss_weight)
 
     def init(self, rng, input_shape):
         d = input_shape[-1]
@@ -59,12 +66,20 @@ class MoE(Layer):
             "w1": w1, "b1": jnp.zeros((e, hid)),
             "w2": w2, "b2": jnp.zeros((e, d)),
         }
-        return params, {}, tuple(input_shape)
+        state = {}
+        if self.aux_loss_weight:
+            from distkeras_tpu.models.core import AUX_LOSS_KEY
+            state[AUX_LOSS_KEY] = jnp.zeros((), jnp.float32)
+        return params, state, tuple(input_shape)
 
     def _gate_probs(self, x, gate):
-        """[B, S, E] routing weights: softmax over top-k logits, 0 elsewhere."""
+        """Routing weights [B, S, E] (softmax over top-k logits, 0
+        elsewhere) plus the full softmax and slot mask for the balance
+        loss."""
         logits = jnp.einsum("bsd,de->bse", x.astype(jnp.float32),
                             gate.astype(jnp.float32))
+        full = jax.nn.softmax(logits, axis=-1)
+        mask = None
         if self.top_k < self.num_experts:
             # mask from top_k INDICES, not a >= kth-value test: on tied
             # logits the value test would admit every tied expert, breaking
@@ -73,12 +88,24 @@ class MoE(Layer):
             mask = jax.nn.one_hot(idxs, self.num_experts,
                                   dtype=jnp.bool_).any(axis=-2)
             logits = jnp.where(mask, logits, -jnp.inf)
-        return jax.nn.softmax(logits, axis=-1)
+        return jax.nn.softmax(logits, axis=-1), full, mask
+
+    def _balance_loss(self, full, mask):
+        """E · Σ_e f_e·P_e (Switch eq. 4, GShard): minimized at uniform
+        routing, where it equals 1."""
+        e = self.num_experts
+        if mask is None:            # top_k == E: every slot hits every expert
+            frac = jnp.full((e,), 1.0 / e)
+        else:
+            frac = jnp.mean(mask.astype(jnp.float32), axis=(0, 1)) \
+                / self.top_k        # fraction of routing slots per expert
+        pmean = jnp.mean(full, axis=(0, 1))
+        return e * jnp.sum(frac * pmean)
 
     def apply(self, params, state, x, *, training=False, rng=None):
         dt = jnp.dtype(self.dtype)
         act = get_activation(self.activation)
-        probs = self._gate_probs(x, params["gate"])     # [B, S, E] f32
+        probs, full, mask = self._gate_probs(x, params["gate"])  # f32
 
         xc = x.astype(dt)
         # local experts: [El, ...] slice when sharded over the expert axis
@@ -98,11 +125,20 @@ class MoE(Layer):
             local = lax.dynamic_slice_in_dim(probs, idx * el, el, axis=-1)
             out = jnp.einsum("bse,besd->bsd", local.astype(dt), y)
             out = lax.psum(out, self.expert_axis_name)
-        return out.astype(x.dtype), state
+        new_state = state
+        if self.aux_loss_weight and training:
+            from distkeras_tpu.models.core import AUX_LOSS_KEY
+            # router inputs/gate are replicated under expert sharding, so
+            # this value is identical on every shard — no psum needed
+            new_state = dict(state)
+            new_state[AUX_LOSS_KEY] = (self.aux_loss_weight *
+                                       self._balance_loss(full, mask))
+        return out.astype(x.dtype), new_state
 
     def get_config(self):
         return {"num_experts": self.num_experts, "hidden_dim": self.hidden_dim,
                 "top_k": self.top_k, "activation": self.activation,
                 "dtype": self.dtype,
                 "expert_axis_name": self.expert_axis_name,
-                "kernel_init": self.kernel_init}
+                "kernel_init": self.kernel_init,
+                "aux_loss_weight": self.aux_loss_weight}

@@ -135,7 +135,8 @@ def transformer_lm(vocab_size: int, d_model: int = 512, num_heads: int = 8,
                    attn_impl: str = "xla",
                    seq_axis_name: Optional[str] = None,
                    moe_every: int = 0, num_experts: int = 0,
-                   moe_expert_axis: Optional[str] = None) -> Sequential:
+                   moe_expert_axis: Optional[str] = None,
+                   moe_aux_loss_weight: float = 0.0) -> Sequential:
     """Decoder-only causal transformer LM — the long-context flagship.
 
     Absent from the reference (no attention models; SURVEY §5.7); this is
@@ -161,7 +162,8 @@ def transformer_lm(vocab_size: int, d_model: int = 512, num_heads: int = 8,
         if moe_every and num_experts and (i + 1) % moe_every == 0:
             from distkeras_tpu.models.moe import MoE
             mlp_layer = MoE(num_experts, mlp_ratio * d_model,
-                            dtype=dtype, expert_axis_name=moe_expert_axis)
+                            dtype=dtype, expert_axis_name=moe_expert_axis,
+                            aux_loss_weight=moe_aux_loss_weight)
         layers.append(TransformerBlock(
             num_heads, mlp_ratio=mlp_ratio, causal=True, use_rope=use_rope,
             norm=norm, dtype=dtype, attn_impl=attn_impl,

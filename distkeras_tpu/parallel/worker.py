@@ -63,7 +63,11 @@ def make_train_step(module, loss_fn: Callable, optimizer: Optimizer,
         def objective(params):
             out, new_state = module.apply(params, state, xb,
                                           training=True, rng=sub)
-            return loss_fn(yb, out), (new_state, out)
+            # layer-published auxiliary losses (models.core.AUX_LOSS_KEY,
+            # e.g. MoE router balance) join the optimized loss here
+            from distkeras_tpu.models.core import collect_aux_losses
+            return loss_fn(yb, out) + collect_aux_losses(new_state), \
+                (new_state, out)
 
         (loss, (new_state, out)), grads = jax.value_and_grad(
             objective, has_aux=True)(params)

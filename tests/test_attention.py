@@ -233,10 +233,62 @@ def test_moe_topk_masks_routing():
     moe = MoE(8, 4, top_k=2)
     params, _, _ = moe.init(jax.random.PRNGKey(8), (4,))
     x = jax.random.normal(jax.random.PRNGKey(9), (1, 4, 4))
-    probs = moe._gate_probs(x, params["gate"])
+    probs, _, _ = moe._gate_probs(x, params["gate"])
     nonzero = (np.asarray(probs) > 0).sum(-1)
     assert (nonzero == 2).all()
     np.testing.assert_allclose(np.asarray(probs).sum(-1), 1.0, atol=1e-6)
+
+
+def test_moe_balance_loss_math():
+    moe = MoE(8, 4, top_k=2, aux_loss_weight=0.01)
+    params, state, _ = moe.init(jax.random.PRNGKey(8), (4,))
+    assert "__aux_loss__" in state
+    # uniform router (zero gate) -> balance loss exactly 1
+    params["gate"] = jnp.zeros_like(params["gate"])
+    x = jax.random.normal(jax.random.PRNGKey(9), (2, 16, 4))
+    _, full, mask = moe._gate_probs(x, params["gate"])
+    np.testing.assert_allclose(float(moe._balance_loss(full, mask)), 1.0,
+                               atol=1e-5)
+    # a collapsed router (expert 0 gets all prob, slots split 0/1)
+    # scores E * (0.5*1.0) = 4 — far above the uniform optimum of 1
+    full_c = jnp.zeros((2, 16, 8)).at[..., 0].set(1.0)
+    mask_c = (jnp.zeros((2, 16, 8), bool).at[..., 0].set(True)
+              .at[..., 1].set(True))
+    np.testing.assert_allclose(float(moe._balance_loss(full_c, mask_c)),
+                               4.0, atol=1e-5)
+    # aux only published in TRAINING mode
+    _, st_eval = moe.apply(params, state, x, training=False)
+    assert float(st_eval["__aux_loss__"]) == 0.0
+    _, st_train = moe.apply(params, state, x, training=True)
+    assert float(st_train["__aux_loss__"]) > 0.005  # ~0.01 * >=1
+
+
+def test_moe_aux_loss_joins_training_loss():
+    from distkeras_tpu.models.core import collect_aux_losses
+    from distkeras_tpu.ops import get_loss, get_optimizer
+    from distkeras_tpu.parallel.worker import TrainCarry, make_train_step
+
+    tokens = jax.random.randint(jax.random.PRNGKey(11), (2, 8), 0, 17)
+
+    losses = {}
+    states = {}
+    for w in (0.0, 0.1):
+        spec = zoo.transformer_lm(17, d_model=16, num_heads=2, num_layers=2,
+                                  mlp_ratio=2, moe_every=1, num_experts=4,
+                                  moe_aux_loss_weight=w)
+        model = Model.build(spec, (8,), seed=3)
+        opt = get_optimizer("sgd", learning_rate=0.0)
+        step = make_train_step(
+            spec, get_loss("sparse_categorical_crossentropy_from_logits"),
+            opt)
+        carry = TrainCarry(model.params, model.state,
+                           opt.init(model.params), jax.random.PRNGKey(0))
+        new_carry, loss = step(carry, (tokens, tokens))
+        losses[w] = float(loss)
+        states[w] = new_carry.state
+    aux = float(collect_aux_losses(states[0.1]))
+    assert aux > 0.05  # two MoE blocks, each >= 0.1 * ~1.0... scaled
+    np.testing.assert_allclose(losses[0.1] - losses[0.0], aux, rtol=1e-4)
 
 
 def test_transformer_lm_forward_and_train_step():
@@ -302,7 +354,7 @@ def test_moe_topk_exact_on_tied_logits():
     moe = MoE(8, 4, top_k=2)
     params, _, _ = moe.init(jax.random.PRNGKey(8), (4,))
     x = jnp.zeros((1, 4, 4))
-    probs = moe._gate_probs(x, params["gate"])
+    probs, _, _ = moe._gate_probs(x, params["gate"])
     nonzero = (np.asarray(probs) > 0).sum(-1)
     assert (nonzero == 2).all(), nonzero
 
