@@ -17,3 +17,5 @@ from distkeras_tpu.models.moe import MoE  # noqa: F401  (registers 'MoE')
 from distkeras_tpu.models import zoo  # noqa: F401
 from distkeras_tpu.models.serialization import (  # noqa: F401
     deserialize_model, load_model, save_model, serialize_model)
+from distkeras_tpu.models.quantize import (  # noqa: F401
+    QuantizedModel, dequantize_model, quantize_model)

@@ -25,7 +25,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from distkeras_tpu.models.core import Layer, register_layer
+from distkeras_tpu.models.core import (AUX_LOSS_KEY, Layer,
+                                       register_layer)
 from distkeras_tpu.models.layers import get_activation, init_weights
 
 
@@ -68,7 +69,6 @@ class MoE(Layer):
         }
         state = {}
         if self.aux_loss_weight:
-            from distkeras_tpu.models.core import AUX_LOSS_KEY
             state[AUX_LOSS_KEY] = jnp.zeros((), jnp.float32)
         return params, state, tuple(input_shape)
 
@@ -127,7 +127,6 @@ class MoE(Layer):
             out = lax.psum(out, self.expert_axis_name)
         new_state = state
         if self.aux_loss_weight and training:
-            from distkeras_tpu.models.core import AUX_LOSS_KEY
             # router inputs/gate are replicated under expert sharding, so
             # this value is identical on every shard — no psum needed
             new_state = dict(state)

@@ -61,12 +61,11 @@ def serialize_model(model: Model) -> Dict[str, Any]:
     }
 
 
-def deserialize_model(payload: Dict[str, Any]) -> Model:
-    """Plain dict -> Model (rebuilds spec from registry, restores weights).
-
-    Uses ``jax.eval_shape`` to get the parameter template, so no random
-    initialization work is done just to be overwritten (matters for
-    ResNet-scale models)."""
+def _abstract_template(payload: Dict[str, Any]):
+    """(module, params_template, state_template, in_shape, out_shape) from
+    an arch dict — ``jax.eval_shape`` only, so no random initialization
+    work is done just to be overwritten (matters for ResNet-scale
+    models)."""
     if payload.get("format") != FORMAT_VERSION:
         raise ValueError(f"Unknown model format: {payload.get('format')!r}")
     module = LAYER_REGISTRY[payload["class"]].from_config(payload["config"])
@@ -80,28 +79,102 @@ def deserialize_model(payload: Dict[str, Any]) -> Model:
         return p, s
 
     p_template, s_template = jax.eval_shape(abstract_init)
-    params = _unflatten_like(p_template, payload["params"])
-    state = _unflatten_like(s_template, payload["state"])
-    return Model(module, params, state, input_shape, captured["out_shape"])
+    return module, p_template, s_template, input_shape, \
+        captured["out_shape"]
 
 
-def save_model(model: Model, path: str) -> None:
+def deserialize_model(payload: Dict[str, Any]) -> Model:
+    """Plain dict -> Model (rebuilds spec from registry, restores weights)."""
+    module, p_t, s_t, in_shape, out_shape = _abstract_template(payload)
+    params = _unflatten_like(p_t, payload["params"])
+    state = _unflatten_like(s_t, payload["state"])
+    return Model(module, params, state, in_shape, out_shape)
+
+
+def save_model(model: Model, path: str, quantize: bool = False) -> None:
+    """``quantize=True`` stores matrix weights as int8 + per-channel f32
+    scales (``models.quantize``) — ~4× smaller files; ``load_model``
+    restores f32 transparently (or the int8 form with
+    ``keep_quantized=True``)."""
     payload = serialize_model(model)
     arch = {k: payload[k] for k in ("format", "class", "config",
                                     "input_shape")}
+    arrays = {f"params:{k}": v for k, v in payload["params"].items()}
+    if quantize:
+        from distkeras_tpu.models.quantize import (_is_quantizable,
+                                                   _quantize_leaf)
+        arch["quantized"] = True
+        qarrays = {}
+        for k, v in arrays.items():
+            if _is_quantizable(v, k.split("/")[-1]):
+                d = _quantize_leaf(v)
+                qarrays[k] = d["q"]
+                qarrays[k + ":scale"] = d["scale"]
+            else:
+                qarrays[k] = v
+        arrays = qarrays
     with open(path + ".json", "w") as f:
         json.dump(arch, f, indent=2)
-    arrays = {f"params:{k}": v for k, v in payload["params"].items()}
     arrays.update({f"state:{k}": v for k, v in payload["state"].items()})
     np.savez(path + ".npz", **arrays)
 
 
-def load_model(path: str) -> Model:
+def load_model(path: str, keep_quantized: bool = False):
+    """Returns a ``Model`` (f32) — or, for a quantized file with
+    ``keep_quantized=True``, a ``models.quantize.QuantizedModel`` whose
+    predict dequantizes in-graph."""
     with open(path + ".json") as f:
         arch = json.load(f)
     arrays = np.load(path + ".npz")
-    params = {k[len("params:"):]: arrays[k] for k in arrays.files
-              if k.startswith("params:")}
     state = {k[len("state:"):]: arrays[k] for k in arrays.files
              if k.startswith("state:")}
+    if arch.pop("quantized", False):
+        from distkeras_tpu.models.quantize import (QuantizedModel,
+                                                   _dequantize_leaf)
+        if not keep_quantized:
+            params = {}
+            for k in arrays.files:
+                if not k.startswith("params:") or k.endswith(":scale"):
+                    continue
+                name = k[len("params:"):]
+                if k + ":scale" in arrays.files:
+                    params[name] = np.asarray(_dequantize_leaf(
+                        arrays[k], arrays[k + ":scale"]))
+                else:
+                    params[name] = arrays[k]
+            return deserialize_model({**arch, "params": params,
+                                      "state": state})
+        # int8 serving handle built DIRECTLY from the stored q/scale
+        # arrays — no f32 materialization, scales verbatim
+        module, p_t, s_t, in_shape, out_shape = _abstract_template(arch)
+        flat_t, treedef = jax.tree_util.tree_flatten_with_path(p_t)
+        qleaves, sleaves = [], []
+        for path, leaf in flat_t:
+            key = "params:" + "/".join(
+                str(getattr(p, "key", getattr(p, "idx", p)))
+                for p in path)
+            arr = arrays[key]
+            if tuple(arr.shape) != tuple(leaf.shape):
+                raise ValueError(
+                    f"weight {key!r} shape {arr.shape} != "
+                    f"expected {leaf.shape}")
+            if key + ":scale" in arrays.files:
+                qleaves.append(arr)                       # int8 verbatim
+                sleaves.append(arrays[key + ":scale"])
+            else:
+                qleaves.append(arr.astype(leaf.dtype))
+                sleaves.append(None)
+        qparams = jax.tree_util.tree_unflatten(
+            jax.tree_util.tree_structure(p_t), qleaves)
+        scales = jax.tree_util.tree_unflatten(
+            jax.tree_util.tree_structure(p_t), sleaves)
+        return QuantizedModel(module, qparams, scales,
+                              _unflatten_like(s_t, state),
+                              in_shape, out_shape)
+    if keep_quantized:
+        raise ValueError(
+            f"{path} was not saved with quantize=True; load it normally "
+            "and call models.quantize.quantize_model()")
+    params = {k[len("params:"):]: arrays[k] for k in arrays.files
+              if k.startswith("params:")}
     return deserialize_model({**arch, "params": params, "state": state})

@@ -24,6 +24,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from distkeras_tpu.models.core import collect_aux_losses
 from distkeras_tpu.ops.optimizers import Optimizer, apply_updates
 
 
@@ -65,7 +66,6 @@ def make_train_step(module, loss_fn: Callable, optimizer: Optimizer,
                                           training=True, rng=sub)
             # layer-published auxiliary losses (models.core.AUX_LOSS_KEY,
             # e.g. MoE router balance) join the optimized loss here
-            from distkeras_tpu.models.core import collect_aux_losses
             return loss_fn(yb, out) + collect_aux_losses(new_state), \
                 (new_state, out)
 

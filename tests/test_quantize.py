@@ -1,0 +1,115 @@
+"""Weight-only int8 quantization: accuracy, size, save/load integration.
+
+Capability ADD (the reference ships full-precision Keras weight lists;
+``distkeras/utils.py :: serialize_keras_model``)."""
+
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from distkeras_tpu.models import (Dense, Model, Sequential, load_model,
+                                  quantize_model, save_model, zoo)
+from distkeras_tpu.models.quantize import (dequantize_model,
+                                           dequantize_params,
+                                           quantize_params)
+
+
+def trained_mlp(seed=0):
+    rs = np.random.RandomState(seed)
+    X = rs.randn(512, 16).astype(np.float32)
+    y = np.argmax(X @ rs.randn(16, 4), axis=1)
+    m = Model.build(Sequential([Dense(64, activation="relu"), Dense(4)]),
+                    (16,), seed=seed)
+    m.fit(X, y, optimizer="adam", learning_rate=1e-2, epochs=20,
+          batch_size=64,
+          loss="sparse_categorical_crossentropy_from_logits")
+    return m, X, y
+
+
+def test_quantize_roundtrip_error_small():
+    m, X, _ = trained_mlp()
+    qp, scales = quantize_params(m.params)
+    back = jax.device_get(dequantize_params(qp, scales))
+    for a, b in zip(jax.tree_util.tree_leaves(m.params),
+                    jax.tree_util.tree_leaves(back)):
+        a = np.asarray(a)
+        if a.ndim >= 2:  # quantized leaves: error bounded by scale/2
+            step = np.abs(a).max(axis=tuple(range(a.ndim - 1)),
+                                 keepdims=True) / 127.0
+            assert (np.abs(a - b) <= 0.5 * step + 1e-8).all()
+        else:            # biases untouched
+            np.testing.assert_array_equal(a, b)
+
+
+def test_quantized_model_predictions_close():
+    m, X, y = trained_mlp()
+    qm = quantize_model(m)
+    ref = m.predict(X)
+    out = qm.predict(X)
+    # same argmax decisions almost everywhere
+    agree = (ref.argmax(-1) == out.argmax(-1)).mean()
+    assert agree > 0.99, agree
+    # int8 storage is ~4x smaller than the f32 kernels
+    f32_bytes = sum(np.asarray(l).nbytes
+                    for l in jax.tree_util.tree_leaves(m.params))
+    assert qm.num_bytes() < 0.45 * f32_bytes  # tiny model: bias+scale overhead
+    # and back to full precision
+    m2 = dequantize_model(qm)
+    np.testing.assert_allclose(m2.predict(X), out, atol=1e-5)
+
+
+def test_save_load_quantized(tmp_path):
+    m, X, _ = trained_mlp(seed=1)
+    p_f32 = str(tmp_path / "full")
+    p_q = str(tmp_path / "quant")
+    save_model(m, p_f32)
+    save_model(m, p_q, quantize=True)
+
+    # the ~4x shrink shows at realistic kernel sizes (tiny models are
+    # dominated by per-entry npz container overhead)
+    big = Model.build(Sequential([Dense(512, activation="relu"),
+                                  Dense(512), Dense(4)]), (256,), seed=0)
+    save_model(big, str(tmp_path / "big"))
+    save_model(big, str(tmp_path / "bigq"), quantize=True)
+    assert os.path.getsize(str(tmp_path / "bigq.npz")) < \
+        0.35 * os.path.getsize(str(tmp_path / "big.npz"))
+
+    loaded = load_model(p_q)                      # transparent f32 restore
+    assert (loaded.predict(X).argmax(-1) ==
+            m.predict(X).argmax(-1)).mean() > 0.99
+
+    qm = load_model(p_q, keep_quantized=True)     # int8 serving handle
+    np.testing.assert_allclose(qm.predict(X), loaded.predict(X), atol=1e-5)
+
+    with pytest.raises(ValueError, match="quantize=True"):
+        load_model(p_f32, keep_quantized=True)
+
+
+def test_quantize_policy_is_name_based():
+    """Only the big matmul kernels/embeddings go int8 — MoE's stacked
+    [E, ...] bias MATRICES, norm params, and the router gate stay f32."""
+    from distkeras_tpu.models.moe import MoE
+    m = Model.build(
+        Sequential([MoE(num_experts=4, hidden_dim=8, top_k=2)]), (8,),
+        seed=0)
+    qp, scales = quantize_params(m.params)
+    moe_p = qp[0]
+    moe_s = scales[0]
+    assert moe_p["w1"].dtype == np.int8 and moe_s["w1"] is not None
+    assert moe_p["w2"].dtype == np.int8 and moe_s["w2"] is not None
+    # 2-D but accuracy-critical: untouched
+    for name in ("b1", "b2", "gate"):
+        assert moe_p[name].dtype == np.float32, name
+        assert moe_s[name] is None, name
+
+
+def test_quantize_resnet_smoke():
+    m = Model.build(zoo.resnet18_thin(num_classes=10, width=8),
+                    (32, 32, 3), seed=0)
+    qm = quantize_model(m)
+    x = np.random.RandomState(0).rand(2, 32, 32, 3).astype(np.float32)
+    ref, out = m.predict(x), qm.predict(x)
+    assert out.shape == ref.shape
+    np.testing.assert_allclose(out, ref, atol=0.1)  # bn-dominated net
