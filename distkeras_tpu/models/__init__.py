@@ -19,3 +19,4 @@ from distkeras_tpu.models.serialization import (  # noqa: F401
     deserialize_model, load_model, save_model, serialize_model)
 from distkeras_tpu.models.quantize import (  # noqa: F401
     QuantizedModel, dequantize_model, quantize_model)
+from distkeras_tpu.models.decoding import generate  # noqa: F401

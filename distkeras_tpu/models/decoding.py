@@ -1,0 +1,204 @@
+"""Autoregressive decoding with a KV cache: ``generate()`` for the LM family.
+
+Capability ADD with no reference analogue (dist-keras predates generative
+models; its Predictor is batch-scoring only — SURVEY §3.4). TPU-first
+design:
+
+  * The whole generation loop is ONE jitted ``lax.scan`` over time steps —
+    no per-token Python dispatch, static shapes throughout (the cache is a
+    preallocated ``[B, P+N, H, Dh]`` buffer written with
+    ``dynamic_update_slice``).
+  * Prompt prefill reuses the same scan (tokens before the prompt length
+    are teacher-forced from the prompt buffer), so there is exactly one
+    compiled program regardless of prompt length.
+  * Per-step attention reads the cache with a causal validity mask — the
+    [S, S] score matrix never exists; each step is O(L) like flash
+    decoding.
+
+Works on ``zoo.transformer_lm``-shaped models: a ``Sequential`` of
+Embedding / PositionalEmbedding / TransformerBlock / norm / Dense. MoE
+blocks decode fine (dense routing is per-token already). Sequence-parallel
+``attn_impl`` settings are ignored at decode time — generation is a
+single-device (or TP-sharded) path; the cache layout is the same BSHD as
+training.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from distkeras_tpu.models.attention import (MultiHeadAttention,
+                                            PositionalEmbedding,
+                                            TransformerBlock)
+from distkeras_tpu.models.core import Model, Sequential
+from distkeras_tpu.models.layers import Dropout
+from distkeras_tpu.ops.attention import NEG_INF, apply_rope
+
+
+def init_cache(module: Sequential, batch: int, max_len: int,
+               dtype=jnp.float32):
+    """Per-layer KV buffers ([B, max_len, H, Dh]) mirroring the Sequential;
+    non-attention layers get ``None``."""
+    cache = []
+    for layer in module.layers:
+        if isinstance(layer, TransformerBlock):
+            attn = layer.attn
+            h = attn.num_heads
+            # head_dim resolves at init; recover it from the layer config
+            dh = attn.head_dim
+            if dh is None:
+                raise ValueError(
+                    "init_cache needs head_dim; build the model first "
+                    "(Model.build resolves it) or pass head_dim explicitly")
+            shape = (batch, max_len, h, dh)
+            cache.append({"k": jnp.zeros(shape, dtype),
+                          "v": jnp.zeros(shape, dtype)})
+        else:
+            cache.append(None)
+    return cache
+
+
+def _resolve_head_dims(module: Sequential, params) -> None:
+    """Fill in ``head_dim`` on each attention layer from its params (the
+    layer leaves it None until init; decode needs it statically)."""
+    for layer, p in zip(module.layers, params):
+        if isinstance(layer, TransformerBlock) and layer.attn.head_dim is None:
+            layer.attn.head_dim = int(p["attn"]["wq"].shape[-1])
+
+
+def _decode_attn(attn: MultiHeadAttention, p, kv, x, t):
+    """One-token attention against the cache. x: [B, 1, d]; t: step."""
+    dt = jnp.dtype(attn.dtype)
+    xc = x.astype(dt)
+    q = jnp.einsum("bsd,dhe->bshe", xc, p["wq"].astype(dt))
+    k = jnp.einsum("bsd,dhe->bshe", xc, p["wk"].astype(dt))
+    v = jnp.einsum("bsd,dhe->bshe", xc, p["wv"].astype(dt))
+    if attn.use_rope:
+        pos = jnp.full((1,), t)
+        q = apply_rope(q, pos)
+        k = apply_rope(k, pos)
+    kv = {"k": lax.dynamic_update_slice_in_dim(
+              kv["k"], k.astype(kv["k"].dtype), t, axis=1),
+          "v": lax.dynamic_update_slice_in_dim(
+              kv["v"], v.astype(kv["v"].dtype), t, axis=1)}
+    scale = (attn.head_dim or q.shape[-1]) ** -0.5
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32) * scale,
+                   kv["k"].astype(jnp.float32))          # [B, H, 1, L]
+    valid = jnp.arange(kv["k"].shape[1]) <= t
+    s = jnp.where(valid[None, None, None, :], s, NEG_INF)
+    w = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", w,
+                     kv["v"].astype(jnp.float32)).astype(dt)
+    y = jnp.einsum("bshe,hed->bsd", out, p["wo"].astype(dt))
+    return y.astype(x.dtype), kv
+
+
+def _decode_block(block: TransformerBlock, p, s, kv, x, t):
+    h, _ = block.norm1.apply(p["norm1"], s["norm1"], x)
+    a, kv = _decode_attn(block.attn, p["attn"], kv, h, t)
+    x = x + a
+    h, _ = block.norm2.apply(p["norm2"], s["norm2"], x)
+    m, _ = block.mlp.apply(p["mlp"], s["mlp"], h, training=False)
+    return x + m, kv
+
+
+def decode_step(module: Sequential, params, state, cache, tok, t):
+    """One token through the stack. tok: [B] int; returns ([B, V] logits,
+    cache)."""
+    x = tok[:, None]                                     # [B, 1]
+    new_cache = list(cache)
+    for i, layer in enumerate(module.layers):
+        p, s, kv = params[i], state[i], cache[i]
+        if isinstance(layer, TransformerBlock):
+            x, new_cache[i] = _decode_block(layer, p, s, kv, x, t)
+        elif isinstance(layer, PositionalEmbedding):
+            x = x + p["embeddings"][t][None, None, :].astype(x.dtype)
+        elif isinstance(layer, Dropout):
+            pass                                         # eval: identity
+        else:
+            x, _ = layer.apply(p, s, x, training=False)
+    return x[:, 0], new_cache                            # [B, V]
+
+
+def _sample(logits, temperature, top_k, rng):
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1)
+    logits = logits.astype(jnp.float32) / temperature
+    if top_k is not None:
+        kth = lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, NEG_INF, logits)
+    return jax.random.categorical(rng, logits, axis=-1)
+
+
+def generate(model: Model, prompts, max_new_tokens: int,
+             temperature: float = 0.0, top_k: Optional[int] = None,
+             seed: int = 0, cache_dtype=jnp.float32) -> np.ndarray:
+    """Autoregressive continuation: ``[B, P]`` int prompts ->
+    ``[B, P + max_new_tokens]`` tokens. ``temperature=0`` is greedy;
+    otherwise softmax sampling (optionally top-k-truncated)."""
+    module = model.module
+    if not isinstance(module, Sequential):
+        raise TypeError("generate() expects a Sequential LM "
+                        f"(got {type(module).__name__})")
+    prompts = jnp.asarray(prompts)
+    if prompts.ndim != 2:
+        raise ValueError(f"prompts must be [B, P], got {prompts.shape}")
+    b, p_len = prompts.shape
+    total = p_len + int(max_new_tokens)
+    _resolve_head_dims(module, model.params)
+    for layer in module.layers:
+        # out-of-range position gathers CLAMP under jit (silent wrong-
+        # position logits) — fail loudly up front instead
+        if isinstance(layer, PositionalEmbedding) and total > layer.max_len:
+            raise ValueError(
+                f"PositionalEmbedding(max_len={layer.max_len}) is too "
+                f"small for prompt {p_len} + {max_new_tokens} new tokens "
+                f"= {total} positions")
+    cache = init_cache(module, b, total, cache_dtype)
+
+    tokens0 = jnp.concatenate(
+        [prompts, jnp.zeros((b, int(max_new_tokens)), prompts.dtype)],
+        axis=1)
+
+    # one compiled scan per (model, shape, sampling) configuration — cached
+    # on the Model so a serving loop pays trace+compile once, like
+    # Model.predict's cached forward
+    key = (b, p_len, int(max_new_tokens), float(temperature), top_k,
+           jnp.dtype(cache_dtype).name)
+    jit_cache = getattr(model, "_jit_generate", None)
+    if jit_cache is None:
+        jit_cache = model._jit_generate = {}
+    run = jit_cache.get(key)
+    if run is None:
+        @jax.jit
+        def run(params, state, tokens, cache, rng):
+            def body(carry, t):
+                tokens, cache, rng = carry
+                tok = lax.dynamic_slice_in_dim(tokens, t, 1, axis=1)[:, 0]
+                logits, cache = decode_step(module, params, state, cache,
+                                            tok, t)
+                rng, sub = jax.random.split(rng)
+                nxt = _sample(logits, temperature, top_k, sub)
+                # teacher-force inside the prompt; write samples after it
+                cur = lax.dynamic_slice_in_dim(tokens, t + 1, 1,
+                                               axis=1)[:, 0]
+                nxt = jnp.where(t + 1 >= p_len,
+                                nxt, cur).astype(tokens.dtype)
+                tokens = lax.dynamic_update_slice_in_dim(
+                    tokens, nxt[:, None], t + 1, axis=1)
+                return (tokens, cache, rng), None
+
+            (tokens, _, _), _ = lax.scan(body, (tokens, cache, rng),
+                                         jnp.arange(total - 1))
+            return tokens
+
+        jit_cache[key] = run
+
+    out = run(model.params, model.state, tokens0, cache,
+              jax.random.PRNGKey(seed))
+    return np.asarray(out)
