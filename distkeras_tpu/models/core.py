@@ -307,6 +307,12 @@ class Model:
             res[metric_name(m)] = float(get_metric(m)(yv, preds))
         return res
 
+    def generate(self, prompts, max_new_tokens: int, **kwargs):
+        """Keras-style convenience over ``models.decoding.generate`` (KV-
+        cache autoregressive sampling for transformer-LM-shaped models)."""
+        from distkeras_tpu.models.decoding import generate
+        return generate(self, prompts, max_new_tokens, **kwargs)
+
     # -- bookkeeping ------------------------------------------------------
     def num_params(self) -> int:
         return sum(int(np.prod(p.shape))

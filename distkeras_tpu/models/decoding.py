@@ -137,10 +137,16 @@ def _sample(logits, temperature, top_k, rng):
 
 def generate(model: Model, prompts, max_new_tokens: int,
              temperature: float = 0.0, top_k: Optional[int] = None,
-             seed: int = 0, cache_dtype=jnp.float32) -> np.ndarray:
+             seed: int = 0, cache_dtype=jnp.float32,
+             stop_token: Optional[int] = None) -> np.ndarray:
     """Autoregressive continuation: ``[B, P]`` int prompts ->
     ``[B, P + max_new_tokens]`` tokens. ``temperature=0`` is greedy;
-    otherwise softmax sampling (optionally top-k-truncated)."""
+    otherwise softmax sampling (optionally top-k-truncated).
+
+    ``stop_token``: once a sequence emits it, every later position is
+    filled with it too (the compiled scan always runs ``max_new_tokens``
+    steps — static shapes — so "stopping" is per-sequence padding, which
+    is also what makes the batch ragged-safe)."""
     module = model.module
     if not isinstance(module, Sequential):
         raise TypeError("generate() expects a Sequential LM "
@@ -169,7 +175,7 @@ def generate(model: Model, prompts, max_new_tokens: int,
     # on the Model so a serving loop pays trace+compile once, like
     # Model.predict's cached forward
     key = (b, p_len, int(max_new_tokens), float(temperature), top_k,
-           jnp.dtype(cache_dtype).name)
+           jnp.dtype(cache_dtype).name, stop_token)
     jit_cache = getattr(model, "_jit_generate", None)
     if jit_cache is None:
         jit_cache = model._jit_generate = {}
@@ -177,24 +183,30 @@ def generate(model: Model, prompts, max_new_tokens: int,
     if run is None:
         @jax.jit
         def run(params, state, tokens, cache, rng):
+            done0 = jnp.zeros((b,), bool)
+
             def body(carry, t):
-                tokens, cache, rng = carry
+                tokens, cache, rng, done = carry
                 tok = lax.dynamic_slice_in_dim(tokens, t, 1, axis=1)[:, 0]
                 logits, cache = decode_step(module, params, state, cache,
                                             tok, t)
                 rng, sub = jax.random.split(rng)
                 nxt = _sample(logits, temperature, top_k, sub)
+                if stop_token is not None:
+                    nxt = jnp.where(done, stop_token, nxt)
                 # teacher-force inside the prompt; write samples after it
                 cur = lax.dynamic_slice_in_dim(tokens, t + 1, 1,
                                                axis=1)[:, 0]
-                nxt = jnp.where(t + 1 >= p_len,
-                                nxt, cur).astype(tokens.dtype)
+                in_prompt = t + 1 < p_len
+                nxt = jnp.where(in_prompt, cur, nxt).astype(tokens.dtype)
+                if stop_token is not None:
+                    done = done | (~in_prompt & (nxt == stop_token))
                 tokens = lax.dynamic_update_slice_in_dim(
                     tokens, nxt[:, None], t + 1, axis=1)
-                return (tokens, cache, rng), None
+                return (tokens, cache, rng, done), None
 
-            (tokens, _, _), _ = lax.scan(body, (tokens, cache, rng),
-                                         jnp.arange(total - 1))
+            (tokens, _, _, _), _ = lax.scan(
+                body, (tokens, cache, rng, done0), jnp.arange(total - 1))
             return tokens
 
         jit_cache[key] = run

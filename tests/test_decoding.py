@@ -44,20 +44,28 @@ def test_decode_step_matches_full_forward(use_rope, moe):
                                atol=2e-4)
 
 
-def test_generate_continues_memorized_sequence():
-    """Overfit a tiny LM on one repeating sequence; greedy generate must
-    reproduce it from a prefix."""
-    pattern = np.array([3, 1, 4, 1, 5, 9, 2, 6, 5, 3, 5, 8])
-    X = np.tile(pattern, (256, 1))
+PATTERN = np.array([3, 1, 4, 1, 5, 9, 2, 6, 5, 3, 5, 8])
+
+
+@pytest.fixture(scope="module")
+def memorized_lm():
+    """A tiny LM overfit on one repeating sequence — greedy decode then
+    has huge argmax margins, so token-level assertions are robust."""
+    X = np.tile(PATTERN, (256, 1))
     m = lm(seed=2)
     m.fit(X[:, :-1], X[:, 1:], optimizer="adam", learning_rate=5e-3,
           batch_size=64, epochs=30,
           loss="sparse_categorical_crossentropy_from_logits")
+    return m
 
-    out = generate(m, X[:2, :4], max_new_tokens=7, temperature=0.0)
+
+def test_generate_continues_memorized_sequence(memorized_lm):
+    prompts = np.tile(PATTERN[:4], (2, 1))
+    out = generate(memorized_lm, prompts, max_new_tokens=7,
+                   temperature=0.0)
     assert out.shape == (2, 11)
-    np.testing.assert_array_equal(out[0], pattern[:11])
-    np.testing.assert_array_equal(out[1], pattern[:11])
+    np.testing.assert_array_equal(out[0], PATTERN[:11])
+    np.testing.assert_array_equal(out[1], PATTERN[:11])
 
 
 def test_generate_sampling_and_validation():
@@ -77,30 +85,44 @@ def test_generate_sampling_and_validation():
         generate(m, np.array([1, 2, 3]), max_new_tokens=2)
 
 
+def test_generate_stop_token_pads_tail(memorized_lm):
+    """After a sequence emits stop_token, every later slot is stop_token;
+    the overfit LM emits the pattern, so making one of its tokens the stop
+    token truncates deterministically."""
+    out = memorized_lm.generate(PATTERN[None, :4], max_new_tokens=7,
+                                temperature=0.0, stop_token=9)
+    np.testing.assert_array_equal(out[0, :6], PATTERN[:6])  # ...,5,9
+    np.testing.assert_array_equal(out[0, 6:], np.full(5, 9))  # padded
+
+
 def test_generate_rejects_positions_beyond_table():
     m = lm(use_rope=False)  # PositionalEmbedding(max_len=64)
     with pytest.raises(ValueError, match="too\\s+small"):
         generate(m, np.zeros((1, 60), np.int32), max_new_tokens=10)
 
 
-def test_generate_with_tp_sharded_params():
+def test_generate_with_tp_sharded_params(memorized_lm):
     """Generation under tensor parallelism: shard the params with Megatron
-    specs and let GSPMD partition the decode scan — numerics must match
-    the replicated run (logits within reduction-reorder tolerance; exact
-    token equality would flake on argmax near-ties)."""
+    specs and let GSPMD partition the decode scan.
+
+    Two layers of coverage: (a) per-step logits match the replicated run
+    within reduction-reorder tolerance on an untrained model; (b) the
+    FULL compiled generate scan reproduces the memorized pattern
+    token-for-token when sharded (the overfit model's argmax margins are
+    huge, so exact token equality is robust)."""
     from distkeras_tpu.models.decoding import (_resolve_head_dims,
                                                decode_step, init_cache)
     from distkeras_tpu.parallel.mesh import make_mesh_2d
     from distkeras_tpu.parallel.sharding import param_specs, shard_params
 
+    mesh = make_mesh_2d({"workers": 2, "tp": 4})
+
+    # (a) stepwise logits, untrained model
     m = lm(seed=4)
     prompts = np.array([[1, 2, 3, 4], [5, 6, 7, 8]])
     _resolve_head_dims(m.module, m.params)
-
-    mesh = make_mesh_2d({"workers": 2, "tp": 4})
     specs = param_specs(m.module, m.params, mesh, tp_axis="tp")
     sharded = shard_params(m.params, specs, mesh)
-
     cache_r = init_cache(m.module, 2, 4)
     cache_s = init_cache(m.module, 2, 4)
     for t in range(4):
@@ -111,11 +133,14 @@ def test_generate_with_tp_sharded_params():
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    rtol=1e-4, atol=1e-5)
 
-    # and the full generate scan runs under the sharded placement
-    m2 = Model(m.module, sharded, m.state, m.input_shape, m.output_shape)
-    toks = generate(m2, prompts, max_new_tokens=5, temperature=0.0)
-    assert toks.shape == (2, 9)
-    np.testing.assert_array_equal(toks[:, :4], prompts)
+    # (b) full compiled scan, sharded, end-to-end token equality
+    mm = memorized_lm
+    specs = param_specs(mm.module, mm.params, mesh, tp_axis="tp")
+    m2 = Model(mm.module, shard_params(mm.params, specs, mesh), mm.state,
+               mm.input_shape, mm.output_shape)
+    toks = generate(m2, PATTERN[None, :4], max_new_tokens=7,
+                    temperature=0.0)
+    np.testing.assert_array_equal(toks[0], PATTERN[:11])
 
 
 def test_generate_jit_cached_across_calls():
