@@ -7,6 +7,6 @@ from distkeras_tpu.data.sharded import ShardedDataset  # noqa: F401
 from distkeras_tpu.data.transformers import (  # noqa: F401
     DenseTransformer, LabelIndexTransformer, MinMaxTransformer,
     HashingTransformer, OneHotTransformer, ReshapeTransformer,
-    StandardScaleTransformer,
-    Transformer)
+    StandardScaleTransformer, StringIndexerTransformer,
+    Transformer, VectorAssemblerTransformer)
 from distkeras_tpu.data import native  # noqa: F401

@@ -241,3 +241,84 @@ class HashingTransformer(Transformer):
                 buckets = uh[inverse.reshape(-1)]
             out[rows, buckets] = 1.0
         return dataset.with_column(self.output_col, out)
+
+
+class StringIndexerTransformer(Transformer):
+    """String/categorical column -> integer index column.
+
+    Reference parity: the examples' Spark-ML ``StringIndexer`` stage
+    (SURVEY §2.2 — the MNIST/ATLAS workflows run StringIndexer before
+    training). Spark semantics kept: indices are assigned by DESCENDING
+    frequency (ties broken lexically), so index 0 is the most common
+    value. Fit on the training data via ``fit`` (or lazily on first
+    transform), then reuse on serve data; unseen values raise by default
+    (``handle_invalid="error"``) or get index ``len(labels_)``
+    (``"keep"``) — two of Spark's three modes (``"skip"``, which DROPS
+    rows, is deliberately unsupported: silent row loss).
+    """
+
+    def __init__(self, input_col: str, output_col: Optional[str] = None,
+                 handle_invalid: str = "error"):
+        if handle_invalid not in ("error", "keep"):
+            raise ValueError(
+                f"handle_invalid must be 'error' or 'keep', "
+                f"got {handle_invalid!r}")
+        self.input_col = input_col
+        self.output_col = output_col or f"{input_col}_index"
+        self.handle_invalid = handle_invalid
+        self.labels_ = None  # fitted vocabulary, most-frequent first
+
+    def fit(self, dataset: Dataset) -> "StringIndexerTransformer":
+        values = np.asarray(dataset[self.input_col])
+        uniq, counts = np.unique(values, return_counts=True)
+        # descending count, ascending value on ties (np.unique pre-sorts
+        # values, and stable argsort on -counts preserves that order)
+        order = np.argsort(-counts, kind="stable")
+        self.labels_ = uniq[order]
+        self._index = {v: i for i, v in enumerate(self.labels_)}
+        return self
+
+    def transform(self, dataset: Dataset) -> Dataset:
+        if self.labels_ is None:
+            self.fit(dataset)
+        values = np.asarray(dataset[self.input_col])
+        unseen = len(self.labels_)
+        # map each DISTINCT value once (categoricals repeat heavily), then
+        # spread via the inverse — same O(n_unique) pattern as Hashing
+        uniq, inverse = np.unique(values, return_inverse=True)
+        lut = np.fromiter((self._index.get(v, unseen) for v in uniq),
+                          dtype=np.int64, count=len(uniq))
+        out = lut[inverse.reshape(-1)]
+        if self.handle_invalid == "error" and (out == unseen).any():
+            bad = sorted({str(v) for v in values[out == unseen]})[:5]
+            raise ValueError(
+                f"StringIndexer({self.input_col!r}) saw unseen values "
+                f"{bad}; fit on data covering them or use "
+                "handle_invalid='keep'")
+        return dataset.with_column(self.output_col, out)
+
+
+class VectorAssemblerTransformer(Transformer):
+    """Concatenate feature columns into one flat feature matrix.
+
+    Reference parity: the examples' Spark-ML ``VectorAssembler`` stage
+    (SURVEY §2.2) — the step that builds the ``features_col`` every
+    trainer consumes. Scalars become width-1 columns; multi-dim columns
+    are flattened per row; all inputs are cast to float32.
+    """
+
+    def __init__(self, input_cols: Sequence[str],
+                 output_col: str = "features"):
+        if not input_cols:
+            raise ValueError("VectorAssembler needs at least one input_col")
+        self.input_cols = list(input_cols)
+        self.output_col = output_col
+
+    def transform(self, dataset: Dataset) -> Dataset:
+        n = len(dataset)
+        parts = []
+        for col in self.input_cols:
+            v = np.asarray(dataset[col], dtype=np.float32)
+            parts.append(v.reshape(n, -1))
+        return dataset.with_column(self.output_col,
+                                   np.concatenate(parts, axis=1))

@@ -27,14 +27,14 @@ def make_synthetic_criteo(n: int = 16384, wide_dim: int = 64,
     w_true = rs.randn(wide_dim) * 2.0
     h = wide @ w_true + np.tanh(deep[:, :4]).sum(-1) + 0.3 * rs.randn(n)
     y = (h > np.median(h)).astype(np.int64)
-    X = np.concatenate([wide, deep], axis=1)
-    return X, y
+    return wide, deep, y
 
 
 def main():
     import jax
 
-    from distkeras_tpu.data import Dataset, LabelIndexTransformer
+    from distkeras_tpu.data import (Dataset, LabelIndexTransformer,
+                                    VectorAssemblerTransformer)
     from distkeras_tpu.inference import AccuracyEvaluator, Evaluator, \
         ModelPredictor
     from distkeras_tpu.models import Model
@@ -42,8 +42,11 @@ def main():
     from distkeras_tpu.parallel import DOWNPOUR
 
     WIDE, DEEP = 64, 16
-    X, y = make_synthetic_criteo(wide_dim=WIDE, deep_dim=DEEP)
-    ds = Dataset({"features": X, "label": y})
+    wide, deep, y = make_synthetic_criteo(wide_dim=WIDE, deep_dim=DEEP)
+    # Spark-ML-style assembly: the VectorAssembler stage builds the
+    # features_col every trainer consumes (SURVEY §2.2)
+    ds = VectorAssemblerTransformer(["wide", "deep"])(
+        Dataset({"wide": wide, "deep": deep, "label": y}))
 
     model = Model.build(
         WideAndDeep(wide_dim=WIDE, deep_hidden=(64, 32), num_classes=2),

@@ -164,6 +164,51 @@ def test_hashing_transformer_stable_multi_hot():
         HashingTransformer(0, ["cat_a"])
 
 
+def test_string_indexer_spark_semantics():
+    from distkeras_tpu.data import Dataset, StringIndexerTransformer
+    ds = Dataset({"cat": np.array(["b", "a", "b", "c", "b", "a"]),
+                  "label": np.zeros(6)})
+    t = StringIndexerTransformer("cat")
+    out = t(ds)
+    # frequency desc: b(3)=0, a(2)=1, c(1)=2
+    np.testing.assert_array_equal(out["cat_index"], [0, 1, 0, 2, 0, 1])
+    assert list(t.labels_) == ["b", "a", "c"]
+
+    # fitted transformer reused on serve data
+    serve = Dataset({"cat": np.array(["c", "a"]), "label": np.zeros(2)})
+    np.testing.assert_array_equal(t(serve)["cat_index"], [2, 1])
+
+    # unseen values: error by default, 'keep' assigns the overflow index
+    bad = Dataset({"cat": np.array(["zz"]), "label": np.zeros(1)})
+    with pytest.raises(ValueError, match="unseen"):
+        t(bad)
+    tk = StringIndexerTransformer("cat", handle_invalid="keep").fit(ds)
+    np.testing.assert_array_equal(tk(bad)["cat_index"], [3])
+
+    # frequency ties break lexically (Spark order)
+    tie = Dataset({"cat": np.array(["y", "x"]), "label": np.zeros(2)})
+    tt = StringIndexerTransformer("cat").fit(tie)
+    assert list(tt.labels_) == ["x", "y"]
+
+    with pytest.raises(ValueError, match="handle_invalid"):
+        StringIndexerTransformer("cat", handle_invalid="skip")
+
+
+def test_vector_assembler_concats_and_flattens():
+    from distkeras_tpu.data import Dataset, VectorAssemblerTransformer
+    ds = Dataset({"a": np.array([1.0, 2.0]),             # scalar col
+                  "b": np.array([[3, 4], [5, 6]]),       # vector col
+                  "c": np.arange(8).reshape(2, 2, 2),    # matrix col
+                  "label": np.zeros(2)})
+    out = VectorAssemblerTransformer(["a", "b", "c"])(ds)
+    feats = out["features"]
+    assert feats.shape == (2, 7) and feats.dtype == np.float32
+    np.testing.assert_array_equal(feats[0], [1, 3, 4, 0, 1, 2, 3])
+    np.testing.assert_array_equal(feats[1], [2, 5, 6, 4, 5, 6, 7])
+    with pytest.raises(ValueError, match="input_col"):
+        VectorAssemblerTransformer([])
+
+
 def test_hashing_transformer_multidim_and_object_columns():
     from distkeras_tpu.data import Dataset, HashingTransformer
 
