@@ -270,6 +270,11 @@ class StringIndexerTransformer(Transformer):
 
     def fit(self, dataset: Dataset) -> "StringIndexerTransformer":
         values = np.asarray(dataset[self.input_col])
+        if values.ndim != 1:
+            raise ValueError(
+                f"StringIndexer expects a 1-D categorical column; "
+                f"{self.input_col!r} has shape {values.shape} (index each "
+                "sub-column separately)")
         uniq, counts = np.unique(values, return_counts=True)
         # descending count, ascending value on ties (np.unique pre-sorts
         # values, and stable argsort on -counts preserves that order)
@@ -282,6 +287,10 @@ class StringIndexerTransformer(Transformer):
         if self.labels_ is None:
             self.fit(dataset)
         values = np.asarray(dataset[self.input_col])
+        if values.ndim != 1:
+            raise ValueError(
+                f"StringIndexer expects a 1-D categorical column; "
+                f"{self.input_col!r} has shape {values.shape}")
         unseen = len(self.labels_)
         # map each DISTINCT value once (categoricals repeat heavily), then
         # spread via the inverse — same O(n_unique) pattern as Hashing

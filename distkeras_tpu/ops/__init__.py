@@ -13,7 +13,8 @@ def __getattr__(name):
         from distkeras_tpu.ops.flash_attention import flash_attention
         return flash_attention
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
-from distkeras_tpu.ops.losses import LOSSES, get_loss  # noqa: F401
+from distkeras_tpu.ops.losses import (  # noqa: F401
+    LOSSES, get_loss, with_class_weight)
 from distkeras_tpu.ops.metrics import METRICS, get_metric  # noqa: F401
 from distkeras_tpu.ops.optimizers import (  # noqa: F401
     OPTIMIZERS, Optimizer, apply_updates, get_optimizer)

@@ -110,3 +110,110 @@ def get_loss(loss: Union[str, LossFn]) -> LossFn:
         return LOSSES[loss]
     except KeyError:
         raise ValueError(f"Unknown loss {loss!r}; known: {sorted(LOSSES)}")
+
+
+# ---------------------------------------------------------------------------
+# class weighting (Keras ``class_weight`` semantics)
+# ---------------------------------------------------------------------------
+# per-sample forms of the CLASSIFICATION losses: (y_true, y_pred) ->
+# (loss_per_sample, class_index_per_sample); shapes follow y_true's batch
+# dims ([B] or [B, S] for token-level models)
+
+def _ps_categorical(y_true, y_pred):
+    p = jnp.clip(y_pred.astype(jnp.float32), EPS, 1.0 - EPS)
+    ls = -jnp.sum(y_true.astype(jnp.float32) * jnp.log(p), axis=-1)
+    return ls, jnp.argmax(y_true, axis=-1)
+
+
+def _ps_categorical_logits(y_true, y_pred):
+    logp = jax.nn.log_softmax(y_pred.astype(jnp.float32), axis=-1)
+    ls = -jnp.sum(y_true.astype(jnp.float32) * logp, axis=-1)
+    return ls, jnp.argmax(y_true, axis=-1)
+
+
+def _ps_sparse(y_true, y_pred):
+    cls = y_true.astype(jnp.int32)
+    p = jnp.clip(y_pred.astype(jnp.float32), EPS, 1.0 - EPS)
+    ls = -jnp.take_along_axis(jnp.log(p), cls[..., None], axis=-1)[..., 0]
+    return ls, cls
+
+
+def _ps_sparse_logits(y_true, y_pred):
+    cls = y_true.astype(jnp.int32)
+    logp = jax.nn.log_softmax(y_pred.astype(jnp.float32), axis=-1)
+    ls = -jnp.take_along_axis(logp, cls[..., None], axis=-1)[..., 0]
+    return ls, cls
+
+
+def _ps_binary(y_true, y_pred):
+    t = y_true.astype(jnp.float32)
+    p = jnp.clip(y_pred.astype(jnp.float32).reshape(t.shape), EPS, 1.0 - EPS)
+    ls = -(t * jnp.log(p) + (1.0 - t) * jnp.log(1.0 - p))
+    return ls, t.astype(jnp.int32)
+
+
+def _ps_binary_logits(y_true, y_pred):
+    t = y_true.astype(jnp.float32)
+    x = y_pred.astype(jnp.float32).reshape(t.shape)
+    ls = jnp.maximum(x, 0) - x * t + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    return ls, t.astype(jnp.int32)
+
+
+_PER_SAMPLE = {
+    "categorical_crossentropy": _ps_categorical,
+    "categorical_crossentropy_from_logits": _ps_categorical_logits,
+    "sparse_categorical_crossentropy": _ps_sparse,
+    "sparse_categorical_crossentropy_from_logits": _ps_sparse_logits,
+    "binary_crossentropy": _ps_binary,
+    "binary_crossentropy_from_logits": _ps_binary_logits,
+}
+
+
+def with_class_weight(loss: Union[str, LossFn], class_weight) -> LossFn:
+    """Keras ``class_weight`` semantics: each sample's loss is scaled by
+    the weight of its TRUE class, then mean-reduced. Exposed on every
+    trainer and ``model.fit`` as ``class_weight={class: weight}`` (or a
+    dense weight array indexed by class).
+
+    Classification losses only — the loss must be one of the registry
+    NAMES in ``_PER_SAMPLE`` (a custom callable has no per-sample form to
+    weight)."""
+    if not isinstance(loss, str) or loss not in _PER_SAMPLE:
+        raise ValueError(
+            f"class_weight needs a classification loss name, one of "
+            f"{sorted(_PER_SAMPLE)}; got {loss!r}")
+    import numpy as np
+    if isinstance(class_weight, dict):
+        idx = np.asarray([int(k) for k in class_weight], np.int32)
+        vals = np.asarray([float(class_weight[k]) for k in class_weight],
+                          np.float32)
+        if (idx < 0).any():
+            raise ValueError(f"negative class in class_weight: {idx.min()}")
+        dense = None
+    else:
+        dense = np.asarray(class_weight, np.float32)
+    per_sample = _PER_SAMPLE[loss]
+    binary = loss.startswith("binary")
+
+    def fn(y_true, y_pred):
+        ls, cls = per_sample(y_true, y_pred)
+        # size the table from the STATIC class count so an out-of-table
+        # class can never silently clamp onto a neighbor's weight
+        # (unlisted dict classes default to 1.0, Keras-style)
+        n = 2 if binary else y_pred.shape[-1]
+        if dense is not None:
+            if len(dense) != n:
+                raise ValueError(
+                    f"class_weight array has {len(dense)} entries but the "
+                    f"loss sees {n} classes")
+            tbl = jnp.asarray(dense)
+        else:
+            if idx.size and idx.max() >= n:
+                raise ValueError(
+                    f"class_weight has class {idx.max()} but the loss "
+                    f"sees only {n} classes")
+            tbl = jnp.ones((n,), jnp.float32).at[idx].set(vals)
+        return jnp.mean(ls * tbl[cls])
+
+    fn.__name__ = f"{loss}_class_weighted"
+    return fn

@@ -53,7 +53,8 @@ class Trainer:
                  grad_accum_steps: int = 1,
                  validation_data=None,
                  callbacks: Optional[Sequence] = None,
-                 clip_grad_norm: Optional[float] = None):
+                 clip_grad_norm: Optional[float] = None,
+                 class_weight: Optional[dict] = None):
         self.master_model = keras_model
         opt_kwargs = dict(optimizer_kwargs or {})
         if learning_rate is not None and not isinstance(worker_optimizer,
@@ -66,7 +67,17 @@ class Trainer:
             from distkeras_tpu.ops.optimizers import clip_by_global_norm
             self.worker_optimizer = clip_by_global_norm(
                 self.worker_optimizer, clip_grad_norm)
-        self.loss = get_loss(loss)
+        # eval_loss stays UNWEIGHTED (Keras semantics: class_weight shapes
+        # the TRAINING objective only — val_loss must remain comparable
+        # across weighted and unweighted runs)
+        self.eval_loss = get_loss(loss)
+        if class_weight is not None:
+            # Keras class_weight: per-sample losses scaled by the true
+            # class's weight (pure loss wrapper — every trainer inherits)
+            from distkeras_tpu.ops.losses import with_class_weight
+            self.loss = with_class_weight(loss, class_weight)
+        else:
+            self.loss = self.eval_loss
         self.metrics = metrics or []
         self.features_col = features_col
         self.label_col = label_col
@@ -274,7 +285,7 @@ class Trainer:
         if val is None:
             return None
         Xv, yv = val
-        loss_fn = self.loss
+        loss_fn = self.eval_loss  # unweighted even under class_weight
         metric_fns = self._metric_fns() or {}
 
         # the arrays are jit ARGUMENTS (not closure captures) so the whole

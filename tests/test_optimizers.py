@@ -171,6 +171,106 @@ def test_losses_scalar_and_nonnegative(loss_name):
     assert float(val) >= -1e-6
 
 
+def test_class_weight_math_and_identity():
+    from distkeras_tpu.ops import with_class_weight
+    logits = jax.random.normal(jax.random.PRNGKey(2), (6, 3))
+    y = jnp.array([0, 1, 2, 0, 1, 2])
+    base = get_loss("sparse_categorical_crossentropy_from_logits")
+    # all-ones weights == unweighted
+    w1 = with_class_weight("sparse_categorical_crossentropy_from_logits",
+                           {0: 1.0, 1: 1.0, 2: 1.0})
+    np.testing.assert_allclose(float(w1(y, logits)),
+                               float(base(y, logits)), rtol=1e-6)
+    # manual check: per-sample ce scaled by the true class's weight
+    wfn = with_class_weight("sparse_categorical_crossentropy_from_logits",
+                            {0: 1.0, 1: 5.0, 2: 0.5})
+    logp = np.asarray(jax.nn.log_softmax(logits, axis=-1))
+    per = -logp[np.arange(6), np.asarray(y)]
+    expect = (per * np.array([1.0, 5.0, 0.5, 1.0, 5.0, 0.5])).mean()
+    np.testing.assert_allclose(float(wfn(y, logits)), expect, rtol=1e-5)
+    # binary + dense-array form
+    wb = with_class_weight("binary_crossentropy_from_logits",
+                           np.array([1.0, 3.0]))
+    x = jnp.array([0.5, -0.5])
+    t = jnp.array([1, 0])
+    per_b = np.log1p(np.exp(-np.abs(np.asarray(x)))) + \
+        np.maximum(np.asarray(x), 0) - np.asarray(x) * np.asarray(t)
+    np.testing.assert_allclose(float(wb(t, x)),
+                               (per_b * np.array([3.0, 1.0])).mean(),
+                               rtol=1e-5)
+    with pytest.raises(ValueError, match="classification"):
+        with_class_weight("mse", {0: 1.0})
+    # classes missing from the dict default to weight 1.0 (Keras-style),
+    # never clamp onto a neighbor's weight
+    w_partial = with_class_weight(
+        "sparse_categorical_crossentropy_from_logits", {1: 5.0})
+    per3 = -logp[np.arange(6), np.asarray(y)]
+    exp3 = (per3 * np.array([1.0, 5.0, 1.0, 1.0, 5.0, 1.0])).mean()
+    np.testing.assert_allclose(float(w_partial(y, logits)), exp3,
+                               rtol=1e-5)
+    # a weight for a class the loss can't see fails loudly at trace time
+    w_over = with_class_weight(
+        "sparse_categorical_crossentropy_from_logits", {7: 2.0})
+    with pytest.raises(ValueError, match="only 3 classes"):
+        w_over(y, logits)
+    with pytest.raises(ValueError, match="3 entries"):
+        with_class_weight("sparse_categorical_crossentropy_from_logits",
+                          np.ones(3))(y, logits[:, :2])
+
+
+def test_class_weight_leaves_val_loss_unweighted():
+    from distkeras_tpu.data import Dataset
+    from distkeras_tpu.models import Dense, Model, Sequential
+    from distkeras_tpu.parallel import SingleTrainer
+    rs = np.random.RandomState(3)
+    X = rs.randn(128, 4).astype(np.float32)
+    y = rs.randint(0, 2, 128)
+    ds = Dataset({"features": X, "label": y})
+    kw = dict(worker_optimizer="sgd", learning_rate=0.0, batch_size=64,
+              num_epoch=1, shuffle_each_epoch=False,
+              loss="sparse_categorical_crossentropy_from_logits",
+              validation_data=(X, y))
+    m = Model.build(Sequential([Dense(2)]), (4,), seed=5)
+    t0 = SingleTrainer(m, **kw)
+    t0.train(ds)
+    m2 = Model.build(Sequential([Dense(2)]), (4,), seed=5)
+    t1 = SingleTrainer(m2, class_weight={0: 1.0, 1: 10.0}, **kw)
+    t1.train(ds)
+    # lr=0: same params throughout; TRAIN loss differs, VAL loss must not
+    assert t1.get_history().losses()[0] > 2 * t0.get_history().losses()[0]
+    np.testing.assert_allclose(t1.get_history().metric("val_loss"),
+                               t0.get_history().metric("val_loss"),
+                               rtol=1e-6)
+
+
+def test_trainer_class_weight_shifts_decisions():
+    """10x weight on the rare class must raise its recall vs unweighted
+    on an imbalanced problem."""
+    from distkeras_tpu.data import Dataset
+    from distkeras_tpu.models import Dense, Model, Sequential
+    from distkeras_tpu.parallel import SingleTrainer
+    rs = np.random.RandomState(0)
+    n = 2048
+    y = (rs.rand(n) < 0.15).astype(np.int32)    # 15% positives
+    # heavy class overlap: the OPTIMAL boundary depends on the weighting
+    X = (rs.randn(n, 2) + y[:, None] * 0.8).astype(np.float32)
+    ds = Dataset({"features": X, "label": y})
+
+    def recall(model):
+        pred = model.predict(X).argmax(-1)
+        return (pred[y == 1] == 1).mean()
+
+    kw = dict(worker_optimizer="sgd", learning_rate=0.1, batch_size=128,
+              num_epoch=20,
+              loss="sparse_categorical_crossentropy_from_logits")
+    m0 = Model.build(Sequential([Dense(2)]), (2,), seed=1)
+    t0 = SingleTrainer(m0, **kw).train(ds)
+    m1 = Model.build(Sequential([Dense(2)]), (2,), seed=1)
+    t1 = SingleTrainer(m1, class_weight={0: 1.0, 1: 10.0},
+                       **kw).train(ds)
+    assert recall(t1) > recall(t0) + 0.1, (recall(t0), recall(t1))
+
+
 def test_crossentropy_from_logits_matches_probs():
     logits = jax.random.normal(jax.random.PRNGKey(0), (5, 7))
     y = jax.nn.one_hot(jnp.arange(5) % 7, 7)
