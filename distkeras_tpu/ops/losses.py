@@ -23,101 +23,11 @@ EPS = 1e-7
 LossFn = Callable[[jnp.ndarray, jnp.ndarray], jnp.ndarray]
 
 
-def mean_squared_error(y_true, y_pred):
-    return jnp.mean(jnp.square(y_pred.astype(jnp.float32) -
-                               y_true.astype(jnp.float32)))
-
-
-def mean_absolute_error(y_true, y_pred):
-    return jnp.mean(jnp.abs(y_pred.astype(jnp.float32) -
-                            y_true.astype(jnp.float32)))
-
-
-def categorical_crossentropy(y_true, y_pred):
-    """One-hot targets vs probability outputs (post-softmax), Keras-style."""
-    p = jnp.clip(y_pred.astype(jnp.float32), EPS, 1.0 - EPS)
-    return -jnp.mean(jnp.sum(y_true.astype(jnp.float32) * jnp.log(p),
-                             axis=-1))
-
-
-def categorical_crossentropy_from_logits(y_true, y_pred):
-    """One-hot targets vs raw logits — the numerically preferred TPU path
-    (fuses log_softmax into the loss; avoids a softmax round-trip)."""
-    logp = jax.nn.log_softmax(y_pred.astype(jnp.float32), axis=-1)
-    return -jnp.mean(jnp.sum(y_true.astype(jnp.float32) * logp, axis=-1))
-
-
-def sparse_categorical_crossentropy(y_true, y_pred):
-    """Integer targets vs probability outputs."""
-    p = jnp.clip(y_pred.astype(jnp.float32), EPS, 1.0 - EPS)
-    logp = jnp.log(p)
-    picked = jnp.take_along_axis(
-        logp, y_true.astype(jnp.int32)[..., None], axis=-1)[..., 0]
-    return -jnp.mean(picked)
-
-
-def sparse_categorical_crossentropy_from_logits(y_true, y_pred):
-    logp = jax.nn.log_softmax(y_pred.astype(jnp.float32), axis=-1)
-    picked = jnp.take_along_axis(
-        logp, y_true.astype(jnp.int32)[..., None], axis=-1)[..., 0]
-    return -jnp.mean(picked)
-
-
-def binary_crossentropy(y_true, y_pred):
-    p = jnp.clip(y_pred.astype(jnp.float32), EPS, 1.0 - EPS)
-    t = y_true.astype(jnp.float32)
-    return -jnp.mean(t * jnp.log(p) + (1.0 - t) * jnp.log(1.0 - p))
-
-
-def binary_crossentropy_from_logits(y_true, y_pred):
-    x = y_pred.astype(jnp.float32)
-    t = y_true.astype(jnp.float32)
-    # stable formulation: max(x,0) - x*t + log(1+exp(-|x|))
-    return jnp.mean(jnp.maximum(x, 0) - x * t +
-                    jnp.log1p(jnp.exp(-jnp.abs(x))))
-
-
-def hinge(y_true, y_pred):
-    t = y_true.astype(jnp.float32)
-    # Keras-compatible: 0/1 binary labels are converted to -1/+1 (traced-safe
-    # via a scalar select, no Python control flow).
-    is_binary = jnp.all((t == 0.0) | (t == 1.0))
-    t = jnp.where(is_binary, 2.0 * t - 1.0, t)
-    return jnp.mean(jnp.maximum(0.0, 1.0 - t * y_pred.astype(jnp.float32)))
-
-
-LOSSES = {
-    "mse": mean_squared_error,
-    "mean_squared_error": mean_squared_error,
-    "mae": mean_absolute_error,
-    "mean_absolute_error": mean_absolute_error,
-    "categorical_crossentropy": categorical_crossentropy,
-    "categorical_crossentropy_from_logits":
-        categorical_crossentropy_from_logits,
-    "sparse_categorical_crossentropy": sparse_categorical_crossentropy,
-    "sparse_categorical_crossentropy_from_logits":
-        sparse_categorical_crossentropy_from_logits,
-    "binary_crossentropy": binary_crossentropy,
-    "binary_crossentropy_from_logits": binary_crossentropy_from_logits,
-    "hinge": hinge,
-}
-
-
-def get_loss(loss: Union[str, LossFn]) -> LossFn:
-    if callable(loss):
-        return loss
-    try:
-        return LOSSES[loss]
-    except KeyError:
-        raise ValueError(f"Unknown loss {loss!r}; known: {sorted(LOSSES)}")
-
-
-# ---------------------------------------------------------------------------
-# class weighting (Keras ``class_weight`` semantics)
-# ---------------------------------------------------------------------------
-# per-sample forms of the CLASSIFICATION losses: (y_true, y_pred) ->
-# (loss_per_sample, class_index_per_sample); shapes follow y_true's batch
-# dims ([B] or [B, S] for token-level models)
+# per-sample forms of the classification losses: (y_true, y_pred) ->
+# (loss_per_sample, class_index_per_sample); batch dims follow y_true
+# ([B] or [B, S] for token-level models). The registry's mean losses
+# are defined from these so each formula lives exactly ONCE (the
+# class_weight wrapper below reuses the same forms).
 
 def _ps_categorical(y_true, y_pred):
     p = jnp.clip(y_pred.astype(jnp.float32), EPS, 1.0 - EPS)
@@ -159,6 +69,82 @@ def _ps_binary_logits(y_true, y_pred):
     return ls, t.astype(jnp.int32)
 
 
+def mean_squared_error(y_true, y_pred):
+    return jnp.mean(jnp.square(y_pred.astype(jnp.float32) -
+                               y_true.astype(jnp.float32)))
+
+
+def mean_absolute_error(y_true, y_pred):
+    return jnp.mean(jnp.abs(y_pred.astype(jnp.float32) -
+                            y_true.astype(jnp.float32)))
+
+
+def categorical_crossentropy(y_true, y_pred):
+    """One-hot targets vs probability outputs (post-softmax), Keras-style."""
+    return jnp.mean(_ps_categorical(y_true, y_pred)[0])
+
+
+def categorical_crossentropy_from_logits(y_true, y_pred):
+    """One-hot targets vs raw logits — the numerically preferred TPU path
+    (fuses log_softmax into the loss; avoids a softmax round-trip)."""
+    return jnp.mean(_ps_categorical_logits(y_true, y_pred)[0])
+
+
+def sparse_categorical_crossentropy(y_true, y_pred):
+    """Integer targets vs probability outputs."""
+    return jnp.mean(_ps_sparse(y_true, y_pred)[0])
+
+
+def sparse_categorical_crossentropy_from_logits(y_true, y_pred):
+    return jnp.mean(_ps_sparse_logits(y_true, y_pred)[0])
+
+
+def binary_crossentropy(y_true, y_pred):
+    return jnp.mean(_ps_binary(y_true, y_pred)[0])
+
+
+def binary_crossentropy_from_logits(y_true, y_pred):
+    return jnp.mean(_ps_binary_logits(y_true, y_pred)[0])
+
+
+def hinge(y_true, y_pred):
+    t = y_true.astype(jnp.float32)
+    # Keras-compatible: 0/1 binary labels are converted to -1/+1 (traced-safe
+    # via a scalar select, no Python control flow).
+    is_binary = jnp.all((t == 0.0) | (t == 1.0))
+    t = jnp.where(is_binary, 2.0 * t - 1.0, t)
+    return jnp.mean(jnp.maximum(0.0, 1.0 - t * y_pred.astype(jnp.float32)))
+
+
+LOSSES = {
+    "mse": mean_squared_error,
+    "mean_squared_error": mean_squared_error,
+    "mae": mean_absolute_error,
+    "mean_absolute_error": mean_absolute_error,
+    "categorical_crossentropy": categorical_crossentropy,
+    "categorical_crossentropy_from_logits":
+        categorical_crossentropy_from_logits,
+    "sparse_categorical_crossentropy": sparse_categorical_crossentropy,
+    "sparse_categorical_crossentropy_from_logits":
+        sparse_categorical_crossentropy_from_logits,
+    "binary_crossentropy": binary_crossentropy,
+    "binary_crossentropy_from_logits": binary_crossentropy_from_logits,
+    "hinge": hinge,
+}
+
+
+def get_loss(loss: Union[str, LossFn]) -> LossFn:
+    if callable(loss):
+        return loss
+    try:
+        return LOSSES[loss]
+    except KeyError:
+        raise ValueError(f"Unknown loss {loss!r}; known: {sorted(LOSSES)}")
+
+
+# ---------------------------------------------------------------------------
+# class weighting (Keras ``class_weight`` semantics)
+# ---------------------------------------------------------------------------
 _PER_SAMPLE = {
     "categorical_crossentropy": _ps_categorical,
     "categorical_crossentropy_from_logits": _ps_categorical_logits,

@@ -126,6 +126,37 @@ def f1(y_true, y_pred):
     return 2.0 * p * r / jnp.maximum(p + r, 1e-12)
 
 
+def auc(y_true, y_pred):
+    """Binary ROC-AUC via the rank statistic (Mann–Whitney U): the
+    probability a random positive scores above a random negative, with
+    ties counted half. Keras-parity metric for imbalanced problems (the
+    Criteo config) where accuracy is uninformative.
+
+    ``y_pred``: scores — a [N] vector (probability OR logit; AUC is
+    rank-based so monotone transforms don't matter) or an [N, 2] softmax/
+    logit pair (class-1 column used). ``y_true``: 0/1 labels.
+    """
+    y_true = jnp.asarray(y_true).reshape(-1).astype(jnp.float32)
+    s = jnp.asarray(y_pred)
+    if s.ndim > 1 and s.shape[-1] == 2:
+        # the DIFFERENCE is monotone in softmax p1 for logits AND for
+        # probability pairs; column 1 alone is not rank-equivalent for
+        # logits (p1 depends on s1 - s0)
+        s = s[..., 1] - s[..., 0]
+    s = s.reshape(-1).astype(jnp.float32)
+    # average ranks via sort + searchsorted (O(N log N), no [N, N]
+    # pairwise matrix): a value whose equal-group occupies sorted
+    # positions lo+1..hi gets the midpoint rank (lo + hi + 1) / 2
+    sorted_s = jnp.sort(s)
+    lo = jnp.searchsorted(sorted_s, s, side="left").astype(jnp.float32)
+    hi = jnp.searchsorted(sorted_s, s, side="right").astype(jnp.float32)
+    ranks = (lo + hi + 1.0) / 2.0
+    npos = jnp.sum(y_true)
+    nneg = y_true.shape[0] - npos
+    u = jnp.sum(ranks * y_true) - npos * (npos + 1.0) / 2.0
+    return jnp.where((npos > 0) & (nneg > 0), u / (npos * nneg), 0.5)
+
+
 METRICS = {
     "accuracy": accuracy,
     "top_5_accuracy": lambda t, p: top_k_accuracy(t, p, 5),
@@ -133,6 +164,7 @@ METRICS = {
     "precision": precision,
     "recall": recall,
     "f1": f1,
+    "auc": auc,
 }
 
 

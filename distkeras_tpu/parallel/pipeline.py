@@ -277,8 +277,9 @@ class PipelineTrainer:
                  batch_size: int = 32, num_epoch: int = 1,
                  features_col: str = "features", label_col: str = "label",
                  seed: int = 0, shuffle_each_epoch: bool = True,
-                 clip_grad_norm: Optional[float] = None):
-        from distkeras_tpu.ops.losses import get_loss
+                 clip_grad_norm: Optional[float] = None,
+                 class_weight: Optional[dict] = None):
+        from distkeras_tpu.ops.losses import get_loss, with_class_weight
         from distkeras_tpu.ops.optimizers import (clip_by_global_norm,
                                                   get_optimizer)
         from distkeras_tpu.utils.history import History
@@ -293,7 +294,8 @@ class PipelineTrainer:
         if clip_grad_norm is not None:
             self.optimizer = clip_by_global_norm(self.optimizer,
                                                  clip_grad_norm)
-        self.loss = get_loss(loss)
+        self.loss = (with_class_weight(loss, class_weight)
+                     if class_weight is not None else get_loss(loss))
         self.batch_size = int(batch_size)
         self.num_epoch = int(num_epoch)
         self.features_col = features_col

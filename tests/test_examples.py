@@ -28,6 +28,13 @@ def test_mnist_workflow(trainer):
     assert acc > 0.75, (trainer, acc)
 
 
+def test_lm_generate_example(capsys):
+    acc = run_example("examples.lm_generate")
+    out = capsys.readouterr().out
+    assert "int8 vs f32" in out
+    assert acc > 0.9, acc
+
+
 def test_vit_finetune_callbacks_example(capsys):
     acc = run_example("examples.vit_finetune_callbacks")
     out = capsys.readouterr().out

@@ -171,6 +171,37 @@ def test_losses_scalar_and_nonnegative(loss_name):
     assert float(val) >= -1e-6
 
 
+def test_auc_rank_statistic():
+    from distkeras_tpu.ops.metrics import auc
+    # perfect separation -> 1.0; inverted -> 0.0; random-ish hand case
+    y = jnp.array([0, 0, 1, 1])
+    assert float(auc(y, jnp.array([0.1, 0.2, 0.8, 0.9]))) == 1.0
+    assert float(auc(y, jnp.array([0.9, 0.8, 0.2, 0.1]))) == 0.0
+    # hand-computed: pairs (neg, pos): (.4,.3)=0, (.4,.8)=1, (.6,.3)=0,
+    # (.6,.8)=1 -> AUC = 2/4
+    assert float(auc(y, jnp.array([0.4, 0.6, 0.3, 0.8]))) == \
+        pytest.approx(0.5)
+    # ties count half: all-equal scores -> 0.5
+    assert float(auc(y, jnp.ones(4))) == pytest.approx(0.5)
+    # monotone-transform invariant (logits vs probs)
+    p = jnp.array([0.2, 0.7, 0.4, 0.9])
+    logit = jnp.log(p) - jnp.log1p(-p)
+    assert float(auc(y, p)) == pytest.approx(float(auc(y, logit)))
+    # [N, 2] softmax input ranks by the class-1 margin
+    two = jnp.stack([1 - p, p], axis=-1)
+    assert float(auc(y, two)) == pytest.approx(float(auc(y, p)))
+    # [N, 2] LOGIT input: ranking must follow softmax p1 (= s1 - s0), not
+    # the raw class-1 column (regression: [[0,1],[10,2]] ranks wrong by
+    # column alone)
+    ylg = jnp.array([1, 0])
+    lg = jnp.array([[0.0, 1.0], [10.0, 2.0]])
+    assert float(auc(ylg, lg)) == 1.0
+    # degenerate single-class labels -> 0.5, not NaN
+    assert float(auc(jnp.zeros(4), p)) == 0.5
+    # works under jit
+    assert float(jax.jit(auc)(y, p)) == pytest.approx(float(auc(y, p)))
+
+
 def test_class_weight_math_and_identity():
     from distkeras_tpu.ops import with_class_weight
     logits = jax.random.normal(jax.random.PRNGKey(2), (6, 3))
