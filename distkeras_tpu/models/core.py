@@ -313,6 +313,33 @@ class Model:
         from distkeras_tpu.models.decoding import generate
         return generate(self, prompts, max_new_tokens, **kwargs)
 
+    def get_weights(self) -> List[np.ndarray]:
+        """Keras-style flat weight list: params THEN state leaves (host
+        numpy, pytree leaf order). State is included so BatchNorm running
+        stats round-trip — as Keras's moving_mean/moving_variance do."""
+        return [np.asarray(w) for w in
+                jax.tree_util.tree_leaves((self.params, self.state))]
+
+    def set_weights(self, weights: Sequence[np.ndarray]) -> None:
+        """Keras-style inverse of :meth:`get_weights` — shapes must match
+        leaf-for-leaf."""
+        leaves, treedef = jax.tree_util.tree_flatten(
+            (self.params, self.state))
+        if len(weights) != len(leaves):
+            raise ValueError(
+                f"set_weights got {len(weights)} arrays, model has "
+                f"{len(leaves)} weight tensors (params + state)")
+        new = []
+        for i, (leaf, w) in enumerate(zip(leaves, weights)):
+            w = jnp.asarray(w, dtype=leaf.dtype)
+            if tuple(w.shape) != tuple(leaf.shape):
+                raise ValueError(
+                    f"set_weights: tensor {i} has shape {w.shape}, "
+                    f"expected {leaf.shape}")
+            new.append(w)
+        self.params, self.state = jax.tree_util.tree_unflatten(treedef, new)
+        self._jit_fwd = None
+
     # -- bookkeeping ------------------------------------------------------
     def num_params(self) -> int:
         return sum(int(np.prod(p.shape))

@@ -134,9 +134,20 @@ def auc(y_true, y_pred):
 
     ``y_pred``: scores — a [N] vector (probability OR logit; AUC is
     rank-based so monotone transforms don't matter) or an [N, 2] softmax/
-    logit pair (class-1 column used). ``y_true``: 0/1 labels.
+    logit pair (the class-1 margin is used). ``y_true``: 0/1 labels.
+
+    FULL-DATASET evaluator metric: as a per-batch training metric
+    (``metrics=["auc"]``) the history records batch-wise AUCs whose mean
+    is biased toward 0.5 on imbalanced data (single-class batches score
+    exactly 0.5) — use ``inference.Evaluator("auc")`` or
+    ``model.evaluate`` over the whole set for the real number.
     """
     y_true = jnp.asarray(y_true).reshape(-1).astype(jnp.float32)
+    if y_true.shape[0] >= 2 ** 24:
+        # f32 rank arithmetic loses integer precision beyond 2^24
+        raise ValueError(
+            f"auc supports up to 2^24 rows (got {y_true.shape[0]}); "
+            "evaluate on a subsample")
     s = jnp.asarray(y_pred)
     if s.ndim > 1 and s.shape[-1] == 2:
         # the DIFFERENCE is monotone in softmax p1 for logits AND for

@@ -6,7 +6,7 @@ synthesizes a Criteo-shaped problem: ``wide_dim`` one-hot cross features
 with a sparse linear ground truth + dense numeric features with a
 nonlinear one; the model is ``models.blocks.WideAndDeep`` (linear over the
 wide half + MLP over the deep half), trained data-parallel with DOWNPOUR
-and evaluated with the full predictor pipeline (AUC-free: accuracy + F1).
+and evaluated with the full predictor pipeline (accuracy, macro-F1, AUC).
 
 Run:
     XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
@@ -69,7 +69,8 @@ def main():
                                output_col="predicted_index")(ds)
     acc = AccuracyEvaluator(prediction_col="predicted_index").evaluate(ds)
     f1 = Evaluator("f1", prediction_col="prediction").evaluate(ds)
-    print(f"eval accuracy: {acc:.4f}  macro-F1: {f1:.4f}")
+    roc = Evaluator("auc", prediction_col="prediction").evaluate(ds)
+    print(f"eval accuracy: {acc:.4f}  macro-F1: {f1:.4f}  AUC: {roc:.4f}")
     return acc
 
 

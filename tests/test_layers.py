@@ -252,6 +252,33 @@ def test_vit_builds_and_runs():
     assert not np.allclose(np.asarray(ya), np.asarray(yb))
 
 
+def test_model_get_set_weights_keras_style():
+    m = build([Dense(4, activation="relu"), Dense(2)], (8,))
+    ws = m.get_weights()
+    assert all(isinstance(w, np.ndarray) for w in ws)
+    m2 = build([Dense(4, activation="relu"), Dense(2)], (8,))
+    m2.set_weights(ws)
+    x = np.random.RandomState(0).randn(3, 8).astype(np.float32)
+    np.testing.assert_allclose(m2.predict(x), m.predict(x), atol=1e-6)
+    with pytest.raises(ValueError, match="arrays"):
+        m2.set_weights(ws[:-1])
+    with pytest.raises(ValueError, match="shape"):
+        m2.set_weights([np.zeros((1, 1))] * len(ws))
+
+    # STATE rides along (Keras includes BN moving stats): a trained BN
+    # model round-trips its running statistics, so eval-mode predictions
+    # reproduce exactly
+    rs = np.random.RandomState(1)
+    Xb = rs.randn(256, 8).astype(np.float32)
+    yb = rs.randint(0, 2, 256)
+    mb = build([Dense(4), BatchNorm(), Dense(2)], (8,))
+    mb.fit(Xb, yb, optimizer="sgd", epochs=3, batch_size=64,
+           loss="sparse_categorical_crossentropy_from_logits")
+    mb2 = build([Dense(4), BatchNorm(), Dense(2)], (8,))
+    mb2.set_weights(mb.get_weights())
+    np.testing.assert_allclose(mb2.predict(Xb), mb.predict(Xb), atol=1e-6)
+
+
 def test_mixed_precision_bf16_activation_flow():
     """bf16 layers emit bf16 (activations stay low-precision between
     layers — the HBM-bandwidth policy); norm stats and user-facing
