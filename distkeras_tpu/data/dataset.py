@@ -125,6 +125,23 @@ class Dataset:
         return Dataset({k: native.gather(v, perm)
                         for k, v in self._columns.items()})
 
+    def filter(self, mask) -> "Dataset":
+        """Row subset by boolean mask — ``mask`` is a length-N bool array
+        or a callable ``Dataset -> bool array`` (the DataFrame-ish
+        ``df.filter(df.label == 1)`` idiom):
+        ``ds.filter(lambda d: d["label"] == 1)``."""
+        if callable(mask):
+            mask = mask(self)
+        mask = np.asarray(mask)
+        if mask.dtype != np.bool_ or mask.shape != (len(self),):
+            raise ValueError(
+                f"filter mask must be bool[{len(self)}], got "
+                f"{mask.dtype}{list(mask.shape)}")
+        from distkeras_tpu.data import native
+        idx = np.flatnonzero(mask)  # multithreaded gather, as shuffle does
+        return Dataset({k: native.gather(v, idx)
+                        for k, v in self._columns.items()})
+
     def take(self, n: int) -> "Dataset":
         return Dataset({k: v[:n] for k, v in self._columns.items()})
 

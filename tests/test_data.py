@@ -164,6 +164,19 @@ def test_hashing_transformer_stable_multi_hot():
         HashingTransformer(0, ["cat_a"])
 
 
+def test_dataset_filter():
+    from distkeras_tpu.data import Dataset
+    ds = Dataset({"x": np.arange(6), "label": np.array([0, 1, 0, 1, 1, 0])})
+    out = ds.filter(lambda d: d["label"] == 1)
+    np.testing.assert_array_equal(out["x"], [1, 3, 4])
+    out2 = ds.filter(np.array([True, False] * 3))
+    np.testing.assert_array_equal(out2["x"], [0, 2, 4])
+    with pytest.raises(ValueError, match="bool"):
+        ds.filter(np.arange(6))
+    with pytest.raises(ValueError, match="bool"):
+        ds.filter(np.array([True, False]))
+
+
 def test_string_indexer_spark_semantics():
     from distkeras_tpu.data import Dataset, StringIndexerTransformer
     ds = Dataset({"cat": np.array(["b", "a", "b", "c", "b", "a"]),

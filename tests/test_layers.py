@@ -256,7 +256,12 @@ def test_model_get_set_weights_keras_style():
     m = build([Dense(4, activation="relu"), Dense(2)], (8,))
     ws = m.get_weights()
     assert all(isinstance(w, np.ndarray) for w in ws)
-    m2 = build([Dense(4, activation="relu"), Dense(2)], (8,))
+    # DIFFERENT init seed: the transfer must actually move weights (same
+    # seed would make the round-trip assertion vacuous)
+    m2 = Model.build(Sequential([Dense(4, activation="relu"), Dense(2)]),
+                     (8,), seed=42)
+    assert any(not np.allclose(a, b)
+               for a, b in zip(m2.get_weights(), ws))
     m2.set_weights(ws)
     x = np.random.RandomState(0).randn(3, 8).astype(np.float32)
     np.testing.assert_allclose(m2.predict(x), m.predict(x), atol=1e-6)
