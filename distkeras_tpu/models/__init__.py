@@ -4,9 +4,9 @@ from distkeras_tpu.models.core import (  # noqa: F401
     LAYER_REGISTRY, Layer, Model, Sequential, register_layer)
 from distkeras_tpu.models.layers import (  # noqa: F401
     ACTIVATIONS, Activation, AveragePooling2D, BatchNorm, Conv1D, Conv2D,
-    Dense, Dropout, Embedding, Flatten, GlobalAveragePooling1D,
-    GlobalAveragePooling2D, GroupNorm, MaxPooling2D, Reshape,
-    get_activation)
+    Conv2DTranspose, Dense, DepthwiseConv2D, Dropout, Embedding, Flatten,
+    GlobalAveragePooling1D, GlobalAveragePooling2D, GroupNorm,
+    MaxPooling2D, Reshape, UpSampling2D, get_activation)
 from distkeras_tpu.models.blocks import Residual, WideAndDeep  # noqa: F401
 from distkeras_tpu.models.attention import (  # noqa: F401
     LayerNorm, MultiHeadAttention, PositionalEmbedding, RMSNorm,

@@ -206,8 +206,10 @@ def _pair(v):
 
 
 class _ConvND(Layer):
-    """Shared N-D convolution core; subclasses fix the spatial rank and the
-    channels-last ``dimension_numbers`` (XLA's native TPU conv layout)."""
+    """Shared N-D convolution core; subclasses fix the spatial rank /
+    channels-last ``dimension_numbers`` (XLA's native TPU conv layout) and
+    may override the kernel shape, output-channel count, and conv
+    primitive (depthwise, transpose)."""
 
     _dims: tuple  # e.g. ("NHWC", "HWIO", "NHWC")
 
@@ -236,25 +238,33 @@ class _ConvND(Layer):
             return tuple(int(e) for e in v)
         return (int(v),) * n
 
+    # -- subclass hooks -----------------------------------------------------
+    def _kernel_shape(self, c: int) -> tuple:
+        return self.kernel_size + (c, self.filters)
+
+    def _out_channels(self, c: int) -> int:
+        return self.filters
+
+    def _conv(self, x, k):
+        return lax.conv_general_dilated(
+            x, k, self.strides, self.padding, dimension_numbers=self._dims)
+
+    # -- shared body --------------------------------------------------------
     def init(self, rng, input_shape):
         c = input_shape[-1]
-        kshape = self.kernel_size + (c, self.filters)
+        kshape = self._kernel_shape(c)
         params = {"kernel": init_weights(self.kernel_init, rng, kshape)}
         if self.use_bias:
-            params["bias"] = jnp.zeros((self.filters,))
+            params["bias"] = jnp.zeros((self._out_channels(c),))
         out = jax.eval_shape(
-            lambda x, k: lax.conv_general_dilated(
-                x, k, self.strides, self.padding,
-                dimension_numbers=self._dims),
+            self._conv,
             jax.ShapeDtypeStruct((1,) + tuple(input_shape), jnp.float32),
             jax.ShapeDtypeStruct(kshape, jnp.float32))
         return params, {}, tuple(out.shape[1:])
 
     def apply(self, params, state, x, *, training=False, rng=None):
         dt = jnp.dtype(self.dtype)
-        y = lax.conv_general_dilated(
-            x.astype(dt), params["kernel"].astype(dt), self.strides,
-            self.padding, dimension_numbers=self._dims)
+        y = self._conv(x.astype(dt), params["kernel"].astype(dt))
         if self.use_bias:
             y = y + params["bias"].astype(dt)
         y = get_activation(self.activation)(y)
@@ -282,6 +292,79 @@ class Conv1D(_ConvND):
     """1-D convolution over [B, W, C] (text-CNN / signal models)."""
 
     _dims = ("NWC", "WIO", "NWC")
+
+
+@register_layer
+class DepthwiseConv2D(_ConvND):
+    """Depthwise 2-D convolution (each input channel convolved with its
+    own ``depth_multiplier`` filters) — the MobileNet-era Keras staple.
+    Lowered with ``feature_group_count = C`` so XLA picks its native
+    grouped-conv path."""
+
+    _dims = ("NHWC", "HWIO", "NHWC")
+
+    def __init__(self, kernel_size, strides=1, padding: str = "SAME",
+                 depth_multiplier: int = 1, activation=None,
+                 use_bias: bool = True, kernel_init: str = "he_normal",
+                 dtype: str = "float32"):
+        # filters is unused (output width derives from C × multiplier) but
+        # kept so the base get_config can read it before we pop the key
+        super().__init__(filters=0, kernel_size=kernel_size,
+                         strides=strides, padding=padding,
+                         activation=activation, use_bias=use_bias,
+                         kernel_init=kernel_init, dtype=dtype)
+        self.depth_multiplier = int(depth_multiplier)
+
+    def _kernel_shape(self, c):
+        # HWIO with I=1 per group (feature_group_count = C)
+        return self.kernel_size + (1, c * self.depth_multiplier)
+
+    def _out_channels(self, c):
+        return c * self.depth_multiplier
+
+    def _conv(self, x, k):
+        return lax.conv_general_dilated(
+            x, k, self.strides, self.padding, dimension_numbers=self._dims,
+            feature_group_count=x.shape[-1])
+
+    def get_config(self):
+        cfg = super().get_config()
+        cfg.pop("filters")
+        cfg["depth_multiplier"] = self.depth_multiplier
+        return cfg
+
+
+@register_layer
+class Conv2DTranspose(_ConvND):
+    """Transposed 2-D convolution (learned upsampling for decoder /
+    segmentation heads) via ``lax.conv_transpose``."""
+
+    _dims = ("NHWC", "HWIO", "NHWC")
+
+    def _conv(self, x, k):
+        return lax.conv_transpose(x, k, self.strides, self.padding,
+                                  dimension_numbers=self._dims)
+
+
+@register_layer
+class UpSampling2D(Layer):
+    """Nearest-neighbor spatial upsampling ([B, H, W, C] -> [B, rH, rW, C])
+    — a pure repeat, no parameters."""
+
+    def __init__(self, size=2):
+        self.size = _pair(size)
+
+    def init(self, rng, input_shape):
+        h, w, c = input_shape
+        return {}, {}, (h * self.size[0], w * self.size[1], c)
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        y = jnp.repeat(jnp.repeat(x, self.size[0], axis=1),
+                       self.size[1], axis=2)
+        return y, state
+
+    def get_config(self):
+        return {"size": list(self.size)}
 
 
 class _Pool2D(Layer):

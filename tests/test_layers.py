@@ -252,6 +252,47 @@ def test_vit_builds_and_runs():
     assert not np.allclose(np.asarray(ya), np.asarray(yb))
 
 
+def test_depthwise_conv2d():
+    from distkeras_tpu.models import DepthwiseConv2D
+    m = build([DepthwiseConv2D(3, depth_multiplier=2, use_bias=False)],
+              (5, 5, 4))
+    assert m.output_shape == (5, 5, 8)
+    x = jax.random.normal(jax.random.PRNGKey(10), (1, 5, 5, 4))
+    y, _ = m.apply(m.params, m.state, x)
+    assert y.shape == (1, 5, 5, 8)
+    # channel independence: perturbing channel 0 must only change its own
+    # depth_multiplier output slots (grouped conv semantics)
+    x2 = x.at[..., 0].add(1.0)
+    y2, _ = m.apply(m.params, m.state, x2)
+    diff = np.abs(np.asarray(y2 - y)).reshape(-1, 8).max(axis=0)
+    assert (diff[:2] > 0).all() and np.allclose(diff[2:], 0.0)
+
+
+def test_conv2d_transpose_upsamples():
+    from distkeras_tpu.models import Conv2DTranspose
+    m = build([Conv2DTranspose(3, 4, strides=2)], (5, 5, 2))
+    assert m.output_shape == (10, 10, 3)
+    y, _ = m.apply(m.params, m.state, jnp.ones((2, 5, 5, 2)))
+    assert y.shape == (2, 10, 10, 3)
+    # transpose-of-conv shape identity: conv(stride 2) then transpose
+    # (stride 2) restores the spatial dims
+    from distkeras_tpu.models import Conv2D
+    m2 = build([Conv2D(4, 3, strides=2), Conv2DTranspose(1, 3, strides=2)],
+               (8, 8, 1))
+    assert m2.output_shape == (8, 8, 1)
+
+
+def test_upsampling2d_nearest():
+    from distkeras_tpu.models import UpSampling2D
+    m = build([UpSampling2D(2)], (2, 2, 1))
+    assert m.output_shape == (4, 4, 1)
+    x = jnp.arange(4.0).reshape(1, 2, 2, 1)
+    y, _ = m.apply(m.params, m.state, x)
+    np.testing.assert_array_equal(
+        np.asarray(y)[0, :, :, 0],
+        [[0, 0, 1, 1], [0, 0, 1, 1], [2, 2, 3, 3], [2, 2, 3, 3]])
+
+
 def test_model_get_set_weights_keras_style():
     m = build([Dense(4, activation="relu"), Dense(2)], (8,))
     ws = m.get_weights()
