@@ -352,6 +352,9 @@ class UpSampling2D(Layer):
     — a pure repeat, no parameters."""
 
     def __init__(self, size=2):
+        if isinstance(size, (tuple, list)) and len(size) != 2:
+            raise ValueError(
+                f"UpSampling2D expects 2 spatial factors, got {size}")
         self.size = _pair(size)
 
     def init(self, rng, input_shape):

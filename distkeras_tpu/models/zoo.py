@@ -22,8 +22,8 @@ from typing import Optional, Sequence, Tuple
 from distkeras_tpu.models.blocks import Residual, WideAndDeep
 from distkeras_tpu.models.core import Sequential
 from distkeras_tpu.models.layers import (
-    Activation, BatchNorm, Conv2D, Dense, Dropout, Embedding, Flatten,
-    GlobalAveragePooling2D, MaxPooling2D)
+    Activation, BatchNorm, Conv2D, Dense, DepthwiseConv2D, Dropout,
+    Embedding, Flatten, GlobalAveragePooling2D, MaxPooling2D)
 from distkeras_tpu.models.recurrent import LSTM, Bidirectional
 
 
@@ -205,4 +205,35 @@ def vit(image_size: int = 224, patch_size: int = 16, d_model: int = 384,
             norm="layernorm", dtype=dtype, dropout_rate=dropout_rate))
     layers += [LayerNorm(), GlobalAveragePooling1D(),
                Dense(num_classes, dtype=dtype)]
+    return Sequential(layers)
+
+
+def mobilenet(num_classes: int = 1000, width_mult: float = 1.0,
+              dtype: str = "float32",
+              bn_axis_name: Optional[str] = None) -> Sequential:
+    """MobileNet-v1 (Howard et al. 2017) — depthwise-separable CNN built
+    on ``DepthwiseConv2D``; the classic efficient-inference counterpart to
+    ``resnet50`` (capability ADD: the reference's CNN examples stop at
+    LeNet-scale). NHWC, BN after every conv, ``width_mult`` scales every
+    channel count."""
+    from distkeras_tpu.models.layers import DepthwiseConv2D
+
+    def ch(c):
+        return max(8, int(c * width_mult))
+
+    bn = lambda: BatchNorm(axis_name=bn_axis_name)
+    layers = [Conv2D(ch(32), 3, strides=2, use_bias=False, dtype=dtype),
+              bn(), Activation("relu")]
+    # (pointwise out-channels, stride) per separable block
+    plan = [(64, 1), (128, 2), (128, 1), (256, 2), (256, 1), (512, 2),
+            (512, 1), (512, 1), (512, 1), (512, 1), (512, 1), (1024, 2),
+            (1024, 1)]
+    for out_c, stride in plan:
+        layers += [
+            DepthwiseConv2D(3, strides=stride, use_bias=False, dtype=dtype),
+            bn(), Activation("relu"),
+            Conv2D(ch(out_c), 1, use_bias=False, dtype=dtype),
+            bn(), Activation("relu"),
+        ]
+    layers += [GlobalAveragePooling2D(), Dense(num_classes, dtype=dtype)]
     return Sequential(layers)

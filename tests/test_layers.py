@@ -293,6 +293,19 @@ def test_upsampling2d_nearest():
         [[0, 0, 1, 1], [0, 0, 1, 1], [2, 2, 3, 3], [2, 2, 3, 3]])
 
 
+def test_mobilenet_builds_and_runs():
+    from distkeras_tpu.models import zoo
+    m = Model.build(zoo.mobilenet(num_classes=10, width_mult=0.125),
+                    (32, 32, 3), seed=0)
+    assert m.output_shape == (10,)
+    y, _ = m.apply(m.params, m.state, jnp.ones((2, 32, 32, 3)),
+                   training=True)
+    assert y.shape == (2, 10)
+    # depthwise-separable structure: far fewer params than a dense conv
+    # net of the same channel plan would carry
+    assert m.num_params() < 80_000, m.num_params()
+
+
 def test_model_get_set_weights_keras_style():
     m = build([Dense(4, activation="relu"), Dense(2)], (8,))
     ws = m.get_weights()
