@@ -226,13 +226,18 @@ class Model:
     # -- Keras-style conveniences ----------------------------------------
     def fit(self, x, y=None, *, optimizer="sgd", loss="mean_squared_error",
             batch_size: int = 32, epochs: int = 1, metrics=None,
-            validation_data=None, seed: int = 0, **trainer_kwargs):
+            validation_data=None, validation_split: float = 0.0,
+            seed: int = 0, **trainer_kwargs):
         """Keras-style ``model.fit`` — a thin wrapper over SingleTrainer
         (use the trainer classes directly for distributed training).
 
         ``x`` may be a ``data.Dataset`` (with the default feature/label
         columns) or a feature array with ``y`` labels. Trains IN PLACE
         (this model's params/state are updated) and returns the History.
+
+        ``validation_split``: Keras semantics — hold out the LAST fraction
+        of the (unshuffled) data as validation (mutually exclusive with
+        ``validation_data``; not available for ShardedDataset).
         """
         from distkeras_tpu.data.dataset import Dataset
         from distkeras_tpu.data.sharded import ShardedDataset
@@ -244,6 +249,19 @@ class Model:
             if y is None:
                 raise ValueError("fit(x, y): y is required for array input")
             ds = Dataset({"features": np.asarray(x), "label": np.asarray(y)})
+        if validation_split:
+            if validation_data is not None:
+                raise ValueError(
+                    "pass validation_split OR validation_data, not both")
+            if not 0.0 < validation_split < 1.0:
+                raise ValueError(
+                    f"validation_split must be in (0, 1), got "
+                    f"{validation_split}")
+            if isinstance(ds, ShardedDataset):
+                raise ValueError(
+                    "validation_split needs in-memory data; hold out "
+                    "shards yourself for a ShardedDataset")
+            ds, validation_data = ds.split(1.0 - validation_split)
         trainer = SingleTrainer(
             self, worker_optimizer=optimizer, loss=loss,
             batch_size=batch_size, num_epoch=epochs, metrics=metrics,

@@ -136,3 +136,30 @@ def test_model_fit_evaluate_keras_style():
     res = model.evaluate(
         X, y, loss="sparse_categorical_crossentropy_from_logits")
     assert res["accuracy"] > 0.9 and np.isfinite(res["loss"])
+
+
+def test_fit_validation_split():
+    import numpy as np
+
+    from distkeras_tpu.models import Dense, Model, Sequential
+
+    rs = np.random.RandomState(1)
+    X = rs.randn(512, 8).astype(np.float32)
+    y = (X @ rs.randn(8, 3)).argmax(-1)
+    model = Model.build(Sequential([Dense(16, activation="relu"),
+                                    Dense(3)]), (8,), seed=0)
+    hist = model.fit(X, y, optimizer="adam", learning_rate=1e-2,
+                     loss="sparse_categorical_crossentropy_from_logits",
+                     batch_size=64, epochs=3, metrics=["accuracy"],
+                     validation_split=0.25)
+    # 384 train rows -> 6 steps/epoch; val metrics recorded per epoch
+    assert hist.losses().shape[0] == 3 * (384 // 64)
+    assert hist.metric("val_loss").shape == (3,)
+    assert "val_accuracy" in hist.metric_names()
+
+    with pytest.raises(ValueError, match="not both"):
+        model.fit(X, y, validation_split=0.2, validation_data=(X, y),
+                  loss="sparse_categorical_crossentropy_from_logits")
+    with pytest.raises(ValueError, match="in \\(0, 1\\)"):
+        model.fit(X, y, validation_split=1.5,
+                  loss="sparse_categorical_crossentropy_from_logits")
