@@ -325,6 +325,18 @@ class Model:
             res[metric_name(m)] = float(get_metric(m)(yv, preds))
         return res
 
+    def save(self, path: str, quantize: bool = False) -> None:
+        """Keras-style ``model.save`` (see ``models.serialization
+        .save_model``; writes ``<path>.json`` + ``<path>.npz``)."""
+        from distkeras_tpu.models.serialization import save_model
+        save_model(self, path, quantize=quantize)
+
+    @staticmethod
+    def load(path: str, keep_quantized: bool = False):
+        """Keras-style loader (``models.serialization.load_model``)."""
+        from distkeras_tpu.models.serialization import load_model
+        return load_model(path, keep_quantized=keep_quantized)
+
     def generate(self, prompts, max_new_tokens: int, **kwargs):
         """Keras-style convenience over ``models.decoding.generate`` (KV-
         cache autoregressive sampling for transformer-LM-shaped models)."""

@@ -57,3 +57,20 @@ def test_config_describes_architecture():
     rebuilt = Sequential.from_config(cfg)
     assert rebuilt.layers[0].units == 3
     assert rebuilt.layers[0].activation == "tanh"
+
+
+def test_model_save_load_methods(tmp_path):
+    """Keras idiom: model.save(path) / Model.load(path)."""
+    import numpy as np
+
+    from distkeras_tpu.models import Dense, Model, Sequential
+
+    m = Model.build(Sequential([Dense(4)]), (8,), seed=0)
+    p = str(tmp_path / "m.dkt")
+    m.save(p)
+    loaded = Model.load(p)
+    x = np.ones((2, 8), np.float32)
+    np.testing.assert_allclose(loaded.predict(x), m.predict(x), atol=1e-6)
+    m.save(str(tmp_path / "mq.dkt"), quantize=True)
+    qm = Model.load(str(tmp_path / "mq.dkt"), keep_quantized=True)
+    assert qm.predict(x).shape == (2, 4)
