@@ -6,7 +6,7 @@ from distkeras_tpu.models.layers import (  # noqa: F401
     ACTIVATIONS, Activation, AveragePooling2D, BatchNorm, Conv1D, Conv2D,
     Conv2DTranspose, Dense, DepthwiseConv2D, Dropout, Embedding, Flatten,
     GlobalAveragePooling1D, GlobalAveragePooling2D, GroupNorm,
-    MaxPooling2D, Reshape, UpSampling2D, get_activation)
+    MaxPooling2D, Reshape, SeparableConv2D, UpSampling2D, get_activation)
 from distkeras_tpu.models.blocks import Residual, WideAndDeep  # noqa: F401
 from distkeras_tpu.models.attention import (  # noqa: F401
     LayerNorm, MultiHeadAttention, PositionalEmbedding, RMSNorm,

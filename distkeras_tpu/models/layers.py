@@ -335,6 +335,54 @@ class DepthwiseConv2D(_ConvND):
 
 
 @register_layer
+class SeparableConv2D(Layer):
+    """Depthwise-separable convolution (Keras ``SeparableConv2D``): a
+    ``DepthwiseConv2D`` followed by a 1×1 pointwise ``Conv2D`` — the
+    MobileNet/Xception building block as one layer."""
+
+    def __init__(self, filters: int, kernel_size, strides=1,
+                 padding: str = "SAME", depth_multiplier: int = 1,
+                 activation=None, use_bias: bool = True,
+                 kernel_init: str = "he_normal", dtype: str = "float32"):
+        self.filters = int(filters)
+        self.depth_multiplier = int(depth_multiplier)
+        self.activation = activation
+        self.use_bias = use_bias
+        self.kernel_init = kernel_init
+        self.dtype = dtype
+        self.depthwise = DepthwiseConv2D(
+            kernel_size, strides=strides, padding=padding,
+            depth_multiplier=depth_multiplier, use_bias=False,
+            kernel_init=kernel_init, dtype=dtype)
+        # activation/bias live on the pointwise half, Keras-style
+        self.pointwise = Conv2D(filters, 1, activation=activation,
+                                use_bias=use_bias, kernel_init=kernel_init,
+                                dtype=dtype)
+
+    def init(self, rng, input_shape):
+        k1, k2 = jax.random.split(rng)
+        pd, _, shape = self.depthwise.init(k1, input_shape)
+        pp, _, shape = self.pointwise.init(k2, shape)
+        return {"depthwise": pd, "pointwise": pp}, {}, shape
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        y, _ = self.depthwise.apply(params["depthwise"], {}, x,
+                                    training=training)
+        y, _ = self.pointwise.apply(params["pointwise"], {}, y,
+                                    training=training)
+        return y, state
+
+    def get_config(self):
+        # spatial formatting delegated to the depthwise sublayer's base
+        cfg = _ConvND.get_config(self.depthwise)
+        cfg.pop("filters")
+        cfg.update(filters=self.filters,
+                   depth_multiplier=self.depth_multiplier,
+                   activation=self.activation, use_bias=self.use_bias)
+        return cfg
+
+
+@register_layer
 class Conv2DTranspose(_ConvND):
     """Transposed 2-D convolution (learned upsampling for decoder /
     segmentation heads) via ``lax.conv_transpose``."""

@@ -142,6 +142,41 @@ def get_loss(loss: Union[str, LossFn]) -> LossFn:
         raise ValueError(f"Unknown loss {loss!r}; known: {sorted(LOSSES)}")
 
 
+def with_label_smoothing(loss: Union[str, LossFn],
+                         label_smoothing: float) -> LossFn:
+    """Keras ``label_smoothing`` for the CATEGORICAL crossentropies: the
+    target distribution becomes ``y*(1-s) + s/K`` (integer targets are
+    one-hot expanded first). Usage:
+    ``loss=with_label_smoothing("sparse_categorical_crossentropy_from_logits",
+    0.1)`` anywhere a loss is accepted."""
+    s = float(label_smoothing)
+    if not 0.0 <= s < 1.0:
+        raise ValueError(f"label_smoothing must be in [0, 1), got {s}")
+    smoothable = {
+        "categorical_crossentropy": _ps_categorical,
+        "categorical_crossentropy_from_logits": _ps_categorical_logits,
+        "sparse_categorical_crossentropy": _ps_categorical,
+        "sparse_categorical_crossentropy_from_logits":
+            _ps_categorical_logits,
+    }
+    if not isinstance(loss, str) or loss not in smoothable:
+        raise ValueError(
+            f"label_smoothing needs a categorical crossentropy name, one "
+            f"of {sorted(smoothable)}; got {loss!r}")
+    per_sample = smoothable[loss]
+    sparse = loss.startswith("sparse")
+
+    def fn(y_true, y_pred):
+        k = y_pred.shape[-1]
+        if sparse:
+            y_true = jax.nn.one_hot(y_true.astype(jnp.int32), k)
+        y_true = y_true.astype(jnp.float32) * (1.0 - s) + s / k
+        return jnp.mean(per_sample(y_true, y_pred)[0])
+
+    fn.__name__ = f"{loss}_smoothed_{s}"
+    return fn
+
+
 # ---------------------------------------------------------------------------
 # class weighting (Keras ``class_weight`` semantics)
 # ---------------------------------------------------------------------------

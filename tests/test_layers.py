@@ -377,3 +377,21 @@ def test_bf16_mlp_trains():
           loss="sparse_categorical_crossentropy_from_logits")
     acc = float((m.predict(X).argmax(-1) == y).mean())
     assert acc > 0.9, acc
+
+
+def test_separable_conv2d():
+    from distkeras_tpu.models import SeparableConv2D
+    from distkeras_tpu.models.serialization import (deserialize_model,
+                                                    serialize_model)
+    m = build([SeparableConv2D(8, 3, strides=2, activation="relu")],
+              (8, 8, 4))
+    assert m.output_shape == (4, 4, 8)
+    x = jax.random.normal(jax.random.PRNGKey(11), (2, 8, 8, 4))
+    y, _ = m.apply(m.params, m.state, x)
+    assert y.shape == (2, 4, 4, 8) and (np.asarray(y) >= 0).all()
+    # separable params << dense conv params for the same shape
+    dense_equiv = 3 * 3 * 4 * 8
+    assert m.num_params() < dense_equiv
+    m2 = deserialize_model(serialize_model(m))
+    np.testing.assert_allclose(np.asarray(m2.apply(m2.params, m2.state, x)[0]),
+                               np.asarray(y), atol=1e-6)

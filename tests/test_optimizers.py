@@ -433,3 +433,30 @@ def test_precision_rejects_out_of_range_predictions():
     from distkeras_tpu.ops.metrics import precision
     with pytest.raises(ValueError, match="predictions contain class 7"):
         precision(np.eye(2)[[0, 0, 1]], np.array([0, 7, 1]))
+
+
+def test_label_smoothing():
+    from distkeras_tpu.ops import with_label_smoothing
+    logits = jax.random.normal(jax.random.PRNGKey(5), (4, 3))
+    y = jnp.array([0, 1, 2, 0])
+    # s=0 identical to the plain loss
+    f0 = with_label_smoothing(
+        "sparse_categorical_crossentropy_from_logits", 0.0)
+    base = get_loss("sparse_categorical_crossentropy_from_logits")
+    np.testing.assert_allclose(float(f0(y, logits)), float(base(y, logits)),
+                               rtol=1e-6)
+    # manual check at s=0.3
+    fs = with_label_smoothing(
+        "sparse_categorical_crossentropy_from_logits", 0.3)
+    logp = np.asarray(jax.nn.log_softmax(logits, axis=-1))
+    tgt = np.eye(3)[np.asarray(y)] * 0.7 + 0.1
+    expect = -(tgt * logp).sum(-1).mean()
+    np.testing.assert_allclose(float(fs(y, logits)), expect, rtol=1e-5)
+    # dense one-hot targets path
+    fd = with_label_smoothing("categorical_crossentropy_from_logits", 0.3)
+    np.testing.assert_allclose(float(fd(jnp.eye(3)[y], logits)), expect,
+                               rtol=1e-5)
+    with pytest.raises(ValueError, match="categorical"):
+        with_label_smoothing("mse", 0.1)
+    with pytest.raises(ValueError, match="\\[0, 1\\)"):
+        with_label_smoothing("categorical_crossentropy", 1.0)

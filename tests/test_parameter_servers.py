@@ -278,3 +278,18 @@ def test_ps_socket_stress_interleaved_pull_commit():
                 for w in range(n_threads) for i in range(n_commits))
     np.testing.assert_allclose(np.asarray(ps.get_model()["w"]),
                                np.full((32,), total))
+
+
+def test_host_async_trainer_callbacks_early_stop():
+    from distkeras_tpu.utils import EarlyStopping
+    ds, X, Y, d, c = _toy_problem()
+    model = Model.build(zoo.mlp((16,), num_classes=c), (d,), seed=1)
+    es = EarlyStopping(monitor="loss", min_delta=1e9, patience=0)
+    tr = HostAsyncTrainer(
+        model, algorithm="downpour", num_workers=2, batch_size=16,
+        communication_window=4, num_epoch=10, worker_optimizer="sgd",
+        optimizer_kwargs={"learning_rate": 0.1},
+        loss="sparse_categorical_crossentropy_from_logits",
+        callbacks=[es])
+    tr.train(ds)
+    assert len(tr.get_history().epochs) == 2  # epoch 0 best, stop at 1
