@@ -36,6 +36,29 @@ Everything — local steps, masked collectives, server updates — runs inside
 one ``lax.scan`` under ``shard_map`` under ``jit``: per epoch there is ONE
 Python dispatch, and XLA overlaps the per-window psum with local compute
 where the schedule allows.
+
+Communication amortization (the whole point of ``communication_window``,
+SURVEY §2.3): with a uniform window K the epoch compiles to a TWO-LEVEL
+scan — outer over ``S // K`` window blocks, inner over K purely-local
+steps with ZERO collectives — so a param-sized ``psum`` crosses the ICI
+exactly ``ceil(S / K)`` times per epoch, not S times. Per-worker async
+staggering survives the restructure: worker i snapshots its params into a
+carried buffer at its phase step ``(K - 1 - offset_i) mod K`` inside each
+block (a masked select, no comms), the boundary collective commits the
+*snapshot*'s contribution, and a tail-carry
+``params := post_commit + (params_now - snapshot)`` preserves the local
+steps the worker took after its snapshot. For synchronous algorithms
+(offsets = 0) the snapshot is the final step of the block, so when K
+divides the epoch length the program is step-for-step equivalent to the
+per-step path (tail = 0). Deliberate semantic differences from the
+per-step path: window phase resets at each epoch (the per-step path's
+global step counter carries it across), and a remainder block (S % K
+steps) TRUNCATES the final window — every worker commits its residual at
+the epoch boundary, like the reference worker committing when its
+partition iterator ends. Heterogeneous per-worker windows (DynSGD's K_i
+lists) and non-amortizable algorithms (DynSGD's staleness counter, ADAG's
+nonlinear accumulator) fall back to the per-step masked path, where
+fine-grained commit serialization is the point.
 """
 
 from __future__ import annotations
@@ -101,6 +124,11 @@ class DistAlgorithm:
     staggered: bool = True
     #: whether workers track a pull-time snapshot of the center
     needs_pull: bool = False
+    #: False: the algorithm's semantics need per-commit serialization
+    #: through the center (e.g. DynSGD's staleness counter, which is what
+    #: keeps its full-scale deltas stable) — the engine then uses the
+    #: per-step masked path even for uniform windows
+    amortizable: bool = True
 
     def init_server(self, params: Pytree) -> Dict[str, Pytree]:
         return {}
@@ -211,12 +239,19 @@ class AdagAlgo(DistAlgorithm):
     reference formula once the mount is populated):
         acc    += delta^2
         center += adag_lr * delta / (sqrt(acc) + eps)
+
+    Not amortizable: the accumulator is nonlinear in the commits —
+    batching a window's n contributions into one server round squares the
+    SUM ((Σδ)² ≠ Σδ², cross terms) and divides by sqrt(acc) once instead
+    of n serialized times. Like DynSGD, the per-step path's one-at-a-time
+    commit ordering IS the algorithm.
     """
     adag_lr: float = 0.05
     epsilon: float = 1e-8
     commit_scale: float = 1.0
     staggered: bool = True
     needs_pull: bool = True
+    amortizable: bool = False
 
     def init_server(self, params):
         return {"acc": _tmap(jnp.zeros_like, params)}
@@ -247,9 +282,15 @@ class DynSGDAlgo(DistAlgorithm):
     worker's last pull (SURVEY §3.3). Server clock = ``num_updates``; each
     worker carries its last-pull clock value; commit applies
     ``delta / max(1, clock - last_pull + 1)``.
+
+    Not amortizable: batching a round's commits makes every worker's
+    staleness 1 (all pulled at the same boundary), so the 1/staleness
+    damping that keeps the full-scale deltas stable vanishes — staleness
+    only exists when commits serialize through the center one at a time.
     """
     staggered: bool = True
     needs_pull: bool = True
+    amortizable: bool = False
 
     def init_server(self, params):
         return {"clock": jnp.zeros((), jnp.int32)}
@@ -312,6 +353,10 @@ class EngineConfig:
     num_workers: int
     window: Union[int, Sequence[int]]  # K, scalar or per-worker
     axis_name: str = "workers"
+    #: None = auto (two-level amortized scan when the window is uniform,
+    #: per-step masked path otherwise). False forces the per-step path —
+    #: kept for heterogeneous windows and for equivalence testing.
+    amortized: Optional[bool] = None
 
 
 class DistributedEngine:
@@ -340,6 +385,18 @@ class DistributedEngine:
             offsets = np.zeros((n,), np.int32)
         self._Ks = jnp.asarray(Ks)
         self._offsets = jnp.asarray(offsets % np.maximum(Ks, 1))
+        uniform = bool((Ks == Ks[0]).all())
+        if config.amortized and not uniform:
+            raise ValueError(
+                "amortized=True requires a uniform window; per-worker "
+                f"windows {Ks.tolist()} need the per-step path")
+        if config.amortized and not algo.amortizable:
+            raise ValueError(
+                f"{type(algo).__name__} is not amortizable (needs "
+                "per-commit serialization through the center)")
+        self.amortized = (uniform and algo.amortizable) \
+            if config.amortized is None else bool(config.amortized)
+        self._uniform_K = int(Ks[0]) if uniform else None
         self._epoch_fn = None  # built lazily (jitted shard_map)
 
     # -- state ------------------------------------------------------------
@@ -373,6 +430,129 @@ class DistributedEngine:
 
     # -- compiled epoch ---------------------------------------------------
     def _build(self):
+        inner = self._make_inner_amortized() if self.amortized \
+            else self._make_inner_perstep()
+        axis = self.config.axis_name
+        state_specs = {"worker": P(axis), "center": P(), "server": P()}
+        mapped = jax.shard_map(
+            inner, mesh=self.mesh,
+            in_specs=(state_specs, P(None, axis), P(None, axis)),
+            out_specs=(state_specs, P(None, axis)),
+            check_vma=False)
+        self._epoch_fn = jax.jit(mapped, donate_argnums=(0,))
+
+    def _make_inner_amortized(self):
+        """Two-level epoch program: a param-sized collective once per
+        window block (``ceil(S/K)`` per epoch), never per micro-step."""
+        axis = self.config.axis_name
+        train_step = make_train_step(self.module, self.loss_fn,
+                                     self.optimizer, self.metric_fns)
+        algo = self.algo
+        K = self._uniform_K
+        offsets = self._offsets
+
+        def inner(state, X, Y):
+            w = _tmap(lambda a: a[0], state["worker"])
+            center = state["center"]
+            server_aux = state["server"]["aux"]
+            gt0 = state["server"]["t"]
+            widx = lax.axis_index(axis)
+            # local step within a block at which this worker's commit
+            # snapshot is taken: solves (lt + 1 + offset) % K == 0
+            snap_step = (K - 1 - offsets[widx]) % K
+
+            X0, Y0 = X[:, 0], Y[:, 0]
+            S = X0.shape[0]
+            nblocks, rem = divmod(S, K)
+
+            def make_local_step(target):
+                def local_step(carry, batch):
+                    w, snap = carry
+                    xb, yb, lt = batch
+                    tc = TrainCarry(w["params"], w["state"], w["opt"],
+                                    w["rng"])
+                    tc, outs = train_step(tc, (xb, yb))
+                    w = {**w, "params": tc.params, "state": tc.state,
+                         "opt": tc.opt_state, "rng": tc.rng}
+                    snap = _select(lt == target, w["params"], snap)
+                    return (w, snap), outs
+                return local_step
+
+            def commit(w, snap, center, server_aux):
+                """One boundary exchange: psum every worker's snapshot
+                contribution (all workers commit at every boundary — a
+                short remainder block clamps the snapshot to its last
+                step), update the center, and re-join each worker with its
+                post-snapshot tail."""
+                contrib = algo.contrib(snap, w["pull"], center["params"],
+                                       server_aux, w["extras"])
+                total = lax.psum(contrib, axis)
+                n_commits = lax.psum(jnp.float32(1.0), axis)
+                new_cparams, new_aux = algo.server_update(
+                    center["params"], server_aux, total, n_commits)
+                post, new_pull, new_extras = algo.worker_post(
+                    snap, w["pull"], contrib, new_cparams, new_aux,
+                    w["extras"], jnp.bool_(True))
+                # tail-carry: local steps taken after the snapshot survive
+                # the commit and fold into the next window's contribution
+                new_params = _tmap(lambda q, s, p: q + (p - s),
+                                   post, snap, w["params"])
+                w = {**w, "params": new_params, "pull": new_pull,
+                     "extras": new_extras}
+                return w, {**center, "params": new_cparams}, new_aux
+
+            def block(carry, block_data):
+                w, center, server_aux = carry
+                xb, yb = block_data  # [K, batch, ...]
+                (w, snap), outs = lax.scan(
+                    make_local_step(snap_step), (w, w["params"]),
+                    (xb, yb, jnp.arange(K, dtype=jnp.int32)))
+                w, center, server_aux = commit(w, snap, center, server_aux)
+                return (w, center, server_aux), outs
+
+            carry = (w, center, server_aux)
+            outs_parts = []
+            if nblocks:
+                Xb = X0[:nblocks * K].reshape((nblocks, K) + X0.shape[1:])
+                Yb = Y0[:nblocks * K].reshape((nblocks, K) + Y0.shape[1:])
+                carry, outs_b = lax.scan(block, carry, (Xb, Yb))
+                # [nblocks, K] per-step scalars -> [nblocks*K]
+                outs_parts.append(_tmap(
+                    lambda a: a.reshape((nblocks * K,) + a.shape[2:]),
+                    outs_b))
+            if rem:
+                w, center, server_aux = carry
+                # the final window TRUNCATES at the epoch boundary (the
+                # reference's worker commits its residual when its
+                # partition iterator ends): snapshot at the phase step if
+                # it fits, else at the block's last step, and every worker
+                # commits — a worker whose phase never arrives (K > S sync
+                # cases) must not sit out the epoch entirely
+                (w, snap), outs_r = lax.scan(
+                    make_local_step(jnp.minimum(snap_step, rem - 1)),
+                    (w, w["params"]),
+                    (X0[nblocks * K:], Y0[nblocks * K:],
+                     jnp.arange(rem, dtype=jnp.int32)))
+                carry = commit(w, snap, center, server_aux)
+                outs_parts.append(outs_r)
+            w, center, server_aux = carry
+            outs = outs_parts[0] if len(outs_parts) == 1 else _tmap(
+                lambda *xs: jnp.concatenate(xs, axis=0), *outs_parts)
+
+            new_state = {
+                "worker": _tmap(lambda a: a[None], w),
+                "center": center,
+                "server": {"aux": server_aux, "t": gt0 + S},
+            }
+            return new_state, _tmap(lambda a: a[:, None], outs)
+
+        return inner
+
+    def _make_inner_perstep(self):
+        """Per-micro-step masked-psum path: exact fine-grained commit
+        interleaving, param-sized collective every step. Retained for
+        heterogeneous per-worker windows and as the equivalence oracle for
+        the amortized program."""
         axis = self.config.axis_name
         train_step = make_train_step(self.module, self.loss_fn,
                                      self.optimizer, self.metric_fns)
@@ -427,13 +607,7 @@ class DistributedEngine:
             # gain the worker axis back: [S] -> [S, 1]
             return new_state, _tmap(lambda a: a[:, None], outs)
 
-        state_specs = {"worker": P(axis), "center": P(), "server": P()}
-        mapped = jax.shard_map(
-            inner, mesh=self.mesh,
-            in_specs=(state_specs, P(None, axis), P(None, axis)),
-            out_specs=(state_specs, P(None, axis)),
-            check_vma=False)
-        self._epoch_fn = jax.jit(mapped, donate_argnums=(0,))
+        return inner
 
     def run_epoch(self, state: Dict, Xs, Ys):
         """Run S micro-steps. ``Xs``/``Ys``: ``[S, W, batch, ...]``."""

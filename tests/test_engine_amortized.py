@@ -1,0 +1,206 @@
+"""Communication-amortization tests for the SPMD engine.
+
+The whole point of ``communication_window`` in the reference
+(``distkeras/workers.py`` window counters, SURVEY §2.3) is *comms
+amortization*: K local steps per parameter-server round-trip. These tests
+pin down that the engine's compiled epoch preserves that property on the
+mesh — a param-sized collective fires once per window, NOT once per
+micro-step — and that the amortized program is semantically faithful to
+the per-step masked path.
+"""
+
+import re
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distkeras_tpu.models import Dense, Model, Sequential
+from distkeras_tpu.ops.losses import get_loss
+from distkeras_tpu.ops.optimizers import get_optimizer
+from distkeras_tpu.parallel.engine import (
+    DistributedEngine, DownpourAlgo, ElasticAlgo, EngineConfig)
+from distkeras_tpu.parallel.mesh import make_mesh
+
+D, C, B, W = 16, 4, 4, 8
+
+
+def _make_engine(algo, window, amortized=None):
+    model = Model.build(
+        Sequential([Dense(8, activation="relu"), Dense(C)]), (D,), seed=0)
+    engine = DistributedEngine(
+        model.module, get_loss("sparse_categorical_crossentropy_from_logits"),
+        get_optimizer("sgd", learning_rate=0.05), algo, make_mesh(W),
+        EngineConfig(num_workers=W, window=window, amortized=amortized))
+    return model, engine
+
+
+def _epoch_args(engine, model, S, seed=0):
+    rs = np.random.RandomState(seed)
+    Xf = rs.randn(S * W * B, D).astype(np.float32)
+    yf = np.argmax(Xf @ rs.randn(D, C), axis=1)  # separable teacher
+    X = jnp.asarray(Xf.reshape(S, W, B, D))
+    Y = jnp.asarray(yf.reshape(S, W, B))
+    # copy params: run_epoch donates its state, and the center leaf aliases
+    # the model's params buffer
+    params = jax.tree_util.tree_map(jnp.array, model.params)
+    state = engine.init_state(params, model.state, jax.random.PRNGKey(0))
+    state = jax.device_put(state, engine.shardings())
+    return state, X, Y
+
+
+# -- dynamic psum count: the S/K-proportionality proof ----------------------
+
+def _subjaxprs(eqn):
+    mult = eqn.params.get("length", 1) if eqn.primitive.name == "scan" else 1
+    for p in eqn.params.values():
+        if hasattr(p, "eqns"):
+            yield p, mult
+        elif hasattr(p, "jaxpr"):
+            yield p.jaxpr, mult
+        elif isinstance(p, (list, tuple)):
+            for pi in p:
+                if hasattr(pi, "jaxpr"):
+                    yield pi.jaxpr, mult
+
+
+def count_dynamic_psums(jaxpr, trips=1):
+    """Total psum *executions* per call: each psum eqn weighted by the
+    product of enclosing scan lengths."""
+    total = 0
+    for eqn in jaxpr.eqns:
+        if "psum" in eqn.primitive.name:
+            total += trips
+        for sub, mult in _subjaxprs(eqn):
+            total += count_dynamic_psums(sub, trips * mult)
+    return total
+
+
+def _psums_per_epoch(algo, window, S, amortized):
+    model, engine = _make_engine(algo, window, amortized)
+    state, X, Y = _epoch_args(engine, model, S)
+    engine._build()
+    return count_dynamic_psums(jax.make_jaxpr(engine._epoch_fn)(
+        state, X, Y).jaxpr)
+
+
+@pytest.mark.parametrize("window,S", [(4, 32), (8, 32), (5, 32), (16, 32)])
+def test_psum_executions_proportional_to_windows(window, S):
+    # 4 param leaves + 1 n_commits scalar cross the mesh per commit round
+    per_commit = 5
+    amortized = _psums_per_epoch(DownpourAlgo(), window, S, amortized=True)
+    n_windows = -(-S // window)  # ceil: remainder block flushes once
+    assert amortized == n_windows * per_commit, (
+        f"window={window}: expected {n_windows} collective rounds/epoch, "
+        f"got {amortized / per_commit}")
+    perstep = _psums_per_epoch(DownpourAlgo(), window, S, amortized=False)
+    assert perstep == S * per_commit  # the round-1 behavior: every step
+
+
+def test_window_one_is_per_step_either_way():
+    assert _psums_per_epoch(DownpourAlgo(), 1, 16, True) == \
+        _psums_per_epoch(DownpourAlgo(), 1, 16, False)
+
+
+# -- compiled-HLO check: collective sits OUTSIDE the inner step loop --------
+
+def _while_depths(txt, op):
+    depths = set()
+    for line in txt.splitlines():
+        if f"%{op}" in line and "op_name=" in line:
+            m = re.search(r'op_name="([^"]+)"', line)
+            if m:
+                depths.add(m.group(1).count("while/"))
+    return depths
+
+
+def test_hlo_all_reduce_outside_inner_loop():
+    """In the lowered+compiled epoch, matmuls run inside the two-level
+    scan nest (while-depth 2) but all-reduce only in the outer window loop
+    (depth 1). The per-step build keeps both at the same depth."""
+    model, engine = _make_engine(DownpourAlgo(), 8, amortized=True)
+    state, X, Y = _epoch_args(engine, model, 32)
+    engine._build()
+    txt = engine._epoch_fn.lower(state, X, Y).compile().as_text()
+    ar, dot = _while_depths(txt, "all-reduce"), _while_depths(txt, "dot")
+    assert ar and dot, "HLO should contain all-reduce and dot ops"
+    assert max(ar) < max(dot), (
+        f"all-reduce nesting {ar} should be shallower than compute {dot}")
+
+    model, engine = _make_engine(DownpourAlgo(), 8, amortized=False)
+    state, X, Y = _epoch_args(engine, model, 32)
+    engine._build()
+    txt = engine._epoch_fn.lower(state, X, Y).compile().as_text()
+    ar, dot = _while_depths(txt, "all-reduce"), _while_depths(txt, "dot")
+    assert max(ar) == max(dot)
+
+
+# -- semantic equivalence ----------------------------------------------------
+
+def _run_epochs(engine, model, S, epochs=2):
+    state, X, Y = _epoch_args(engine, model, S)
+    for _ in range(epochs):
+        state, outs = engine.run_epoch(state, X, Y)
+    params, mstate = engine.extract_model(state)
+    return params, jax.device_get(outs)
+
+
+def test_sync_elastic_amortized_equals_perstep():
+    """Synchronous algorithms (offsets = 0) commit at the window's final
+    step, where the amortized snapshot IS the live params — the two builds
+    must produce the same trajectory to float tolerance."""
+    algo = lambda: ElasticAlgo(alpha=0.05, synchronous=True)
+    model, e_am = _make_engine(algo(), 4, amortized=True)
+    _, e_ps = _make_engine(algo(), 4, amortized=False)
+    p_am, l_am = _run_epochs(e_am, model, 16)
+    p_ps, l_ps = _run_epochs(e_ps, model, 16)
+    np.testing.assert_allclose(l_am, l_ps, rtol=1e-5, atol=1e-6)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6),
+        p_am, p_ps)
+
+
+def test_staggered_amortized_still_learns_vs_perstep():
+    """Staggered (async-emulation) algorithms change commit batching under
+    amortization by design; both paths must still descend comparably."""
+    model, e_am = _make_engine(DownpourAlgo(), 4, amortized=True)
+    _, e_ps = _make_engine(DownpourAlgo(), 4, amortized=False)
+    _, l_am = _run_epochs(e_am, model, 32, epochs=3)
+    _, l_ps = _run_epochs(e_ps, model, 32, epochs=3)
+    # both trajectories end well below the ~ln(4)=1.39 random-init loss
+    assert float(np.mean(l_am[-8:])) < 1.0
+    assert float(np.mean(l_ps[-8:])) < 1.0
+
+
+# -- heterogeneous windows ---------------------------------------------------
+
+def test_heterogeneous_windows_use_perstep_path():
+    _, engine = _make_engine(DownpourAlgo(), [2] * 4 + [4] * 4)
+    assert engine.amortized is False
+
+
+def test_amortized_forced_with_heterogeneous_windows_raises():
+    with pytest.raises(ValueError, match="uniform window"):
+        _make_engine(DownpourAlgo(), [2] * 4 + [4] * 4, amortized=True)
+
+
+def test_uniform_window_defaults_to_amortized():
+    _, engine = _make_engine(DownpourAlgo(), 8)
+    assert engine.amortized is True
+    # a list of equal windows is uniform too
+    _, engine = _make_engine(DownpourAlgo(), [8] * W)
+    assert engine.amortized is True
+
+
+def test_non_amortizable_algorithms_stay_per_step():
+    """DynSGD's staleness damping and ADAG's nonlinear accumulator require
+    per-commit serialization; the engine must not amortize them even with
+    a uniform window."""
+    from distkeras_tpu.parallel.engine import AdagAlgo, DynSGDAlgo
+
+    for algo_cls in (DynSGDAlgo, AdagAlgo):
+        _, engine = _make_engine(algo_cls(), 8)
+        assert engine.amortized is False, algo_cls.__name__
+        with pytest.raises(ValueError, match="not amortizable"):
+            _make_engine(algo_cls(), 8, amortized=True)
