@@ -46,6 +46,13 @@ def init_cache(module: Sequential, batch: int, max_len: int,
     non-attention layers get ``None``."""
     cache = []
     for layer in module.layers:
+        # custom serving loops enter through here: out-of-range position
+        # gathers CLAMP under jit (silently wrong-position logits), so the
+        # capacity check must fail loudly at cache construction too
+        if isinstance(layer, PositionalEmbedding) and max_len > layer.max_len:
+            raise ValueError(
+                f"PositionalEmbedding(max_len={layer.max_len}) is too small "
+                f"for a {max_len}-position decode cache")
         if isinstance(layer, TransformerBlock):
             attn = layer.attn
             h = attn.num_heads
@@ -130,8 +137,13 @@ def _sample(logits, temperature, top_k, rng):
         return jnp.argmax(logits, axis=-1)
     logits = logits.astype(jnp.float32) / temperature
     if top_k is not None:
-        kth = lax.top_k(logits, top_k)[0][..., -1:]
-        logits = jnp.where(logits < kth, NEG_INF, logits)
+        # mask from top_k's INDICES, not a value threshold — ties at the
+        # k-th logit would otherwise admit more than k candidates (the MoE
+        # router masks the same way for the same reason)
+        _, idx = lax.top_k(logits, top_k)
+        keep = jnp.zeros_like(logits, bool).at[
+            jnp.arange(logits.shape[0])[:, None], idx].set(True)
+        logits = jnp.where(keep, logits, NEG_INF)
     return jax.random.categorical(rng, logits, axis=-1)
 
 

@@ -112,6 +112,7 @@ class Dense(Layer):
     def __init__(self, units: int, activation=None, use_bias: bool = True,
                  kernel_init: str = "glorot_uniform", dtype: str = "float32"):
         self.units = int(units)
+        get_activation(activation)  # fail at construction, not first forward
         self.activation = activation
         self.use_bias = use_bias
         self.kernel_init = kernel_init
@@ -146,6 +147,7 @@ class Dense(Layer):
 @register_layer
 class Activation(Layer):
     def __init__(self, activation: str):
+        get_activation(activation)  # fail at construction, not first forward
         self.activation = activation
 
     def apply(self, params, state, x, *, training=False, rng=None):
@@ -216,6 +218,7 @@ class _ConvND(Layer):
     def __init__(self, filters: int, kernel_size, strides=1, padding="SAME",
                  activation=None, use_bias: bool = True,
                  kernel_init: str = "he_normal", dtype: str = "float32"):
+        get_activation(activation)  # fail at construction, not first forward
         self.filters = int(filters)
         self.kernel_size = self._spatial(kernel_size)
         self.strides = self._spatial(strides)

@@ -106,10 +106,15 @@ def save_model(model: Model, path: str, quantize: bool = False) -> None:
         arch["quantized"] = True
         qarrays = {}
         for k, v in arrays.items():
-            if _is_quantizable(v, k.split("/")[-1]):
+            # param name = last path segment with the "params:" store
+            # prefix stripped (root-level params have no "/" at all);
+            # scales live in their own "scale:" namespace so a param
+            # literally named "scale" can never collide with them
+            name = k.split(":", 1)[1].split("/")[-1]
+            if _is_quantizable(v, name):
                 d = _quantize_leaf(v)
                 qarrays[k] = d["q"]
-                qarrays[k + ":scale"] = d["scale"]
+                qarrays["scale:" + k] = d["scale"]
             else:
                 qarrays[k] = v
         arrays = qarrays
@@ -131,15 +136,35 @@ def load_model(path: str, keep_quantized: bool = False):
     if arch.pop("quantized", False):
         from distkeras_tpu.models.quantize import (QuantizedModel,
                                                    _dequantize_leaf)
+        files = set(arrays.files)
+
+        def scale_key(k):
+            """Scale entry for param key ``k``, or None. Current format:
+            ``scale:<k>`` namespace; legacy (round-1) format: ``<k>:scale``
+            suffix — still read so old files dequantize instead of
+            silently loading int8 codes as floats. A genuine param whose
+            key happens to end in ``:scale`` is only mistaken for a legacy
+            scale if its prefix is itself a stored param key — impossible
+            for the current writer (scales live in their own namespace)."""
+            if "scale:" + k in files:
+                return "scale:" + k
+            legacy = k + ":scale"
+            return legacy if legacy in files else None
+
+        def is_scale_entry(k):
+            return k.startswith("scale:") or (
+                k.endswith(":scale") and k[:-len(":scale")] in files)
+
         if not keep_quantized:
             params = {}
             for k in arrays.files:
-                if not k.startswith("params:") or k.endswith(":scale"):
+                if not k.startswith("params:") or is_scale_entry(k):
                     continue
                 name = k[len("params:"):]
-                if k + ":scale" in arrays.files:
+                sk = scale_key(k)
+                if sk is not None:
                     params[name] = np.asarray(_dequantize_leaf(
-                        arrays[k], arrays[k + ":scale"]))
+                        arrays[k], arrays[sk]))
                 else:
                     params[name] = arrays[k]
             return deserialize_model({**arch, "params": params,
@@ -158,9 +183,10 @@ def load_model(path: str, keep_quantized: bool = False):
                 raise ValueError(
                     f"weight {key!r} shape {arr.shape} != "
                     f"expected {leaf.shape}")
-            if key + ":scale" in arrays.files:
+            sk = scale_key(key)
+            if sk is not None:
                 qleaves.append(arr)                       # int8 verbatim
-                sleaves.append(arrays[key + ":scale"])
+                sleaves.append(arrays[sk])
             else:
                 qleaves.append(arr.astype(leaf.dtype))
                 sleaves.append(None)

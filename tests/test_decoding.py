@@ -152,3 +152,28 @@ def test_generate_jit_cached_across_calls():
     assert len(m._jit_generate) == 1
     generate(m, prompts, max_new_tokens=3, temperature=0.5)
     assert len(m._jit_generate) == 2             # new sampling config
+
+def test_top_k_ties_admit_exactly_k():
+    """Ties at the k-th logit must not widen the candidate set: the mask is
+    built from top_k's indices, not a value threshold."""
+    from distkeras_tpu.models.decoding import _sample
+
+    logits = jnp.asarray([[0.0, 5.0, 5.0, 5.0, -1.0]])  # 3-way tie, k=2
+    idx = set(jax.device_get(jax.lax.top_k(logits, 2)[1][0]).tolist())
+    draws = {
+        int(_sample(logits, 1.0, 2, jax.random.PRNGKey(s))[0])
+        for s in range(200)
+    }
+    assert draws == idx, f"sampled outside the top-2 set: {draws - idx}"
+
+
+def test_init_cache_rejects_capacity_beyond_position_table():
+    """Custom serving loops build caches directly — the max_len guard must
+    fire here too, not only inside generate()."""
+    from distkeras_tpu.models.decoding import _resolve_head_dims
+
+    m = lm(use_rope=False)  # PositionalEmbedding(max_len=64)
+    _resolve_head_dims(m.module, m.params)
+    with pytest.raises(ValueError, match="too small"):
+        init_cache(m.module, 1, 65)
+    init_cache(m.module, 1, 64)  # at capacity: fine

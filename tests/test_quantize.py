@@ -113,3 +113,61 @@ def test_quantize_resnet_smoke():
     ref, out = m.predict(x), qm.predict(x)
     assert out.shape == ref.shape
     np.testing.assert_allclose(out, ref, atol=0.1)  # bn-dominated net
+
+def test_save_load_quantized_root_level_params(tmp_path):
+    """A module whose params live at the pytree ROOT (bare layer, no
+    Sequential nesting): the quantize name check must strip the ``params:``
+    store prefix, and a root param literally named ``scale`` (GroupNorm's)
+    must survive the round-trip — scales live in their own ``scale:``
+    namespace, so no name can collide with them."""
+    from distkeras_tpu.models.layers import GroupNorm
+
+    rs = np.random.RandomState(0)
+    X = rs.randn(8, 64).astype(np.float32)
+
+    dense = Model.build(Dense(32), (64,), seed=0)
+    p = str(tmp_path / "bare_dense")
+    save_model(dense, p, quantize=True)
+    stored = np.load(p + ".npz")
+    assert "scale:params:kernel" in stored.files, (
+        "root-level kernel should be quantized (store-prefix stripped "
+        "before the name check)")
+    assert stored["params:kernel"].dtype == np.int8
+    loaded = load_model(p)
+    np.testing.assert_allclose(loaded.predict(X), dense.predict(X),
+                               atol=0.05)
+
+    norm = Model.build(GroupNorm(groups=4), (64,), seed=0)
+    pn = str(tmp_path / "bare_norm")
+    save_model(norm, pn, quantize=True)
+    stored = np.load(pn + ".npz")
+    # 'scale' is accuracy-critical: never quantized, and its key
+    # ``params:scale`` must not be mistaken for a quantization scale
+    assert "params:scale" in stored.files
+    assert stored["params:scale"].dtype == np.float32
+    loaded = load_model(pn)
+    np.testing.assert_allclose(loaded.predict(X), norm.predict(X),
+                               atol=1e-6)
+
+
+def test_load_legacy_scale_suffix_quantized_file(tmp_path):
+    """Round-1 quantized files stored scales as '<key>:scale' suffixes;
+    they must still dequantize (not silently load int8 codes as floats)."""
+    m, X, _ = trained_mlp(seed=2)
+    p = str(tmp_path / "legacy")
+    save_model(m, p, quantize=True)
+    stored = dict(np.load(p + ".npz").items())
+    legacy = {}
+    for k, v in stored.items():
+        if k.startswith("scale:"):
+            legacy[k[len("scale:"):] + ":scale"] = v
+        else:
+            legacy[k] = v
+    np.savez(p + ".npz", **legacy)
+
+    loaded = load_model(p)
+    assert (loaded.predict(X).argmax(-1) ==
+            m.predict(X).argmax(-1)).mean() > 0.99
+    # int8 serving handle reads legacy scales too
+    qm = load_model(p, keep_quantized=True)
+    np.testing.assert_allclose(qm.predict(X), loaded.predict(X), atol=1e-5)
