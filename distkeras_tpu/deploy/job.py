@@ -134,12 +134,37 @@ def _worker_env(spec: JobSpec, coord: str, pid: int) -> Dict[str, str]:
 
 
 class Job:
-    """Run a ``JobSpec`` as N local worker processes (reference:
-    ``job_deployment.py :: Job.run``, with the Spark cluster replaced by a
-    ``jax.distributed`` coordination domain on this host)."""
+    """Run a ``JobSpec`` as N worker processes — local by default, or one
+    per remote host over SSH (reference: ``job_deployment.py :: Job.run``,
+    which packages and submits to a Spark cluster over SSH; SURVEY §2.1 L0).
 
-    def __init__(self, spec: JobSpec):
+    ``hosts=None``: N local processes in one ``jax.distributed``
+    coordination domain (the reference's ``local[*]`` analogue).
+
+    ``hosts=[...]``: host i runs process i via ``<transport> <host>
+    <command>``; the command line embeds the ``DKT_*`` coordination env
+    exactly as ``ssh_commands`` prints it. ``transport`` defaults to
+    non-interactive ssh and is injectable (tests substitute a loopback
+    stub; operators can substitute ``gcloud compute tpus tpu-vm ssh``-style
+    wrappers). Logs and whole-job retry behave as in the local path;
+    ``spec.timeout`` is additionally enforced on the remote side by
+    wrapping the command in coreutils ``timeout -k`` (killing the local
+    ssh client alone would leave remote workers holding their devices).
+    """
+
+    def __init__(self, spec: JobSpec, hosts: Optional[Sequence[str]] = None,
+                 coordinator_host: Optional[str] = None,
+                 python: str = "python3",
+                 transport: Sequence[str] = ("ssh", "-o", "BatchMode=yes")):
         self.spec = spec
+        self.hosts = list(hosts) if hosts else None
+        if self.hosts and len(self.hosts) != spec.num_processes:
+            raise ValueError(
+                f"{len(self.hosts)} hosts for {spec.num_processes} "
+                "processes; deployment is one process per host")
+        self.coordinator_host = coordinator_host
+        self.python = python
+        self.transport = list(transport)
 
     def run(self) -> JobResult:
         """Launch; on failure relaunch up to ``max_retries`` times (each
@@ -147,28 +172,55 @@ class Job:
         result with ``attempts`` filled in."""
         attempts = max(1, self.spec.max_retries + 1)
         for attempt in range(attempts):
-            result = self._run_once(force_free_port=attempt > 0)
+            result = self._run_once(attempt=attempt)
             result.attempts = attempt + 1
             if result.ok or attempt == attempts - 1:
                 return result
         return result  # pragma: no cover
 
-    def _run_once(self, force_free_port: bool = False) -> JobResult:
+    def _spawn(self, attempt: int) -> List[subprocess.Popen]:
         spec = self.spec
-        # retries always re-pick: a pinned port can still be held by a
-        # not-yet-reaped child of the failed attempt
-        port = (spec.coordinator_port
-                if spec.coordinator_port and not force_free_port
-                else _free_port())
-        coord = f"127.0.0.1:{port}"
-        t0 = time.perf_counter()
-        procs = []
-        for pid in range(spec.num_processes):
-            procs.append(subprocess.Popen(
+        if self.hosts is None:
+            # retries always re-pick: a pinned port can still be held by a
+            # not-yet-reaped child of the failed attempt
+            port = (spec.coordinator_port
+                    if spec.coordinator_port and attempt == 0
+                    else _free_port())
+            coord = f"127.0.0.1:{port}"
+            return [subprocess.Popen(
                 [sys.executable, spec.script, *spec.args],
                 env=_worker_env(spec, coord, pid),
                 stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
-                text=True))
+                text=True) for pid in range(spec.num_processes)]
+        # remote: the coordinator port lives on a remote host, so a local
+        # free-port probe is meaningless — offset the base port per retry
+        base = spec.coordinator_port or 29500
+        spec_attempt = JobSpec(**{**spec.to_dict(),
+                                  "coordinator_port": base + attempt})
+        cmds = ssh_commands(spec_attempt, self.hosts,
+                            coordinator_host=self.coordinator_host,
+                            python=self.python)
+        if spec.timeout:
+            # killing the local ssh client does NOT kill the remote worker
+            # (a process blocked in a collective never notices the broken
+            # pipe and would hold its devices into the retry attempt) —
+            # enforce the deadline on the REMOTE side too, TERM then KILL
+            # `env` carries the K=V prefix: timeout exec()s its argument
+            # directly (no shell), so a bare env-assignment prefix would
+            # be taken for the command name. Ceil with a floor of 1 —
+            # coreutils treats duration 0 as NO limit
+            import math
+            secs = max(1, math.ceil(spec.timeout))
+            cmds = [f"timeout -k 15 {secs} env {cmd}" for cmd in cmds]
+        return [subprocess.Popen(
+            [*self.transport, host, cmd],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True) for host, cmd in zip(self.hosts, cmds)]
+
+    def _run_once(self, attempt: int = 0) -> JobResult:
+        spec = self.spec
+        t0 = time.perf_counter()
+        procs = self._spawn(attempt)
         # drain every pipe CONCURRENTLY: a worker that fills its 64KB stdout
         # pipe would otherwise block mid-collective and hang the whole
         # coordination domain while run() sat in an earlier communicate()
@@ -219,6 +271,8 @@ def ssh_commands(spec: JobSpec, hosts: Sequence[str],
                 ENV_COORD: f"{coord_host}:{port}",
                 ENV_NUM_PROCS: str(len(hosts)),
                 ENV_PROC_ID: str(pid)}
+        if spec.devices_per_process:
+            envs[ENV_DEVICES_PER_PROC] = str(spec.devices_per_process)
         import shlex
         env_str = " ".join(f"{k}={shlex.quote(str(v))}"
                            for k, v in sorted(envs.items()))
