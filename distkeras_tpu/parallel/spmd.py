@@ -49,8 +49,15 @@ class SPMDTrainer(Trainer):
                  data_axes: Union[str, Sequence[str]] = ("workers",),
                  tp_axis: Optional[str] = "tp",
                  ep_axis: Optional[str] = None,
-                 fsdp_axis: Optional[str] = None, **kwargs):
+                 fsdp_axis: Optional[str] = None,
+                 sharded_checkpoints: bool = True, **kwargs):
         super().__init__(keras_model, **kwargs)
+        #: per-shard checkpoint files (utils.checkpoint.
+        #: ShardedCheckpointManager): saves write only addressable shards,
+        #: restores device_put shard-by-shard — the full tree never lands
+        #: on one host (this trainer exists for models where it can't).
+        #: Requires checkpoint_dir on SHARED storage under multi-process.
+        self.sharded_checkpoints = bool(sharded_checkpoints)
         if mesh is None:
             from distkeras_tpu.parallel.mesh import make_mesh
             mesh = make_mesh()
@@ -94,6 +101,69 @@ class SPMDTrainer(Trainer):
                            fsdp_axis=self.fsdp_axis)
 
     # -- resume plumbing ----------------------------------------------------
+    def _checkpoint_manager(self):
+        if self.checkpoint_dir is None:
+            return None
+        if self.sharded_checkpoints:
+            if self.checkpoint_async:
+                raise ValueError(
+                    "checkpoint_async is not supported with "
+                    "sharded_checkpoints: the sharded save runs "
+                    "multi-process barriers that must stay on the training "
+                    "thread. Pass sharded_checkpoints=False to keep async "
+                    "dense snapshots.")
+            from distkeras_tpu.utils.checkpoint import \
+                ShardedCheckpointManager
+            return ShardedCheckpointManager(self.checkpoint_dir)
+        return super()._checkpoint_manager()
+
+    def _opt_shardings(self, params_host, param_sh, repl):
+        """Shardings for the optimizer state: moment subtrees that mirror
+        the params tree get the params' shardings (moments live WITH their
+        params); anything else (step counters) replicates. Used both to
+        constrain the fresh ``jit(init)`` (GSPMD would otherwise be free to
+        shard unconstrained zeros however it likes) and to place restored
+        checkpoint shards — keeping save and restore layouts identical."""
+        opt_shapes = jax.eval_shape(self.worker_optimizer.init, params_host)
+        pstruct = jax.tree_util.tree_structure(params_host)
+        rmap = lambda tree: jax.tree_util.tree_map(lambda _: repl, tree)
+        mirror = lambda sub: param_sh if jax.tree_util.tree_structure(
+            sub) == pstruct else rmap(sub)
+        if isinstance(opt_shapes, dict):
+            return {k: mirror(v) for k, v in opt_shapes.items()}
+        return rmap(opt_shapes)
+
+    def _restore_sharded(self, manager, model: Model, param_sh, repl):
+        """Device-direct resume: build the sharding tree matching the saved
+        carry and let the manager place every stored shard. Returns
+        ``(device carry tree | None, start_epoch)``. Old dense or
+        params-only checkpoints restore too (full-copy slicing / fresh
+        moments)."""
+        if manager is None or not self.resume:
+            return None, 0
+        latest = manager.latest_step()
+        if latest is None:
+            return None, 0
+        keys = manager.keys(latest) or []
+        full_carry = any(k == "rng" or k.startswith("rng/") for k in keys)
+
+        rmap = lambda tree: jax.tree_util.tree_map(lambda _: repl, tree)
+        shardings = {"params": param_sh, "state": rmap(model.state)}
+        if full_carry:
+            shardings["opt"] = self._opt_shardings(model.params, param_sh,
+                                                   repl)
+            shardings["rng"] = repl
+        else:
+            import warnings
+            warnings.warn(
+                "checkpoint predates the full-carry format; restoring "
+                "params/state only (optimizer moments and rng restart "
+                "fresh)", stacklevel=2)
+        tree = manager.restore_sharded(shardings, step=latest)
+        meta = manager.metadata(step=latest)
+        start = int(meta.get("epoch", -1)) + 1
+        return (tree if start > 0 else None), start
+
     def _ckpt_format(self, manager) -> int:
         """0: no checkpoint; 1: old params/state-only; 2: full carry.
 
@@ -180,7 +250,11 @@ class SPMDTrainer(Trainer):
         # rng) so a resumed run is bitwise-identical to an uninterrupted
         # one — same contract as SingleTrainer
         manager = self._checkpoint_manager()
-        restored, start_epoch = self._restore_full_carry(manager, model)
+        if self.sharded_checkpoints:
+            restored, start_epoch = self._restore_sharded(
+                manager, model, param_sh, repl)
+        else:
+            restored, start_epoch = self._restore_full_carry(manager, model)
 
         if restored is None:
             # fresh start: shard params first, then init the optimizer
@@ -189,8 +263,25 @@ class SPMDTrainer(Trainer):
             params = jax.tree_util.tree_map(jax.device_put, model.params,
                                             param_sh)
             state = jax.device_put(model.state, repl)
-            opt_state = jax.jit(self.worker_optimizer.init)(params)
+            opt_state = jax.jit(
+                self.worker_optimizer.init,
+                out_shardings=self._opt_shardings(model.params, param_sh,
+                                                  repl))(params)
             rng = jax.device_put(jax.random.PRNGKey(self.seed), repl)
+        elif self.sharded_checkpoints:
+            # already device-resident with the right shardings; fill any
+            # missing slots (params-only legacy checkpoints)
+            params = restored["params"]
+            state = restored["state"]
+            opt_state = restored.get("opt")
+            if opt_state is None:
+                opt_state = jax.jit(
+                    self.worker_optimizer.init,
+                    out_shardings=self._opt_shardings(
+                        model.params, param_sh, repl))(params)
+            rng = restored.get("rng")
+            if rng is None:
+                rng = jax.device_put(jax.random.PRNGKey(self.seed), repl)
         else:
             params = jax.tree_util.tree_map(jax.device_put,
                                             restored["params"], param_sh)
@@ -203,7 +294,16 @@ class SPMDTrainer(Trainer):
         step = make_train_step(model.module, self.loss, self.worker_optimizer,
                                self._metric_fns(), self.grad_accum_steps)
 
-        @partial(jax.jit, donate_argnums=(0,))
+        # pin the carry's layout across epochs: GSPMD is otherwise free to
+        # re-shard unconstrained outputs (e.g. row-shard a replicated
+        # param's adam moment), which would drift the layout away from
+        # what _opt_shardings promised the checkpoint format
+        rmap = lambda tree: jax.tree_util.tree_map(lambda _: repl, tree)
+        carry_sh = TrainCarry(
+            param_sh, rmap(model.state),
+            self._opt_shardings(model.params, param_sh, repl), repl)
+
+        @partial(jax.jit, donate_argnums=(0,), out_shardings=(carry_sh, None))
         def run_epoch(carry, Xs, Ys):
             return jax.lax.scan(step, carry, (Xs, Ys))
 
@@ -249,17 +349,24 @@ class SPMDTrainer(Trainer):
                                                       carry.state)).items()}
                     self.history.append_epoch(loss=losses, **mets, **extra)
                     if manager is not None and self._should_checkpoint(epoch):
-                        # host_fetch is a COLLECTIVE under multi-process
-                        # (allgather of non-addressable shards) — every
-                        # process must enter it; only the write is gated on
-                        # process 0
-                        snapshot = host_fetch({"params": carry.params,
-                                               "state": carry.state,
-                                               "opt": carry.opt_state,
-                                               "rng": carry.rng})
-                        if jax.process_index() == 0:
-                            manager.save(epoch, snapshot,
+                        carry_tree = {"params": carry.params,
+                                      "state": carry.state,
+                                      "opt": carry.opt_state,
+                                      "rng": carry.rng}
+                        if self.sharded_checkpoints:
+                            # every process writes ITS shards (barriers
+                            # inside); no host gather of the full tree
+                            manager.save(epoch, carry_tree,
                                          metadata={"epoch": epoch})
+                        else:
+                            # host_fetch is a COLLECTIVE under multi-process
+                            # (allgather of non-addressable shards) — every
+                            # process must enter it; only the write is gated
+                            # on process 0
+                            snapshot = host_fetch(carry_tree)
+                            if jax.process_index() == 0:
+                                manager.save(epoch, snapshot,
+                                             metadata={"epoch": epoch})
                     # logs derive from replicated values, so every process
                     # sees identical callback decisions (incl. stop_training
                     # and any collective get_weights fetch inside a callback)
