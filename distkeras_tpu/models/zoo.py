@@ -132,7 +132,7 @@ def transformer_lm(vocab_size: int, d_model: int = 512, num_heads: int = 8,
                    num_layers: int = 6, mlp_ratio: int = 4,
                    max_len: Optional[int] = None, use_rope: bool = True,
                    norm: str = "rmsnorm", dtype: str = "float32",
-                   attn_impl: str = "xla",
+                   attn_impl: str = "auto",
                    seq_axis_name: Optional[str] = None,
                    moe_every: int = 0, num_experts: int = 0,
                    moe_expert_axis: Optional[str] = None,

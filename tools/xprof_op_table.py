@@ -1,0 +1,101 @@
+"""Render a per-op time table from an XProf trace directory.
+
+Usage:
+    python bench.py --profile /tmp/xprof            # capture
+    python tools/xprof_op_table.py /tmp/xprof       # render markdown
+
+Parses the ``*.xplane.pb`` the JAX profiler writes, aggregates the TPU
+device plane's "XLA Ops" line by op, and prints a markdown table of the
+top ops plus a category rollup (convolution/matmul vs batch-norm-statistics
+reductions vs other fusions vs data movement). Runs with the pure-python
+protobuf implementation so it works even where the tensorboard profile
+plugin's C++ bridge is version-mismatched (set
+``PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION=python`` if import fails).
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import re
+import sys
+from collections import defaultdict
+
+os.environ.setdefault("PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION", "python")
+
+
+def _category(op_name: str) -> str:
+    n = op_name.lower()
+    # on TPU the compiler fuses convolutions WITH their bf16->f32 convert +
+    # BN-statistics reduction epilogues; op names alone cannot split conv
+    # FLOPs from BN stats, so the buckets describe the fusion shapes
+    if "convert_reduce" in n:
+        return "fused conv + stats-reduce blocks"
+    if "convolution" in n or re.match(r"%?(conv(?!ert)|dot)", n):
+        return "unfused conv/matmul"
+    if "reduce" in n and "window" not in n and "scatter" not in n:
+        return "standalone reductions"
+    if "select-and-scatter" in n or "reduce-window" in n:
+        return "pooling"
+    if "copy" in n or "transpose" in n or "bitcast" in n:
+        return "data movement"
+    if "all-reduce" in n or "all-gather" in n or "collective" in n \
+            or "permute" in n:
+        return "collectives"
+    if "fusion" in n:
+        return "elementwise fusions"
+    return "other"
+
+
+def load_op_times(trace_dir: str):
+    from tensorflow.tsl.profiler.protobuf import xplane_pb2
+
+    files = sorted(glob.glob(trace_dir + "/**/*.xplane.pb", recursive=True))
+    if not files:
+        raise SystemExit(f"no .xplane.pb under {trace_dir}")
+    xs = xplane_pb2.XSpace()
+    with open(files[-1], "rb") as f:
+        xs.ParseFromString(f.read())
+    dur = defaultdict(float)
+    cnt = defaultdict(int)
+    for p in xs.planes:
+        if not p.name.startswith("/device:TPU"):
+            continue
+        ev_meta = {m.id: m.name for m in p.event_metadata.values()}
+        for line in p.lines:
+            if line.name != "XLA Ops":
+                continue
+            for ev in line.events:
+                name = ev_meta.get(ev.metadata_id, "?").split(" = ")[0]
+                dur[name] += ev.duration_ps / 1e12
+                cnt[name] += 1
+    return dur, cnt
+
+
+def main():
+    trace_dir = sys.argv[1] if len(sys.argv) > 1 else "/tmp/xprof"
+    top_n = int(sys.argv[2]) if len(sys.argv) > 2 else 10
+    dur, cnt = load_op_times(trace_dir)
+    total = sum(dur.values())
+    if not total:
+        raise SystemExit("trace has no TPU XLA Ops events")
+
+    cats = defaultdict(float)
+    for name, d in dur.items():
+        cats[_category(name)] += d
+
+    print(f"Total device op time: {total:.4f}s "
+          f"({len(dur)} distinct ops)\n")
+    print("| category | time | share |")
+    print("|---|---|---|")
+    for cat, d in sorted(cats.items(), key=lambda kv: -kv[1]):
+        print(f"| {cat} | {d * 1e3:.1f} ms | {100 * d / total:.1f}% |")
+    print(f"\n| top-{top_n} op | time | share | calls |")
+    print("|---|---|---|---|")
+    for name, d in sorted(dur.items(), key=lambda kv: -kv[1])[:top_n]:
+        print(f"| `{name[:60]}` | {d * 1e3:.1f} ms | "
+              f"{100 * d / total:.1f}% | {cnt[name]} |")
+
+
+if __name__ == "__main__":
+    main()
