@@ -21,8 +21,95 @@ if "xla_force_host_platform_device_count" not in flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+# persistent compile cache: most test wall-time on a small box is jit
+# compilation; warming the cache across runs cuts repeat suite time
+jax.config.update("jax_compilation_cache_dir",
+                  os.environ.get("DKT_TEST_CACHE",
+                                 "/tmp/distkeras_test_jax_cache"))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
 import pytest  # noqa: E402
+
+# ---------------------------------------------------------------------------
+# Test tiers: `pytest -m "not slow"` is the fast default tier (~2-3 min on a
+# 1-CPU box); the full suite (~19 min) runs everything. Slow = multi-epoch
+# convergence runs, multi-process jobs, and big-model builds; every
+# subsystem keeps at least one fast test in the default tier.
+# ---------------------------------------------------------------------------
+
+SLOW_FILES = {
+    "test_examples.py",        # full example scripts, multi-epoch
+    "test_async_crossval.py",  # 8-12 epoch engine-vs-threads runs
+    "test_golden_real.py",     # 30-40 epoch real-data convergence
+    "test_pipeline.py",        # pipeline-parallel training runs
+    "test_schedules_remat.py",  # pipeline remat comparisons
+    "test_sharded.py",         # out-of-core shard streams
+    "test_adapters_ring.py",   # ring/ulysses integration
+}
+
+SLOW_TESTS = {
+    # multi-process jax.distributed launches (subprocess + compile each)
+    "test_multiprocess_checkpoint_resume_consistent",
+    "test_job_runs_distributed_trainer_across_processes",
+    "test_job_retry_recovers", "test_job_no_retry_reports_failure",
+    "test_job_runs_multiprocess_psum", "test_job_remote_retry_offsets_port",
+    "test_job_remote_executes_over_transport",
+    # big-model builds / long roundtrips in otherwise-fast files
+    "test_mobilenet_builds_and_runs", "test_vit_builds_and_runs",
+    "test_moe_aux_loss_joins_training_loss",
+    "test_thin_resnet_forward_and_residual_shapes",
+    "test_residual_serialization_roundtrip", "test_roundtrip_cnn_with_state",
+    "test_roundtrip_bilstm", "test_quantize_resnet_smoke",
+    "test_transformer_lm_forward_and_train_step",
+    "test_transformer_moe_lm_builds",
+    "test_ensemble_trainer_trains_independent_models",
+    "test_decode_step_matches_full_forward",
+    "test_generate_with_tp_sharded_params",
+    "test_distributed_resume_with_different_worker_count",
+    "test_spmd_trainer_moe_ep", "test_spmd_trainer_resume_exact",
+    "test_lenet5_shapes", "test_tp_sharded_forward_matches_replicated",
+    "test_transformer_block_serialization_roundtrip",
+    # second tier: 3-10s each; every subsystem keeps >=1 fast
+    # representative (e.g. host-async keeps the downpour variant, engine
+    # amortization tests all stay — they are the round-2 regression net)
+    "test_golden_mnist_mlp_convergence",
+    "test_spmd_trainer_matches_single_device_sgd",
+    "test_param_specs_moe_expert_parallel",
+    "test_host_async_trainer_converges",  # all variants; downpour ~3s too
+    "test_model_get_set_weights_keras_style",
+    "test_accum_matches_full_batch_exactly",
+    "test_bilstm_batched_inference", "test_predictor_tp_sharded_params",
+    "test_conv_pool_flatten_lenet_shapes",
+    "test_resume_is_exact_for_single_trainer",
+    "test_generate_jit_cached_across_calls",
+    "test_generate_continues_memorized_sequence",
+    "test_generate_stop_token_pads_tail",
+    "test_conv2d_transpose_upsamples", "test_ensemble_trainer_metrics",
+    "test_host_async_checkpoint_and_resume",
+    "test_mixed_precision_bf16_activation_flow",
+    "test_dynsgd_learns_with_heterogeneous_windows",
+    "test_host_async_trainer_metrics", "test_moe_dense_vs_expert_parallel",
+    "test_distributed_validation_uses_trained_bn_state",
+    "test_generate_sampling_and_validation", "test_separable_conv2d",
+    "test_host_async_trainer_validation", "test_averaging_trainer_learns",
+    "test_host_async_trainer_callbacks_early_stop",
+    "test_mha_ulysses_layer_matches_xla",
+}
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: multi-epoch/multi-process/big-model tests "
+        "excluded from the fast default tier (-m 'not slow')")
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        name = item.originalname if hasattr(item, "originalname") \
+            else item.name
+        if (item.fspath.basename in SLOW_FILES
+                or name.split("[")[0] in SLOW_TESTS):
+            item.add_marker(pytest.mark.slow)
 
 
 @pytest.fixture(scope="session")
