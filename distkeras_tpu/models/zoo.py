@@ -56,11 +56,27 @@ def lenet5(num_classes: int = 10, dtype: str = "float32") -> Sequential:
     ])
 
 
+def _resnet_norm(norm: str, bn_axis_name: Optional[str],
+                 norm_groups: int = 32):
+    """Norm factory for the resnet family: ``"batch"`` (reference-standard
+    BN) or ``"group"`` (GroupNorm-32, Wu & He 2018 — no batch statistics,
+    so no cross-replica stats axis, identical train/eval, and on TPU no
+    f32 stats-reduction epilogue fused after every conv; see docs/PERF.md
+    for the measured profile share of BN statistics)."""
+    if norm == "batch":
+        return lambda: BatchNorm(axis_name=bn_axis_name)
+    if norm == "group":
+        from distkeras_tpu.models.layers import GroupNorm
+        return lambda: GroupNorm(groups=norm_groups)
+    raise ValueError(f"norm must be 'batch' or 'group', got {norm!r}")
+
+
 def _bottleneck(filters: int, stride: int, project: bool,
-                dtype: str, bn_axis_name: Optional[str]) -> Residual:
-    """ResNet-v1.5 bottleneck: 1x1 -> 3x3(stride) -> 1x1(4f), BN after each
-    conv, relu after the residual add."""
-    bn = lambda: BatchNorm(axis_name=bn_axis_name)
+                dtype: str, bn_axis_name: Optional[str],
+                norm: str = "batch", norm_groups: int = 32) -> Residual:
+    """ResNet-v1.5 bottleneck: 1x1 -> 3x3(stride) -> 1x1(4f), norm after
+    each conv, relu after the residual add."""
+    bn = _resnet_norm(norm, bn_axis_name, norm_groups)
     main = Sequential([
         Conv2D(filters, 1, use_bias=False, dtype=dtype), bn(),
         Activation("relu"),
@@ -79,11 +95,13 @@ def _bottleneck(filters: int, stride: int, project: bool,
 
 def resnet(stage_sizes: Sequence[int], num_classes: int = 1000,
            width: int = 64, dtype: str = "float32",
-           bn_axis_name: Optional[str] = None) -> Sequential:
-    """ResNet-v1.5 family over bottleneck blocks (NHWC)."""
+           bn_axis_name: Optional[str] = None,
+           norm: str = "batch", norm_groups: int = 32) -> Sequential:
+    """ResNet-v1.5 family over bottleneck blocks (NHWC). ``norm_groups``
+    only applies to ``norm="group"`` and must divide every stage width."""
     layers = [
         Conv2D(width, 7, strides=2, use_bias=False, dtype=dtype),
-        BatchNorm(axis_name=bn_axis_name), Activation("relu"),
+        _resnet_norm(norm, bn_axis_name, norm_groups)(), Activation("relu"),
         MaxPooling2D(3, strides=2, padding="SAME"),
     ]
     filters = width
@@ -92,16 +110,20 @@ def resnet(stage_sizes: Sequence[int], num_classes: int = 1000,
             stride = 2 if (stage > 0 and block == 0) else 1
             project = (block == 0)
             layers.append(_bottleneck(filters, stride, project, dtype,
-                                      bn_axis_name))
+                                      bn_axis_name, norm, norm_groups))
         filters *= 2
     layers += [GlobalAveragePooling2D(), Dense(num_classes, dtype=dtype)]
     return Sequential(layers)
 
 
 def resnet50(num_classes: int = 1000, dtype: str = "float32",
-             bn_axis_name: Optional[str] = None) -> Sequential:
-    """ResNet-50 (BASELINE config 3 / the north-star model)."""
-    return resnet([3, 4, 6, 3], num_classes, 64, dtype, bn_axis_name)
+             bn_axis_name: Optional[str] = None,
+             norm: str = "batch") -> Sequential:
+    """ResNet-50 (BASELINE config 3 / the north-star model). ``norm=
+    "group"`` gives the GroupNorm variant (different numerics — a model
+    choice, not a drop-in BN replacement)."""
+    return resnet([3, 4, 6, 3], num_classes, 64, dtype, bn_axis_name,
+                  norm)
 
 
 def resnet18_thin(num_classes: int = 10, width: int = 8,

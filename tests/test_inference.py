@@ -194,3 +194,36 @@ def test_bilstm_batched_inference():
     # sharded path == plain forward
     np.testing.assert_allclose(out["prediction"][:8],
                                model.predict(X[:8]), rtol=1e-5, atol=1e-5)
+
+
+def test_resnet_groupnorm_variant_builds_and_trains():
+    """zoo.resnet(norm='group'): no batch statistics (state empty of
+    running stats), identical train/eval, one step runs."""
+    import jax
+    import numpy as np
+
+    from distkeras_tpu.models import Model, zoo
+    from distkeras_tpu.ops import get_loss, get_optimizer
+    from distkeras_tpu.parallel.worker import TrainCarry, make_train_step
+
+    module = zoo.resnet([1, 1], num_classes=4, width=8, norm="group",
+                        norm_groups=4)
+    model = Model.build(module, (32, 32, 3), seed=0)
+    # GroupNorm keeps no running stats: the state tree has no arrays
+    assert not any(hasattr(leaf, "shape") and leaf.size
+                   for leaf in jax.tree_util.tree_leaves(model.state))
+    opt = get_optimizer("sgd", learning_rate=0.1)
+    step = make_train_step(
+        module, get_loss("sparse_categorical_crossentropy_from_logits"),
+        opt)
+    rs = np.random.RandomState(0)
+    xb = np.asarray(rs.rand(8, 32, 32, 3), np.float32)
+    yb = rs.randint(0, 4, 8)
+    carry = TrainCarry(model.params, model.state, opt.init(model.params),
+                       jax.random.PRNGKey(0))
+    carry, loss = jax.jit(step)(carry, (xb, yb))
+    assert np.isfinite(float(loss))
+
+    import pytest
+    with pytest.raises(ValueError, match="norm must be"):
+        zoo.resnet([1], norm="instance")
