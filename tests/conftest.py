@@ -54,6 +54,7 @@ SLOW_TESTS = {
     "test_job_retry_recovers", "test_job_no_retry_reports_failure",
     "test_job_runs_multiprocess_psum", "test_job_remote_retry_offsets_port",
     "test_job_remote_executes_over_transport",
+    "test_fault_injection_mid_training_recovery",
     # big-model builds / long roundtrips in otherwise-fast files
     "test_mobilenet_builds_and_runs", "test_vit_builds_and_runs",
     "test_moe_aux_loss_joins_training_loss",
@@ -94,6 +95,7 @@ SLOW_TESTS = {
     "test_host_async_trainer_validation", "test_averaging_trainer_learns",
     "test_host_async_trainer_callbacks_early_stop",
     "test_mha_ulysses_layer_matches_xla",
+    "test_resnet_groupnorm_variant_builds_and_trains",
 }
 
 

@@ -311,3 +311,64 @@ def test_job_remote_retry_offsets_port(tmp_path):
 def test_job_remote_host_count_must_match():
     with pytest.raises(ValueError, match="one process per host"):
         Job(JobSpec(script="x.py", num_processes=3), hosts=["a", "b"])
+
+
+def test_fault_injection_mid_training_recovery(tmp_path):
+    """End-to-end elastic recovery (SURVEY §5.3): a worker process DIES
+    mid-training (SIGKILL on itself after epoch 1 of attempt 1); the
+    whole-job retry relaunches, the trainer resumes from the last center
+    checkpoint, and training completes all epochs with every process
+    agreeing on the final model."""
+    marker = tmp_path / "crashed_once"
+    ckpt = tmp_path / "ckpt"
+    script = _write(tmp_path, "crashy.py", f"""
+        import os, signal
+        from distkeras_tpu.deploy import initialize_from_env
+        info = initialize_from_env()
+        import numpy as np, jax
+        from distkeras_tpu.data import Dataset
+        from distkeras_tpu.models import Model, zoo
+        from distkeras_tpu.parallel import ADAG, make_mesh
+        from distkeras_tpu.utils.callbacks import Callback
+
+        rs = np.random.RandomState(0)
+        X = rs.randn(256, 8).astype(np.float32)
+        Y = (X @ rs.randn(8, 3)).argmax(-1)
+        model = Model.build(zoo.mlp((16,), num_classes=3), (8,), seed=0)
+
+        class CrashOnce(Callback):
+            def on_epoch_end(self, epoch, logs=None):
+                if (epoch == 1 and jax.process_index() == 1
+                        and not os.path.exists({str(marker)!r})):
+                    open({str(marker)!r}, "w").close()
+                    os.kill(os.getpid(), signal.SIGKILL)  # hard death
+
+        cdir = {str(ckpt)!r} if jax.process_index() == 0 \\
+            else {str(ckpt)!r} + f"-p{{jax.process_index()}}"
+        tr = ADAG(model, num_workers=4, mesh=make_mesh(4), batch_size=8,
+                  num_epoch=4, communication_window=2,
+                  worker_optimizer="sgd",
+                  optimizer_kwargs={{"learning_rate": 0.1}},
+                  loss="sparse_categorical_crossentropy_from_logits",
+                  checkpoint_dir=cdir, resume=True,
+                  callbacks=[CrashOnce()])
+        t = tr.train(Dataset({{"features": X, "label": Y}}))
+        epochs_run = tr.get_history().losses().shape[0] // 8
+        digest = float(np.asarray(t.predict(X[:16])).sum())
+        print(f"RECOVERY {{info['process_id']}} {{epochs_run}} "
+              f"{{digest:.6f}}")
+    """)
+    spec = JobSpec(script=script, num_processes=2, devices_per_process=2,
+                   env={"PYTHONPATH": REPO}, timeout=300, max_retries=2)
+    result = Job(spec).run()
+    assert result.ok, result.logs
+    assert result.attempts == 2, "expected exactly one relaunch"
+    assert marker.exists()
+    lines = [l for log in result.logs for l in log.splitlines()
+             if l.startswith("RECOVERY")]
+    assert len(lines) == 2, result.logs
+    # the relaunched run resumed past the checkpointed epochs (trained
+    # fewer than num_epoch) and both processes agree on the final model
+    epochs_after_resume = int(lines[0].split()[2])
+    assert epochs_after_resume < 4, lines
+    assert lines[0].split()[3] == lines[1].split()[3], lines
