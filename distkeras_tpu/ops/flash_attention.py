@@ -11,10 +11,15 @@ across the K sweep. Causal q/k tiles above the diagonal skip their compute
 via ``pl.when``. Sequence lengths that don't divide the block sizes are
 zero-padded and the pad keys masked off.
 
-The backward pass is a blockwise XLA recomputation (``lax.scan`` over K
-blocks, recomputing probabilities from the saved log-sum-exp) — O(S) memory
-like the forward, with XLA fusing the per-block einsums. A fully in-kernel
-backward is a later optimization.
+The backward pass is in-kernel too (two Pallas kernels: dq sweeps K blocks
+innermost; dk/dv sweeps Q blocks innermost, both recomputing probabilities
+from the saved log-sum-exp with f32 VMEM accumulators) — the probability
+tile never touches HBM. A blockwise XLA-scan backward is retained for
+interpreter/CPU runs and as a cross-check oracle (``bwd="xla"``). Measured
+on a v5e at B8 H16 S2048 D64 causal bf16: attention fwd+bwd ~16 ms with
+the kernel backward, and end-to-end 218M-param LM training throughput
+rises 37% (42.7K -> 58.5K tokens/sec, 1.96x the fused-XLA attention
+path; ``bench.py --model lm``).
 
 On non-TPU backends the kernel runs in Pallas interpreter mode (tests) or
 falls back to the fused-XLA reference (``ops.attention``) for speed.
@@ -168,6 +173,169 @@ def _flash_forward(q, k, v, scale: float, causal: bool, block_q: int,
     return out, lse
 
 
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, dq_ref,
+                   dq_acc, *, scale: float, causal: bool, k_len: int):
+    """dq pass: one (batch*head, q_block, k_block) program, K innermost.
+    ``dq_acc`` [bq, D] f32 persists across the K sweep."""
+    qi, ki = pl.program_id(1), pl.program_id(2)
+    nk = pl.num_programs(2)
+    block_q, block_k = q_ref.shape[1], k_ref.shape[1]
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    run = (ki * block_k <= qi * block_q + block_q - 1) if causal \
+        else (ki >= 0)
+
+    @pl.when(run)
+    def _compute():
+        qs = q_ref[0].astype(jnp.float32) * scale
+        kblk = k_ref[0].astype(jnp.float32)
+        vblk = v_ref[0].astype(jnp.float32)
+        g32 = g_ref[0].astype(jnp.float32)
+        s = lax.dot_general(qs, kblk, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+        q_pos = qi * block_q + lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        k_pos = ki * block_k + lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        if causal:
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        if k_len % block_k:
+            s = jnp.where(k_pos < k_len, s, NEG_INF)
+        p = jnp.exp(s - lse_ref[0])                        # [bq, bk]
+        dp = lax.dot_general(g32, vblk, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0])
+        dq_acc[:] += lax.dot_general(
+            ds, kblk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_acc, dv_acc,
+                    *, scale: float, causal: bool, k_len: int):
+    """dk/dv pass: one (batch*head, k_block, q_block) program, Q innermost.
+    ``dk_acc``/``dv_acc`` [bk, D] f32 persist across the Q sweep."""
+    ki, qi = pl.program_id(1), pl.program_id(2)
+    nq = pl.num_programs(2)
+    block_k, block_q = k_ref.shape[1], q_ref.shape[1]
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    # causal: q tiles entirely above the diagonal see none of this k block
+    run = (qi * block_q + block_q - 1 >= ki * block_k) if causal \
+        else (qi >= 0)
+
+    @pl.when(run)
+    def _compute():
+        qs = q_ref[0].astype(jnp.float32) * scale
+        kblk = k_ref[0].astype(jnp.float32)
+        vblk = v_ref[0].astype(jnp.float32)
+        g32 = g_ref[0].astype(jnp.float32)
+        s = lax.dot_general(qs, kblk, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+        q_pos = qi * block_q + lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        k_pos = ki * block_k + lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        if causal:
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        if k_len % block_k:
+            s = jnp.where(k_pos < k_len, s, NEG_INF)
+        p = jnp.exp(s - lse_ref[0])                        # [bq, bk]
+        dv_acc[:] += lax.dot_general(
+            p, g32, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = lax.dot_general(g32, vblk, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0])
+        dk_acc[:] += lax.dot_general(
+            ds, qs, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(qi == nq - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _flash_backward_pallas(res, g, scale: float, causal: bool,
+                           block_q: int, block_k: int, interpret: bool):
+    """In-kernel backward: the [bq, bk] probability tile lives only in
+    VMEM; f32 accumulators carry across the sequential grid axis."""
+    q, k, v, out, lse = res
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    round8 = lambda n: max(8, -(-n // 8) * 8)
+    block_q = min(block_q, round8(sq))
+    block_k = min(block_k, round8(sk))
+    qp, gp = _pad_seq(q, block_q), _pad_seq(g, block_q)
+    kp, vp = _pad_seq(k, block_k), _pad_seq(v, block_k)
+    sq_p, sk_p = qp.shape[1], kp.shape[1]
+
+    # delta_i = rowsum(dO * O) (flash trick); pad rows contribute zeros
+    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1)                               # [B, Sq, H]
+    deltaf = delta.transpose(0, 2, 1).reshape(b * h, sq, 1)
+    lsef = lse.reshape(b * h, sq, 1)
+    pad_q = sq_p - sq
+    if pad_q:
+        deltaf = jnp.pad(deltaf, ((0, 0), (0, pad_q), (0, 0)))
+        # pad lse with zeros: padded q rows have g = 0, so p's garbage
+        # rows multiply into zero contributions everywhere
+        lsef = jnp.pad(lsef, ((0, 0), (0, pad_q), (0, 0)))
+
+    to_flat = lambda x: x.transpose(0, 2, 1, 3).reshape(
+        b * h, x.shape[1], d)
+    qf, kf, vf, gf = to_flat(qp), to_flat(kp), to_flat(vp), to_flat(gp)
+
+    nq, nk = sq_p // block_q, sk_p // block_k
+    kwargs = {}
+    if pltpu is not None and not interpret:
+        kwargs["compiler_params"] = pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"))
+
+    q_spec = pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0))
+    k_spec = pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0))
+    row_q = pl.BlockSpec((1, block_q, 1), lambda bh, qi, ki: (bh, qi, 0))
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                          k_len=sk),
+        grid=(b * h, nq, nk),
+        in_specs=[q_spec, k_spec, k_spec, q_spec, row_q, row_q],
+        out_specs=[q_spec],
+        out_shape=[jax.ShapeDtypeStruct((b * h, sq_p, d), q.dtype)],
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret, **kwargs,
+    )(qf, kf, vf, gf, lsef, deltaf)[0]
+
+    # second pass: k blocks parallel, q innermost
+    q_spec2 = pl.BlockSpec((1, block_q, d), lambda bh, ki, qi: (bh, qi, 0))
+    k_spec2 = pl.BlockSpec((1, block_k, d), lambda bh, ki, qi: (bh, ki, 0))
+    row_q2 = pl.BlockSpec((1, block_q, 1), lambda bh, ki, qi: (bh, qi, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
+                          k_len=sk),
+        grid=(b * h, nk, nq),
+        in_specs=[q_spec2, k_spec2, k_spec2, q_spec2, row_q2, row_q2],
+        out_specs=[k_spec2, k_spec2],
+        out_shape=[jax.ShapeDtypeStruct((b * h, sk_p, d), k.dtype),
+                   jax.ShapeDtypeStruct((b * h, sk_p, d), v.dtype)],
+        scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
+                        pltpu.VMEM((block_k, d), jnp.float32)],
+        interpret=interpret, **kwargs,
+    )(qf, kf, vf, gf, lsef, deltaf)
+
+    unflat = lambda x, s: x.reshape(b, h, x.shape[1], d) \
+        .transpose(0, 2, 1, 3)[:, :s]
+    return unflat(dq, sq), unflat(dk, sk), unflat(dv, sk)
+
+
 def _flash_backward(res, g, scale: float, causal: bool, block_k: int):
     """Blockwise XLA backward: scan over K/V blocks, recompute P from lse."""
     q, k, v, out, lse = res
@@ -216,20 +384,25 @@ def _flash_backward(res, g, scale: float, causal: bool, block_k: int):
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash(q, k, v, scale, causal, block_q, block_k, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash(q, k, v, scale, causal, block_q, block_k, interpret, bwd):
     out, _ = _flash_forward(q, k, v, scale, causal, block_q, block_k,
                             interpret)
     return out
 
 
-def _flash_fwd_rule(q, k, v, scale, causal, block_q, block_k, interpret):
+def _flash_fwd_rule(q, k, v, scale, causal, block_q, block_k, interpret,
+                    bwd):
     out, lse = _flash_forward(q, k, v, scale, causal, block_q, block_k,
                               interpret)
     return out, (q, k, v, out, lse)
 
 
-def _flash_bwd_rule(scale, causal, block_q, block_k, interpret, res, g):
+def _flash_bwd_rule(scale, causal, block_q, block_k, interpret, bwd, res,
+                    g):
+    if bwd == "pallas":
+        return _flash_backward_pallas(res, g, scale, causal, block_q,
+                                      block_k, interpret)
     return _flash_backward(res, g, scale, causal, block_k)
 
 
@@ -240,12 +413,18 @@ def flash_attention(q, k, v, *, causal: bool = False,
                     scale: Optional[float] = None,
                     block_q: int = DEFAULT_BLOCK_Q,
                     block_k: int = DEFAULT_BLOCK_K,
-                    interpret: Optional[bool] = None) -> jnp.ndarray:
+                    interpret: Optional[bool] = None,
+                    bwd: Optional[str] = None) -> jnp.ndarray:
     """Flash attention, BSHD in/out. Differentiable (custom VJP).
 
     ``interpret=None`` auto-selects: real kernel on TPU, interpreter mode
     elsewhere (falling back to the fused-XLA reference for big shapes or
     when ``interpret=False`` is forced off-TPU, where Mosaic can't lower).
+
+    ``bwd``: ``"pallas"`` (in-kernel backward — the TPU default) or
+    ``"xla"`` (blockwise-scan recomputation — the interpreter default,
+    since interpreted kernels are slow on CPU; also the cross-check
+    oracle for the kernel backward's numerics).
     """
     if scale is None:
         scale = q.shape[-1] ** -0.5
@@ -259,4 +438,8 @@ def flash_attention(q, k, v, *, causal: bool = False,
             return dot_product_attention(q, k, v, causal=causal, scale=scale)
     if not on_tpu and not interpret:
         return dot_product_attention(q, k, v, causal=causal, scale=scale)
-    return _flash(q, k, v, scale, causal, block_q, block_k, interpret)
+    if bwd is None:
+        bwd = "pallas" if not interpret else "xla"
+    if bwd not in ("pallas", "xla"):
+        raise ValueError(f"bwd must be 'pallas' or 'xla', got {bwd!r}")
+    return _flash(q, k, v, scale, causal, block_q, block_k, interpret, bwd)

@@ -413,3 +413,31 @@ def test_positional_embedding_undersized_table_raises(devices):
         mesh=mesh, in_specs=(P(), P(None, "sp")), out_specs=P(None, "sp"))
     with pytest.raises(ValueError, match="too small"):
         jax.jit(fn)(params, x)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_pallas_backward_matches_oracles(causal):
+    """The in-kernel backward (TPU default) must match both the XLA-scan
+    backward and the reference SDPA gradients — including a sequence that
+    doesn't divide the block sizes (pad-row handling in both passes)."""
+    q, k, v = _rand_qkv(jax.random.PRNGKey(5), b=2, s=44, h=2, d=8)
+    co = jax.random.normal(jax.random.PRNGKey(9), q.shape)
+
+    def grads(fn):
+        return jax.grad(lambda a, b, c: jnp.sum(fn(a, b, c) * co),
+                        argnums=(0, 1, 2))(q, k, v)
+
+    ref = grads(lambda a, b, c: dot_product_attention(a, b, c,
+                                                      causal=causal))
+    pal = grads(lambda a, b, c: flash_attention(
+        a, b, c, causal=causal, interpret=True, bwd="pallas",
+        block_q=16, block_k=16))
+    xla = grads(lambda a, b, c: flash_attention(
+        a, b, c, causal=causal, interpret=True, bwd="xla",
+        block_q=16, block_k=16))
+    for p, x, r in zip(pal, xla, ref):
+        np.testing.assert_allclose(p, r, atol=2e-5)
+        np.testing.assert_allclose(p, x, atol=2e-5)
+
+    with pytest.raises(ValueError, match="bwd must be"):
+        flash_attention(q, k, v, interpret=True, bwd="fused")
