@@ -160,18 +160,34 @@ class DenseTransformer(Transformer):
 
 class StandardScaleTransformer(Transformer):
     """Zero-mean/unit-variance scaling (capability add beyond the reference's
-    MinMax; common preprocessing for the physics examples)."""
+    MinMax; common preprocessing for the physics examples).
+
+    Spark's StandardScaler is an Estimator: ``fit(train)`` freezes the
+    training split's mean/std, and every later call applies THOSE stats —
+    so eval data never leaks its own statistics into the transform.
+    Unfitted use keeps the old per-dataset behavior."""
 
     def __init__(self, input_col: str = "features",
                  output_col: str = "features_scaled", epsilon: float = 1e-8):
         self.input_col = input_col
         self.output_col = output_col
         self.epsilon = float(epsilon)
+        self.mean_ = None
+        self.std_ = None
+
+    def fit(self, dataset: Dataset) -> "StandardScaleTransformer":
+        x = dataset[self.input_col].astype(np.float32)
+        self.mean_ = x.mean(axis=0, keepdims=True)
+        self.std_ = x.std(axis=0, keepdims=True)
+        return self
 
     def transform(self, dataset: Dataset) -> Dataset:
         x = dataset[self.input_col].astype(np.float32)
-        mean = x.mean(axis=0, keepdims=True)
-        std = x.std(axis=0, keepdims=True)
+        if self.mean_ is not None:
+            mean, std = self.mean_, self.std_
+        else:
+            mean = x.mean(axis=0, keepdims=True)
+            std = x.std(axis=0, keepdims=True)
         return dataset.with_column(self.output_col,
                                    (x - mean) / (std + self.epsilon))
 

@@ -258,3 +258,28 @@ def test_hashing_transformer_multidim_and_object_columns():
             Dataset({"c": vals.astype(b), "label": np.zeros(2)}))
         np.testing.assert_array_equal(wa["features_hashed"],
                                       wb["features_hashed"])
+
+
+def test_standard_scale_fit_freezes_training_stats():
+    """Estimator semantics (Spark's StandardScaler): fit on train, apply
+    the SAME stats to eval — eval statistics must not leak."""
+    from distkeras_tpu.data import Dataset, StandardScaleTransformer
+
+    rs = np.random.RandomState(0)
+    train = Dataset({"features": (rs.randn(512, 4) * 5 + 3)
+                     .astype(np.float32)})
+    evalset = Dataset({"features": (rs.randn(128, 4) * 9 - 2)
+                       .astype(np.float32)})
+
+    t = StandardScaleTransformer("features").fit(train)
+    tr = t(train)["features_scaled"]
+    ev = t(evalset)["features_scaled"]
+    np.testing.assert_allclose(tr.mean(0), 0.0, atol=1e-4)
+    np.testing.assert_allclose(tr.std(0), 1.0, atol=1e-3)
+    # eval transformed with TRAIN stats -> not standardized to its own
+    assert abs(float(ev.mean())) > 0.1
+
+    # unfitted: old per-dataset behavior
+    ev_self = StandardScaleTransformer("features")(evalset)[
+        "features_scaled"]
+    np.testing.assert_allclose(ev_self.mean(0), 0.0, atol=1e-4)

@@ -70,3 +70,11 @@ def test_imagenet_resnet_spmd_example():
                       ("x", "--n", "2048", "--epochs", "4", "--batch",
                        "32", "--fsdp"))
     assert acc > 0.9, acc
+
+
+def test_higgs_physics_example(capsys):
+    acc = run_example("examples.higgs_physics",
+                      ("x", "--epochs", "4", "--n", "8192"))
+    out = capsys.readouterr().out
+    assert "ROC-AUC" in out
+    assert acc > 0.8, acc
