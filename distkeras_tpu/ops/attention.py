@@ -72,14 +72,18 @@ def rope_frequencies(head_dim: int, base: float = 10000.0) -> jnp.ndarray:
                                       dtype=jnp.float32) / head_dim))
 
 
-def apply_rope(x, positions=None, base: float = 10000.0):
-    """Rotary position embedding on a BSHD tensor.
+def apply_rope(x, positions=None, base: float = 10000.0,
+               layout: str = "bshd"):
+    """Rotary position embedding on a BSHD (default) or BHSD tensor.
 
     ``positions``: optional [S] or [B, S] int array of global token positions
     (defaults to 0..S-1 — pass explicit positions for sequence-sharded
     shards in ring attention).
     """
-    b, s, h, d = x.shape
+    if layout == "bhsd":
+        b, h, s, d = x.shape
+    else:
+        b, s, h, d = x.shape
     if positions is None:
         positions = jnp.arange(s)
     positions = jnp.asarray(positions, jnp.float32)
@@ -87,10 +91,14 @@ def apply_rope(x, positions=None, base: float = 10000.0):
         positions = positions[None, :]  # [1, S] broadcasts over batch
     freqs = rope_frequencies(d, base)                   # [D/2]
     angles = positions[..., None] * freqs               # [B?, S, D/2]
-    cos = jnp.cos(angles)[:, :, None, :]                # [B?, S, 1, D/2]
-    sin = jnp.sin(angles)[:, :, None, :]
+    if layout == "bhsd":
+        cos = jnp.cos(angles)[:, None, :, :]            # [B?, 1, S, D/2]
+        sin = jnp.sin(angles)[:, None, :, :]
+    else:
+        cos = jnp.cos(angles)[:, :, None, :]            # [B?, S, 1, D/2]
+        sin = jnp.sin(angles)[:, :, None, :]
     x1, x2 = x[..., ::2].astype(jnp.float32), x[..., 1::2].astype(jnp.float32)
     r1 = x1 * cos - x2 * sin
     r2 = x2 * cos + x1 * sin
-    out = jnp.stack([r1, r2], axis=-1).reshape(b, s, h, d)
+    out = jnp.stack([r1, r2], axis=-1).reshape(x.shape)
     return out.astype(x.dtype)

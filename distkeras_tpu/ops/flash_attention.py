@@ -109,18 +109,26 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
         lse_ref[0] = m_ref[:] + jnp.log(l_safe)
 
 
-def _pad_seq(x, block: int):
-    s = x.shape[1]
+def _pad_seq(x, block: int, axis: int = 1):
+    s = x.shape[axis]
     pad = (-s) % block
     if pad:
-        x = jnp.pad(x, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        widths = [(0, 0)] * x.ndim
+        widths[axis] = (0, pad)
+        x = jnp.pad(x, widths)
     return x
 
 
 def _flash_forward(q, k, v, scale: float, causal: bool, block_q: int,
-                   block_k: int, interpret: bool):
-    b, sq, h, d = q.shape
-    sk = k.shape[1]
+                   block_k: int, interpret: bool, bhsd: bool = False):
+    if bhsd:
+        b, h, sq, d = q.shape
+        sk = k.shape[2]
+        seq_axis = 2
+    else:
+        b, sq, h, d = q.shape
+        sk = k.shape[1]
+        seq_axis = 1
     # clamp to the (8-rounded) sequence length: Mosaic requires the block's
     # second-to-last dim % 8 == 0, so a raw min(block, seq) would fail to
     # lower for seq in (block, 8k) that isn't a multiple of 8 — the padder
@@ -128,14 +136,22 @@ def _flash_forward(q, k, v, scale: float, causal: bool, block_q: int,
     round8 = lambda n: max(8, -(-n // 8) * 8)
     block_q = min(block_q, round8(sq))
     block_k = min(block_k, round8(sk))
-    qp, kp, vp = _pad_seq(q, block_q), _pad_seq(k, block_k), _pad_seq(v,
-                                                                      block_k)
-    sq_p, sk_p = qp.shape[1], kp.shape[1]
+    qp = _pad_seq(q, block_q, seq_axis)
+    kp = _pad_seq(k, block_k, seq_axis)
+    vp = _pad_seq(v, block_k, seq_axis)
+    sq_p, sk_p = qp.shape[seq_axis], kp.shape[seq_axis]
 
-    # BSHD -> (B*H, S, D): one grid row per (batch, head)
-    qf = qp.transpose(0, 2, 1, 3).reshape(b * h, sq_p, d)
-    kf = kp.transpose(0, 2, 1, 3).reshape(b * h, sk_p, d)
-    vf = vp.transpose(0, 2, 1, 3).reshape(b * h, sk_p, d)
+    if bhsd:
+        # BHSD -> (B*H, S, D) is a FREE reshape (no data movement) — the
+        # layout the layer uses when it targets this kernel
+        qf = qp.reshape(b * h, sq_p, d)
+        kf = kp.reshape(b * h, sk_p, d)
+        vf = vp.reshape(b * h, sk_p, d)
+    else:
+        # BSHD -> (B*H, S, D): one grid row per (batch, head)
+        qf = qp.transpose(0, 2, 1, 3).reshape(b * h, sq_p, d)
+        kf = kp.transpose(0, 2, 1, 3).reshape(b * h, sk_p, d)
+        vf = vp.transpose(0, 2, 1, 3).reshape(b * h, sk_p, d)
 
     grid = (b * h, sq_p // block_q, sk_p // block_k)
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
@@ -168,7 +184,10 @@ def _flash_forward(q, k, v, scale: float, causal: bool, block_q: int,
         interpret=interpret,
         **kwargs,
     )(qf, kf, vf)
-    out = out.reshape(b, h, sq_p, d).transpose(0, 2, 1, 3)[:, :sq]
+    if bhsd:
+        out = out.reshape(b, h, sq_p, d)[:, :, :sq]
+    else:
+        out = out.reshape(b, h, sq_p, d).transpose(0, 2, 1, 3)[:, :sq]
     lse = lse.reshape(b, h, sq_p)[:, :, :sq]
     return out, lse
 
@@ -265,23 +284,31 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
 
 
 def _flash_backward_pallas(res, g, scale: float, causal: bool,
-                           block_q: int, block_k: int, interpret: bool):
+                           block_q: int, block_k: int, interpret: bool,
+                           bhsd: bool = False):
     """In-kernel backward: the [bq, bk] probability tile lives only in
     VMEM; f32 accumulators carry across the sequential grid axis."""
     q, k, v, out, lse = res
-    b, sq, h, d = q.shape
-    sk = k.shape[1]
+    if bhsd:
+        b, h, sq, d = q.shape
+        sk = k.shape[2]
+        seq_axis = 2
+    else:
+        b, sq, h, d = q.shape
+        sk = k.shape[1]
+        seq_axis = 1
     round8 = lambda n: max(8, -(-n // 8) * 8)
     block_q = min(block_q, round8(sq))
     block_k = min(block_k, round8(sk))
-    qp, gp = _pad_seq(q, block_q), _pad_seq(g, block_q)
-    kp, vp = _pad_seq(k, block_k), _pad_seq(v, block_k)
-    sq_p, sk_p = qp.shape[1], kp.shape[1]
+    qp, gp = _pad_seq(q, block_q, seq_axis), _pad_seq(g, block_q, seq_axis)
+    kp, vp = _pad_seq(k, block_k, seq_axis), _pad_seq(v, block_k, seq_axis)
+    sq_p, sk_p = qp.shape[seq_axis], kp.shape[seq_axis]
 
     # delta_i = rowsum(dO * O) (flash trick); pad rows contribute zeros
     delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
-                    axis=-1)                               # [B, Sq, H]
-    deltaf = delta.transpose(0, 2, 1).reshape(b * h, sq, 1)
+                    axis=-1)                   # [B, Sq, H] or [B, H, Sq]
+    deltaf = (delta if bhsd else delta.transpose(0, 2, 1)) \
+        .reshape(b * h, sq, 1)
     lsef = lse.reshape(b * h, sq, 1)
     pad_q = sq_p - sq
     if pad_q:
@@ -290,8 +317,11 @@ def _flash_backward_pallas(res, g, scale: float, causal: bool,
         # rows multiply into zero contributions everywhere
         lsef = jnp.pad(lsef, ((0, 0), (0, pad_q), (0, 0)))
 
-    to_flat = lambda x: x.transpose(0, 2, 1, 3).reshape(
-        b * h, x.shape[1], d)
+    if bhsd:
+        to_flat = lambda x: x.reshape(b * h, x.shape[2], d)  # free
+    else:
+        to_flat = lambda x: x.transpose(0, 2, 1, 3).reshape(
+            b * h, x.shape[1], d)
     qf, kf, vf, gf = to_flat(qp), to_flat(kp), to_flat(vp), to_flat(gp)
 
     nq, nk = sq_p // block_q, sk_p // block_k
@@ -331,8 +361,11 @@ def _flash_backward_pallas(res, g, scale: float, causal: bool,
         interpret=interpret, **kwargs,
     )(qf, kf, vf, gf, lsef, deltaf)
 
-    unflat = lambda x, s: x.reshape(b, h, x.shape[1], d) \
-        .transpose(0, 2, 1, 3)[:, :s]
+    if bhsd:
+        unflat = lambda x, s: x.reshape(b, h, x.shape[1], d)[:, :, :s]
+    else:
+        unflat = lambda x, s: x.reshape(b, h, x.shape[1], d) \
+            .transpose(0, 2, 1, 3)[:, :s]
     return unflat(dq, sq), unflat(dk, sk), unflat(dv, sk)
 
 
@@ -384,25 +417,32 @@ def _flash_backward(res, g, scale: float, causal: bool, block_k: int):
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
-def _flash(q, k, v, scale, causal, block_q, block_k, interpret, bwd):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
+def _flash(q, k, v, scale, causal, block_q, block_k, interpret, bwd, bhsd):
     out, _ = _flash_forward(q, k, v, scale, causal, block_q, block_k,
-                            interpret)
+                            interpret, bhsd)
     return out
 
 
 def _flash_fwd_rule(q, k, v, scale, causal, block_q, block_k, interpret,
-                    bwd):
+                    bwd, bhsd):
     out, lse = _flash_forward(q, k, v, scale, causal, block_q, block_k,
-                              interpret)
+                              interpret, bhsd)
     return out, (q, k, v, out, lse)
 
 
-def _flash_bwd_rule(scale, causal, block_q, block_k, interpret, bwd, res,
-                    g):
+def _flash_bwd_rule(scale, causal, block_q, block_k, interpret, bwd, bhsd,
+                    res, g):
     if bwd == "pallas":
         return _flash_backward_pallas(res, g, scale, causal, block_q,
-                                      block_k, interpret)
+                                      block_k, interpret, bhsd)
+    if bhsd:
+        # the scan-backward oracle is written for BSHD; convert around it
+        t = lambda x: x.transpose(0, 2, 1, 3)
+        q, k, v, out, lse = res
+        dq, dk, dv = _flash_backward((t(q), t(k), t(v), t(out), lse),
+                                     t(g), scale, causal, block_k)
+        return t(dq), t(dk), t(dv)
     return _flash_backward(res, g, scale, causal, block_k)
 
 
@@ -414,8 +454,13 @@ def flash_attention(q, k, v, *, causal: bool = False,
                     block_q: int = DEFAULT_BLOCK_Q,
                     block_k: int = DEFAULT_BLOCK_K,
                     interpret: Optional[bool] = None,
-                    bwd: Optional[str] = None) -> jnp.ndarray:
-    """Flash attention, BSHD in/out. Differentiable (custom VJP).
+                    bwd: Optional[str] = None,
+                    layout: str = "bshd") -> jnp.ndarray:
+    """Flash attention, BSHD in/out by default. Differentiable (custom
+    VJP). ``layout="bhsd"`` takes/returns [B, H, S, D] — the kernel's
+    native flattening is then a free reshape instead of four
+    [B,S,H,D]<->[B,H,S,D] transposes per call (the layer's flash path
+    produces BHSD directly for exactly this reason).
 
     ``interpret=None`` auto-selects: real kernel on TPU, interpreter mode
     elsewhere (falling back to the fused-XLA reference for big shapes or
@@ -426,20 +471,33 @@ def flash_attention(q, k, v, *, causal: bool = False,
     since interpreted kernels are slow on CPU; also the cross-check
     oracle for the kernel backward's numerics).
     """
+    if layout not in ("bshd", "bhsd"):
+        raise ValueError(f"layout must be 'bshd' or 'bhsd', got {layout!r}")
+    bhsd = layout == "bhsd"
+    seq_axis = 2 if bhsd else 1
     if scale is None:
         scale = q.shape[-1] ** -0.5
-    if pltpu is None:  # no Pallas TPU support in this jax build
+
+    def _xla_fallback():
+        if bhsd:
+            t = lambda x: x.transpose(0, 2, 1, 3)
+            return t(dot_product_attention(t(q), t(k), t(v), causal=causal,
+                                           scale=scale))
         return dot_product_attention(q, k, v, causal=causal, scale=scale)
+
+    if pltpu is None:  # no Pallas TPU support in this jax build
+        return _xla_fallback()
     on_tpu = jax.default_backend() == "tpu"
     if interpret is None:
         interpret = not on_tpu
-        if interpret and q.shape[1] * k.shape[1] > 256 * 256:
+        if interpret and q.shape[seq_axis] * k.shape[seq_axis] > 256 * 256:
             # interpreter is too slow for big shapes; use the XLA reference
-            return dot_product_attention(q, k, v, causal=causal, scale=scale)
+            return _xla_fallback()
     if not on_tpu and not interpret:
-        return dot_product_attention(q, k, v, causal=causal, scale=scale)
+        return _xla_fallback()
     if bwd is None:
         bwd = "pallas" if not interpret else "xla"
     if bwd not in ("pallas", "xla"):
         raise ValueError(f"bwd must be 'pallas' or 'xla', got {bwd!r}")
-    return _flash(q, k, v, scale, causal, block_q, block_k, interpret, bwd)
+    return _flash(q, k, v, scale, causal, block_q, block_k, interpret, bwd,
+                  bhsd)

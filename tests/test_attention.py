@@ -441,3 +441,32 @@ def test_flash_pallas_backward_matches_oracles(causal):
 
     with pytest.raises(ValueError, match="bwd must be"):
         flash_attention(q, k, v, interpret=True, bwd="fused")
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_bhsd_layout_matches_bshd(causal):
+    """layout="bhsd" (the layer's transpose-free path) must match the
+    default layout in both passes."""
+    q, k, v = _rand_qkv(jax.random.PRNGKey(3), b=2, s=40, h=2, d=8)
+    co = jax.random.normal(jax.random.PRNGKey(4), q.shape)
+    t = lambda x: x.transpose(0, 2, 1, 3)
+
+    out_s = flash_attention(q, k, v, causal=causal, interpret=True,
+                            block_q=16, block_k=16)
+    out_h = flash_attention(t(q), t(k), t(v), causal=causal,
+                            layout="bhsd", interpret=True,
+                            block_q=16, block_k=16)
+    np.testing.assert_allclose(t(out_h), out_s, atol=1e-6)
+
+    gs = jax.grad(lambda a, b, c: jnp.sum(flash_attention(
+        a, b, c, causal=causal, interpret=True, bwd="pallas",
+        block_q=16, block_k=16) * co), argnums=(0, 1, 2))(q, k, v)
+    gh = jax.grad(lambda a, b, c: jnp.sum(flash_attention(
+        a, b, c, causal=causal, layout="bhsd", interpret=True,
+        bwd="pallas", block_q=16, block_k=16) * t(co)),
+        argnums=(0, 1, 2))(t(q), t(k), t(v))
+    for a, b in zip(gh, gs):
+        np.testing.assert_allclose(t(a), b, atol=2e-5)
+
+    with pytest.raises(ValueError, match="layout must be"):
+        flash_attention(q, k, v, layout="hbsd")
