@@ -78,6 +78,35 @@ class Dataset:
             np.delete(data, label_col_index, axis=1), dtype=np.float32)
         return cls({features_col: X, label_col: y})
 
+    @classmethod
+    def from_pandas(cls, df) -> "Dataset":
+        """pandas DataFrame -> Dataset: one column per frame column
+        (object/string columns kept as numpy object arrays for the
+        StringIndexer/Hashing transformers). The Spark-DataFrame-handoff
+        analogue for the common pandas interchange case."""
+        return cls({str(c): np.asarray(df[c].to_numpy())
+                    for c in df.columns})
+
+    @classmethod
+    def from_parquet(cls, path, columns: Optional[Sequence[str]] = None
+                     ) -> "Dataset":
+        """Parquet ingest via pyarrow (the reference's de-facto Spark
+        storage format). List-valued columns become 2-D feature
+        matrices."""
+        import pyarrow.parquet as pq
+
+        table = pq.read_table(path, columns=list(columns) if columns
+                              else None)
+        out = {}
+        for name in table.column_names:
+            col = table.column(name)
+            arr = col.to_numpy(zero_copy_only=False)
+            if arr.dtype == object and len(arr) and isinstance(
+                    arr[0], np.ndarray):
+                arr = np.stack(arr)  # fixed-size list column -> matrix
+            out[name] = arr
+        return cls(out)
+
     # -- introspection ----------------------------------------------------
     @property
     def columns(self) -> List[str]:

@@ -283,3 +283,36 @@ def test_standard_scale_fit_freezes_training_stats():
     ev_self = StandardScaleTransformer("features")(evalset)[
         "features_scaled"]
     np.testing.assert_allclose(ev_self.mean(0), 0.0, atol=1e-4)
+
+
+def test_from_pandas_and_parquet_roundtrip(tmp_path):
+    """DataFrame-style ingest (the reference's Spark DataFrame role):
+    pandas frames and parquet files land as columnar Datasets, including
+    a list-valued features column becoming the 2-D features matrix."""
+    import pandas as pd
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from distkeras_tpu.data import Dataset
+
+    rs = np.random.RandomState(0)
+    X = rs.randn(64, 4).astype(np.float32)
+    y = rs.randint(0, 3, 64)
+    cat = np.array(["a", "b", "c", "a"] * 16, dtype=object)
+
+    df = pd.DataFrame({"label": y, "category": cat})
+    ds = Dataset.from_pandas(df)
+    np.testing.assert_array_equal(ds["label"], y)
+    assert list(ds["category"][:4]) == ["a", "b", "c", "a"]
+
+    path = str(tmp_path / "t.parquet")
+    pq.write_table(pa.table({
+        "features": pa.array(list(X)),   # list column -> feature matrix
+        "label": pa.array(y),
+    }), path)
+    ds2 = Dataset.from_parquet(path)
+    np.testing.assert_allclose(np.asarray(ds2["features"], np.float32), X,
+                               rtol=1e-6)
+    np.testing.assert_array_equal(ds2["label"], y)
+    ds3 = Dataset.from_parquet(path, columns=["label"])
+    assert ds3.columns == ["label"]
