@@ -2,7 +2,8 @@
 
 from distkeras_tpu.utils.callbacks import (  # noqa: F401
     Callback, CSVLogger, EarlyStopping, EMAWeights, LambdaCallback,
-    ModelCheckpoint, TerminateOnNaN)
-from distkeras_tpu.utils.checkpoint import CheckpointManager  # noqa: F401
+    ModelCheckpoint, TensorBoardLogger, TerminateOnNaN)
+from distkeras_tpu.utils.checkpoint import (  # noqa: F401
+    CheckpointManager, ShardedCheckpointManager)
 from distkeras_tpu.utils.history import History  # noqa: F401
 from distkeras_tpu.utils import profiling  # noqa: F401

@@ -264,6 +264,48 @@ class CSVLogger(Callback):
             self._file = None
 
 
+class TensorBoardLogger(Callback):
+    """Write per-epoch scalars as TensorBoard event files (the
+    observability ADD over the reference's stdout-only logging — SURVEY
+    §5.5). Uses ``tf.summary`` from the installed TensorFlow; a missing
+    TF degrades to a warning, not a crash, so training scripts stay
+    portable. One writer per run directory, process 0 only."""
+
+    def __init__(self, log_dir: str):
+        self.log_dir = str(log_dir)
+        self._writer = None
+
+    def on_train_begin(self, logs=None):
+        import jax
+        if jax.process_index() != 0:
+            return
+        try:
+            import tensorflow as tf
+        except ImportError:
+            import warnings
+            warnings.warn("TensorBoardLogger: tensorflow not available; "
+                          "no event files will be written", stacklevel=2)
+            return
+        self._writer = tf.summary.create_file_writer(self.log_dir)
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self._writer is None:
+            return
+        import tensorflow as tf
+        with self._writer.as_default(step=epoch):
+            for key, value in sorted((logs or {}).items()):
+                try:
+                    tf.summary.scalar(key, float(value))
+                except (TypeError, ValueError):
+                    continue  # non-scalar log entries are skipped
+        self._writer.flush()
+
+    def on_train_end(self, logs=None):
+        if self._writer is not None:
+            self._writer.close()
+            self._writer = None
+
+
 class TerminateOnNaN(Callback):
     """Stop training as soon as the epoch loss is NaN/inf."""
 

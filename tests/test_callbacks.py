@@ -195,3 +195,31 @@ def test_fit_accepts_callbacks():
                  callbacks=[EarlyStopping(monitor="loss", min_delta=1e9,
                                           patience=0)])
     assert len(hist.epochs) == 2
+
+def test_tensorboard_logger_writes_event_files(tmp_path):
+    from distkeras_tpu.data import Dataset
+    from distkeras_tpu.models import Dense, Model, Sequential
+    from distkeras_tpu.parallel import SingleTrainer
+    from distkeras_tpu.utils import TensorBoardLogger
+
+    rs = np.random.RandomState(0)
+    X = rs.randn(256, 8).astype(np.float32)
+    y = (X @ rs.randn(8, 3)).argmax(-1)
+    logdir = str(tmp_path / "tb")
+    trainer = SingleTrainer(
+        Model.build(Sequential([Dense(16, activation="relu"), Dense(3)]),
+                    (8,), seed=0),
+        batch_size=32, num_epoch=2, worker_optimizer="sgd",
+        learning_rate=0.1,
+        loss="sparse_categorical_crossentropy_from_logits",
+        callbacks=[TensorBoardLogger(logdir)])
+    trainer.train(Dataset({"features": X, "label": y}))
+
+    import glob
+    events = glob.glob(logdir + "/events.out.tfevents.*")
+    assert events, "no TensorBoard event file written"
+    # the loss scalar is actually in the file
+    from tensorflow.python.summary.summary_iterator import summary_iterator
+    tags = {v.tag for e in summary_iterator(events[0])
+            for v in e.summary.value}
+    assert "loss" in tags, tags
