@@ -43,11 +43,13 @@ except ImportError:  # pragma: no cover
 from distkeras_tpu.ops.attention import (NEG_INF, causal_mask,
                                          dot_product_attention)
 
-# Measured on TPU at S=8192 (B2 H8 D64, causal bf16): 512/512 runs ~16%
-# faster than 128/128 and 7x faster than the fused-XLA reference; VMEM use
-# at 512 is ~1.4MB for D=64 (scores dominate), safe through D=256.
+# Measured on TPU v5e (causal bf16, fwd+bwd, BHSD): 512/1024 is the knee —
+# S=2048 B8 H16: 14.8 ms vs 17.5 ms at 512/512; S=8192 B2 H8: 22.0 ms,
+# where 512/512 (and 256/256 at S=2048) hit a Mosaic slow path that is
+# ~100x worse. Keep block_k >= 1024 unless VMEM forces smaller: the score
+# tile at 512x1024 f32 is 2 MB, safe through D=256.
 DEFAULT_BLOCK_Q = 512
-DEFAULT_BLOCK_K = 512
+DEFAULT_BLOCK_K = 1024
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
