@@ -168,6 +168,14 @@ class MultiHeadAttention(Layer):
 
     Projections are single einsums against ``[d_model, H, Dh]`` tensors —
     one MXU matmul each; the heads axis is the TP shard axis.
+
+    ``num_kv_heads < num_heads`` gives grouped-query attention (GQA;
+    ``num_kv_heads=1`` is multi-query): K/V project to fewer heads, each
+    shared by ``num_heads // num_kv_heads`` query heads. Training-side
+    the shared heads are broadcast before the kernel (compute is
+    matmul-dominated either way); the payoff is serving — the KV cache
+    shrinks by the group factor (``models.decoding`` sizes it by
+    ``num_kv_heads``).
     """
 
     def __init__(self, num_heads: int, head_dim: Optional[int] = None,
@@ -175,8 +183,16 @@ class MultiHeadAttention(Layer):
                  dtype: str = "float32", attn_impl: str = "auto",
                  seq_axis_name: Optional[str] = None,
                  kernel_init: str = "glorot_uniform",
-                 ring_block_size: Optional[int] = None):
+                 ring_block_size: Optional[int] = None,
+                 num_kv_heads: Optional[int] = None):
         self.num_heads = int(num_heads)
+        self.num_kv_heads = (int(num_kv_heads) if num_kv_heads is not None
+                             else None)
+        kv = self.num_kv_heads or self.num_heads
+        if self.num_heads % kv:
+            raise ValueError(
+                f"num_heads {self.num_heads} must be a multiple of "
+                f"num_kv_heads {kv}")
         self.head_dim = head_dim if head_dim is None else int(head_dim)
         self.causal = bool(causal)
         self.use_rope = bool(use_rope)
@@ -186,9 +202,14 @@ class MultiHeadAttention(Layer):
         self.kernel_init = kernel_init
         self.ring_block_size = ring_block_size  # inner k-blocking (memory)
 
+    @property
+    def kv_heads(self) -> int:
+        return self.num_kv_heads or self.num_heads
+
     def init(self, rng, input_shape):
         d_model = input_shape[-1]
         h, dh = self.num_heads, self.head_dim or d_model // self.num_heads
+        hkv = self.kv_heads
         ks = jax.random.split(rng, 4)
         # initialize as the LOGICAL 2D matrices and reshape: the generic
         # fan rules would treat [d_model, H, Dh] as a conv kernel and
@@ -196,11 +217,16 @@ class MultiHeadAttention(Layer):
         w2d = lambda k, m, n: init_weights(self.kernel_init, k, (m, n))
         params = {
             "wq": w2d(ks[0], d_model, h * dh).reshape(d_model, h, dh),
-            "wk": w2d(ks[1], d_model, h * dh).reshape(d_model, h, dh),
-            "wv": w2d(ks[2], d_model, h * dh).reshape(d_model, h, dh),
+            "wk": w2d(ks[1], d_model, hkv * dh).reshape(d_model, hkv, dh),
+            "wv": w2d(ks[2], d_model, hkv * dh).reshape(d_model, hkv, dh),
             "wo": w2d(ks[3], h * dh, d_model).reshape(h, dh, d_model),
         }
         return params, {}, tuple(input_shape)
+
+    def _expand_kv(self, t, head_axis: int):
+        """Broadcast grouped K/V heads up to num_heads for the kernels."""
+        reps = self.num_heads // self.kv_heads
+        return t if reps == 1 else jnp.repeat(t, reps, axis=head_axis)
 
     def apply(self, params, state, x, *, training=False, rng=None):
         dt = jnp.dtype(self.dtype)
@@ -227,6 +253,7 @@ class MultiHeadAttention(Layer):
             if self.use_rope:
                 q = apply_rope(q, positions, layout="bhsd")
                 k = apply_rope(k, positions, layout="bhsd")
+            k, v = self._expand_kv(k, 1), self._expand_kv(v, 1)
             from distkeras_tpu.ops.flash_attention import flash_attention
             out = flash_attention(q, k, v, causal=self.causal,
                                   layout="bhsd")
@@ -239,6 +266,7 @@ class MultiHeadAttention(Layer):
         if self.use_rope:
             q = apply_rope(q, positions)
             k = apply_rope(k, positions)
+        k, v = self._expand_kv(k, 2), self._expand_kv(v, 2)
         out = _attention_compute(q, k, v, causal=self.causal,
                                  impl=impl,
                                  axis_name=self.seq_axis_name,
@@ -252,7 +280,8 @@ class MultiHeadAttention(Layer):
                 "dtype": self.dtype, "attn_impl": self.attn_impl,
                 "seq_axis_name": self.seq_axis_name,
                 "kernel_init": self.kernel_init,
-                "ring_block_size": self.ring_block_size}
+                "ring_block_size": self.ring_block_size,
+                "num_kv_heads": self.num_kv_heads}
 
 
 @register_layer
@@ -307,8 +336,10 @@ class TransformerBlock(Layer):
                  seq_axis_name: Optional[str] = None,
                  mlp_layer: Optional[Layer] = None,
                  dropout_rate: float = 0.0,
-                 ring_block_size: Optional[int] = None):
+                 ring_block_size: Optional[int] = None,
+                 num_kv_heads: Optional[int] = None):
         self.num_heads = int(num_heads)
+        self.num_kv_heads = num_kv_heads
         self.mlp_ratio = int(mlp_ratio)
         self.head_dim = head_dim
         self.causal = causal
@@ -329,7 +360,7 @@ class TransformerBlock(Layer):
         self.attn = MultiHeadAttention(
             num_heads, head_dim=head_dim, causal=causal, use_rope=use_rope,
             dtype=dtype, attn_impl=attn_impl, seq_axis_name=seq_axis_name,
-            ring_block_size=ring_block_size)
+            ring_block_size=ring_block_size, num_kv_heads=num_kv_heads)
         self.mlp = mlp_layer  # resolved in init once d_model is known
 
     def init(self, rng, input_shape):
@@ -386,7 +417,8 @@ class TransformerBlock(Layer):
                "attn_impl": self.attn_impl,
                "seq_axis_name": self.seq_axis_name,
                "dropout_rate": self.dropout_rate,
-               "ring_block_size": self.ring_block_size}
+               "ring_block_size": self.ring_block_size,
+               "num_kv_heads": self.num_kv_heads}
         if self._mlp_override is not None:
             cfg["mlp_layer"] = layer_spec(self._mlp_override)
         return cfg

@@ -55,7 +55,9 @@ def init_cache(module: Sequential, batch: int, max_len: int,
                 f"for a {max_len}-position decode cache")
         if isinstance(layer, TransformerBlock):
             attn = layer.attn
-            h = attn.num_heads
+            # GQA: the cache stores only the kv heads — the whole point
+            # of grouped queries at serving time
+            h = attn.kv_heads
             # head_dim resolves at init; recover it from the layer config
             dh = attn.head_dim
             if dh is None:
@@ -79,7 +81,11 @@ def _resolve_head_dims(module: Sequential, params) -> None:
 
 
 def _decode_attn(attn: MultiHeadAttention, p, kv, x, t):
-    """One-token attention against the cache. x: [B, 1, d]; t: step."""
+    """One-token attention against the cache. x: [B, 1, d]; t: step.
+
+    GQA-aware: the cache holds ``kv_heads`` heads; queries are grouped
+    ``[B, 1, Hkv, G, D]`` and contracted against the cache directly — the
+    shared K/V heads are never materialized ``G`` times."""
     dt = jnp.dtype(attn.dtype)
     xc = x.astype(dt)
     q = jnp.einsum("bsd,dhe->bshe", xc, p["wq"].astype(dt))
@@ -94,13 +100,19 @@ def _decode_attn(attn: MultiHeadAttention, p, kv, x, t):
           "v": lax.dynamic_update_slice_in_dim(
               kv["v"], v.astype(kv["v"].dtype), t, axis=1)}
     scale = (attn.head_dim or q.shape[-1]) ** -0.5
-    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32) * scale,
-                   kv["k"].astype(jnp.float32))          # [B, H, 1, L]
+    b = q.shape[0]
+    hkv = attn.kv_heads
+    g = attn.num_heads // hkv
+    qg = (q.astype(jnp.float32) * scale).reshape(
+        b, 1, hkv, g, q.shape[-1])                       # [B, 1, Hkv, G, D]
+    s = jnp.einsum("bqhgd,bkhd->bhgqk", qg,
+                   kv["k"].astype(jnp.float32))          # [B, Hkv, G, 1, L]
     valid = jnp.arange(kv["k"].shape[1]) <= t
-    s = jnp.where(valid[None, None, None, :], s, NEG_INF)
+    s = jnp.where(valid[None, None, None, None, :], s, NEG_INF)
     w = jax.nn.softmax(s, axis=-1)
-    out = jnp.einsum("bhqk,bkhd->bqhd", w,
+    out = jnp.einsum("bhgqk,bkhd->bqhgd", w,
                      kv["v"].astype(jnp.float32)).astype(dt)
+    out = out.reshape(b, 1, attn.num_heads, q.shape[-1])
     y = jnp.einsum("bshe,hed->bsd", out, p["wo"].astype(dt))
     return y.astype(x.dtype), kv
 

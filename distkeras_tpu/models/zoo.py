@@ -156,6 +156,7 @@ def transformer_lm(vocab_size: int, d_model: int = 512, num_heads: int = 8,
                    norm: str = "rmsnorm", dtype: str = "float32",
                    attn_impl: str = "auto",
                    seq_axis_name: Optional[str] = None,
+                   num_kv_heads: Optional[int] = None,
                    moe_every: int = 0, num_experts: int = 0,
                    moe_expert_axis: Optional[str] = None,
                    moe_aux_loss_weight: float = 0.0) -> Sequential:
@@ -167,6 +168,8 @@ def transformer_lm(vocab_size: int, d_model: int = 512, num_heads: int = 8,
 
     ``moe_every=k`` (with ``num_experts``) swaps every k-th block's MLP for
     a mixture-of-experts layer (expert-parallel over ``moe_expert_axis``).
+    ``num_kv_heads < num_heads`` builds a grouped-query (GQA) model — the
+    KV cache at serving time shrinks by the group factor.
     """
     from distkeras_tpu.models.attention import (
         LayerNorm, PositionalEmbedding, RMSNorm, TransformerBlock)
@@ -189,7 +192,8 @@ def transformer_lm(vocab_size: int, d_model: int = 512, num_heads: int = 8,
         layers.append(TransformerBlock(
             num_heads, mlp_ratio=mlp_ratio, causal=True, use_rope=use_rope,
             norm=norm, dtype=dtype, attn_impl=attn_impl,
-            seq_axis_name=seq_axis_name, mlp_layer=mlp_layer))
+            seq_axis_name=seq_axis_name, mlp_layer=mlp_layer,
+            num_kv_heads=num_kv_heads))
     layers.append(RMSNorm() if norm == "rmsnorm" else LayerNorm())
     layers.append(Dense(vocab_size, use_bias=False, dtype=dtype))
     return Sequential(layers)

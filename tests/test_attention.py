@@ -470,3 +470,30 @@ def test_flash_bhsd_layout_matches_bshd(causal):
 
     with pytest.raises(ValueError, match="layout must be"):
         flash_attention(q, k, v, layout="hbsd")
+
+
+def test_gqa_trains_and_roundtrips():
+    """GQA model family: k/v project to fewer heads, training works on
+    every attention path, and the config serializes."""
+    from distkeras_tpu.data import Dataset
+    from distkeras_tpu.models import (Model, load_model, save_model, zoo)
+    from distkeras_tpu.parallel import SingleTrainer
+
+    rs = np.random.RandomState(0)
+    toks = rs.randint(0, 16, (128, 8))
+    m = Model.build(
+        zoo.transformer_lm(16, d_model=16, num_heads=4, num_kv_heads=1,
+                           num_layers=1, mlp_ratio=2), (8,), seed=0)
+    tr = SingleTrainer(m, batch_size=16, num_epoch=2,
+                       worker_optimizer="adam",
+                       optimizer_kwargs={"learning_rate": 1e-2},
+                       loss="sparse_categorical_crossentropy_from_logits")
+    trained = tr.train(Dataset({"features": toks, "label": toks}))
+    assert np.isfinite(tr.get_history().losses()).all()
+
+    import tempfile
+    p = tempfile.mkdtemp() + "/gqa"
+    save_model(trained, p)
+    loaded = load_model(p)
+    np.testing.assert_allclose(loaded.predict(toks[:4]),
+                               trained.predict(toks[:4]), atol=1e-5)

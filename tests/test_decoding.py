@@ -177,3 +177,45 @@ def test_init_cache_rejects_capacity_beyond_position_table():
     with pytest.raises(ValueError, match="too small"):
         init_cache(m.module, 1, 65)
     init_cache(m.module, 1, 64)  # at capacity: fine
+
+
+def test_gqa_decode_matches_full_forward_and_shrinks_cache():
+    """Grouped-query attention: the KV cache stores only kv_heads heads,
+    and incremental decode matches the full forward exactly."""
+    m = Model.build(
+        zoo.transformer_lm(V, d_model=32, num_heads=4, num_kv_heads=2,
+                           num_layers=2, mlp_ratio=2, use_rope=True),
+        (S,), seed=0)
+    from distkeras_tpu.models.decoding import _resolve_head_dims
+    _resolve_head_dims(m.module, m.params)
+
+    # kv projections and cache sized by kv heads
+    blk = next(l for l in m.module.layers
+               if type(l).__name__ == "TransformerBlock")
+    assert blk.attn.kv_heads == 2
+    i = m.module.layers.index(blk)
+    assert m.params[i]["attn"]["wk"].shape == (32, 2, 8)
+    cache = init_cache(m.module, 2, S)
+    kv = next(c for c in cache if c is not None)
+    assert kv["k"].shape == (2, S, 2, 8)
+
+    rs = np.random.RandomState(0)
+    toks = rs.randint(0, V, (2, S))
+    full = m.predict(toks)                       # [B, S, V]
+    logits_steps = []
+    for t in range(S):
+        step_logits, cache = decode_step(m.module, m.params, m.state,
+                                         cache, jnp.asarray(toks[:, t]), t)
+        logits_steps.append(np.asarray(step_logits))
+    np.testing.assert_allclose(np.stack(logits_steps, axis=1), full,
+                               atol=2e-4)
+
+    out = generate(m, toks[:, :3], max_new_tokens=4)
+    assert out.shape == (2, 7)
+
+
+def test_gqa_validates_head_divisibility():
+    from distkeras_tpu.models.attention import MultiHeadAttention
+
+    with pytest.raises(ValueError, match="multiple of"):
+        MultiHeadAttention(num_heads=4, num_kv_heads=3)
