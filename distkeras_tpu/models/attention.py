@@ -188,11 +188,12 @@ class MultiHeadAttention(Layer):
         self.num_heads = int(num_heads)
         self.num_kv_heads = (int(num_kv_heads) if num_kv_heads is not None
                              else None)
-        kv = self.num_kv_heads or self.num_heads
-        if self.num_heads % kv:
+        kv = self.num_kv_heads if self.num_kv_heads is not None \
+            else self.num_heads
+        if kv < 1 or self.num_heads % kv:
             raise ValueError(
-                f"num_heads {self.num_heads} must be a multiple of "
-                f"num_kv_heads {kv}")
+                f"num_kv_heads must be a positive divisor of num_heads "
+                f"{self.num_heads}, got {kv}")
         self.head_dim = head_dim if head_dim is None else int(head_dim)
         self.causal = bool(causal)
         self.use_rope = bool(use_rope)

@@ -165,14 +165,19 @@ class ShardingRules:
 
     # MHA: wq/wk/wv [d, H, Dh] column-parallel on heads; wo [H, Dh, d]
     # row-parallel on heads (Megatron split — one psum per attention).
+    # GQA: wk/wv carry only kv_heads heads, so their shard decision uses
+    # THEIR head count — tp > kv_heads degrades those two to replicated
+    # (never an error), while wq/wo still shard on the full head axis.
     def _rule_MultiHeadAttention(self, layer, params):
-        heads = params["wq"].shape[1]
-        tp = self._tp(heads)
+        tp_q = self._tp(params["wq"].shape[1])
+        tp_kv = self._tp(params["wk"].shape[1])
         return {
-            "wq": self._maybe_fsdp(P(None, tp, None), params["wq"].shape),
-            "wk": self._maybe_fsdp(P(None, tp, None), params["wk"].shape),
-            "wv": self._maybe_fsdp(P(None, tp, None), params["wv"].shape),
-            "wo": self._maybe_fsdp(P(tp, None, None), params["wo"].shape),
+            "wq": self._maybe_fsdp(P(None, tp_q, None), params["wq"].shape),
+            "wk": self._maybe_fsdp(P(None, tp_kv, None),
+                                   params["wk"].shape),
+            "wv": self._maybe_fsdp(P(None, tp_kv, None),
+                                   params["wv"].shape),
+            "wo": self._maybe_fsdp(P(tp_q, None, None), params["wo"].shape),
         }
 
     # Transformer MLP: w1 [d, hidden] column, w2 [hidden, d] row.

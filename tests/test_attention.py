@@ -472,7 +472,7 @@ def test_flash_bhsd_layout_matches_bshd(causal):
         flash_attention(q, k, v, layout="hbsd")
 
 
-def test_gqa_trains_and_roundtrips():
+def test_gqa_trains_and_roundtrips(tmp_path):
     """GQA model family: k/v project to fewer heads, training works on
     every attention path, and the config serializes."""
     from distkeras_tpu.data import Dataset
@@ -491,9 +491,17 @@ def test_gqa_trains_and_roundtrips():
     trained = tr.train(Dataset({"features": toks, "label": toks}))
     assert np.isfinite(tr.get_history().losses()).all()
 
-    import tempfile
-    p = tempfile.mkdtemp() + "/gqa"
+    p = str(tmp_path / "gqa")
     save_model(trained, p)
     loaded = load_model(p)
     np.testing.assert_allclose(loaded.predict(toks[:4]),
                                trained.predict(toks[:4]), atol=1e-5)
+
+
+def test_gqa_rejects_nonpositive_kv_heads():
+    from distkeras_tpu.models.attention import MultiHeadAttention
+
+    with pytest.raises(ValueError, match="positive divisor"):
+        MultiHeadAttention(num_heads=8, num_kv_heads=0)
+    with pytest.raises(ValueError, match="positive divisor"):
+        MultiHeadAttention(num_heads=8, num_kv_heads=-4)

@@ -217,5 +217,5 @@ def test_gqa_decode_matches_full_forward_and_shrinks_cache():
 def test_gqa_validates_head_divisibility():
     from distkeras_tpu.models.attention import MultiHeadAttention
 
-    with pytest.raises(ValueError, match="multiple of"):
+    with pytest.raises(ValueError, match="positive divisor"):
         MultiHeadAttention(num_heads=4, num_kv_heads=3)

@@ -316,3 +316,24 @@ def test_distributed_resume_with_different_worker_count(tmp_path):
     assert losses.shape == (3 * (512 // (4 * 16)), 4)  # 3 epochs, 4 workers
     from distkeras_tpu.ops.metrics import accuracy
     assert float(accuracy(y, m.predict(X))) > 0.8
+
+
+def test_gqa_tp_sharding_degrades_kv_to_replicated():
+    """tp divides num_heads but not num_kv_heads: wq/wo shard on heads,
+    wk/wv degrade to replicated (never an error)."""
+    from distkeras_tpu.models import Model, zoo
+
+    mesh = make_mesh_2d({"workers": 2, "tp": 4})
+    module = zoo.transformer_lm(16, d_model=32, num_heads=8,
+                                num_kv_heads=2, num_layers=1, mlp_ratio=2)
+    model = Model.build(module, (8,), seed=0)
+    specs = param_specs(module, model.params, mesh, tp_axis="tp")
+    blk = next(i for i, l in enumerate(module.layers)
+               if type(l).__name__ == "TransformerBlock")
+    attn = specs[blk]["attn"]
+    assert attn["wq"] == P(None, "tp", None)
+    assert attn["wo"] == P("tp", None, None)
+    assert attn["wk"] == P(None, None, None)   # 2 kv heads, tp=4
+    assert attn["wv"] == P(None, None, None)
+    # and the placement actually works end-to-end
+    shard_params(model.params, specs, mesh)
