@@ -184,7 +184,9 @@ class MultiHeadAttention(Layer):
                  seq_axis_name: Optional[str] = None,
                  kernel_init: str = "glorot_uniform",
                  ring_block_size: Optional[int] = None,
-                 num_kv_heads: Optional[int] = None):
+                 num_kv_heads: Optional[int] = None,
+                 rope_scale: float = 1.0):
+        self.rope_scale = float(rope_scale)
         self.num_heads = int(num_heads)
         self.num_kv_heads = (int(num_kv_heads) if num_kv_heads is not None
                              else None)
@@ -252,8 +254,10 @@ class MultiHeadAttention(Layer):
             k = jnp.einsum("bsd,dhe->bhse", xc, params["wk"].astype(dt))
             v = jnp.einsum("bsd,dhe->bhse", xc, params["wv"].astype(dt))
             if self.use_rope:
-                q = apply_rope(q, positions, layout="bhsd")
-                k = apply_rope(k, positions, layout="bhsd")
+                q = apply_rope(q, positions, layout="bhsd",
+                               scale=self.rope_scale)
+                k = apply_rope(k, positions, layout="bhsd",
+                               scale=self.rope_scale)
             k, v = self._expand_kv(k, 1), self._expand_kv(v, 1)
             from distkeras_tpu.ops.flash_attention import flash_attention
             out = flash_attention(q, k, v, causal=self.causal,
@@ -265,8 +269,8 @@ class MultiHeadAttention(Layer):
         k = jnp.einsum("bsd,dhe->bshe", xc, params["wk"].astype(dt))
         v = jnp.einsum("bsd,dhe->bshe", xc, params["wv"].astype(dt))
         if self.use_rope:
-            q = apply_rope(q, positions)
-            k = apply_rope(k, positions)
+            q = apply_rope(q, positions, scale=self.rope_scale)
+            k = apply_rope(k, positions, scale=self.rope_scale)
         k, v = self._expand_kv(k, 2), self._expand_kv(v, 2)
         out = _attention_compute(q, k, v, causal=self.causal,
                                  impl=impl,
@@ -282,7 +286,8 @@ class MultiHeadAttention(Layer):
                 "seq_axis_name": self.seq_axis_name,
                 "kernel_init": self.kernel_init,
                 "ring_block_size": self.ring_block_size,
-                "num_kv_heads": self.num_kv_heads}
+                "num_kv_heads": self.num_kv_heads,
+                "rope_scale": self.rope_scale}
 
 
 @register_layer
@@ -338,9 +343,11 @@ class TransformerBlock(Layer):
                  mlp_layer: Optional[Layer] = None,
                  dropout_rate: float = 0.0,
                  ring_block_size: Optional[int] = None,
-                 num_kv_heads: Optional[int] = None):
+                 num_kv_heads: Optional[int] = None,
+                 rope_scale: float = 1.0):
         self.num_heads = int(num_heads)
         self.num_kv_heads = num_kv_heads
+        self.rope_scale = float(rope_scale)
         self.mlp_ratio = int(mlp_ratio)
         self.head_dim = head_dim
         self.causal = causal
@@ -361,7 +368,8 @@ class TransformerBlock(Layer):
         self.attn = MultiHeadAttention(
             num_heads, head_dim=head_dim, causal=causal, use_rope=use_rope,
             dtype=dtype, attn_impl=attn_impl, seq_axis_name=seq_axis_name,
-            ring_block_size=ring_block_size, num_kv_heads=num_kv_heads)
+            ring_block_size=ring_block_size, num_kv_heads=num_kv_heads,
+            rope_scale=rope_scale)
         self.mlp = mlp_layer  # resolved in init once d_model is known
 
     def init(self, rng, input_shape):
@@ -419,7 +427,8 @@ class TransformerBlock(Layer):
                "seq_axis_name": self.seq_axis_name,
                "dropout_rate": self.dropout_rate,
                "ring_block_size": self.ring_block_size,
-               "num_kv_heads": self.num_kv_heads}
+               "num_kv_heads": self.num_kv_heads,
+               "rope_scale": self.rope_scale}
         if self._mlp_override is not None:
             cfg["mlp_layer"] = layer_spec(self._mlp_override)
         return cfg

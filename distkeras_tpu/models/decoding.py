@@ -93,8 +93,8 @@ def _decode_attn(attn: MultiHeadAttention, p, kv, x, t):
     v = jnp.einsum("bsd,dhe->bshe", xc, p["wv"].astype(dt))
     if attn.use_rope:
         pos = jnp.full((1,), t)
-        q = apply_rope(q, pos)
-        k = apply_rope(k, pos)
+        q = apply_rope(q, pos, scale=attn.rope_scale)
+        k = apply_rope(k, pos, scale=attn.rope_scale)
     kv = {"k": lax.dynamic_update_slice_in_dim(
               kv["k"], k.astype(kv["k"].dtype), t, axis=1),
           "v": lax.dynamic_update_slice_in_dim(

@@ -73,12 +73,17 @@ def rope_frequencies(head_dim: int, base: float = 10000.0) -> jnp.ndarray:
 
 
 def apply_rope(x, positions=None, base: float = 10000.0,
-               layout: str = "bshd"):
+               layout: str = "bshd", scale: float = 1.0):
     """Rotary position embedding on a BSHD (default) or BHSD tensor.
 
     ``positions``: optional [S] or [B, S] int array of global token positions
     (defaults to 0..S-1 — pass explicit positions for sequence-sharded
     shards in ring attention).
+
+    ``scale > 1`` is linear position interpolation (Chen et al. 2023):
+    positions are divided by ``scale`` so a model trained to length L
+    serves length ``scale * L`` inside its trained rotary range — the
+    standard cheap long-context extension.
     """
     if layout == "bhsd":
         b, h, s, d = x.shape
@@ -87,6 +92,8 @@ def apply_rope(x, positions=None, base: float = 10000.0,
     if positions is None:
         positions = jnp.arange(s)
     positions = jnp.asarray(positions, jnp.float32)
+    if scale != 1.0:
+        positions = positions / scale
     if positions.ndim == 1:
         positions = positions[None, :]  # [1, S] broadcasts over batch
     freqs = rope_frequencies(d, base)                   # [D/2]

@@ -505,3 +505,27 @@ def test_gqa_rejects_nonpositive_kv_heads():
         MultiHeadAttention(num_heads=8, num_kv_heads=0)
     with pytest.raises(ValueError, match="positive divisor"):
         MultiHeadAttention(num_heads=8, num_kv_heads=-4)
+
+
+def test_rope_scale_interpolates_positions():
+    """Linear position interpolation: scale=2 at position 2t equals
+    scale=1 at position t, and a scaled model decodes consistently."""
+    from distkeras_tpu.ops.attention import apply_rope
+
+    x = jax.random.normal(jax.random.PRNGKey(0), (1, 4, 2, 8))
+    a = apply_rope(x, positions=jnp.asarray([0, 2, 4, 6]), scale=2.0)
+    b = apply_rope(x, positions=jnp.asarray([0, 1, 2, 3]), scale=1.0)
+    np.testing.assert_allclose(a, b, atol=1e-6)
+
+    from distkeras_tpu.models import Model, zoo
+    from distkeras_tpu.models.decoding import generate
+
+    m = Model.build(zoo.transformer_lm(16, d_model=16, num_heads=2,
+                                       num_layers=1, mlp_ratio=2,
+                                       rope_scale=4.0), (8,), seed=0)
+    out = generate(m, np.zeros((1, 4), np.int32), max_new_tokens=4)
+    assert out.shape == (1, 8)
+    # config roundtrip carries the scale
+    blk = next(l for l in m.module.layers
+               if type(l).__name__ == "TransformerBlock")
+    assert blk.get_config()["rope_scale"] == 4.0
