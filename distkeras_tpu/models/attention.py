@@ -127,7 +127,7 @@ class PositionalEmbedding(Layer):
 
 
 def _attention_compute(q, k, v, *, causal, impl, axis_name=None,
-                       ring_block_size=None):
+                       ring_block_size=None, window=None):
     """Dispatch on attention implementation. q/k/v are BSHD."""
     if impl == "auto":
         # measured on TPU v5e (bench.py --model lm): the Pallas flash
@@ -137,7 +137,12 @@ def _attention_compute(q, k, v, *, causal, impl, axis_name=None,
         impl = "flash" if jax.default_backend() == "tpu" else "xla"
     if impl == "flash":
         from distkeras_tpu.ops.flash_attention import flash_attention
-        return flash_attention(q, k, v, causal=causal)
+        return flash_attention(q, k, v, causal=causal, window=window)
+    if window is not None and impl in ("ring", "ulysses",
+                                       "ulysses_flash"):
+        raise ValueError(
+            f"attn_window is not supported with attn_impl={impl!r} "
+            "(sequence-parallel paths have no windowed variant yet)")
     if impl == "ring":
         if not axis_name:
             raise ValueError(
@@ -159,7 +164,7 @@ def _attention_compute(q, k, v, *, causal, impl, axis_name=None,
         return ulysses_attention(
             q, k, v, axis_name=axis_name, causal=causal,
             impl="flash" if impl == "ulysses_flash" else "xla")
-    return dot_product_attention(q, k, v, causal=causal)
+    return dot_product_attention(q, k, v, causal=causal, window=window)
 
 
 @register_layer
@@ -185,8 +190,15 @@ class MultiHeadAttention(Layer):
                  kernel_init: str = "glorot_uniform",
                  ring_block_size: Optional[int] = None,
                  num_kv_heads: Optional[int] = None,
-                 rope_scale: float = 1.0):
+                 rope_scale: float = 1.0,
+                 attn_window: Optional[int] = None):
         self.rope_scale = float(rope_scale)
+        #: causal sliding window (Mistral-style SWA): each query attends
+        #: to at most the last attn_window keys. None = full causal.
+        self.attn_window = (int(attn_window) if attn_window is not None
+                            else None)
+        if self.attn_window is not None and not causal:
+            raise ValueError("attn_window requires causal=True")
         self.num_heads = int(num_heads)
         self.num_kv_heads = (int(num_kv_heads) if num_kv_heads is not None
                              else None)
@@ -261,7 +273,7 @@ class MultiHeadAttention(Layer):
             k, v = self._expand_kv(k, 1), self._expand_kv(v, 1)
             from distkeras_tpu.ops.flash_attention import flash_attention
             out = flash_attention(q, k, v, causal=self.causal,
-                                  layout="bhsd")
+                                  layout="bhsd", window=self.attn_window)
             y = jnp.einsum("bhse,hed->bsd", out, params["wo"].astype(dt))
             return y.astype(x.dtype), state
 
@@ -275,7 +287,8 @@ class MultiHeadAttention(Layer):
         out = _attention_compute(q, k, v, causal=self.causal,
                                  impl=impl,
                                  axis_name=self.seq_axis_name,
-                                 ring_block_size=self.ring_block_size)
+                                 ring_block_size=self.ring_block_size,
+                                 window=self.attn_window)
         y = jnp.einsum("bshe,hed->bsd", out, params["wo"].astype(dt))
         return y.astype(x.dtype), state
 
@@ -287,7 +300,8 @@ class MultiHeadAttention(Layer):
                 "kernel_init": self.kernel_init,
                 "ring_block_size": self.ring_block_size,
                 "num_kv_heads": self.num_kv_heads,
-                "rope_scale": self.rope_scale}
+                "rope_scale": self.rope_scale,
+                "attn_window": self.attn_window}
 
 
 @register_layer
@@ -344,10 +358,12 @@ class TransformerBlock(Layer):
                  dropout_rate: float = 0.0,
                  ring_block_size: Optional[int] = None,
                  num_kv_heads: Optional[int] = None,
-                 rope_scale: float = 1.0):
+                 rope_scale: float = 1.0,
+                 attn_window: Optional[int] = None):
         self.num_heads = int(num_heads)
         self.num_kv_heads = num_kv_heads
         self.rope_scale = float(rope_scale)
+        self.attn_window = attn_window
         self.mlp_ratio = int(mlp_ratio)
         self.head_dim = head_dim
         self.causal = causal
@@ -369,7 +385,7 @@ class TransformerBlock(Layer):
             num_heads, head_dim=head_dim, causal=causal, use_rope=use_rope,
             dtype=dtype, attn_impl=attn_impl, seq_axis_name=seq_axis_name,
             ring_block_size=ring_block_size, num_kv_heads=num_kv_heads,
-            rope_scale=rope_scale)
+            rope_scale=rope_scale, attn_window=attn_window)
         self.mlp = mlp_layer  # resolved in init once d_model is known
 
     def init(self, rng, input_shape):
@@ -428,7 +444,8 @@ class TransformerBlock(Layer):
                "dropout_rate": self.dropout_rate,
                "ring_block_size": self.ring_block_size,
                "num_kv_heads": self.num_kv_heads,
-               "rope_scale": self.rope_scale}
+               "rope_scale": self.rope_scale,
+               "attn_window": self.attn_window}
         if self._mlp_override is not None:
             cfg["mlp_layer"] = layer_spec(self._mlp_override)
         return cfg

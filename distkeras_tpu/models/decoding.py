@@ -108,6 +108,8 @@ def _decode_attn(attn: MultiHeadAttention, p, kv, x, t):
     s = jnp.einsum("bqhgd,bkhd->bhgqk", qg,
                    kv["k"].astype(jnp.float32))          # [B, Hkv, G, 1, L]
     valid = jnp.arange(kv["k"].shape[1]) <= t
+    if attn.attn_window is not None:
+        valid &= jnp.arange(kv["k"].shape[1]) > t - attn.attn_window
     s = jnp.where(valid[None, None, None, None, :], s, NEG_INF)
     w = jax.nn.softmax(s, axis=-1)
     out = jnp.einsum("bhgqk,bkhd->bqhgd", w,

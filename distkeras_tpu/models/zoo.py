@@ -158,6 +158,7 @@ def transformer_lm(vocab_size: int, d_model: int = 512, num_heads: int = 8,
                    seq_axis_name: Optional[str] = None,
                    num_kv_heads: Optional[int] = None,
                    rope_scale: float = 1.0,
+                   attn_window: Optional[int] = None,
                    moe_every: int = 0, num_experts: int = 0,
                    moe_expert_axis: Optional[str] = None,
                    moe_aux_loss_weight: float = 0.0) -> Sequential:
@@ -194,7 +195,8 @@ def transformer_lm(vocab_size: int, d_model: int = 512, num_heads: int = 8,
             num_heads, mlp_ratio=mlp_ratio, causal=True, use_rope=use_rope,
             norm=norm, dtype=dtype, attn_impl=attn_impl,
             seq_axis_name=seq_axis_name, mlp_layer=mlp_layer,
-            num_kv_heads=num_kv_heads, rope_scale=rope_scale))
+            num_kv_heads=num_kv_heads, rope_scale=rope_scale,
+            attn_window=attn_window))
     layers.append(RMSNorm() if norm == "rmsnorm" else LayerNorm())
     layers.append(Dense(vocab_size, use_bias=False, dtype=dtype))
     return Sequential(layers)

@@ -38,21 +38,31 @@ def causal_mask(q_len: int, k_len: int, q_offset: int = 0,
 
 def dot_product_attention(q, k, v, *, causal: bool = False,
                           mask: Optional[jnp.ndarray] = None,
-                          scale: Optional[float] = None) -> jnp.ndarray:
+                          scale: Optional[float] = None,
+                          window: Optional[int] = None) -> jnp.ndarray:
     """Reference (pure-XLA) attention. BSHD in, BSHD out.
 
     XLA fuses this well for moderate sequence lengths; the Pallas flash
     kernel (``ops.flash_attention``) avoids materializing the [S, S] scores
     for long sequences.
+
+    ``window=W`` (requires ``causal``) restricts each query to the last W
+    keys — causal sliding-window attention.
     """
     head_dim = q.shape[-1]
     if scale is None:
         scale = head_dim ** -0.5
+    if window is not None and not causal:
+        raise ValueError("window requires causal=True")
     # [B, H, Sq, Sk] scores in f32
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
                    preferred_element_type=jnp.float32) * scale
     if causal:
         allowed = causal_mask(q.shape[1], k.shape[1])
+        if window is not None:
+            q_pos = jnp.arange(q.shape[1])[:, None]
+            k_pos = jnp.arange(k.shape[1])[None, :]
+            allowed = allowed & (k_pos > q_pos - window)
         s = jnp.where(allowed[None, None], s, NEG_INF)
     if mask is not None:
         s = jnp.where(mask, s, NEG_INF)

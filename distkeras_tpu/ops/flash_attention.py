@@ -43,17 +43,19 @@ except ImportError:  # pragma: no cover
 from distkeras_tpu.ops.attention import (NEG_INF, causal_mask,
                                          dot_product_attention)
 
-# Measured on TPU v5e (causal bf16, fwd+bwd, BHSD): 512/1024 is the knee —
-# S=2048 B8 H16: 14.8 ms vs 17.5 ms at 512/512; S=8192 B2 H8: 22.0 ms,
-# where 512/512 (and 256/256 at S=2048) hit a Mosaic slow path that is
-# ~100x worse. Keep block_k >= 1024 unless VMEM forces smaller: the score
-# tile at 512x1024 f32 is 2 MB, safe through D=256.
+# Measured on TPU v5e (causal bf16, fwd+bwd, BHSD, steady state —
+# the tunneled backend's FIRST timed loop after compile can pay a one-off
+# ~0.5 s lazy-init cost; always discard trial 0 when benchmarking here):
+# 512/1024 beats 512/512 by ~10-15% at both S=2048 (14.8 vs 17.5 ms,
+# B8 H16) and S=8192 (22.0 vs 24-25 ms, B2 H8). Score tile at 512x1024
+# f32 is 2 MB of VMEM, safe through D=256.
 DEFAULT_BLOCK_Q = 512
 DEFAULT_BLOCK_K = 1024
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
-                *, scale: float, causal: bool, k_len: int):
+                *, scale: float, causal: bool, k_len: int,
+                window=None):
     """One (batch*head, q_block, k_block) program.
 
     Block shapes: q_ref [1, bq, D]; k_ref/v_ref [1, bk, D];
@@ -73,9 +75,14 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
         l_ref[:] = jnp.zeros_like(l_ref)
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    # causal: tiles strictly above the diagonal contribute nothing
+    # causal: tiles strictly above the diagonal contribute nothing;
+    # sliding window: tiles entirely OLDER than any query's window start
+    # contribute nothing either
     run = (ki * block_k <= qi * block_q + block_q - 1) if causal \
         else (ki >= 0)
+    if window is not None:
+        run = jnp.logical_and(
+            run, ki * block_k + block_k - 1 > qi * block_q - window)
 
     @pl.when(run)
     def _compute():
@@ -90,6 +97,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
                  lax.broadcasted_iota(jnp.int32, s.shape, 1))
         if causal:
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        if window is not None:
+            s = jnp.where(k_pos > q_pos - window, s, NEG_INF)
         # mask zero-padded keys past the true sequence end
         if k_len % block_k:
             s = jnp.where(k_pos < k_len, s, NEG_INF)
@@ -122,7 +131,8 @@ def _pad_seq(x, block: int, axis: int = 1):
 
 
 def _flash_forward(q, k, v, scale: float, causal: bool, block_q: int,
-                   block_k: int, interpret: bool, bhsd: bool = False):
+                   block_k: int, interpret: bool, bhsd: bool = False,
+                   window=None):
     if bhsd:
         b, h, sq, d = q.shape
         sk = k.shape[2]
@@ -157,7 +167,7 @@ def _flash_forward(q, k, v, scale: float, causal: bool, block_q: int,
 
     grid = (b * h, sq_p // block_q, sk_p // block_k)
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                               k_len=sk)
+                               k_len=sk, window=window)
     kwargs = {}
     if pltpu is not None and not interpret:
         kwargs["compiler_params"] = pltpu.CompilerParams(
@@ -195,7 +205,8 @@ def _flash_forward(q, k, v, scale: float, causal: bool, block_q: int,
 
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, dq_ref,
-                   dq_acc, *, scale: float, causal: bool, k_len: int):
+                   dq_acc, *, scale: float, causal: bool, k_len: int,
+                   window=None):
     """dq pass: one (batch*head, q_block, k_block) program, K innermost.
     ``dq_acc`` [bq, D] f32 persists across the K sweep."""
     qi, ki = pl.program_id(1), pl.program_id(2)
@@ -208,6 +219,10 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, dq_ref,
 
     run = (ki * block_k <= qi * block_q + block_q - 1) if causal \
         else (ki >= 0)
+    if window is not None:
+        run = jnp.logical_and(
+            run, ki * block_k + block_k - 1
+            > qi * block_q - window)
 
     @pl.when(run)
     def _compute():
@@ -221,6 +236,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, dq_ref,
         k_pos = ki * block_k + lax.broadcasted_iota(jnp.int32, s.shape, 1)
         if causal:
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        if window is not None:
+            s = jnp.where(k_pos > q_pos - window, s, NEG_INF)
         if k_len % block_k:
             s = jnp.where(k_pos < k_len, s, NEG_INF)
         p = jnp.exp(s - lse_ref[0])                        # [bq, bk]
@@ -238,7 +255,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, dq_ref,
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, dk_acc, dv_acc,
-                    *, scale: float, causal: bool, k_len: int):
+                    *, scale: float, causal: bool, k_len: int,
+                    window=None):
     """dk/dv pass: one (batch*head, k_block, q_block) program, Q innermost.
     ``dk_acc``/``dv_acc`` [bk, D] f32 persist across the Q sweep."""
     ki, qi = pl.program_id(1), pl.program_id(2)
@@ -250,9 +268,15 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
 
-    # causal: q tiles entirely above the diagonal see none of this k block
+    # causal: q tiles entirely above the diagonal see none of this k
+    # block; sliding window: q tiles entirely NEWER than every key's
+    # window reach see none of it either
     run = (qi * block_q + block_q - 1 >= ki * block_k) if causal \
         else (qi >= 0)
+    if window is not None:
+        run = jnp.logical_and(
+            run, qi * block_q
+            < ki * block_k + block_k - 1 + window)
 
     @pl.when(run)
     def _compute():
@@ -266,6 +290,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
         k_pos = ki * block_k + lax.broadcasted_iota(jnp.int32, s.shape, 1)
         if causal:
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        if window is not None:
+            s = jnp.where(k_pos > q_pos - window, s, NEG_INF)
         if k_len % block_k:
             s = jnp.where(k_pos < k_len, s, NEG_INF)
         p = jnp.exp(s - lse_ref[0])                        # [bq, bk]
@@ -287,7 +313,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
 
 def _flash_backward_pallas(res, g, scale: float, causal: bool,
                            block_q: int, block_k: int, interpret: bool,
-                           bhsd: bool = False):
+                           bhsd: bool = False, window=None):
     """In-kernel backward: the [bq, bk] probability tile lives only in
     VMEM; f32 accumulators carry across the sequential grid axis."""
     q, k, v, out, lse = res
@@ -337,7 +363,7 @@ def _flash_backward_pallas(res, g, scale: float, causal: bool,
     row_q = pl.BlockSpec((1, block_q, 1), lambda bh, qi, ki: (bh, qi, 0))
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
-                          k_len=sk),
+                          k_len=sk, window=window),
         grid=(b * h, nq, nk),
         in_specs=[q_spec, k_spec, k_spec, q_spec, row_q, row_q],
         out_specs=[q_spec],
@@ -352,7 +378,7 @@ def _flash_backward_pallas(res, g, scale: float, causal: bool,
     row_q2 = pl.BlockSpec((1, block_q, 1), lambda bh, ki, qi: (bh, qi, 0))
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
-                          k_len=sk),
+                          k_len=sk, window=window),
         grid=(b * h, nk, nq),
         in_specs=[q_spec2, k_spec2, k_spec2, q_spec2, row_q2, row_q2],
         out_specs=[k_spec2, k_spec2],
@@ -371,7 +397,8 @@ def _flash_backward_pallas(res, g, scale: float, causal: bool,
     return unflat(dq, sq), unflat(dk, sk), unflat(dv, sk)
 
 
-def _flash_backward(res, g, scale: float, causal: bool, block_k: int):
+def _flash_backward(res, g, scale: float, causal: bool, block_k: int,
+                    window=None):
     """Blockwise XLA backward: scan over K/V blocks, recompute P from lse."""
     q, k, v, out, lse = res
     b, sq, h, d = q.shape
@@ -396,6 +423,10 @@ def _flash_backward(res, g, scale: float, causal: bool, block_k: int):
                        preferred_element_type=jnp.float32)
         allowed = causal_mask(sq, block_k, k_offset=kb * block_k) \
             if causal else True
+        if window is not None:
+            q_pos = jnp.arange(sq)[:, None]
+            k_pos = (kb * block_k + jnp.arange(block_k))[None, :]
+            allowed = jnp.logical_and(allowed, k_pos > q_pos - window)
         k_valid = (kb * block_k + jnp.arange(block_k)) < sk
         mask = jnp.logical_and(allowed, k_valid[None, :]) if causal \
             else k_valid[None, :]
@@ -419,33 +450,35 @@ def _flash_backward(res, g, scale: float, causal: bool, block_k: int):
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
-def _flash(q, k, v, scale, causal, block_q, block_k, interpret, bwd, bhsd):
+@functools.partial(jax.custom_vjp,
+                   nondiff_argnums=(3, 4, 5, 6, 7, 8, 9, 10))
+def _flash(q, k, v, scale, causal, block_q, block_k, interpret, bwd, bhsd,
+           window):
     out, _ = _flash_forward(q, k, v, scale, causal, block_q, block_k,
-                            interpret, bhsd)
+                            interpret, bhsd, window)
     return out
 
 
 def _flash_fwd_rule(q, k, v, scale, causal, block_q, block_k, interpret,
-                    bwd, bhsd):
+                    bwd, bhsd, window):
     out, lse = _flash_forward(q, k, v, scale, causal, block_q, block_k,
-                              interpret, bhsd)
+                              interpret, bhsd, window)
     return out, (q, k, v, out, lse)
 
 
 def _flash_bwd_rule(scale, causal, block_q, block_k, interpret, bwd, bhsd,
-                    res, g):
+                    window, res, g):
     if bwd == "pallas":
         return _flash_backward_pallas(res, g, scale, causal, block_q,
-                                      block_k, interpret, bhsd)
+                                      block_k, interpret, bhsd, window)
     if bhsd:
         # the scan-backward oracle is written for BSHD; convert around it
         t = lambda x: x.transpose(0, 2, 1, 3)
         q, k, v, out, lse = res
         dq, dk, dv = _flash_backward((t(q), t(k), t(v), t(out), lse),
-                                     t(g), scale, causal, block_k)
+                                     t(g), scale, causal, block_k, window)
         return t(dq), t(dk), t(dv)
-    return _flash_backward(res, g, scale, causal, block_k)
+    return _flash_backward(res, g, scale, causal, block_k, window)
 
 
 _flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
@@ -457,7 +490,8 @@ def flash_attention(q, k, v, *, causal: bool = False,
                     block_k: int = DEFAULT_BLOCK_K,
                     interpret: Optional[bool] = None,
                     bwd: Optional[str] = None,
-                    layout: str = "bshd") -> jnp.ndarray:
+                    layout: str = "bshd",
+                    window: Optional[int] = None) -> jnp.ndarray:
     """Flash attention, BSHD in/out by default. Differentiable (custom
     VJP). ``layout="bhsd"`` takes/returns [B, H, S, D] — the kernel's
     native flattening is then a free reshape instead of four
@@ -479,13 +513,20 @@ def flash_attention(q, k, v, *, causal: bool = False,
     seq_axis = 2 if bhsd else 1
     if scale is None:
         scale = q.shape[-1] ** -0.5
+    if window is not None:
+        window = int(window)
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
+        if not causal:
+            raise ValueError("window requires causal=True")
 
     def _xla_fallback():
         if bhsd:
             t = lambda x: x.transpose(0, 2, 1, 3)
             return t(dot_product_attention(t(q), t(k), t(v), causal=causal,
-                                           scale=scale))
-        return dot_product_attention(q, k, v, causal=causal, scale=scale)
+                                           scale=scale, window=window))
+        return dot_product_attention(q, k, v, causal=causal, scale=scale,
+                                     window=window)
 
     if pltpu is None:  # no Pallas TPU support in this jax build
         return _xla_fallback()
@@ -502,4 +543,4 @@ def flash_attention(q, k, v, *, causal: bool = False,
     if bwd not in ("pallas", "xla"):
         raise ValueError(f"bwd must be 'pallas' or 'xla', got {bwd!r}")
     return _flash(q, k, v, scale, causal, block_q, block_k, interpret, bwd,
-                  bhsd)
+                  bhsd, window)

@@ -529,3 +529,67 @@ def test_rope_scale_interpolates_positions():
     blk = next(l for l in m.module.layers
                if type(l).__name__ == "TransformerBlock")
     assert blk.get_config()["rope_scale"] == 4.0
+
+
+@pytest.mark.parametrize("window", [1, 7, 16, 100])
+def test_sliding_window_matches_banded_reference(window):
+    """Causal sliding-window attention (fwd + both backwards) must equal
+    an explicitly band-masked softmax reference, including windows larger
+    than the sequence (== full causal) and non-divisible lengths."""
+    from distkeras_tpu.ops.attention import NEG_INF
+
+    B, S, H, D = 2, 44, 2, 8
+    q, k, v = _rand_qkv(jax.random.PRNGKey(7), b=B, s=S, h=H, d=D)
+    co = jax.random.normal(jax.random.PRNGKey(8), q.shape)
+
+    def banded(q, k, v):
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * (D ** -0.5)
+        qp, kp = jnp.arange(S)[:, None], jnp.arange(S)[None, :]
+        allowed = (qp >= kp) & (kp > qp - window)
+        w = jax.nn.softmax(jnp.where(allowed[None, None], s, NEG_INF), -1)
+        return jnp.einsum("bhqk,bkhd->bqhd", w, v)
+
+    out = flash_attention(q, k, v, causal=True, window=window,
+                          interpret=True, block_q=16, block_k=16)
+    np.testing.assert_allclose(out, banded(q, k, v), atol=1e-5)
+
+    gr = jax.grad(lambda a, b, c: jnp.sum(banded(a, b, c) * co),
+                  argnums=(0, 1, 2))(q, k, v)
+    for bwd in ("pallas", "xla"):
+        gw = jax.grad(lambda a, b, c: jnp.sum(flash_attention(
+            a, b, c, causal=True, window=window, interpret=True, bwd=bwd,
+            block_q=16, block_k=16) * co), argnums=(0, 1, 2))(q, k, v)
+        for x, y in zip(gw, gr):
+            np.testing.assert_allclose(x, y, atol=2e-5)
+
+    with pytest.raises(ValueError, match="causal"):
+        flash_attention(q, k, v, causal=False, window=window,
+                        interpret=True)
+
+
+def test_sliding_window_model_trains_and_decodes():
+    """attn_window on the LM family: training runs, decode_step masks the
+    cache to the window and matches the full forward."""
+    from distkeras_tpu.models import Model, zoo
+    from distkeras_tpu.models.decoding import (decode_step, init_cache,
+                                               _resolve_head_dims)
+
+    S = 10
+    m = Model.build(zoo.transformer_lm(16, d_model=16, num_heads=2,
+                                       num_layers=1, mlp_ratio=2,
+                                       attn_window=4), (S,), seed=0)
+    _resolve_head_dims(m.module, m.params)
+    rs = np.random.RandomState(0)
+    toks = rs.randint(0, 16, (2, S))
+    full = m.predict(toks)
+    cache = init_cache(m.module, 2, S)
+    steps = []
+    for t in range(S):
+        lg, cache = decode_step(m.module, m.params, m.state, cache,
+                                jnp.asarray(toks[:, t]), t)
+        steps.append(np.asarray(lg))
+    np.testing.assert_allclose(np.stack(steps, axis=1), full, atol=2e-4)
+
+    with pytest.raises(ValueError, match="causal"):
+        from distkeras_tpu.models.attention import MultiHeadAttention
+        MultiHeadAttention(num_heads=2, causal=False, attn_window=4)
