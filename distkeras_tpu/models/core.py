@@ -90,6 +90,13 @@ class Layer:
     ``input_shape``/``output_shape`` exclude the batch dimension.
     """
 
+    #: Keras-style freezing: set False BEFORE training and the layer's
+    #: params (its whole subtree, for containers) receive no updates —
+    #: every trainer masks the gradients, so optimizer moments stay zero
+    #: too. Like Keras, this is a training-time attribute, not part of
+    #: the serialized architecture config.
+    trainable: bool = True
+
     def init(self, rng: jax.Array, input_shape: Tuple[int, ...]):
         return {}, {}, input_shape
 
@@ -407,3 +414,25 @@ class Model:
     def __repr__(self):
         return (f"Model({self.module.name}, in={self.input_shape}, "
                 f"out={self.output_shape}, params={self.num_params():,})")
+
+
+def trainable_mask(module: Layer, params):
+    """Boolean pytree matching ``params``: True where updates may flow.
+
+    Returns ``None`` when every layer is trainable (the common case — the
+    trainers then skip the masking entirely). Keras container semantics:
+    a layer with ``trainable = False`` freezes its WHOLE params subtree;
+    ``Sequential`` containers recurse so individual sublayers can be
+    frozen independently.
+    """
+    def walk(layer, sub, enabled):
+        enabled = enabled and getattr(layer, "trainable", True)
+        if isinstance(layer, Sequential):
+            return [walk(l, p, enabled)
+                    for l, p in zip(layer.layers, sub)]
+        return jax.tree_util.tree_map(lambda _: enabled, sub)
+
+    mask = walk(module, params, True)
+    if all(jax.tree_util.tree_leaves(mask)):
+        return None
+    return mask

@@ -198,9 +198,9 @@ class HostAsyncTrainer(Trainer):
         if self.transport == "socket":
             port = self.parameter_server.start(host="127.0.0.1")
 
-        step_fn = jax.jit(make_train_step(model.module, self.loss,
-                                          self.worker_optimizer,
-                                          self._metric_fns()))
+        step_fn = jax.jit(make_train_step(
+            model.module, self.loss, self.worker_optimizer,
+            self._metric_fns(), param_mask=self._param_mask(model)))
 
         validator = self._make_validator(model.module)
         out: Dict[int, Any] = {}  # latest epoch's worker outputs

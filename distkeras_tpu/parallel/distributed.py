@@ -75,7 +75,8 @@ class DistributedTrainer(Trainer):
             self.allocate_algorithm(), mesh,
             EngineConfig(num_workers=self.num_workers,
                          window=self._window(S)),
-            metric_fns=self._metric_fns())
+            metric_fns=self._metric_fns(),
+            param_mask=self._param_mask(model))
 
         # resume restores the CENTER; workers restart from it — the same
         # semantic as the reference's Spark task retry, which re-trains a

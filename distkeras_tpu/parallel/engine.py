@@ -364,7 +364,9 @@ class DistributedEngine:
 
     def __init__(self, module, loss_fn: Callable, optimizer: Optimizer,
                  algo: DistAlgorithm, mesh: Mesh, config: EngineConfig,
-                 metric_fns: Optional[Dict[str, Callable]] = None):
+                 metric_fns: Optional[Dict[str, Callable]] = None,
+                 param_mask=None):
+        self.param_mask = param_mask  # Keras-style layer freezing
         self.module = module
         self.loss_fn = loss_fn
         self.optimizer = optimizer
@@ -446,7 +448,8 @@ class DistributedEngine:
         window block (``ceil(S/K)`` per epoch), never per micro-step."""
         axis = self.config.axis_name
         train_step = make_train_step(self.module, self.loss_fn,
-                                     self.optimizer, self.metric_fns)
+                                     self.optimizer, self.metric_fns,
+                                     param_mask=self.param_mask)
         algo = self.algo
         K = self._uniform_K
         offsets = self._offsets
@@ -555,7 +558,8 @@ class DistributedEngine:
         the amortized program."""
         axis = self.config.axis_name
         train_step = make_train_step(self.module, self.loss_fn,
-                                     self.optimizer, self.metric_fns)
+                                     self.optimizer, self.metric_fns,
+                                     param_mask=self.param_mask)
         algo = self.algo
         Ks, offsets = self._Ks, self._offsets
 

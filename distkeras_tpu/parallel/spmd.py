@@ -292,7 +292,8 @@ class SPMDTrainer(Trainer):
         carry = TrainCarry(params, state, opt_state, rng)
 
         step = make_train_step(model.module, self.loss, self.worker_optimizer,
-                               self._metric_fns(), self.grad_accum_steps)
+                               self._metric_fns(), self.grad_accum_steps,
+                               param_mask=self._param_mask(model))
 
         # pin the carry's layout across epochs: GSPMD is otherwise free to
         # re-shard unconstrained outputs (e.g. row-shard a replicated

@@ -124,6 +124,12 @@ class Trainer:
                 f"{type(self).__name__} does not support grad_accum_steps "
                 "(only SingleTrainer and SPMDTrainer do)")
 
+    def _param_mask(self, model):
+        """Boolean mask honoring Keras-style ``layer.trainable = False``
+        (``models.core.trainable_mask``); None when nothing is frozen."""
+        from distkeras_tpu.models.core import trainable_mask
+        return trainable_mask(model.module, model.params)
+
     def _checkpoint_manager(self):
         if self.checkpoint_dir is None:
             return None
@@ -373,7 +379,8 @@ class SingleTrainer(Trainer):
         if not sharded:
             X, y = self._training_arrays(dataset)
         step = make_train_step(model.module, self.loss, self.worker_optimizer,
-                               self._metric_fns(), self.grad_accum_steps)
+                               self._metric_fns(), self.grad_accum_steps,
+                               param_mask=self._param_mask(model))
         runner = make_epoch_runner(step)
 
         # SingleTrainer checkpoints the FULL carry (params + model state +
@@ -487,7 +494,8 @@ class EnsembleTrainer(Trainer):
         rngs = jax.random.split(jax.random.PRNGKey(self.seed), k)
 
         step = make_train_step(base.module, self.loss, self.worker_optimizer,
-                               self._metric_fns())
+                               self._metric_fns(),
+                               param_mask=self._param_mask(base))
 
         @jax.jit
         def run_epoch(carry, Xk, Yk):

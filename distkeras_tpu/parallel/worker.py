@@ -38,7 +38,8 @@ class TrainCarry(NamedTuple):
 
 def make_train_step(module, loss_fn: Callable, optimizer: Optimizer,
                     metric_fns: Optional[dict] = None,
-                    accum_steps: int = 1) -> Callable:
+                    accum_steps: int = 1,
+                    param_mask=None) -> Callable:
     """Build the per-minibatch step: grad -> optimizer update -> new carry.
 
     Equivalent role to one ``model.train_on_batch`` call in the reference
@@ -48,6 +49,11 @@ def make_train_step(module, loss_fn: Callable, optimizer: Optimizer,
     ``(carry, (loss, {name: value}))`` — the reference's per-batch Keras
     metrics, computed on-device from the training forward's outputs at
     negligible cost (XLA fuses them into the existing graph).
+
+    ``param_mask`` (a boolean pytree matching params, from
+    ``models.core.trainable_mask``) freezes params Keras-style: masked
+    GRADIENTS, so frozen leaves get zero updates AND zero optimizer
+    moments — bitwise-unchanged through any number of steps.
 
     ``accum_steps > 1`` splits the batch into that many microbatches and
     accumulates gradients over an inner ``lax.scan`` before ONE optimizer
@@ -71,6 +77,9 @@ def make_train_step(module, loss_fn: Callable, optimizer: Optimizer,
 
         (loss, (new_state, out)), grads = jax.value_and_grad(
             objective, has_aux=True)(params)
+        if param_mask is not None:
+            grads = jax.tree_util.tree_map(
+                lambda m, g: jnp.where(m, g, 0.0), param_mask, grads)
         mets = ({name: fn(yb, out) for name, fn in metric_fns.items()}
                 if metric_fns else {})
         return loss, grads, new_state, mets

@@ -138,3 +138,22 @@ def test_history_shapes_and_time():
     assert trainer.get_history().losses().shape == (2 * S, 8)
     assert trainer.get_averaged_history().shape == (2 * S,)
     assert trainer.get_training_time() > 0
+
+
+def test_frozen_layers_survive_distributed_training():
+    """layer.trainable=False holds through the SPMD engine: worker deltas
+    and the center stay bitwise at init for the frozen subtree."""
+    ds = make_data()
+    backbone = Dense(64, activation="relu")
+    backbone.trainable = False
+    model = Model.build(Sequential([backbone, Dense(C)]), (D,), seed=0)
+    frozen_before = jax.device_get(model.params[0])
+
+    trainer = DOWNPOUR(
+        model, num_workers=8, batch_size=32, communication_window=4,
+        num_epoch=2, worker_optimizer="sgd", learning_rate=0.05,
+        loss="sparse_categorical_crossentropy_from_logits")
+    trained = trainer.train(ds)
+    for k in frozen_before:
+        np.testing.assert_array_equal(np.asarray(trained.params[0][k]),
+                                      frozen_before[k])

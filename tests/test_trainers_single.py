@@ -163,3 +163,34 @@ def test_fit_validation_split():
     with pytest.raises(ValueError, match="in \\(0, 1\\)"):
         model.fit(X, y, validation_split=1.5,
                   loss="sparse_categorical_crossentropy_from_logits")
+
+
+def test_layer_trainable_false_freezes_params():
+    """Keras-style freezing: a frozen layer's params are bitwise unchanged
+    after training (and its adam moments stay zero), while the rest of
+    the model still learns."""
+    rs = np.random.RandomState(0)
+    X = rs.randn(1024, 8).astype(np.float32)
+    y = (X @ rs.randn(8, 3)).argmax(-1)
+
+    backbone = Dense(32, activation="relu")
+    head = Dense(3)
+    backbone.trainable = False
+    model = Model.build(Sequential([backbone, head]), (8,), seed=0)
+    frozen_before = jax.device_get(model.params[0])
+
+    trainer = SingleTrainer(
+        model, batch_size=32, num_epoch=4, worker_optimizer="adam",
+        optimizer_kwargs={"learning_rate": 1e-2},
+        loss="sparse_categorical_crossentropy_from_logits")
+    trained = trainer.train(Dataset({"features": X, "label": y}))
+
+    for k in frozen_before:
+        np.testing.assert_array_equal(np.asarray(trained.params[0][k]),
+                                      frozen_before[k])
+    # the head DID move and the model still learns through the frozen
+    # random backbone
+    assert not np.allclose(np.asarray(trained.params[1]["kernel"]),
+                           np.asarray(model.params[1]["kernel"]))
+    from distkeras_tpu.ops.metrics import accuracy
+    assert float(accuracy(y, trained.predict(X))) > 0.6
