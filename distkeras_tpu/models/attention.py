@@ -434,6 +434,10 @@ class TransformerBlock(Layer):
             m = drop(m, k_drop2)
         return x + m, new_state
 
+    def sub_layers(self):
+        return {"norm1": self.norm1, "attn": self.attn,
+                "norm2": self.norm2, "mlp": self.mlp}
+
     def get_config(self):
         cfg = {"num_heads": self.num_heads, "mlp_ratio": self.mlp_ratio,
                "head_dim": self.head_dim, "causal": self.causal,

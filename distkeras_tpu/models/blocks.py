@@ -65,6 +65,12 @@ class Residual(Layer):
         out = get_activation(self.activation)(out)
         return out, {"main": sm, "shortcut": ss}
 
+    def sub_layers(self):
+        subs = {"main": self.main}
+        if self.shortcut is not None:
+            subs["shortcut"] = self.shortcut
+        return subs
+
     def get_config(self):
         return {"main_spec": layer_spec(self.main),
                 "shortcut_spec": layer_spec(self.shortcut),
@@ -115,6 +121,9 @@ class WideAndDeep(Layer):
         yd, sd = self.deep.apply(params["deep"], state["deep"], xd,
                                  training=training, rng=rng)
         return yw + yd, {"wide": sw, "deep": sd}
+
+    def sub_layers(self):
+        return {"wide": self.wide, "deep": self.deep}
 
     def get_config(self):
         return {"wide_dim": self.wide_dim,

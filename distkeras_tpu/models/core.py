@@ -416,23 +416,35 @@ class Model:
                 f"out={self.output_shape}, params={self.num_params():,})")
 
 
-def trainable_mask(module: Layer, params):
-    """Boolean pytree matching ``params``: True where updates may flow.
+def trainable_mask(module: Layer, tree):
+    """Boolean pytree matching ``tree`` (params OR state — containers lay
+    both out identically): True where updates may flow.
 
     Returns ``None`` when every layer is trainable (the common case — the
     trainers then skip the masking entirely). Keras container semantics:
-    a layer with ``trainable = False`` freezes its WHOLE params subtree;
-    ``Sequential`` containers recurse so individual sublayers can be
-    frozen independently.
+    a layer with ``trainable = False`` freezes its WHOLE subtree;
+    ``Sequential`` recurses per sublayer, and composite containers that
+    implement ``sub_layers() -> {subtree_key: Layer}`` (Residual,
+    TransformerBlock, ...) recurse through it, so freezing e.g. only a
+    block's attention works. Custom containers without ``sub_layers`` are
+    atomic: only their own flag counts.
     """
     def walk(layer, sub, enabled):
         enabled = enabled and getattr(layer, "trainable", True)
         if isinstance(layer, Sequential):
             return [walk(l, p, enabled)
                     for l, p in zip(layer.layers, sub)]
+        subs = getattr(layer, "sub_layers", None)
+        if callable(subs) and isinstance(sub, dict):
+            named = subs()
+            return {key: (walk(named[key], child, enabled)
+                          if key in named
+                          else jax.tree_util.tree_map(
+                              lambda _: enabled, child))
+                    for key, child in sub.items()}
         return jax.tree_util.tree_map(lambda _: enabled, sub)
 
-    mask = walk(module, params, True)
+    mask = walk(module, tree, True)
     if all(jax.tree_util.tree_leaves(mask)):
         return None
     return mask

@@ -368,6 +368,9 @@ class SeparableConv2D(Layer):
         pp, _, shape = self.pointwise.init(k2, shape)
         return {"depthwise": pd, "pointwise": pp}, {}, shape
 
+    def sub_layers(self):
+        return {"depthwise": self.depthwise, "pointwise": self.pointwise}
+
     def apply(self, params, state, x, *, training=False, rng=None):
         y, _ = self.depthwise.apply(params["depthwise"], {}, x,
                                     training=training)

@@ -169,6 +169,9 @@ class Bidirectional(Layer):
         return {"forward": pf, "backward": pb}, \
             {"forward": sf, "backward": sb}, out
 
+    def sub_layers(self):
+        return {"forward": self.forward, "backward": self.backward}
+
     def apply(self, params, state, x, *, training=False, rng=None):
         yf, sf = self.forward.apply(params["forward"], state["forward"], x,
                                     training=training, rng=rng)

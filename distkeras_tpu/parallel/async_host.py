@@ -200,7 +200,8 @@ class HostAsyncTrainer(Trainer):
 
         step_fn = jax.jit(make_train_step(
             model.module, self.loss, self.worker_optimizer,
-            self._metric_fns(), param_mask=self._param_mask(model)))
+            self._metric_fns(), param_mask=self._param_mask(model),
+            state_mask=self._state_mask(model)))
 
         validator = self._make_validator(model.module)
         out: Dict[int, Any] = {}  # latest epoch's worker outputs

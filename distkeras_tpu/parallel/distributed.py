@@ -76,7 +76,8 @@ class DistributedTrainer(Trainer):
             EngineConfig(num_workers=self.num_workers,
                          window=self._window(S)),
             metric_fns=self._metric_fns(),
-            param_mask=self._param_mask(model))
+            param_mask=self._param_mask(model),
+            state_mask=self._state_mask(model))
 
         # resume restores the CENTER; workers restart from it — the same
         # semantic as the reference's Spark task retry, which re-trains a

@@ -365,8 +365,9 @@ class DistributedEngine:
     def __init__(self, module, loss_fn: Callable, optimizer: Optimizer,
                  algo: DistAlgorithm, mesh: Mesh, config: EngineConfig,
                  metric_fns: Optional[Dict[str, Callable]] = None,
-                 param_mask=None):
+                 param_mask=None, state_mask=None):
         self.param_mask = param_mask  # Keras-style layer freezing
+        self.state_mask = state_mask
         self.module = module
         self.loss_fn = loss_fn
         self.optimizer = optimizer
@@ -449,7 +450,8 @@ class DistributedEngine:
         axis = self.config.axis_name
         train_step = make_train_step(self.module, self.loss_fn,
                                      self.optimizer, self.metric_fns,
-                                     param_mask=self.param_mask)
+                                     param_mask=self.param_mask,
+                                     state_mask=self.state_mask)
         algo = self.algo
         K = self._uniform_K
         offsets = self._offsets
@@ -559,7 +561,8 @@ class DistributedEngine:
         axis = self.config.axis_name
         train_step = make_train_step(self.module, self.loss_fn,
                                      self.optimizer, self.metric_fns,
-                                     param_mask=self.param_mask)
+                                     param_mask=self.param_mask,
+                                     state_mask=self.state_mask)
         algo = self.algo
         Ks, offsets = self._Ks, self._offsets
 

@@ -293,7 +293,8 @@ class SPMDTrainer(Trainer):
 
         step = make_train_step(model.module, self.loss, self.worker_optimizer,
                                self._metric_fns(), self.grad_accum_steps,
-                               param_mask=self._param_mask(model))
+                               param_mask=self._param_mask(model),
+                               state_mask=self._state_mask(model))
 
         # pin the carry's layout across epochs: GSPMD is otherwise free to
         # re-shard unconstrained outputs (e.g. row-shard a replicated

@@ -130,6 +130,12 @@ class Trainer:
         from distkeras_tpu.models.core import trainable_mask
         return trainable_mask(model.module, model.params)
 
+    def _state_mask(self, model):
+        """Same, over the STATE tree (frozen BatchNorm keeps its running
+        stats — Keras inference-mode semantics)."""
+        from distkeras_tpu.models.core import trainable_mask
+        return trainable_mask(model.module, model.state)
+
     def _checkpoint_manager(self):
         if self.checkpoint_dir is None:
             return None
@@ -380,7 +386,8 @@ class SingleTrainer(Trainer):
             X, y = self._training_arrays(dataset)
         step = make_train_step(model.module, self.loss, self.worker_optimizer,
                                self._metric_fns(), self.grad_accum_steps,
-                               param_mask=self._param_mask(model))
+                               param_mask=self._param_mask(model),
+                               state_mask=self._state_mask(model))
         runner = make_epoch_runner(step)
 
         # SingleTrainer checkpoints the FULL carry (params + model state +
@@ -495,7 +502,8 @@ class EnsembleTrainer(Trainer):
 
         step = make_train_step(base.module, self.loss, self.worker_optimizer,
                                self._metric_fns(),
-                               param_mask=self._param_mask(base))
+                               param_mask=self._param_mask(base),
+                               state_mask=self._state_mask(base))
 
         @jax.jit
         def run_epoch(carry, Xk, Yk):

@@ -39,7 +39,7 @@ class TrainCarry(NamedTuple):
 def make_train_step(module, loss_fn: Callable, optimizer: Optimizer,
                     metric_fns: Optional[dict] = None,
                     accum_steps: int = 1,
-                    param_mask=None) -> Callable:
+                    param_mask=None, state_mask=None) -> Callable:
     """Build the per-minibatch step: grad -> optimizer update -> new carry.
 
     Equivalent role to one ``model.train_on_batch`` call in the reference
@@ -51,9 +51,15 @@ def make_train_step(module, loss_fn: Callable, optimizer: Optimizer,
     negligible cost (XLA fuses them into the existing graph).
 
     ``param_mask`` (a boolean pytree matching params, from
-    ``models.core.trainable_mask``) freezes params Keras-style: masked
-    GRADIENTS, so frozen leaves get zero updates AND zero optimizer
-    moments — bitwise-unchanged through any number of steps.
+    ``models.core.trainable_mask``) freezes params Keras-style: gradients
+    are masked (so optimizer moments stay zero) AND the optimizer's
+    updates are masked (so param-coupled terms like adamw/lars/lamb
+    weight decay cannot move frozen leaves either) — frozen params are
+    bitwise-unchanged through any number of steps. ``state_mask`` (same
+    builder over the STATE tree) additionally freezes layer state, the
+    Keras inference-mode semantics for frozen BatchNorm: its running
+    stats must not drift toward the new data while its frozen
+    scale/offset stay matched to the old ones.
 
     ``accum_steps > 1`` splits the batch into that many microbatches and
     accumulates gradients over an inner ``lax.scan`` before ONE optimizer
@@ -124,6 +130,15 @@ def make_train_step(module, loss_fn: Callable, optimizer: Optimizer,
 
         updates, new_opt_state = optimizer.update(grads, carry.opt_state,
                                                   carry.params)
+        if param_mask is not None:
+            updates = jax.tree_util.tree_map(
+                lambda m, u: jnp.where(m, u, 0.0), param_mask, updates)
+        if state_mask is not None:
+            # mask leaves are static Python bools: frozen state keeps the
+            # carried value with zero compute
+            new_state = jax.tree_util.tree_map(
+                lambda m, old, new: new if m else old,
+                state_mask, carry.state, new_state)
         new_params = apply_updates(carry.params, updates)
         new_carry = TrainCarry(new_params, new_state, new_opt_state, rng)
         if metric_fns:

@@ -194,3 +194,77 @@ def test_layer_trainable_false_freezes_params():
                            np.asarray(model.params[1]["kernel"]))
     from distkeras_tpu.ops.metrics import accuracy
     assert float(accuracy(y, trained.predict(X))) > 0.6
+
+
+def test_frozen_layer_immune_to_weight_decay_optimizers():
+    """adamw/lars/lamb apply param-coupled weight-decay terms even with
+    zero gradients — frozen params must still be bitwise unchanged (the
+    updates are masked too, not just the gradients)."""
+    rs = np.random.RandomState(0)
+    X = rs.randn(256, 8).astype(np.float32)
+    y = (X @ rs.randn(8, 3)).argmax(-1)
+    for opt in ("adamw", "lars", "lamb"):
+        backbone = Dense(16, activation="relu")
+        backbone.trainable = False
+        model = Model.build(Sequential([backbone, Dense(3)]), (8,), seed=0)
+        before = jax.device_get(model.params[0])
+        trainer = SingleTrainer(
+            model, batch_size=32, num_epoch=2, worker_optimizer=opt,
+            optimizer_kwargs={"learning_rate": 1e-2},
+            loss="sparse_categorical_crossentropy_from_logits")
+        trained = trainer.train(Dataset({"features": X, "label": y}))
+        for k in before:
+            np.testing.assert_array_equal(
+                np.asarray(trained.params[0][k]), before[k],
+                err_msg=f"{opt} moved frozen param {k!r}")
+
+
+def test_frozen_batchnorm_keeps_running_stats():
+    """Keras inference-mode semantics: a frozen BatchNorm's running
+    mean/var must not drift toward the new data distribution."""
+    from distkeras_tpu.models.layers import BatchNorm
+
+    rs = np.random.RandomState(0)
+    X = (rs.randn(512, 8) * 5 + 3).astype(np.float32)  # shifted data
+    y = (X @ rs.randn(8, 3)).argmax(-1)
+    bn = BatchNorm()
+    bn.trainable = False
+    model = Model.build(Sequential([Dense(16), bn, Dense(3)]), (8,), seed=0)
+    state_before = jax.device_get(model.state[1])
+    trainer = SingleTrainer(
+        model, batch_size=32, num_epoch=2, worker_optimizer="sgd",
+        learning_rate=0.05,
+        loss="sparse_categorical_crossentropy_from_logits")
+    trained = trainer.train(Dataset({"features": X, "label": y}))
+    for k in state_before:
+        np.testing.assert_array_equal(np.asarray(trained.state[1][k]),
+                                      state_before[k])
+
+
+def test_freeze_sublayer_inside_transformer_block():
+    """Containers with sub_layers() recurse: freezing only a block's
+    attention leaves its MLP trainable."""
+    from distkeras_tpu.models import zoo
+
+    rs = np.random.RandomState(0)
+    toks = rs.randint(0, 16, (128, 8))
+    module = zoo.transformer_lm(16, d_model=16, num_heads=2, num_layers=1,
+                                mlp_ratio=2)
+    blk = next(l for l in module.layers
+               if type(l).__name__ == "TransformerBlock")
+    blk.attn.trainable = False
+    model = Model.build(module, (8,), seed=0)
+    i = module.layers.index(blk)
+    attn_before = jax.device_get(model.params[i]["attn"])
+    mlp_before = jax.device_get(model.params[i]["mlp"])
+
+    trainer = SingleTrainer(
+        model, batch_size=16, num_epoch=2, worker_optimizer="adam",
+        optimizer_kwargs={"learning_rate": 1e-2},
+        loss="sparse_categorical_crossentropy_from_logits")
+    trained = trainer.train(Dataset({"features": toks, "label": toks}))
+    for k in attn_before:
+        np.testing.assert_array_equal(
+            np.asarray(trained.params[i]["attn"][k]), attn_before[k])
+    assert not np.allclose(np.asarray(trained.params[i]["mlp"]["w1"]),
+                           mlp_before["w1"])
