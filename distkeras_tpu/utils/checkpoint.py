@@ -21,8 +21,27 @@ from typing import Any, Dict, List, Optional
 import jax
 import numpy as np
 
-from distkeras_tpu.models.serialization import (
-    _flatten_with_paths, _unflatten_like)
+from distkeras_tpu.models.serialization import _flatten_with_paths
+
+
+def _unflatten_like(template, flat):
+    """Checkpoint restore stays in HOST numpy with the STORED dtype:
+    device placement (and any dtype policy) belongs to the trainer that
+    restores, and converting through jax here would silently truncate
+    f64 host arrays to f32 (x64 is disabled). Shapes are validated
+    against the template like the serialization helper."""
+    paths_leaves = jax.tree_util.tree_flatten_with_path(template)
+    leaves = []
+    for path, leaf in paths_leaves[0]:
+        key = "/".join(str(getattr(p, "key", getattr(p, "idx", p)))
+                       for p in path)
+        arr = flat[key]
+        if tuple(arr.shape) != tuple(np.shape(leaf)):
+            raise ValueError(
+                f"checkpoint leaf {key!r} shape {arr.shape} != expected "
+                f"{np.shape(leaf)}")
+        leaves.append(np.asarray(arr))
+    return jax.tree_util.tree_unflatten(paths_leaves[1], leaves)
 
 MANIFEST = "manifest.json"
 ARRAYS = "arrays.npz"
