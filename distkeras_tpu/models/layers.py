@@ -560,12 +560,19 @@ class BatchNorm(Layer):
             m = self.momentum
             new_state = {"mean": m * state["mean"] + (1 - m) * mean,
                          "var": m * state["var"] + (1 - m) * var}
-        else:
-            mean, var = state["mean"], state["var"]
-            new_state = state
+            # hand-derived 2-reduction backward (ops/normalization.py):
+            # autodiff through the expression below produced ~5 full-tensor
+            # f32 reduce chains per BN that made ResNet backward convs
+            # VPU-bound (60 of 98 ms/step in the round-2 profile)
+            from distkeras_tpu.ops.normalization import bn_train_apply
+            y = bn_train_apply(x, params["scale"], params["offset"],
+                               mean, var, self.epsilon, axes,
+                               self.axis_name)
+            return y, new_state
+        mean, var = state["mean"], state["var"]
         inv = lax.rsqrt(var + self.epsilon) * params["scale"]
         y = (xf - mean) * inv + params["offset"]
-        return y.astype(x.dtype), new_state
+        return y.astype(x.dtype), state
 
     def get_config(self):
         return {"momentum": self.momentum, "epsilon": self.epsilon,

@@ -93,6 +93,89 @@ def test_batchnorm_normalizes_and_updates_state():
                                   np.asarray(new_state[0]["mean"]))
 
 
+def test_batchnorm_custom_vjp_matches_autodiff():
+    """The 2-reduction hand-derived BN backward (ops/normalization.py)
+    must match plain autodiff through the naive expression exactly."""
+    from jax import lax
+    from distkeras_tpu.ops.normalization import bn_train_apply
+
+    def bn_autodiff(x, scale, offset, eps=1e-3):
+        xf = x.astype(jnp.float32)
+        axes = tuple(range(x.ndim - 1))
+        mean = jnp.mean(xf, axis=axes)
+        var = jnp.mean(jnp.square(xf), axis=axes) - jnp.square(mean)
+        inv = lax.rsqrt(var + eps) * scale
+        return ((xf - mean) * inv + offset).astype(x.dtype)
+
+    def bn_custom(x, scale, offset, eps=1e-3):
+        xf = x.astype(jnp.float32)
+        axes = tuple(range(x.ndim - 1))
+        mean = jnp.mean(xf, axis=axes)
+        var = jnp.mean(jnp.square(xf), axis=axes) - jnp.square(mean)
+        return bn_train_apply(x, scale, offset, mean, var, eps, axes, None)
+
+    rng = np.random.RandomState(0)
+    for shape, dt, tol in [((8, 5, 5, 16), jnp.float32, 1e-5),
+                           ((8, 5, 5, 16), jnp.bfloat16, 2e-2),
+                           ((32, 10), jnp.float32, 1e-5)]:
+        x = jnp.asarray(rng.randn(*shape), dt)
+        s = jnp.asarray(rng.rand(shape[-1]) + 0.5)
+        b = jnp.asarray(rng.randn(shape[-1]))
+        g = jnp.asarray(rng.randn(*shape), dt)
+        np.testing.assert_array_equal(
+            np.asarray(bn_custom(x, s, b), np.float32),
+            np.asarray(bn_autodiff(x, s, b), np.float32))
+        g1 = jax.grad(lambda *a: jnp.sum(
+            bn_custom(*a).astype(jnp.float32) * g.astype(jnp.float32)),
+            argnums=(0, 1, 2))(x, s, b)
+        g2 = jax.grad(lambda *a: jnp.sum(
+            bn_autodiff(*a).astype(jnp.float32) * g.astype(jnp.float32)),
+            argnums=(0, 1, 2))(x, s, b)
+        for a, c in zip(g1, g2):
+            np.testing.assert_allclose(np.asarray(a, np.float32),
+                                       np.asarray(c, np.float32),
+                                       atol=tol, rtol=tol)
+
+
+def test_batchnorm_cross_replica_grads_match_full_batch():
+    """BN with axis_name under shard_map: per-example grads must equal the
+    single-device full-batch grads (global batch statistics, including the
+    custom backward's psum path)."""
+    from functools import partial
+    from jax.sharding import Mesh, PartitionSpec as P
+    from jax import shard_map
+
+    layer = BatchNorm(momentum=0.9)
+    layer_sp = BatchNorm(momentum=0.9, axis_name="dp")
+    params, state, _ = layer.init(jax.random.PRNGKey(0), (6,))
+    x = jax.random.normal(jax.random.PRNGKey(1), (16, 6)) * 2 + 1
+    g = jax.random.normal(jax.random.PRNGKey(2), (16, 6))
+
+    def loss_full(params, x):
+        y, _ = layer.apply(params, state, x, training=True)
+        return jnp.sum(y * g)
+
+    mesh = Mesh(np.array(jax.devices()), ("dp",))
+
+    @partial(shard_map, mesh=mesh, in_specs=(P(), P("dp"), P("dp")),
+             out_specs=(P(), P("dp")))
+    def grads_sharded(params, x, g):
+        def loss(p, xb):
+            y, _ = layer_sp.apply(p, state, xb, training=True)
+            return jnp.sum(y * g)
+        gp, gx = jax.grad(loss, argnums=(0, 1))(params, x)
+        return jax.tree_util.tree_map(
+            lambda t: jax.lax.psum(t, "dp"), gp), gx
+
+    gp_full, gx_full = jax.grad(loss_full, argnums=(0, 1))(params, x)
+    gp_sh, gx_sh = jax.jit(grads_sharded)(params, x, g)
+    for a, b in zip(jax.tree_util.tree_leaves(gp_full),
+                    jax.tree_util.tree_leaves(gp_sh)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(gx_full), np.asarray(gx_sh),
+                               atol=1e-5)
+
+
 def test_embedding_lookup():
     m = build([Embedding(10, 4)], ())
     ids = jnp.array([[1, 2], [3, 4]])
