@@ -53,9 +53,36 @@ DEFAULT_BLOCK_Q = 512
 DEFAULT_BLOCK_K = 1024
 
 
+def _window_kblocks(block_q: int, block_k: int, nk: int,
+                    window, nq: int) -> int:
+    """Number of k-grid steps per q block under a sliding window: the
+    reachable key span per q block is ``block_q + window - 1`` positions,
+    so the k-axis grid shrinks from ``nk`` to O(window/block_k) — skipped
+    tiles then never pay their K/V DMA (they are not in the grid at all),
+    instead of being ``pl.when``-skipped compute with full-cost DMA.
+    Computed as the EXACT trace-time maximum over q blocks (one fewer
+    step than the closed form when window/block_q align to block_k)."""
+    if window is None:
+        return nk
+    best = 1
+    for qi in range(nq):
+        last = min(nk - 1, (qi * block_q + block_q - 1) // block_k)
+        first = max(0, (qi * block_q - window + 1) // block_k)
+        best = max(best, last - first + 1)
+    return min(nk, best)
+
+
+def _k_base(qi, block_q: int, block_k: int, nkw: int):
+    """First k block visited for q block ``qi`` (window remap): the last
+    ``nkw`` blocks ending at the causal diagonal block, clamped at 0.
+    Shared by the BlockSpec index maps and the kernels' position math."""
+    end = (qi * block_q + block_q - 1) // block_k
+    return jnp.maximum(0, end - (nkw - 1))
+
+
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
                 *, scale: float, causal: bool, k_len: int,
-                window=None):
+                window=None, nkw=None):
     """One (batch*head, q_block, k_block) program.
 
     Block shapes: q_ref [1, bq, D]; k_ref/v_ref [1, bk, D];
@@ -63,11 +90,14 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
     block's last-two dims Mosaic-tileable: (bq, 1) with bq % 8 == 0 and 1
     equal to the full array dim — a [1, bq] block fails TPU lowering).
     Scratch m/l [bq, 1], acc [bq, D] persist across the (sequential,
-    innermost) k grid axis.
+    innermost) k grid axis. Under a sliding window the k grid axis is
+    REMAPPED: grid step ``ki`` addresses actual k block
+    ``_k_base(qi) + ki`` (see ``_window_kblocks``).
     """
     qi, ki = pl.program_id(1), pl.program_id(2)
     nk = pl.num_programs(2)
     block_q, block_k = q_ref.shape[1], k_ref.shape[1]
+    kb = ki if nkw is None else _k_base(qi, block_q, block_k, nkw) + ki
 
     @pl.when(ki == 0)
     def _init():
@@ -78,11 +108,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
     # causal: tiles strictly above the diagonal contribute nothing;
     # sliding window: tiles entirely OLDER than any query's window start
     # contribute nothing either
-    run = (ki * block_k <= qi * block_q + block_q - 1) if causal \
-        else (ki >= 0)
+    run = (kb * block_k <= qi * block_q + block_q - 1) if causal \
+        else (kb >= 0)
     if window is not None:
         run = jnp.logical_and(
-            run, ki * block_k + block_k - 1 > qi * block_q - window)
+            run, kb * block_k + block_k - 1 > qi * block_q - window)
 
     @pl.when(run)
     def _compute():
@@ -93,7 +123,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
                             preferred_element_type=jnp.float32)
         q_pos = (qi * block_q +
                  lax.broadcasted_iota(jnp.int32, s.shape, 0))
-        k_pos = (ki * block_k +
+        k_pos = (kb * block_k +
                  lax.broadcasted_iota(jnp.int32, s.shape, 1))
         if causal:
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
@@ -165,9 +195,20 @@ def _flash_forward(q, k, v, scale: float, causal: bool, block_q: int,
         kf = kp.transpose(0, 2, 1, 3).reshape(b * h, sk_p, d)
         vf = vp.transpose(0, 2, 1, 3).reshape(b * h, sk_p, d)
 
-    grid = (b * h, sq_p // block_q, sk_p // block_k)
+    nk = sk_p // block_k
+    nkw = _window_kblocks(block_q, block_k, nk, window,
+                          sq_p // block_q)
+    remap = nkw < nk
+    grid = (b * h, sq_p // block_q, nkw)
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                               k_len=sk, window=window)
+                               k_len=sk, window=window,
+                               nkw=nkw if remap else None)
+
+    def k_map(bh, qi, ki):
+        if remap:
+            return (bh, _k_base(qi, block_q, block_k, nkw) + ki, 0)
+        return (bh, ki, 0)
+
     kwargs = {}
     if pltpu is not None and not interpret:
         kwargs["compiler_params"] = pltpu.CompilerParams(
@@ -177,8 +218,8 @@ def _flash_forward(q, k, v, scale: float, causal: bool, block_q: int,
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, block_k, d), k_map),
+            pl.BlockSpec((1, block_k, d), k_map),
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
@@ -206,22 +247,24 @@ def _flash_forward(q, k, v, scale: float, causal: bool, block_q: int,
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, dq_ref,
                    dq_acc, *, scale: float, causal: bool, k_len: int,
-                   window=None):
+                   window=None, nkw=None):
     """dq pass: one (batch*head, q_block, k_block) program, K innermost.
-    ``dq_acc`` [bq, D] f32 persists across the K sweep."""
+    ``dq_acc`` [bq, D] f32 persists across the K sweep. Window remap as
+    in ``_fwd_kernel``."""
     qi, ki = pl.program_id(1), pl.program_id(2)
     nk = pl.num_programs(2)
     block_q, block_k = q_ref.shape[1], k_ref.shape[1]
+    kb = ki if nkw is None else _k_base(qi, block_q, block_k, nkw) + ki
 
     @pl.when(ki == 0)
     def _init():
         dq_acc[:] = jnp.zeros_like(dq_acc)
 
-    run = (ki * block_k <= qi * block_q + block_q - 1) if causal \
-        else (ki >= 0)
+    run = (kb * block_k <= qi * block_q + block_q - 1) if causal \
+        else (kb >= 0)
     if window is not None:
         run = jnp.logical_and(
-            run, ki * block_k + block_k - 1
+            run, kb * block_k + block_k - 1
             > qi * block_q - window)
 
     @pl.when(run)
@@ -233,7 +276,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, dq_ref,
         s = lax.dot_general(qs, kblk, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32)
         q_pos = qi * block_q + lax.broadcasted_iota(jnp.int32, s.shape, 0)
-        k_pos = ki * block_k + lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        k_pos = kb * block_k + lax.broadcasted_iota(jnp.int32, s.shape, 1)
         if causal:
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
         if window is not None:
@@ -253,15 +296,40 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, dq_ref,
         dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
 
 
+def _window_qblocks(block_q: int, block_k: int, nq: int,
+                    window, nk: int) -> int:
+    """Mirror of ``_window_kblocks`` for the dk/dv pass: the reachable
+    query span per k block is ``block_k + window - 1`` positions. Exact
+    trace-time maximum over k blocks."""
+    if window is None:
+        return nq
+    best = 1
+    for ki in range(nk):
+        first = min(nq - 1, (ki * block_k) // block_q)
+        last = min(nq - 1,
+                   (ki * block_k + block_k - 1 + window - 1) // block_q)
+        best = max(best, last - first + 1)
+    return min(nq, best)
+
+
+def _q_base(ki, block_q: int, block_k: int, nq: int, nqw: int):
+    """First q block visited for k block ``ki`` (window remap). Clamped
+    from ABOVE to ``nq - nqw`` so every program stays in range without
+    any q block appearing twice in one sweep (a double-visit would
+    double-count its dk/dv contribution)."""
+    return jnp.minimum((ki * block_k) // block_q, nq - nqw)
+
+
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, dk_acc, dv_acc,
                     *, scale: float, causal: bool, k_len: int,
-                    window=None):
+                    window=None, nq=None, nqw=None):
     """dk/dv pass: one (batch*head, k_block, q_block) program, Q innermost.
-    ``dk_acc``/``dv_acc`` [bk, D] f32 persist across the Q sweep."""
+    ``dk_acc``/``dv_acc`` [bk, D] f32 persist across the Q sweep. Window
+    remap: grid step ``qi`` addresses actual q block ``_q_base(ki) + qi``."""
     ki, qi = pl.program_id(1), pl.program_id(2)
-    nq = pl.num_programs(2)
     block_k, block_q = k_ref.shape[1], q_ref.shape[1]
+    qb = qi if nqw is None else _q_base(ki, block_q, block_k, nq, nqw) + qi
 
     @pl.when(qi == 0)
     def _init():
@@ -271,11 +339,11 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
     # causal: q tiles entirely above the diagonal see none of this k
     # block; sliding window: q tiles entirely NEWER than every key's
     # window reach see none of it either
-    run = (qi * block_q + block_q - 1 >= ki * block_k) if causal \
-        else (qi >= 0)
+    run = (qb * block_q + block_q - 1 >= ki * block_k) if causal \
+        else (qb >= 0)
     if window is not None:
         run = jnp.logical_and(
-            run, qi * block_q
+            run, qb * block_q
             < ki * block_k + block_k - 1 + window)
 
     @pl.when(run)
@@ -286,7 +354,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
         g32 = g_ref[0].astype(jnp.float32)
         s = lax.dot_general(qs, kblk, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32)
-        q_pos = qi * block_q + lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        q_pos = qb * block_q + lax.broadcasted_iota(jnp.int32, s.shape, 0)
         k_pos = ki * block_k + lax.broadcasted_iota(jnp.int32, s.shape, 1)
         if causal:
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
@@ -305,7 +373,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
             ds, qs, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
-    @pl.when(qi == nq - 1)
+    @pl.when(qi == pl.num_programs(2) - 1)
     def _finalize():
         dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
         dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
@@ -353,18 +421,27 @@ def _flash_backward_pallas(res, g, scale: float, causal: bool,
     qf, kf, vf, gf = to_flat(qp), to_flat(kp), to_flat(vp), to_flat(gp)
 
     nq, nk = sq_p // block_q, sk_p // block_k
+    nkw = _window_kblocks(block_q, block_k, nk, window, nq)
+    nqw = _window_qblocks(block_q, block_k, nq, window, nk)
     kwargs = {}
     if pltpu is not None and not interpret:
         kwargs["compiler_params"] = pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"))
 
     q_spec = pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0))
-    k_spec = pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0))
+
+    def k_map(bh, qi, ki):
+        if nkw < nk:
+            return (bh, _k_base(qi, block_q, block_k, nkw) + ki, 0)
+        return (bh, ki, 0)
+
+    k_spec = pl.BlockSpec((1, block_k, d), k_map)
     row_q = pl.BlockSpec((1, block_q, 1), lambda bh, qi, ki: (bh, qi, 0))
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
-                          k_len=sk, window=window),
-        grid=(b * h, nq, nk),
+                          k_len=sk, window=window,
+                          nkw=nkw if nkw < nk else None),
+        grid=(b * h, nq, nkw),
         in_specs=[q_spec, k_spec, k_spec, q_spec, row_q, row_q],
         out_specs=[q_spec],
         out_shape=[jax.ShapeDtypeStruct((b * h, sq_p, d), q.dtype)],
@@ -372,14 +449,21 @@ def _flash_backward_pallas(res, g, scale: float, causal: bool,
         interpret=interpret, **kwargs,
     )(qf, kf, vf, gf, lsef, deltaf)[0]
 
-    # second pass: k blocks parallel, q innermost
-    q_spec2 = pl.BlockSpec((1, block_q, d), lambda bh, ki, qi: (bh, qi, 0))
+    # second pass: k blocks parallel, q innermost (window-remapped)
+    def q_map2(bh, ki, qi):
+        if nqw < nq:
+            return (bh, _q_base(ki, block_q, block_k, nq, nqw) + qi, 0)
+        return (bh, qi, 0)
+
+    q_spec2 = pl.BlockSpec((1, block_q, d), q_map2)
     k_spec2 = pl.BlockSpec((1, block_k, d), lambda bh, ki, qi: (bh, ki, 0))
-    row_q2 = pl.BlockSpec((1, block_q, 1), lambda bh, ki, qi: (bh, qi, 0))
+    row_q2 = pl.BlockSpec((1, block_q, 1), q_map2)
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
-                          k_len=sk, window=window),
-        grid=(b * h, nk, nq),
+                          k_len=sk, window=window,
+                          nq=nq if nqw < nq else None,
+                          nqw=nqw if nqw < nq else None),
+        grid=(b * h, nk, nqw),
         in_specs=[q_spec2, k_spec2, k_spec2, q_spec2, row_q2, row_q2],
         out_specs=[k_spec2, k_spec2],
         out_shape=[jax.ShapeDtypeStruct((b * h, sk_p, d), k.dtype),
@@ -486,8 +570,8 @@ _flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 def flash_attention(q, k, v, *, causal: bool = False,
                     scale: Optional[float] = None,
-                    block_q: int = DEFAULT_BLOCK_Q,
-                    block_k: int = DEFAULT_BLOCK_K,
+                    block_q: Optional[int] = None,
+                    block_k: Optional[int] = None,
                     interpret: Optional[bool] = None,
                     bwd: Optional[str] = None,
                     layout: str = "bshd",
@@ -506,9 +590,20 @@ def flash_attention(q, k, v, *, causal: bool = False,
     ``"xla"`` (blockwise-scan recomputation — the interpreter default,
     since interpreted kernels are slow on CPU; also the cross-check
     oracle for the kernel backward's numerics).
+
+    ``block_q``/``block_k`` default adaptively: 512/1024 for full
+    attention (measured optimum, see module header), 512/512 under a
+    sliding ``window`` — the remapped k-grid covers ``~window + block_q +
+    block_k`` keys per q block, so the smaller k block tightens coverage
+    (measured: W=1024 S=8192 fwd+bwd 1.80x full-causal at 512/512 vs
+    1.44x at 1024/1024 on v5e).
     """
     if layout not in ("bshd", "bhsd"):
         raise ValueError(f"layout must be 'bshd' or 'bhsd', got {layout!r}")
+    if block_q is None:
+        block_q = DEFAULT_BLOCK_Q
+    if block_k is None:
+        block_k = DEFAULT_BLOCK_K if window is None else DEFAULT_BLOCK_Q
     bhsd = layout == "bhsd"
     seq_axis = 2 if bhsd else 1
     if scale is None:

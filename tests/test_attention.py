@@ -644,6 +644,44 @@ def test_sliding_window_matches_banded_reference(window):
                         interpret=True)
 
 
+@pytest.mark.parametrize("window,block_q,block_k",
+                         [(8, 16, 8), (24, 8, 16), (3, 8, 8)])
+def test_sliding_window_grid_remap_exact(window, block_q, block_k):
+    """W << S exercises the shrunken, REMAPPED k/q grids (round 3): the
+    k-axis grid covers only each q block's window reach, so correctness
+    here proves the index-map clamping never drops or double-counts a
+    block (fwd, dq, and the mirrored dk/dv sweeps)."""
+    from distkeras_tpu.ops.attention import NEG_INF
+    from distkeras_tpu.ops.flash_attention import (_window_kblocks,
+                                                   _window_qblocks)
+
+    B, S, H, D = 1, 128, 2, 8
+    nk = S // block_k
+    assert _window_kblocks(block_q, block_k, nk, window,
+                           S // block_q) < nk  # remap on
+    q, k, v = _rand_qkv(jax.random.PRNGKey(17), b=B, s=S, h=H, d=D)
+    co = jax.random.normal(jax.random.PRNGKey(18), q.shape)
+
+    def banded(q, k, v):
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * (D ** -0.5)
+        qp, kp = jnp.arange(S)[:, None], jnp.arange(S)[None, :]
+        allowed = (qp >= kp) & (kp > qp - window)
+        w = jax.nn.softmax(jnp.where(allowed[None, None], s, NEG_INF), -1)
+        return jnp.einsum("bhqk,bkhd->bqhd", w, v)
+
+    out = flash_attention(q, k, v, causal=True, window=window,
+                          interpret=True, block_q=block_q,
+                          block_k=block_k)
+    np.testing.assert_allclose(out, banded(q, k, v), atol=1e-5)
+    gr = jax.grad(lambda a, b, c: jnp.sum(banded(a, b, c) * co),
+                  argnums=(0, 1, 2))(q, k, v)
+    gw = jax.grad(lambda a, b, c: jnp.sum(flash_attention(
+        a, b, c, causal=True, window=window, interpret=True, bwd="pallas",
+        block_q=block_q, block_k=block_k) * co), argnums=(0, 1, 2))(q, k, v)
+    for x, y in zip(gw, gr):
+        np.testing.assert_allclose(x, y, atol=2e-5)
+
+
 def test_sliding_window_model_trains_and_decodes():
     """attn_window on the LM family: training runs, decode_step masks the
     cache to the window and matches the full forward."""
