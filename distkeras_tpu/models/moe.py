@@ -6,15 +6,33 @@ for the Spark design) — this is a TPU-native addition. Design:
   * Expert weights are stacked on a leading ``[num_experts, ...]`` axis, so
     expert parallelism is a single ``PartitionSpec("expert", ...)`` shard of
     that axis (see ``parallel.sharding``).
-  * Routing is a **static-shape dense top-k**: the router's softmax is
-    masked to the top-k experts per token and every (local) expert runs on
-    every token. There is no gather/scatter and no capacity dropping —
-    data-dependent dispatch would force dynamic shapes XLA can't tile; the
-    masked-dense form keeps the MXU fed and is exact (same output as
-    dispatched top-k).
-  * Under expert parallelism each device computes only its ``E / A`` local
-    experts and the weighted outputs are ``psum``'d over the ``expert``
-    axis — compute per device drops by the axis size A.
+  * Two routing executions share one router:
+
+    - ``dispatch="dense"``: static-shape masked top-k — the router's
+      softmax is masked to the top-k experts per token and every (local)
+      expert runs on every token. No gather/scatter, no capacity drops,
+      exact — but every token pays ALL experts' FLOPs (E/top_k× the
+      dispatched cost). Kept as the numerics oracle and for tiny shapes
+      where dispatch bookkeeping dominates.
+    - ``dispatch="tokens"`` (round 3): capacity-based sort dispatch — the
+      GShard/Switch construction with static shapes. Token slots are
+      stably sorted by expert id, each expert takes its first
+      ``capacity`` arrivals (choice-major priority: every token's first
+      choice outranks all second choices), dropped slots contribute
+      nothing. Per-token expert FLOPs are ``top_k * capacity_factor``
+      MLPs instead of ``E`` — the compute-sparse economics the name
+      promises. Sort/gather/scatter are memory ops (O(N·d) traffic), so
+      the MXU work is exactly the expert matmuls at [E, C, d] — static
+      shapes throughout.
+
+  * Expert parallelism: under GSPMD (``SPMDTrainer``) the stacked expert
+    einsums partition on the expert axis automatically from the weight
+    shardings. Under ``shard_map`` (``expert_axis_name``) tokens are
+    replicated across the axis, so each shard slices its experts' rows of
+    the dispatch tensor — strictly cheaper than an all_to_all — computes
+    its ``E/A`` experts, and the combined outputs are ``psum``'d. For
+    token-sharded meshes (ep doubling as a data axis) see
+    ``moe_all_to_all`` below: the full GShard all_to_all exchange.
 """
 
 from __future__ import annotations
@@ -30,6 +48,29 @@ from distkeras_tpu.models.core import (AUX_LOSS_KEY, Layer,
 from distkeras_tpu.models.layers import get_activation, init_weights
 
 
+def _dispatch_plan(experts, gates, num_experts: int, capacity: int):
+    """Static-shape dispatch bookkeeping.
+
+    experts/gates: [N, K] top-k expert ids / combine weights per token.
+    Returns (dest, token, weight, keep) flat [N*K] slot arrays in
+    expert-sorted order: ``dest`` indexes an [E*C (+1 overflow)] buffer.
+    Priority is choice-major (slot s = k*N + n): all first choices beat
+    all second choices, ties broken by token order — the GShard rule.
+    """
+    n, k = experts.shape
+    slot_e = experts.T.reshape(-1)                      # [K*N] choice-major
+    slot_t = jnp.tile(jnp.arange(n, dtype=jnp.int32), k)
+    slot_g = gates.T.reshape(-1)
+    order = jnp.argsort(slot_e, stable=True)
+    se, st, sg = slot_e[order], slot_t[order], slot_g[order]
+    counts = jnp.zeros((num_experts,), jnp.int32).at[slot_e].add(1)
+    starts = jnp.cumsum(counts) - counts                # exclusive cumsum
+    pos = jnp.arange(n * k, dtype=jnp.int32) - starts[se]
+    keep = pos < capacity
+    dest = jnp.where(keep, se * capacity + pos, num_experts * capacity)
+    return dest, st, sg, keep
+
+
 @register_layer
 class MoE(Layer):
     """Top-k gated mixture of expert MLPs over [B, S, d_model]."""
@@ -38,7 +79,9 @@ class MoE(Layer):
                  activation: str = "gelu", dtype: str = "float32",
                  expert_axis_name: Optional[str] = None,
                  kernel_init: str = "glorot_uniform",
-                 aux_loss_weight: float = 0.0):
+                 aux_loss_weight: float = 0.0,
+                 dispatch: str = "dense",
+                 capacity_factor: float = 1.25):
         self.num_experts = int(num_experts)
         self.hidden_dim = int(hidden_dim)
         self.top_k = int(top_k)
@@ -52,6 +95,14 @@ class MoE(Layer):
         # pushing the router away from expert collapse. Published via the
         # AUX_LOSS_KEY state channel (parallel.worker picks it up).
         self.aux_loss_weight = float(aux_loss_weight)
+        if dispatch not in ("dense", "tokens"):
+            raise ValueError(
+                f"dispatch must be 'dense' or 'tokens', got {dispatch!r}")
+        self.dispatch = dispatch
+        # expert capacity = ceil(top_k * tokens / E) * capacity_factor:
+        # at 1.0 a perfectly balanced router drops nothing; the default
+        # headroom absorbs imbalance while training the balance loss down
+        self.capacity_factor = float(capacity_factor)
 
     def init(self, rng, input_shape):
         d = input_shape[-1]
@@ -72,23 +123,35 @@ class MoE(Layer):
             state[AUX_LOSS_KEY] = jnp.zeros((), jnp.float32)
         return params, state, tuple(input_shape)
 
-    def _gate_probs(self, x, gate):
-        """Routing weights [B, S, E] (softmax over top-k logits, 0
-        elsewhere) plus the full softmax and slot mask for the balance
-        loss."""
+    def _route(self, x, gate):
+        """Shared router: ``(full, topi, gates, mask)`` — full softmax
+        [B, S, E], top-k expert ids + their renormalized weights [B, S, K]
+        (softmax over the k logits == the masked-softmax restriction, so
+        the dense and dispatched paths combine with IDENTICAL weights),
+        and the top-k slot mask for the balance loss (None at k == E).
+        Top-k INDICES, not a >= kth-value test: on tied logits the value
+        test would admit every tied expert."""
         logits = jnp.einsum("bsd,de->bse", x.astype(jnp.float32),
                             gate.astype(jnp.float32))
         full = jax.nn.softmax(logits, axis=-1)
+        topv, topi = lax.top_k(logits, self.top_k)
+        gates = jax.nn.softmax(topv, axis=-1)
         mask = None
         if self.top_k < self.num_experts:
-            # mask from top_k INDICES, not a >= kth-value test: on tied
-            # logits the value test would admit every tied expert, breaking
-            # the exact-top-k contract
-            idxs = lax.top_k(logits, self.top_k)[1]
-            mask = jax.nn.one_hot(idxs, self.num_experts,
+            mask = jax.nn.one_hot(topi, self.num_experts,
                                   dtype=jnp.bool_).any(axis=-2)
-            logits = jnp.where(mask, logits, -jnp.inf)
-        return jax.nn.softmax(logits, axis=-1), full, mask
+        return full, topi, gates, mask
+
+    def _gate_probs(self, x, gate):
+        """Routing weights [B, S, E] (softmax over top-k logits, 0
+        elsewhere) plus the full softmax and slot mask for the balance
+        loss (the dense path's view of ``_route``)."""
+        full, topi, gates, mask = self._route(x, gate)
+        probs = jnp.einsum(
+            "bske,bsk->bse",
+            jax.nn.one_hot(topi, self.num_experts, dtype=gates.dtype),
+            gates)
+        return probs, full, mask
 
     def _balance_loss(self, full, mask):
         """E · Σ_e f_e·P_e (Switch eq. 4, GShard): minimized at uniform
@@ -102,14 +165,77 @@ class MoE(Layer):
         pmean = jnp.mean(full, axis=(0, 1))
         return e * jnp.sum(frac * pmean)
 
-    def apply(self, params, state, x, *, training=False, rng=None):
+    def _capacity(self, n_tokens: int) -> int:
+        per = -(-self.top_k * n_tokens // self.num_experts)  # ceil
+        return max(1, int(per * self.capacity_factor))
+
+    def _expert_mlp(self, xe, params):
+        """Run the stacked expert MLP on [E(_local), C, d]. Under
+        shard_map expert parallelism the weights arrive pre-sliced to the
+        shard's experts; under GSPMD the einsums partition on ``e`` from
+        the weight shardings automatically."""
         dt = jnp.dtype(self.dtype)
         act = get_activation(self.activation)
+        h = act(jnp.einsum("ecd,edf->ecf", xe, params["w1"].astype(dt))
+                + params["b1"].astype(dt)[:, None, :])
+        return jnp.einsum("ecf,efd->ecd", h, params["w2"].astype(dt)) \
+            + params["b2"].astype(dt)[:, None, :]
+
+    def _apply_dispatched(self, params, x):
+        """Capacity-based sort dispatch (static shapes; see module doc)."""
+        dt = jnp.dtype(self.dtype)
+        b, s, d = x.shape
+        n = b * s
+        e, k = self.num_experts, self.top_k
+        c = self._capacity(n)
+        full, topi, gates, mask = self._route(x, params["gate"])
+
+        dest, st, sg, keep = _dispatch_plan(
+            topi.reshape(n, k), gates.reshape(n, k), e, c)
+        xt = x.reshape(n, d).astype(dt)
+        # row E*C is the overflow bin for dropped slots (sliced off before
+        # compute; reads as zeros in the combine)
+        xe = jnp.zeros((e * c + 1, d), dt).at[dest].set(xt[st])[:e * c]
+
+        if self.expert_axis_name is None:
+            ye = self._expert_mlp(xe.reshape(e, c, d), params)
+            ye_flat = jnp.pad(ye.reshape(e * c, d).astype(jnp.float32),
+                              ((0, 1), (0, 0)))
+        else:
+            # tokens are replicated across the axis: each shard runs only
+            # its pre-sliced experts on its rows of the dispatch buffer,
+            # then the flat outputs are psum-combined (disjoint supports)
+            el = params["w1"].shape[0]
+            idx = lax.axis_index(self.expert_axis_name)
+            xe_l = lax.dynamic_slice_in_dim(
+                xe.reshape(e, c, d), idx * el, el, 0)
+            ye_l = self._expert_mlp(xe_l, params)
+            ye_flat = jnp.zeros((e * c + 1, d), jnp.float32) \
+                .at[jnp.arange(el * c, dtype=jnp.int32) + idx * el * c] \
+                .set(ye_l.reshape(el * c, d).astype(jnp.float32))
+            ye_flat = lax.psum(ye_flat, self.expert_axis_name)
+        contrib = ye_flat[dest] * (sg * keep)[:, None]
+        out = jnp.zeros((n, d), jnp.float32).at[st].add(contrib)
+        return out.reshape(b, s, d), full, mask
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        dt = jnp.dtype(self.dtype)
+
+        if self.dispatch == "tokens":
+            out, full, mask = self._apply_dispatched(params, x)
+            new_state = state
+            if self.aux_loss_weight and training:
+                new_state = dict(state)
+                new_state[AUX_LOSS_KEY] = (self.aux_loss_weight *
+                                           self._balance_loss(full, mask))
+            return out.astype(x.dtype), new_state
+
         probs, full, mask = self._gate_probs(x, params["gate"])  # f32
 
         xc = x.astype(dt)
         # local experts: [El, ...] slice when sharded over the expert axis
         h = jnp.einsum("bsd,edf->besf", xc, params["w1"].astype(dt))
+        act = get_activation(self.activation)
         h = act(h + params["b1"].astype(dt)[None, :, None, :])
         y = jnp.einsum("besf,efd->besd", h, params["w2"].astype(dt))
         y = y + params["b2"].astype(dt)[None, :, None, :]
@@ -140,4 +266,58 @@ class MoE(Layer):
                 "dtype": self.dtype,
                 "expert_axis_name": self.expert_axis_name,
                 "kernel_init": self.kernel_init,
-                "aux_loss_weight": self.aux_loss_weight}
+                "aux_loss_weight": self.aux_loss_weight,
+                "dispatch": self.dispatch,
+                "capacity_factor": self.capacity_factor}
+
+
+def moe_all_to_all(moe: MoE, params, x, *, axis_name: str):
+    """Token-SHARDED expert parallelism: the full GShard all_to_all
+    exchange, for meshes where the expert axis doubles as a data axis
+    (each shard holds DIFFERENT tokens and ``E/A`` experts).
+
+    Must be called inside a ``shard_map`` where ``x`` is batch-sharded and
+    the expert-stacked weights are sharded over ``axis_name``. Flow per
+    shard: route the local tokens; build the local [E, Cs, d] dispatch
+    buffer (Cs = local capacity); ``all_to_all`` so each shard receives
+    every source's rows for ITS experts ([El, A*Cs, d]); run the local
+    experts; ``all_to_all`` back; combine locally. Compute AND tokens both
+    scale 1/A per device — contrast ``MoE.apply``'s replicated-token
+    path, where only compute does.
+
+    Returns ``(out, aux)`` with ``aux = (full_probs, topk_mask)`` for the
+    balance loss (which must then be ``lax.pmean``'d over ``axis_name`` —
+    shards see different tokens).
+    """
+    if moe.dispatch != "tokens":
+        raise ValueError("moe_all_to_all requires dispatch='tokens'")
+    dt = jnp.dtype(moe.dtype)
+    b, s, d = x.shape
+    n = b * s                                       # LOCAL tokens
+    e, k = moe.num_experts, moe.top_k
+    a = lax.psum(1, axis_name)
+    el = params["w1"].shape[0]
+    if el * a != e:
+        raise ValueError(
+            f"num_experts {e} != local experts {el} x axis size {a}")
+    cs = moe._capacity(n)                           # per-source capacity
+
+    full, topi, gates, mask = moe._route(x, params["gate"])
+
+    dest, st, sg, keep = _dispatch_plan(
+        topi.reshape(n, k), gates.reshape(n, k), e, cs)
+    xt = x.reshape(n, d).astype(dt)
+    xe = jnp.zeros((e * cs + 1, d), dt).at[dest].set(xt[st])[:e * cs]
+    # [E, Cs, d] -> exchange: send expert-block a' to shard a', receive
+    # one block per source concatenated on the capacity axis
+    xe = xe.reshape(e, cs, d)
+    recv = lax.all_to_all(xe, axis_name, split_axis=0, concat_axis=1,
+                          tiled=True)               # [El, A*Cs, d]
+    ye_l = moe._expert_mlp(recv, params)            # local experts
+    back = lax.all_to_all(ye_l, axis_name, split_axis=1, concat_axis=0,
+                          tiled=True)               # [E, Cs, d]
+    ye_flat = jnp.pad(back.reshape(e * cs, d).astype(jnp.float32),
+                      ((0, 1), (0, 0)))
+    contrib = ye_flat[dest] * (sg * keep)[:, None]
+    out = jnp.zeros((n, d), jnp.float32).at[st].add(contrib)
+    return out.reshape(b, s, d).astype(x.dtype), (full, mask)

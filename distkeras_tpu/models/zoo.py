@@ -161,7 +161,9 @@ def transformer_lm(vocab_size: int, d_model: int = 512, num_heads: int = 8,
                    attn_window: Optional[int] = None,
                    moe_every: int = 0, num_experts: int = 0,
                    moe_expert_axis: Optional[str] = None,
-                   moe_aux_loss_weight: float = 0.0) -> Sequential:
+                   moe_aux_loss_weight: float = 0.0,
+                   moe_dispatch: str = "dense",
+                   moe_capacity_factor: float = 1.25) -> Sequential:
     """Decoder-only causal transformer LM — the long-context flagship.
 
     Absent from the reference (no attention models; SURVEY §5.7); this is
@@ -169,7 +171,10 @@ def transformer_lm(vocab_size: int, d_model: int = 512, num_heads: int = 8,
     [B, S] int in, logits [B, S, vocab] out.
 
     ``moe_every=k`` (with ``num_experts``) swaps every k-th block's MLP for
-    a mixture-of-experts layer (expert-parallel over ``moe_expert_axis``).
+    a mixture-of-experts layer (expert-parallel over ``moe_expert_axis``);
+    ``moe_dispatch="tokens"`` uses the capacity-based sort dispatch
+    (per-token expert FLOPs ~ top_k x ``moe_capacity_factor`` MLPs instead
+    of all ``num_experts`` — see ``models/moe.py``).
     ``num_kv_heads < num_heads`` builds a grouped-query (GQA) model — the
     KV cache at serving time shrinks by the group factor.
     """
@@ -190,7 +195,9 @@ def transformer_lm(vocab_size: int, d_model: int = 512, num_heads: int = 8,
             from distkeras_tpu.models.moe import MoE
             mlp_layer = MoE(num_experts, mlp_ratio * d_model,
                             dtype=dtype, expert_axis_name=moe_expert_axis,
-                            aux_loss_weight=moe_aux_loss_weight)
+                            aux_loss_weight=moe_aux_loss_weight,
+                            dispatch=moe_dispatch,
+                            capacity_factor=moe_capacity_factor)
         layers.append(TransformerBlock(
             num_heads, mlp_ratio=mlp_ratio, causal=True, use_rope=use_rope,
             norm=norm, dtype=dtype, attn_impl=attn_impl,
