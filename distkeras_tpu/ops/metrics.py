@@ -37,7 +37,12 @@ def _class_vectors(y_true, y_pred):
         k = 2
         is_prob = jnp.all((y_pred >= 0.0) & (y_pred <= 1.0))
         y_pred = y_pred >= jnp.where(is_prob, 0.5, 0.0)
-    if y_true.ndim > 1 and y_true.shape[-1] > 1:
+    # one-hot label encodings are FLOATING-point (what to_categorical and
+    # softmax targets produce); integer multi-dim labels are always class
+    # ids — in particular [B, S] per-token LM targets, which must not be
+    # argmaxed even when S coincidentally equals the class count
+    if y_true.ndim > 1 and y_true.shape[-1] > 1 and \
+            jnp.issubdtype(y_true.dtype, jnp.floating):
         k = max(k or 0, y_true.shape[-1])
         y_true = jnp.argmax(y_true, axis=-1)
     return (y_true.reshape(-1).astype(jnp.int32),

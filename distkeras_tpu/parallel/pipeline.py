@@ -129,7 +129,7 @@ class PipelinedLM:
     """
 
     def __init__(self, embed: Layer, block: Layer, head: Layer,
-                 num_layers: int, num_microbatches: int = 2,
+                 num_layers: int, num_microbatches: int = 4,
                  remat: bool = False):
         self.embed = embed
         self.block = block
@@ -138,6 +138,17 @@ class PipelinedLM:
         self.num_microbatches = int(num_microbatches)
         self.remat = bool(remat)
         self._estate = self._bstate = self._hstate = {}  # set by init()
+
+    def bubble_fraction(self, pp: int) -> float:
+        """Idle fraction of the GPipe schedule: (P-1)/(M+P-1) of the ticks
+        are fill/drain on each of the forward and backward sweeps (autodiff
+        replays the tick scan in reverse, so the fractions match). The
+        lever is ``num_microbatches``; a 1F1B reordering would NOT shrink
+        this bubble (it equals GPipe's at equal M) — 1F1B's real advantage
+        is O(P) activation memory, which ``remat=True`` already provides
+        at O(1) per stage. See docs/parallelism.md."""
+        m = self.num_microbatches
+        return (pp - 1) / (m + pp - 1)
 
     # -- init ---------------------------------------------------------------
     def init(self, rng: jax.Array, input_shape: Tuple[int, ...]):
@@ -172,12 +183,17 @@ class PipelinedLM:
     def make_train_step(self, loss_fn: Callable, optimizer: Optimizer,
                         mesh: Mesh, data_axes: Sequence[str] = ("workers",),
                         pp_axis: str = "pp",
-                        seq_axis: Optional[str] = None) -> Callable:
-        """Build ``step((params, opt_state), (x, y)) -> ((params, opt), loss)``.
+                        seq_axis: Optional[str] = None,
+                        metric_fns: Optional[dict] = None) -> Callable:
+        """Build ``step((params, opt_state), (x, y)) -> ((params, opt),
+        loss)`` — or ``((params, opt), (loss, metrics_dict))`` when
+        ``metric_fns`` is non-empty.
 
         ``data_axes``: mesh axes the batch dim is sharded over (dp).
         ``seq_axis``: mesh axis the sequence dim is sharded over (sp, ring
         attention inside the blocks); None for no sequence parallelism.
+        ``metric_fns``: {name: fn(y, logits)} evaluated on the training
+        batch (same psum accounting as the loss).
         """
         M = self.num_microbatches
         if self.num_layers % mesh.shape[pp_axis]:
@@ -191,6 +207,7 @@ class PipelinedLM:
         d_axes = tuple(data_axes)
         loss_div_axes = d_axes + ((seq_axis,) if seq_axis else ())
         div = int(np.prod([mesh.shape[a] for a in loss_div_axes])) or 1
+        metric_fns = metric_fns or {}
 
         def local_grads(params, x, y):
             def obj(params):
@@ -210,9 +227,10 @@ class PipelinedLM:
                 is_last = (lax.axis_index(pp_axis)
                            == lax.axis_size(pp_axis) - 1)
                 # scaled so that psum over data+pp axes == global mean loss
-                return loss_fn(y, logits) * is_last / div
+                return loss_fn(y, logits) * is_last / div, (logits, is_last)
 
-            loss, grads = jax.value_and_grad(obj)(params)
+            (loss, (logits, is_last)), grads = \
+                jax.value_and_grad(obj, has_aux=True)(params)
             all_axes = loss_div_axes + (pp_axis,)
             grads = {
                 # replicated components: nonzero on one rank; sum everywhere
@@ -222,7 +240,9 @@ class PipelinedLM:
                 # its own stage; reduce over data axes only
                 "blocks": lax.psum(grads["blocks"], loss_div_axes),
             }
-            return grads, lax.psum(loss, all_axes)
+            mets = {name: lax.psum(fn(y, logits) * is_last / div, all_axes)
+                    for name, fn in metric_fns.items()}
+            return grads, lax.psum(loss, all_axes), mets
 
         # x/y: [B, S] -> batch over dp axes, sequence over sp
         seq_entry = (seq_axis,) if seq_axis else (None,)
@@ -231,19 +251,18 @@ class PipelinedLM:
         grads_fn = jax.shard_map(
             local_grads, mesh=mesh,
             in_specs=(pspecs, data_spec, data_spec),
-            out_specs=(pspecs, P()),
+            out_specs=(pspecs, P(), {n: P() for n in metric_fns}),
             check_vma=False)
 
-        @partial(jax.jit, donate_argnums=(0,))
         def step(carry, batch):
             params, opt_state = carry
             x, y = batch
-            grads, loss = grads_fn(params, x, y)
+            grads, loss, mets = grads_fn(params, x, y)
             updates, opt_state = optimizer.update(grads, opt_state, params)
             params = apply_updates(params, updates)
-            return (params, opt_state), loss
+            return (params, opt_state), (loss, mets) if metric_fns else loss
 
-        return step
+        return jax.jit(step, donate_argnums=(0,))
 
     def shard_variables(self, params: Pytree, mesh: Mesh,
                         pp_axis: str = "pp") -> Pytree:
@@ -267,6 +286,16 @@ class PipelineTrainer:
     family (reference: ``distkeras/trainers.py`` constructor-kwargs style)
     for the language-model shape: ``features_col`` holds token ids
     ``[N, S]``, ``label_col`` the per-token targets ``[N, S]``.
+
+    Family-parity services (round 3; previously a feature island): the
+    epoch is ONE jitted ``lax.scan`` over stacked batches (no per-step
+    Python dispatch), training ``metrics``, held-out ``validation_data``
+    scalars per epoch, Keras-style ``callbacks`` (EarlyStopping &co.), and
+    full-carry checkpoint/resume (params + optimizer state), all matching
+    ``Trainer``'s semantics. ``snapshot_model`` is the one deliberate
+    exception: a pipelined trunk is not a ``Model`` (stacked-layer params
+    over a mesh), so ``ModelCheckpoint`` does not apply — use
+    ``checkpoint_dir``.
     """
 
     def __init__(self, lm: PipelinedLM, mesh: Mesh,
@@ -278,7 +307,13 @@ class PipelineTrainer:
                  features_col: str = "features", label_col: str = "label",
                  seed: int = 0, shuffle_each_epoch: bool = True,
                  clip_grad_norm: Optional[float] = None,
-                 class_weight: Optional[dict] = None):
+                 class_weight: Optional[dict] = None,
+                 metrics: Optional[Sequence] = None,
+                 validation_data=None,
+                 callbacks: Optional[Sequence] = None,
+                 checkpoint_dir: Optional[str] = None,
+                 checkpoint_every: int = 1, resume: bool = False,
+                 checkpoint_async: bool = False):
         from distkeras_tpu.ops.losses import get_loss, with_class_weight
         from distkeras_tpu.ops.optimizers import (clip_by_global_norm,
                                                   get_optimizer)
@@ -294,20 +329,85 @@ class PipelineTrainer:
         if clip_grad_norm is not None:
             self.optimizer = clip_by_global_norm(self.optimizer,
                                                  clip_grad_norm)
+        self.eval_loss = get_loss(loss)
         self.loss = (with_class_weight(loss, class_weight)
-                     if class_weight is not None else get_loss(loss))
+                     if class_weight is not None else self.eval_loss)
         self.batch_size = int(batch_size)
         self.num_epoch = int(num_epoch)
         self.features_col = features_col
         self.label_col = label_col
         self.seed = int(seed)
         self.shuffle_each_epoch = bool(shuffle_each_epoch)
+        self.metrics = list(metrics or [])
+        self.validation_data = validation_data
+        self.callbacks = list(callbacks or [])
+        self.checkpoint_dir = checkpoint_dir
+        self.checkpoint_every = int(checkpoint_every)
+        if self.checkpoint_every < 1:
+            raise ValueError(
+                f"checkpoint_every must be >= 1, got {checkpoint_every}")
+        self.resume = bool(resume)
+        self.checkpoint_async = bool(checkpoint_async)
+        self.stop_training = False
         self.history = History()
         self.params_ = None
         self._fwd = None  # cached jitted forward for predict()
+        self._weights_fn = None
+        self._pending_weights = None
 
     def get_history(self):
         return self.history
+
+    # -- callback API (Trainer-compatible surface) -------------------------
+    def get_weights(self):
+        """Host-side ``(params, state)`` of the in-progress weights
+        (callback API; the pipeline has no layer state, so state is {})."""
+        if self._weights_fn is None:
+            raise RuntimeError(
+                "get_weights() is only available to callbacks while "
+                "train() is running")
+        return self._weights_fn()
+
+    def set_weights(self, params, state=None) -> None:
+        self._pending_weights = (params, state or {})
+
+    def snapshot_model(self):
+        raise RuntimeError(
+            "PipelineTrainer has no single-device Model to snapshot "
+            "(pp-sharded stacked trunk); use checkpoint_dir for "
+            "durable snapshots")
+
+    def _metric_fns(self):
+        if not self.metrics:
+            return None
+        from distkeras_tpu.ops.metrics import get_metric, metric_name
+        return {metric_name(m): get_metric(m) for m in self.metrics}
+
+    def _make_validator(self):
+        """Jitted full-set eval on the unsharded reference forward:
+        ``validator(params) -> {"val_loss": ..., "val_<metric>": ...}``."""
+        if self.validation_data is None:
+            return None
+        vd = self.validation_data
+        if isinstance(vd, tuple):
+            Xv, yv = vd
+        else:
+            Xv = np.asarray(vd[self.features_col])
+            yv = np.asarray(vd[self.label_col])
+        Xv, yv = jnp.asarray(Xv), jnp.asarray(yv)
+        loss_fn = self.eval_loss
+        metric_fns = self._metric_fns() or {}
+        lm = self.lm
+
+        @jax.jit
+        def evalf(params, Xv, yv):
+            logits = lm.apply(params, Xv)
+            res = {"val_loss": loss_fn(yv, logits)}
+            for name, fn in metric_fns.items():
+                res[f"val_{name}"] = fn(yv, logits)
+            return res
+
+        return lambda params: evalf(params, Xv, yv)
 
     def _validate(self, X, Y):
         """Fail fast with microbatch/sharding-aware messages instead of a
@@ -334,6 +434,7 @@ class PipelineTrainer:
 
     def train(self, dataset) -> Pytree:
         from distkeras_tpu.data.sharded import ShardedDataset
+        from distkeras_tpu.utils.callbacks import CallbackList
         if isinstance(dataset, ShardedDataset):
             raise ValueError(
                 "PipelineTrainer does not support ShardedDataset "
@@ -346,37 +447,138 @@ class PipelineTrainer:
         self._validate(X, Y)
 
         params, _ = lm.init(jax.random.PRNGKey(self.seed), X.shape[1:])
+        manager = None
+        start_epoch = 0
+        if self.checkpoint_dir is not None:
+            from distkeras_tpu.utils.checkpoint import CheckpointManager
+            manager = CheckpointManager(self.checkpoint_dir,
+                                        async_writes=self.checkpoint_async)
+        opt_state = self.optimizer.init(params)
+        resumed = False
+        if manager is not None and self.resume:
+            latest = manager.latest_step()
+            if latest is not None:
+                tree = manager.restore({"params": params, "opt": opt_state},
+                                       step=latest)
+                params, opt_state = tree["params"], tree["opt"]
+                start_epoch = int(
+                    manager.metadata(step=latest).get("epoch", -1)) + 1
+                resumed = True
+        # opt state sharded LIKE the params (trunk moments on pp, not
+        # replicated — replicating Adam m+v would defeat the memory point
+        # of pipeline parallelism). Same mirror rule as SPMDTrainer: moment
+        # subtrees shaped like the params tree take the params' shardings;
+        # anything else (step counters) replicates.
+        repl = NamedSharding(self.mesh, P())
+        param_sh = {
+            "embed": jax.tree_util.tree_map(lambda _: repl,
+                                            params["embed"]),
+            "blocks": jax.tree_util.tree_map(
+                lambda _: NamedSharding(self.mesh, P(self.pp_axis)),
+                params["blocks"]),
+            "head": jax.tree_util.tree_map(lambda _: repl, params["head"]),
+        }
+        pstruct = jax.tree_util.tree_structure(params)
+        opt_shapes = jax.eval_shape(self.optimizer.init, params)
+        rmap = lambda tree: jax.tree_util.tree_map(lambda _: repl, tree)
+        mirror = lambda sub: param_sh if jax.tree_util.tree_structure(
+            sub) == pstruct else rmap(sub)
+        opt_sh = ({k: mirror(v) for k, v in opt_shapes.items()}
+                  if isinstance(opt_shapes, dict) else rmap(opt_shapes))
         params = lm.shard_variables(params, self.mesh, self.pp_axis)
-        opt_state = jax.jit(self.optimizer.init)(params)
+        if resumed:
+            opt_state = jax.tree_util.tree_map(
+                lambda host, sh: jax.device_put(host, sh),
+                opt_state, opt_sh)
+        else:
+            opt_state = jax.jit(self.optimizer.init,
+                                out_shardings=opt_sh)(params)
         step = lm.make_train_step(self.loss, self.optimizer, self.mesh,
                                   data_axes=self.data_axes,
                                   pp_axis=self.pp_axis,
-                                  seq_axis=self.seq_axis)
+                                  seq_axis=self.seq_axis,
+                                  metric_fns=self._metric_fns())
+
+        have_mets = bool(self._metric_fns())
+
+        # whole epoch = ONE jitted scan over [steps, ...] stacked batches
+        # (family parity with make_epoch_runner; no per-step Python)
+        @partial(jax.jit, donate_argnums=(0,))
+        def run_epoch(carry, Xs, Ys):
+            def body(c, xy):
+                c, out = step(c, xy)
+                return c, out if have_mets else (out, {})
+            return lax.scan(body, carry, (Xs, Ys))
 
         seq_entry = (self.seq_axis,) if self.seq_axis else (None,)
-        data_sh = NamedSharding(self.mesh, P(self.data_axes, *seq_entry))
+        data_sh = NamedSharding(self.mesh,
+                                P(None, self.data_axes, *seq_entry))
 
         from distkeras_tpu.parallel.worker import stack_batches
 
+        validator = self._make_validator()
         carry = (params, opt_state)
+        carry_box = [carry]
+        self.stop_training = False
+        self._pending_weights = None
+        self._weights_fn = lambda: (jax.device_get(carry_box[0][0]), {})
+        cbs = CallbackList(self.callbacks, self)
+        cbs.train_begin()
         self.history.record_training_start()
-        for epoch in range(self.num_epoch):
-            # same shuffle-seed convention as Trainer._epoch_perm
-            perm = (np.random.RandomState(self.seed + 1000 * epoch)
-                    .permutation(len(X)) if self.shuffle_each_epoch
-                    else None)
-            Xs, Ys, nsteps = stack_batches(X, Y, self.batch_size, perm)
-            losses = []
-            for i in range(nsteps):
-                xb = jax.device_put(jnp.asarray(Xs[i]), data_sh)
-                yb = jax.device_put(jnp.asarray(Ys[i]), data_sh)
-                carry, loss = step(carry, (xb, yb))
-                losses.append(loss)
-            self.history.append_epoch(
-                loss=np.asarray(jax.device_get(losses)))
-        self.history.record_training_stop()
+        try:
+            for epoch in range(start_epoch, self.num_epoch):
+                # same shuffle-seed convention as Trainer._epoch_perm
+                perm = (np.random.RandomState(self.seed + 1000 * epoch)
+                        .permutation(len(X)) if self.shuffle_each_epoch
+                        else None)
+                Xs, Ys, nsteps = stack_batches(X, Y, self.batch_size, perm)
+                xb = jax.device_put(jnp.asarray(Xs), data_sh)
+                yb = jax.device_put(jnp.asarray(Ys), data_sh)
+                carry, (losses, mets) = run_epoch(carry, xb, yb)
+                carry_box[0] = carry
+                losses = jax.device_get(losses)
+                mets = jax.device_get(mets)
+                extra = {}
+                if validator is not None:
+                    extra = {k: np.asarray([float(v)]) for k, v in
+                             jax.device_get(validator(carry[0])).items()}
+                self.history.append_epoch(loss=np.asarray(losses),
+                                          **{k: np.asarray(v)
+                                             for k, v in mets.items()},
+                                          **extra)
+                saved = False
+                if manager is not None and (
+                        (epoch + 1) % self.checkpoint_every == 0
+                        or epoch == self.num_epoch - 1):
+                    manager.save(
+                        epoch,
+                        {"params": carry[0], "opt": carry[1]},
+                        metadata={"epoch": epoch})
+                    saved = True
+                logs = {"loss": float(np.mean(losses))}
+                logs.update({k: float(np.mean(np.asarray(v)))
+                             for k, v in mets.items()})
+                logs.update({k: float(np.asarray(v).ravel()[0])
+                             for k, v in extra.items()})
+                cbs.epoch_end(epoch, logs)
+                if self.stop_training:
+                    # early stop between checkpoint_every boundaries: save
+                    # the final state, or resume would lose these epochs
+                    if manager is not None and not saved:
+                        manager.save(
+                            epoch,
+                            {"params": carry[0], "opt": carry[1]},
+                            metadata={"epoch": epoch})
+                    break
+        finally:
+            self.history.record_training_stop()
+            cbs.train_end()
+        if manager is not None:
+            manager.wait()
 
         self.params_ = jax.device_get(carry[0])
+        if self._pending_weights is not None:
+            self.params_ = self._pending_weights[0]
         return self.params_
 
     def predict(self, x) -> np.ndarray:
