@@ -161,10 +161,36 @@ def _sample(logits, temperature, top_k, rng):
     return jax.random.categorical(rng, logits, axis=-1)
 
 
+def _attn_compute_dtype(module: Sequential):
+    """The attention compute dtype of the first TransformerBlock (the
+    LM-family convention: one dtype across the stack), or None."""
+    for layer in module.layers:
+        if isinstance(layer, TransformerBlock):
+            return jnp.dtype(layer.attn.dtype)
+    return None
+
+
+def _serving_params(params, dtype):
+    """Pre-cast the big (ndim >= 2) weight matrices to the serving dtype
+    ONCE, outside the decode scan. For a bf16-compute model this is
+    numerically FREE for every matmul weight (apply casts them per-step
+    anyway — pre-casting just stops the per-step f32 HBM read, which is
+    half the decode byte budget); only the embedding-table gather and the
+    un-cast f32 head read change, both below bf16 round-off of the
+    surrounding compute. Vectors (biases, norm scales) stay f32: they are
+    applied in f32 and cost nothing."""
+    return jax.tree_util.tree_map(
+        lambda p: p.astype(dtype)
+        if (hasattr(p, "ndim") and p.ndim >= 2
+            and jnp.issubdtype(p.dtype, jnp.floating)) else p,
+        params)
+
+
 def generate(model: Model, prompts, max_new_tokens: int,
              temperature: float = 0.0, top_k: Optional[int] = None,
-             seed: int = 0, cache_dtype=jnp.float32,
-             stop_token: Optional[int] = None) -> np.ndarray:
+             seed: int = 0, cache_dtype=None,
+             stop_token: Optional[int] = None,
+             weights_dtype="auto", as_numpy: bool = True) -> np.ndarray:
     """Autoregressive continuation: ``[B, P]`` int prompts ->
     ``[B, P + max_new_tokens]`` tokens. ``temperature=0`` is greedy;
     otherwise softmax sampling (optionally top-k-truncated).
@@ -172,7 +198,17 @@ def generate(model: Model, prompts, max_new_tokens: int,
     ``stop_token``: once a sequence emits it, every later position is
     filled with it too (the compiled scan always runs ``max_new_tokens``
     steps — static shapes — so "stopping" is per-sequence padding, which
-    is also what makes the batch ragged-safe)."""
+    is also what makes the batch ragged-safe).
+
+    Decode is weight+cache HBM-read bound (docs/PERF.md roofline), so
+    storage dtypes are the throughput levers:
+
+    ``cache_dtype=None`` matches the model's attention COMPUTE dtype —
+    for a bf16 model the k/v entries were computed in bf16, so an f32
+    cache stores no extra information while doubling the dominant read.
+    ``weights_dtype="auto"`` pre-casts matrix weights to the same compute
+    dtype once before the scan (see ``_serving_params``); ``None``
+    disables, a dtype forces."""
     module = model.module
     if not isinstance(module, Sequential):
         raise TypeError("generate() expects a Sequential LM "
@@ -191,6 +227,28 @@ def generate(model: Model, prompts, max_new_tokens: int,
                 f"PositionalEmbedding(max_len={layer.max_len}) is too "
                 f"small for prompt {p_len} + {max_new_tokens} new tokens "
                 f"= {total} positions")
+    compute_dt = _attn_compute_dtype(module)
+    if cache_dtype is None:
+        cache_dtype = compute_dt if compute_dt is not None else jnp.float32
+    if weights_dtype == "auto":
+        weights_dtype = compute_dt if (
+            compute_dt is not None
+            and compute_dt != jnp.dtype(jnp.float32)) else None
+    if weights_dtype is None:
+        run_params = model.params
+    else:
+        # cast once per (params identity, dtype): a pipelined serving loop
+        # must not re-pay the full-tree cast every call. The cache holds a
+        # strong reference to the SOURCE tree so an `is` check is a sound
+        # invalidation (no id()-reuse hazard after gc).
+        cached = getattr(model, "_serving_params_cache", None)
+        dt_key = jnp.dtype(weights_dtype).name
+        if (cached is None or cached[0] is not model.params
+                or cached[1] != dt_key):
+            cached = (model.params, dt_key,
+                      _serving_params(model.params, weights_dtype))
+            model._serving_params_cache = cached
+        run_params = cached[2]
     cache = init_cache(module, b, total, cache_dtype)
 
     tokens0 = jnp.concatenate(
@@ -201,7 +259,8 @@ def generate(model: Model, prompts, max_new_tokens: int,
     # on the Model so a serving loop pays trace+compile once, like
     # Model.predict's cached forward
     key = (b, p_len, int(max_new_tokens), float(temperature), top_k,
-           jnp.dtype(cache_dtype).name, stop_token)
+           jnp.dtype(cache_dtype).name, stop_token,
+           None if weights_dtype is None else jnp.dtype(weights_dtype).name)
     jit_cache = getattr(model, "_jit_generate", None)
     if jit_cache is None:
         jit_cache = model._jit_generate = {}
@@ -237,6 +296,10 @@ def generate(model: Model, prompts, max_new_tokens: int,
 
         jit_cache[key] = run
 
-    out = run(model.params, model.state, tokens0, cache,
+    out = run(run_params, model.state, tokens0, cache,
               jax.random.PRNGKey(seed))
-    return np.asarray(out)
+    # as_numpy=False skips the device->host sync: serving loops that
+    # pipeline several generate calls only pay one round trip at the end
+    # (on tunneled backends the per-call sync is ~100 ms — bench.py
+    # measures both modes)
+    return np.asarray(out) if as_numpy else out

@@ -57,7 +57,10 @@ def accuracy(y_true, y_pred):
 
 
 def top_k_accuracy(y_true, y_pred, k: int = 5):
-    if y_true.ndim > 1 and y_true.shape[-1] > 1:
+    # same one-hot rule as _class_vectors: floating labels only — integer
+    # [B, S] per-token targets are class ids, never argmaxed
+    if y_true.ndim > 1 and y_true.shape[-1] > 1 and \
+            jnp.issubdtype(jnp.asarray(y_true).dtype, jnp.floating):
         y_true = jnp.argmax(y_true, axis=-1)
     topk = jnp.argsort(y_pred, axis=-1)[..., -k:]
     hit = jnp.any(topk == y_true[..., None].astype(jnp.int32), axis=-1)

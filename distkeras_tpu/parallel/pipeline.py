@@ -126,6 +126,11 @@ class PipelinedLM:
     ``embed``/``head`` are replicated (their grads psum over the pp axis —
     contributions are zero except on the inject/drain stages); the trunk is
     ``num_layers`` copies of ``block`` sharded over ``pp``.
+
+    ``num_microbatches`` default changed 2 → 4 in round 3 (GPipe bubble
+    at P=2: 33% → 20%; see ``bubble_fraction``). Per-worker batches must
+    divide by it — callers relying on the old default with per-worker
+    batch 2 should pass ``num_microbatches=2`` explicitly.
     """
 
     def __init__(self, embed: Layer, block: Layer, head: Layer,
@@ -384,8 +389,13 @@ class PipelineTrainer:
         return {metric_name(m): get_metric(m) for m in self.metrics}
 
     def _make_validator(self):
-        """Jitted full-set eval on the unsharded reference forward:
-        ``validator(params) -> {"val_loss": ..., "val_<metric>": ...}``."""
+        """Jitted full-set eval: ``validator(params) -> {"val_loss": ...,
+        "val_<metric>": ...}``. Runs under ``shard_map`` over the
+        training mesh — batch over the data axes, sequence over
+        ``seq_axis`` — because sequence-parallel blocks (ring/ulysses)
+        contain collectives that need their axis bound; the pp-sharded
+        trunk is viewed replicated for the reference forward (an
+        all-gather per validation pass, not per step)."""
         if self.validation_data is None:
             return None
         vd = self.validation_data
@@ -395,19 +405,34 @@ class PipelineTrainer:
             Xv = np.asarray(vd[self.features_col])
             yv = np.asarray(vd[self.label_col])
         Xv, yv = jnp.asarray(Xv), jnp.asarray(yv)
+        dp = int(np.prod([self.mesh.shape[a] for a in self.data_axes])) or 1
+        if len(Xv) % dp:
+            raise ValueError(
+                f"validation set size {len(Xv)} must divide over data "
+                f"axes {self.data_axes} (size {dp})")
         loss_fn = self.eval_loss
         metric_fns = self._metric_fns() or {}
         lm = self.lm
+        mean_axes = self.data_axes + ((self.seq_axis,)
+                                      if self.seq_axis else ())
 
-        @jax.jit
         def evalf(params, Xv, yv):
             logits = lm.apply(params, Xv)
-            res = {"val_loss": loss_fn(yv, logits)}
+            res = {"val_loss": lax.pmean(loss_fn(yv, logits), mean_axes)}
             for name, fn in metric_fns.items():
-                res[f"val_{name}"] = fn(yv, logits)
+                res[f"val_{name}"] = lax.pmean(fn(yv, logits), mean_axes)
             return res
 
-        return lambda params: evalf(params, Xv, yv)
+        seq_entry = (self.seq_axis,) if self.seq_axis else (None,)
+        data_spec = P(self.data_axes, *seq_entry)
+        pspecs = {"embed": P(), "blocks": P(), "head": P()}
+        sharded = jax.jit(jax.shard_map(
+            evalf, mesh=self.mesh,
+            in_specs=(pspecs, data_spec, data_spec),
+            out_specs={"val_loss": P(),
+                       **{f"val_{n}": P() for n in metric_fns}},
+            check_vma=False))
+        return lambda params: sharded(params, Xv, yv)
 
     def _validate(self, X, Y):
         """Fail fast with microbatch/sharding-aware messages instead of a
@@ -453,13 +478,20 @@ class PipelineTrainer:
             from distkeras_tpu.utils.checkpoint import CheckpointManager
             manager = CheckpointManager(self.checkpoint_dir,
                                         async_writes=self.checkpoint_async)
-        opt_state = self.optimizer.init(params)
+        opt_state = None
         resumed = False
         if manager is not None and self.resume:
             latest = manager.latest_step()
             if latest is not None:
-                tree = manager.restore({"params": params, "opt": opt_state},
-                                       step=latest)
+                # restore template from eval_shape (host zeros) — a real
+                # optimizer.init here would materialize full unsharded
+                # moments on one device, the very allocation pipeline
+                # parallelism exists to avoid
+                opt_template = jax.tree_util.tree_map(
+                    lambda s: np.zeros(s.shape, s.dtype),
+                    jax.eval_shape(self.optimizer.init, params))
+                tree = manager.restore(
+                    {"params": params, "opt": opt_template}, step=latest)
                 params, opt_state = tree["params"], tree["opt"]
                 start_epoch = int(
                     manager.metadata(step=latest).get("epoch", -1)) + 1

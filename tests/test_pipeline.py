@@ -125,7 +125,10 @@ def test_pipeline_with_ring_attention_sp():
            seq_axis="sp"),
         mesh, seq_axis="sp",
         worker_optimizer="adam", optimizer_kwargs={"learning_rate": 0.01},
-        batch_size=64, num_epoch=6)
+        batch_size=64, num_epoch=6,
+        # sequence-parallel validation: the validator must bind the sp
+        # axis (round-3 regression: it used to run unsharded and crash)
+        validation_data=(X[:32], X[:32]))
     trainer.train(ds)
     losses = trainer.get_history().losses()
     assert np.isfinite(losses).all()
