@@ -301,8 +301,12 @@ def main():
     if args.model == "all":
         # driver mode: the full measured story in one run — each family
         # prints its own JSON line; a family failure must not silence the
-        # others' records
+        # others' records. Per-family --profile subdirectories (one shared
+        # path would silently clobber the headline trace).
+        base_profile = args.profile
         for mode in ("resnet50", "lm", "generate"):
+            if base_profile:
+                args.profile = f"{base_profile.rstrip('/')}/{mode}"
             try:
                 _run_mode(mode, args, on_accel, peak, device_kind)
             except Exception:

@@ -217,6 +217,10 @@ class MultiHeadAttention(Layer):
         self.kernel_init = kernel_init
         self.ring_block_size = ring_block_size  # inner k-blocking (memory)
 
+    #: packed-sequence capability marker (Sequential forwards segment_ids
+    #: only to layers declaring this — containers forward recursively)
+    accepts_segment_ids = True
+
     @property
     def kv_heads(self) -> int:
         return self.num_kv_heads or self.num_heads
@@ -243,12 +247,18 @@ class MultiHeadAttention(Layer):
         reps = self.num_heads // self.kv_heads
         return t if reps == 1 else jnp.repeat(t, reps, axis=head_axis)
 
-    def apply(self, params, state, x, *, training=False, rng=None):
+    def apply(self, params, state, x, *, training=False, rng=None,
+              segment_ids=None):
         dt = jnp.dtype(self.dtype)
         xc = x.astype(dt)
         impl = self.attn_impl
         if impl == "auto":
             impl = "flash" if jax.default_backend() == "tpu" else "xla"
+        if segment_ids is not None and impl not in ("flash", "xla"):
+            raise ValueError(
+                f"segment_ids (packed sequences) are supported by the "
+                f"'flash' and 'xla' attention paths, not attn_impl="
+                f"{impl!r}")
         positions = None
         if (self.use_rope
                 and impl in ("ring", "ulysses", "ulysses_flash")
@@ -273,7 +283,8 @@ class MultiHeadAttention(Layer):
             k, v = self._expand_kv(k, 1), self._expand_kv(v, 1)
             from distkeras_tpu.ops.flash_attention import flash_attention
             out = flash_attention(q, k, v, causal=self.causal,
-                                  layout="bhsd", window=self.attn_window)
+                                  layout="bhsd", window=self.attn_window,
+                                  segment_ids=segment_ids)
             y = jnp.einsum("bhse,hed->bsd", out, params["wo"].astype(dt))
             return y.astype(x.dtype), state
 
@@ -284,11 +295,16 @@ class MultiHeadAttention(Layer):
             q = apply_rope(q, positions, scale=self.rope_scale)
             k = apply_rope(k, positions, scale=self.rope_scale)
         k, v = self._expand_kv(k, 2), self._expand_kv(v, 2)
-        out = _attention_compute(q, k, v, causal=self.causal,
-                                 impl=impl,
-                                 axis_name=self.seq_axis_name,
-                                 ring_block_size=self.ring_block_size,
-                                 window=self.attn_window)
+        if segment_ids is not None:
+            out = dot_product_attention(q, k, v, causal=self.causal,
+                                        window=self.attn_window,
+                                        segment_ids=segment_ids)
+        else:
+            out = _attention_compute(q, k, v, causal=self.causal,
+                                     impl=impl,
+                                     axis_name=self.seq_axis_name,
+                                     ring_block_size=self.ring_block_size,
+                                     window=self.attn_window)
         y = jnp.einsum("bshe,hed->bsd", out, params["wo"].astype(dt))
         return y.astype(x.dtype), state
 
@@ -348,6 +364,8 @@ class TransformerBlock(Layer):
     parallelism); both expose the same Layer protocol.
     """
 
+    accepts_segment_ids = True
+
     def __init__(self, num_heads: int, mlp_ratio: int = 4,
                  head_dim: Optional[int] = None, causal: bool = True,
                  use_rope: bool = True, activation: str = "gelu",
@@ -406,12 +424,14 @@ class TransformerBlock(Layer):
             p[name], s[name], _ = layer.init(k, tuple(input_shape))
         return p, s, tuple(input_shape)
 
-    def apply(self, params, state, x, *, training=False, rng=None):
+    def apply(self, params, state, x, *, training=False, rng=None,
+              segment_ids=None):
         new_state = dict(state)
         h, new_state["norm1"] = self.norm1.apply(
             params["norm1"], state["norm1"], x, training=training)
         a, new_state["attn"] = self.attn.apply(
-            params["attn"], state["attn"], h, training=training)
+            params["attn"], state["attn"], h, training=training,
+            segment_ids=segment_ids)
 
         def drop(y, key):  # both residual branches share the Dropout layer
             return self._dropout.apply({}, {}, y, training=training,

@@ -34,6 +34,11 @@ class Residual(Layer):
                          else layer_from_spec(shortcut_spec))
         self.activation = activation
 
+    @property
+    def accepts_segment_ids(self) -> bool:
+        return any(getattr(l, "accepts_segment_ids", False)
+                   for l in (self.main, self.shortcut) if l is not None)
+
     def init(self, rng, input_shape):
         k1, k2 = jax.random.split(rng)
         pm, sm, out_main = self.main.init(k1, input_shape)
@@ -48,17 +53,24 @@ class Residual(Layer):
         return ({"main": pm, "shortcut": ps},
                 {"main": sm, "shortcut": ss}, tuple(out_main))
 
-    def apply(self, params, state, x, *, training=False, rng=None):
+    def apply(self, params, state, x, *, training=False, rng=None,
+              segment_ids=None):
         if rng is not None:
             rng, r1, r2 = jax.random.split(rng, 3)
         else:
             r1 = r2 = None
-        y, sm = self.main.apply(params["main"], state["main"], x,
-                                training=training, rng=r1)
+
+        def branch(layer, p, s, key):
+            if segment_ids is not None and \
+                    getattr(layer, "accepts_segment_ids", False):
+                return layer.apply(p, s, x, training=training, rng=key,
+                                   segment_ids=segment_ids)
+            return layer.apply(p, s, x, training=training, rng=key)
+
+        y, sm = branch(self.main, params["main"], state["main"], r1)
         if self.shortcut is not None:
-            sc, ss = self.shortcut.apply(params["shortcut"],
-                                         state["shortcut"], x,
-                                         training=training, rng=r2)
+            sc, ss = branch(self.shortcut, params["shortcut"],
+                            state["shortcut"], r2)
         else:
             sc, ss = x, state["shortcut"]
         out = y + sc
@@ -149,10 +161,22 @@ class Remat(Layer):
         if self.inner is None:
             raise ValueError("Remat needs an inner layer")
 
+    @property
+    def accepts_segment_ids(self) -> bool:
+        return getattr(self.inner, "accepts_segment_ids", False)
+
     def init(self, rng, input_shape):
         return self.inner.init(rng, input_shape)
 
-    def apply(self, params, state, x, *, training=False, rng=None):
+    def apply(self, params, state, x, *, training=False, rng=None,
+              segment_ids=None):
+        if segment_ids is not None and self.accepts_segment_ids:
+            def f(p, s, xb, r, seg):
+                return self.inner.apply(p, s, xb, training=training,
+                                        rng=r, segment_ids=seg)
+
+            return jax.checkpoint(f)(params, state, x, rng, segment_ids)
+
         def f(p, s, xb, r):
             return self.inner.apply(p, s, xb, training=training, rng=r)
 

@@ -147,15 +147,41 @@ class Sequential(Layer):
             state.append(s)
         return params, state, shape
 
-    def apply(self, params, state, x, *, training=False, rng=None):
+    @property
+    def accepts_segment_ids(self) -> bool:
+        return any(getattr(l, "accepts_segment_ids", False)
+                   for l in self.layers)
+
+    def apply(self, params, state, x, *, training=False, rng=None,
+              segment_ids=None):
+        """``segment_ids`` ([B, S] int, packed/variable-length sequences)
+        is forwarded to layers that declare ``accepts_segment_ids``
+        (TransformerBlock -> attention masking; containers like Remat /
+        Residual / nested Sequential forward recursively); other layers
+        are position-wise and need no mask — the LOSS masks padded
+        positions (``losses.masked_sparse_categorical_crossentropy_
+        from_logits``). Passing segment_ids into a stack where NO layer
+        accepts them is an error, not a silent unmasked run.
+        """
+        if segment_ids is not None and not self.accepts_segment_ids:
+            raise ValueError(
+                "segment_ids passed, but no layer in this Sequential "
+                "accepts them (packed-sequence masking needs a "
+                "TransformerBlock-family layer)")
         new_state = []
         for i, layer in enumerate(self.layers):
             if rng is not None:
                 rng, sub = jax.random.split(rng)
             else:
                 sub = None
-            x, s = layer.apply(params[i], state[i], x, training=training,
-                               rng=sub)
+            if segment_ids is not None and \
+                    getattr(layer, "accepts_segment_ids", False):
+                x, s = layer.apply(params[i], state[i], x,
+                                   training=training, rng=sub,
+                                   segment_ids=segment_ids)
+            else:
+                x, s = layer.apply(params[i], state[i], x,
+                                   training=training, rng=sub)
             new_state.append(s)
         return x, new_state
 

@@ -39,7 +39,9 @@ def causal_mask(q_len: int, k_len: int, q_offset: int = 0,
 def dot_product_attention(q, k, v, *, causal: bool = False,
                           mask: Optional[jnp.ndarray] = None,
                           scale: Optional[float] = None,
-                          window: Optional[int] = None) -> jnp.ndarray:
+                          window: Optional[int] = None,
+                          segment_ids: Optional[jnp.ndarray] = None
+                          ) -> jnp.ndarray:
     """Reference (pure-XLA) attention. BSHD in, BSHD out.
 
     XLA fuses this well for moderate sequence lengths; the Pallas flash
@@ -48,6 +50,13 @@ def dot_product_attention(q, k, v, *, causal: bool = False,
 
     ``window=W`` (requires ``causal``) restricts each query to the last W
     keys — causal sliding-window attention.
+
+    ``segment_ids``: [B, S] int — packed/variable-length sequences.
+    Attention is restricted to positions with EQUAL ids (cross-segment
+    scores are masked to NEG_INF), composing with ``causal``/``window``.
+    The convention: give padding its own id (e.g. -1); padded rows then
+    attend only to each other and the loss masks them out
+    (``losses.masked_sparse_categorical_crossentropy_from_logits``).
     """
     head_dim = q.shape[-1]
     if scale is None:
@@ -64,6 +73,9 @@ def dot_product_attention(q, k, v, *, causal: bool = False,
             k_pos = jnp.arange(k.shape[1])[None, :]
             allowed = allowed & (k_pos > q_pos - window)
         s = jnp.where(allowed[None, None], s, NEG_INF)
+    if segment_ids is not None:
+        same = segment_ids[:, :, None] == segment_ids[:, None, :]
+        s = jnp.where(same[:, None], s, NEG_INF)
     if mask is not None:
         s = jnp.where(mask, s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)

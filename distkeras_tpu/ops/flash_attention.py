@@ -32,6 +32,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 from jax.experimental import pallas as pl
 
@@ -80,9 +81,8 @@ def _k_base(qi, block_q: int, block_k: int, nkw: int):
     return jnp.maximum(0, end - (nkw - 1))
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
-                *, scale: float, causal: bool, k_len: int,
-                window=None, nkw=None):
+def _fwd_kernel(*refs, scale: float, causal: bool, k_len: int,
+                window=None, nkw=None, has_seg: bool = False):
     """One (batch*head, q_block, k_block) program.
 
     Block shapes: q_ref [1, bq, D]; k_ref/v_ref [1, bk, D];
@@ -92,8 +92,16 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
     Scratch m/l [bq, 1], acc [bq, D] persist across the (sequential,
     innermost) k grid axis. Under a sliding window the k grid axis is
     REMAPPED: grid step ``ki`` addresses actual k block
-    ``_k_base(qi) + ki`` (see ``_window_kblocks``).
+    ``_k_base(qi) + ki`` (see ``_window_kblocks``). With ``has_seg``
+    two extra [1, blk, 1] int32 refs carry packed segment ids; scores
+    with unequal ids are masked (packed-sequence support).
     """
+    if has_seg:
+        (q_ref, k_ref, v_ref, qseg_ref, kseg_ref,
+         o_ref, lse_ref, m_ref, l_ref, acc_ref) = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref = refs
+        qseg_ref = kseg_ref = None
     qi, ki = pl.program_id(1), pl.program_id(2)
     nk = pl.num_programs(2)
     block_q, block_k = q_ref.shape[1], k_ref.shape[1]
@@ -132,6 +140,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
         # mask zero-padded keys past the true sequence end
         if k_len % block_k:
             s = jnp.where(k_pos < k_len, s, NEG_INF)
+        if qseg_ref is not None:
+            same = qseg_ref[0, :, 0][:, None] == kseg_ref[0, :, 0][None, :]
+            s = jnp.where(same, s, NEG_INF)
         m_prev, l_prev, acc_prev = m_ref[:], l_ref[:], acc_ref[:]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
         alpha = jnp.exp(m_prev - m_new)
@@ -160,9 +171,21 @@ def _pad_seq(x, block: int, axis: int = 1):
     return x
 
 
+def _seg_blocks(segment_ids, sq_p: int, sk_p: int):
+    """[B, S] int segment ids -> padded [B, S_p, 1] int32 q/k variants
+    (pads get -1: they never match a real segment, and real ``-1``
+    padding tokens only reach k pads when no k_len masking applies —
+    harmless, those rows are loss-masked)."""
+    seg = jnp.asarray(segment_ids, jnp.int32)
+    b, s = seg.shape
+    segq = jnp.pad(seg, ((0, 0), (0, sq_p - s)), constant_values=-1)
+    segk = jnp.pad(seg, ((0, 0), (0, sk_p - s)), constant_values=-1)
+    return segq[..., None], segk[..., None]
+
+
 def _flash_forward(q, k, v, scale: float, causal: bool, block_q: int,
                    block_k: int, interpret: bool, bhsd: bool = False,
-                   window=None):
+                   window=None, segment_ids=None):
     if bhsd:
         b, h, sq, d = q.shape
         sk = k.shape[2]
@@ -202,13 +225,32 @@ def _flash_forward(q, k, v, scale: float, causal: bool, block_q: int,
     grid = (b * h, sq_p // block_q, nkw)
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
                                k_len=sk, window=window,
-                               nkw=nkw if remap else None)
+                               nkw=nkw if remap else None,
+                               has_seg=segment_ids is not None)
 
     def k_map(bh, qi, ki):
         if remap:
             return (bh, _k_base(qi, block_q, block_k, nkw) + ki, 0)
         return (bh, ki, 0)
 
+    in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
+        pl.BlockSpec((1, block_k, d), k_map),
+        pl.BlockSpec((1, block_k, d), k_map),
+    ]
+    operands = [qf, kf, vf]
+    if segment_ids is not None:
+        segq, segk = _seg_blocks(segment_ids, sq_p, sk_p)
+        # segment ids are per-BATCH: block index maps divide the b*h grid
+        # row back down to the batch row
+        in_specs += [
+            pl.BlockSpec((1, block_q, 1),
+                         lambda bh, qi, ki: (bh // h, qi, 0)),
+            pl.BlockSpec((1, block_k, 1),
+                         lambda bh, qi, ki: (bh // h,) + k_map(bh, qi,
+                                                               ki)[1:]),
+        ]
+        operands += [segq, segk]
     kwargs = {}
     if pltpu is not None and not interpret:
         kwargs["compiler_params"] = pltpu.CompilerParams(
@@ -216,11 +258,7 @@ def _flash_forward(q, k, v, scale: float, causal: bool, block_q: int,
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
-            pl.BlockSpec((1, block_k, d), k_map),
-            pl.BlockSpec((1, block_k, d), k_map),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
             pl.BlockSpec((1, block_q, 1), lambda bh, qi, ki: (bh, qi, 0)),
@@ -236,7 +274,7 @@ def _flash_forward(q, k, v, scale: float, causal: bool, block_q: int,
         ],
         interpret=interpret,
         **kwargs,
-    )(qf, kf, vf)
+    )(*operands)
     if bhsd:
         out = out.reshape(b, h, sq_p, d)[:, :, :sq]
     else:
@@ -245,12 +283,18 @@ def _flash_forward(q, k, v, scale: float, causal: bool, block_q: int,
     return out, lse
 
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, dq_ref,
-                   dq_acc, *, scale: float, causal: bool, k_len: int,
-                   window=None, nkw=None):
+def _bwd_dq_kernel(*refs, scale: float, causal: bool, k_len: int,
+                   window=None, nkw=None, has_seg: bool = False):
     """dq pass: one (batch*head, q_block, k_block) program, K innermost.
     ``dq_acc`` [bq, D] f32 persists across the K sweep. Window remap as
-    in ``_fwd_kernel``."""
+    in ``_fwd_kernel``; ``has_seg`` adds packed-segment masking."""
+    if has_seg:
+        (q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, qseg_ref,
+         kseg_ref, dq_ref, dq_acc) = refs
+    else:
+        (q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, dq_ref,
+         dq_acc) = refs
+        qseg_ref = kseg_ref = None
     qi, ki = pl.program_id(1), pl.program_id(2)
     nk = pl.num_programs(2)
     block_q, block_k = q_ref.shape[1], k_ref.shape[1]
@@ -283,6 +327,9 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, dq_ref,
             s = jnp.where(k_pos > q_pos - window, s, NEG_INF)
         if k_len % block_k:
             s = jnp.where(k_pos < k_len, s, NEG_INF)
+        if qseg_ref is not None:
+            same = qseg_ref[0, :, 0][:, None] == kseg_ref[0, :, 0][None, :]
+            s = jnp.where(same, s, NEG_INF)
         p = jnp.exp(s - lse_ref[0])                        # [bq, bk]
         dp = lax.dot_general(g32, vblk, (((1,), (1,)), ((), ())),
                              preferred_element_type=jnp.float32)
@@ -320,13 +367,18 @@ def _q_base(ki, block_q: int, block_k: int, nq: int, nqw: int):
     return jnp.minimum((ki * block_k) // block_q, nq - nqw)
 
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, dk_acc, dv_acc,
-                    *, scale: float, causal: bool, k_len: int,
-                    window=None, nq=None, nqw=None):
+def _bwd_dkv_kernel(*refs, scale: float, causal: bool, k_len: int,
+                    window=None, nq=None, nqw=None, has_seg: bool = False):
     """dk/dv pass: one (batch*head, k_block, q_block) program, Q innermost.
     ``dk_acc``/``dv_acc`` [bk, D] f32 persist across the Q sweep. Window
     remap: grid step ``qi`` addresses actual q block ``_q_base(ki) + qi``."""
+    if has_seg:
+        (q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, qseg_ref,
+         kseg_ref, dk_ref, dv_ref, dk_acc, dv_acc) = refs
+    else:
+        (q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+         dk_acc, dv_acc) = refs
+        qseg_ref = kseg_ref = None
     ki, qi = pl.program_id(1), pl.program_id(2)
     block_k, block_q = k_ref.shape[1], q_ref.shape[1]
     qb = qi if nqw is None else _q_base(ki, block_q, block_k, nq, nqw) + qi
@@ -362,6 +414,9 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
             s = jnp.where(k_pos > q_pos - window, s, NEG_INF)
         if k_len % block_k:
             s = jnp.where(k_pos < k_len, s, NEG_INF)
+        if qseg_ref is not None:
+            same = qseg_ref[0, :, 0][:, None] == kseg_ref[0, :, 0][None, :]
+            s = jnp.where(same, s, NEG_INF)
         p = jnp.exp(s - lse_ref[0])                        # [bq, bk]
         dv_acc[:] += lax.dot_general(
             p, g32, (((0,), (0,)), ((), ())),
@@ -384,7 +439,7 @@ def _flash_backward_pallas(res, g, scale: float, causal: bool,
                            bhsd: bool = False, window=None):
     """In-kernel backward: the [bq, bk] probability tile lives only in
     VMEM; f32 accumulators carry across the sequential grid axis."""
-    q, k, v, out, lse = res
+    q, k, v, out, lse, segment_ids = res
     if bhsd:
         b, h, sq, d = q.shape
         sk = k.shape[2]
@@ -437,17 +492,30 @@ def _flash_backward_pallas(res, g, scale: float, causal: bool,
 
     k_spec = pl.BlockSpec((1, block_k, d), k_map)
     row_q = pl.BlockSpec((1, block_q, 1), lambda bh, qi, ki: (bh, qi, 0))
+    in_specs = [q_spec, k_spec, k_spec, q_spec, row_q, row_q]
+    operands = [qf, kf, vf, gf, lsef, deltaf]
+    if segment_ids is not None:
+        segq, segk = _seg_blocks(segment_ids, sq_p, sk_p)
+        in_specs += [
+            pl.BlockSpec((1, block_q, 1),
+                         lambda bh, qi, ki: (bh // h, qi, 0)),
+            pl.BlockSpec((1, block_k, 1),
+                         lambda bh, qi, ki: (bh // h,) + k_map(bh, qi,
+                                                               ki)[1:]),
+        ]
+        operands += [segq, segk]
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
                           k_len=sk, window=window,
-                          nkw=nkw if nkw < nk else None),
+                          nkw=nkw if nkw < nk else None,
+                          has_seg=segment_ids is not None),
         grid=(b * h, nq, nkw),
-        in_specs=[q_spec, k_spec, k_spec, q_spec, row_q, row_q],
+        in_specs=in_specs,
         out_specs=[q_spec],
         out_shape=[jax.ShapeDtypeStruct((b * h, sq_p, d), q.dtype)],
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=interpret, **kwargs,
-    )(qf, kf, vf, gf, lsef, deltaf)[0]
+    )(*operands)[0]
 
     # second pass: k blocks parallel, q innermost (window-remapped)
     def q_map2(bh, ki, qi):
@@ -458,20 +526,33 @@ def _flash_backward_pallas(res, g, scale: float, causal: bool,
     q_spec2 = pl.BlockSpec((1, block_q, d), q_map2)
     k_spec2 = pl.BlockSpec((1, block_k, d), lambda bh, ki, qi: (bh, ki, 0))
     row_q2 = pl.BlockSpec((1, block_q, 1), q_map2)
+    in_specs2 = [q_spec2, k_spec2, k_spec2, q_spec2, row_q2, row_q2]
+    operands2 = [qf, kf, vf, gf, lsef, deltaf]
+    if segment_ids is not None:
+        segq, segk = _seg_blocks(segment_ids, sq_p, sk_p)
+        in_specs2 += [
+            pl.BlockSpec((1, block_q, 1),
+                         lambda bh, ki, qi: (bh // h,) + q_map2(bh, ki,
+                                                                qi)[1:]),
+            pl.BlockSpec((1, block_k, 1),
+                         lambda bh, ki, qi: (bh // h, ki, 0)),
+        ]
+        operands2 += [segq, segk]
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
                           k_len=sk, window=window,
                           nq=nq if nqw < nq else None,
-                          nqw=nqw if nqw < nq else None),
+                          nqw=nqw if nqw < nq else None,
+                          has_seg=segment_ids is not None),
         grid=(b * h, nk, nqw),
-        in_specs=[q_spec2, k_spec2, k_spec2, q_spec2, row_q2, row_q2],
+        in_specs=in_specs2,
         out_specs=[k_spec2, k_spec2],
         out_shape=[jax.ShapeDtypeStruct((b * h, sk_p, d), k.dtype),
                    jax.ShapeDtypeStruct((b * h, sk_p, d), v.dtype)],
         scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
                         pltpu.VMEM((block_k, d), jnp.float32)],
         interpret=interpret, **kwargs,
-    )(qf, kf, vf, gf, lsef, deltaf)
+    )(*operands2)
 
     if bhsd:
         unflat = lambda x, s: x.reshape(b, h, x.shape[1], d)[:, :, :s]
@@ -484,7 +565,7 @@ def _flash_backward_pallas(res, g, scale: float, causal: bool,
 def _flash_backward(res, g, scale: float, causal: bool, block_k: int,
                     window=None):
     """Blockwise XLA backward: scan over K/V blocks, recompute P from lse."""
-    q, k, v, out, lse = res
+    q, k, v, out, lse, segment_ids = res
     b, sq, h, d = q.shape
     sk = k.shape[1]
     block_k = min(block_k, sk)
@@ -492,6 +573,10 @@ def _flash_backward(res, g, scale: float, causal: bool, block_k: int,
     if pad:
         k = jnp.pad(k, ((0, 0), (0, pad), (0, 0), (0, 0)))
         v = jnp.pad(v, ((0, 0), (0, pad), (0, 0), (0, 0)))
+    seg = None
+    if segment_ids is not None:
+        seg = jnp.pad(jnp.asarray(segment_ids, jnp.int32),
+                      ((0, 0), (0, pad)), constant_values=-1)
 
     qf = q.astype(jnp.float32) * scale
     g32 = g.astype(jnp.float32)
@@ -515,6 +600,11 @@ def _flash_backward(res, g, scale: float, causal: bool, block_k: int,
         mask = jnp.logical_and(allowed, k_valid[None, :]) if causal \
             else k_valid[None, :]
         s = jnp.where(mask[None, None], s, NEG_INF)
+        if seg is not None:
+            ksg = lax.dynamic_slice_in_dim(seg, kb * block_k, block_k,
+                                           axis=1)
+            same = seg[:, :sq, None] == ksg[:, None, :]     # [B, Sq, bk]
+            s = jnp.where(same[:, None], s, NEG_INF)
         p = jnp.exp(s - lse[..., None])                       # [B,H,Sq,bk]
         dv = jnp.einsum("bhqk,bqhd->bkhd", p, g32,
                         preferred_element_type=jnp.float32)
@@ -535,34 +625,41 @@ def _flash_backward(res, g, scale: float, causal: bool, block_k: int,
 
 
 @functools.partial(jax.custom_vjp,
-                   nondiff_argnums=(3, 4, 5, 6, 7, 8, 9, 10))
-def _flash(q, k, v, scale, causal, block_q, block_k, interpret, bwd, bhsd,
-           window):
+                   nondiff_argnums=(4, 5, 6, 7, 8, 9, 10, 11))
+def _flash(q, k, v, segment_ids, scale, causal, block_q, block_k,
+           interpret, bwd, bhsd, window):
     out, _ = _flash_forward(q, k, v, scale, causal, block_q, block_k,
-                            interpret, bhsd, window)
+                            interpret, bhsd, window, segment_ids)
     return out
 
 
-def _flash_fwd_rule(q, k, v, scale, causal, block_q, block_k, interpret,
-                    bwd, bhsd, window):
+def _flash_fwd_rule(q, k, v, segment_ids, scale, causal, block_q, block_k,
+                    interpret, bwd, bhsd, window):
     out, lse = _flash_forward(q, k, v, scale, causal, block_q, block_k,
-                              interpret, bhsd, window)
-    return out, (q, k, v, out, lse)
+                              interpret, bhsd, window, segment_ids)
+    return out, (q, k, v, out, lse, segment_ids)
 
 
 def _flash_bwd_rule(scale, causal, block_q, block_k, interpret, bwd, bhsd,
                     window, res, g):
+    # segment ids are integer routing data: their cotangent is float0
+    seg = res[5]
+    dseg = None if seg is None else np.zeros(seg.shape, jax.dtypes.float0)
     if bwd == "pallas":
-        return _flash_backward_pallas(res, g, scale, causal, block_q,
-                                      block_k, interpret, bhsd, window)
+        dq, dk, dv = _flash_backward_pallas(res, g, scale, causal, block_q,
+                                            block_k, interpret, bhsd,
+                                            window)
+        return dq, dk, dv, dseg
     if bhsd:
         # the scan-backward oracle is written for BSHD; convert around it
         t = lambda x: x.transpose(0, 2, 1, 3)
-        q, k, v, out, lse = res
-        dq, dk, dv = _flash_backward((t(q), t(k), t(v), t(out), lse),
-                                     t(g), scale, causal, block_k, window)
-        return t(dq), t(dk), t(dv)
-    return _flash_backward(res, g, scale, causal, block_k, window)
+        q, k, v, out, lse, segment_ids = res
+        dq, dk, dv = _flash_backward(
+            (t(q), t(k), t(v), t(out), lse, segment_ids),
+            t(g), scale, causal, block_k, window)
+        return t(dq), t(dk), t(dv), dseg
+    dq, dk, dv = _flash_backward(res, g, scale, causal, block_k, window)
+    return dq, dk, dv, dseg
 
 
 _flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
@@ -575,7 +672,9 @@ def flash_attention(q, k, v, *, causal: bool = False,
                     interpret: Optional[bool] = None,
                     bwd: Optional[str] = None,
                     layout: str = "bshd",
-                    window: Optional[int] = None) -> jnp.ndarray:
+                    window: Optional[int] = None,
+                    segment_ids: Optional[jnp.ndarray] = None
+                    ) -> jnp.ndarray:
     """Flash attention, BSHD in/out by default. Differentiable (custom
     VJP). ``layout="bhsd"`` takes/returns [B, H, S, D] — the kernel's
     native flattening is then a free reshape instead of four
@@ -597,6 +696,11 @@ def flash_attention(q, k, v, *, causal: bool = False,
     block_k`` keys per q block, so the smaller k block tightens coverage
     (measured: W=1024 S=8192 fwd+bwd 1.80x full-causal at 512/512 vs
     1.44x at 1024/1024 on v5e).
+
+    ``segment_ids``: [B, S] int — packed-sequence masking (attention
+    restricted to equal ids) through every path: forward, both Pallas
+    backward kernels, the XLA-scan backward, and the fused-XLA fallback.
+    See ``ops.attention.dot_product_attention`` for the convention.
     """
     if layout not in ("bshd", "bhsd"):
         raise ValueError(f"layout must be 'bshd' or 'bhsd', got {layout!r}")
@@ -619,9 +723,11 @@ def flash_attention(q, k, v, *, causal: bool = False,
         if bhsd:
             t = lambda x: x.transpose(0, 2, 1, 3)
             return t(dot_product_attention(t(q), t(k), t(v), causal=causal,
-                                           scale=scale, window=window))
+                                           scale=scale, window=window,
+                                           segment_ids=segment_ids))
         return dot_product_attention(q, k, v, causal=causal, scale=scale,
-                                     window=window)
+                                     window=window,
+                                     segment_ids=segment_ids)
 
     if pltpu is None:  # no Pallas TPU support in this jax build
         return _xla_fallback()
@@ -637,5 +743,5 @@ def flash_attention(q, k, v, *, causal: bool = False,
         bwd = "pallas" if not interpret else "xla"
     if bwd not in ("pallas", "xla"):
         raise ValueError(f"bwd must be 'pallas' or 'xla', got {bwd!r}")
-    return _flash(q, k, v, scale, causal, block_q, block_k, interpret, bwd,
-                  bhsd, window)
+    return _flash(q, k, v, segment_ids, scale, causal, block_q, block_k,
+                  interpret, bwd, bhsd, window)

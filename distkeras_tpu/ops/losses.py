@@ -99,6 +99,18 @@ def sparse_categorical_crossentropy_from_logits(y_true, y_pred):
     return jnp.mean(_ps_sparse_logits(y_true, y_pred)[0])
 
 
+def masked_sparse_categorical_crossentropy_from_logits(y_true, y_pred):
+    """Sparse CE over logits where labels ``< 0`` are IGNORED — the
+    packed/padded-sequence training loss (pair with ``segment_ids``
+    attention masking; give padding label -1). The mean is over the
+    non-ignored positions only, so padding density does not dilute the
+    gradient scale."""
+    mask = (y_true >= 0)
+    ls, _ = _ps_sparse_logits(jnp.maximum(y_true, 0), y_pred)
+    mf = mask.astype(jnp.float32)
+    return jnp.sum(ls * mf) / jnp.maximum(jnp.sum(mf), 1.0)
+
+
 def binary_crossentropy(y_true, y_pred):
     return jnp.mean(_ps_binary(y_true, y_pred)[0])
 
@@ -127,6 +139,8 @@ LOSSES = {
     "sparse_categorical_crossentropy": sparse_categorical_crossentropy,
     "sparse_categorical_crossentropy_from_logits":
         sparse_categorical_crossentropy_from_logits,
+    "masked_sparse_categorical_crossentropy_from_logits":
+        masked_sparse_categorical_crossentropy_from_logits,
     "binary_crossentropy": binary_crossentropy,
     "binary_crossentropy_from_logits": binary_crossentropy_from_logits,
     "hinge": hinge,

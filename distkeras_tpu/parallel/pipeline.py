@@ -405,16 +405,32 @@ class PipelineTrainer:
             Xv = np.asarray(vd[self.features_col])
             yv = np.asarray(vd[self.label_col])
         Xv, yv = jnp.asarray(Xv), jnp.asarray(yv)
+        loss_fn = self.eval_loss
+        metric_fns = self._metric_fns() or {}
+        lm = self.lm
+
+        if self.seq_axis is None:
+            # no collectives in the blocks: plain unsharded eval (any
+            # validation-set size; the pre-round-3 behavior)
+            @jax.jit
+            def evalf_plain(params, Xv, yv):
+                logits = lm.apply(params, Xv)
+                res = {"val_loss": loss_fn(yv, logits)}
+                for name, fn in metric_fns.items():
+                    res[f"val_{name}"] = fn(yv, logits)
+                return res
+
+            return lambda params: evalf_plain(params, Xv, yv)
+
+        # sequence-parallel blocks (ring/ulysses) contain collectives that
+        # need their axis bound — run under shard_map over the mesh
         dp = int(np.prod([self.mesh.shape[a] for a in self.data_axes])) or 1
         if len(Xv) % dp:
             raise ValueError(
                 f"validation set size {len(Xv)} must divide over data "
-                f"axes {self.data_axes} (size {dp})")
-        loss_fn = self.eval_loss
-        metric_fns = self._metric_fns() or {}
-        lm = self.lm
-        mean_axes = self.data_axes + ((self.seq_axis,)
-                                      if self.seq_axis else ())
+                f"axes {self.data_axes} (size {dp}) for the "
+                f"sequence-parallel validator")
+        mean_axes = self.data_axes + (self.seq_axis,)
 
         def evalf(params, Xv, yv):
             logits = lm.apply(params, Xv)
@@ -423,8 +439,7 @@ class PipelineTrainer:
                 res[f"val_{name}"] = lax.pmean(fn(yv, logits), mean_axes)
             return res
 
-        seq_entry = (self.seq_axis,) if self.seq_axis else (None,)
-        data_spec = P(self.data_axes, *seq_entry)
+        data_spec = P(self.data_axes, self.seq_axis)
         pspecs = {"embed": P(), "blocks": P(), "head": P()}
         sharded = jax.jit(jax.shard_map(
             evalf, mesh=self.mesh,

@@ -1,0 +1,219 @@
+"""Packed/variable-length sequences: segment-id attention masking through
+the XLA path and the flash kernel (fwd + BOTH backwards) vs a band+segment
+masked oracle, provably-zero cross-segment attention end-to-end, and the
+padding-masked LM loss."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distkeras_tpu.models import Model, zoo
+from distkeras_tpu.ops.attention import NEG_INF, dot_product_attention
+from distkeras_tpu.ops.flash_attention import flash_attention
+from distkeras_tpu.ops.losses import get_loss
+
+
+def _segmented_oracle(q, k, v, seg, causal=True):
+    S = q.shape[1]
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * (q.shape[-1] ** -0.5)
+    allowed = seg[:, :, None] == seg[:, None, :]
+    if causal:
+        qp, kp = jnp.arange(S)[:, None], jnp.arange(S)[None, :]
+        allowed = allowed & (qp >= kp)[None]
+    w = jax.nn.softmax(jnp.where(allowed[:, None], s, NEG_INF), -1)
+    return jnp.einsum("bhqk,bkhd->bqhd", w, v)
+
+
+def _packed(rs, b=2, s=40, h=2, d=8, n_seg=3):
+    q, k, v = (jnp.asarray(rs.randn(b, s, h, d), jnp.float32)
+               for _ in range(3))
+    seg = jnp.asarray(np.sort(rs.randint(0, n_seg, (b, s)), axis=1))
+    return q, k, v, seg
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_xla_segment_masking_matches_oracle(causal):
+    rs = np.random.RandomState(0)
+    q, k, v, seg = _packed(rs)
+    out = dot_product_attention(q, k, v, causal=causal, segment_ids=seg)
+    ref = _segmented_oracle(q, k, v, seg, causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+@pytest.mark.parametrize("bwd", ["pallas", "xla"])
+def test_flash_segment_masking_grads_match_oracle(bwd):
+    """Both flash backwards exact vs the masked oracle (non-divisible
+    length exercises the pad path with -1 pad segments)."""
+    rs = np.random.RandomState(1)
+    q, k, v, seg = _packed(rs, s=44)
+    co = jnp.asarray(rs.randn(*q.shape), jnp.float32)
+
+    out = flash_attention(q, k, v, causal=True, segment_ids=seg,
+                          interpret=True, block_q=16, block_k=16)
+    ref = _segmented_oracle(q, k, v, seg)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+    gr = jax.grad(lambda *a: jnp.sum(_segmented_oracle(*a, seg) * co),
+                  argnums=(0, 1, 2))(q, k, v)
+    gw = jax.grad(lambda *a: jnp.sum(flash_attention(
+        *a, causal=True, segment_ids=seg, interpret=True, bwd=bwd,
+        block_q=16, block_k=16) * co), argnums=(0, 1, 2))(q, k, v)
+    for x, y in zip(gw, gr):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y), atol=2e-5)
+
+
+def test_cross_segment_attention_provably_zero_end_to_end():
+    """Invariance proof on the full LM, in the direction CAUSALITY DOES
+    NOT COVER: causal attention alone would already isolate an earlier
+    segment from a later one, so the load-bearing check is that
+    perturbing the EARLIER segment leaves the LATER segment's logits
+    unchanged — that holds only when segment masking actually works."""
+    V, S, CUT = 32, 24, 10
+    model = Model.build(zoo.transformer_lm(V, d_model=32, num_heads=4,
+                                           num_layers=2, mlp_ratio=2),
+                        (S,), seed=0)
+    rs = np.random.RandomState(2)
+    toks = rs.randint(0, V, (2, S))
+    toks2 = toks.copy()
+    toks2[:, :CUT] = rs.randint(0, V, (2, CUT))       # perturb segment 1
+    seg = jnp.asarray((np.arange(S) >= CUT).astype(np.int32))[None, :] \
+        .repeat(2, axis=0)
+
+    def logits(t, s=seg):
+        out, _ = model.module.apply(model.params, model.state,
+                                    jnp.asarray(t), segment_ids=s)
+        return out
+
+    l1, l2 = logits(toks), logits(toks2)
+    # segment-2 logits identical although segment 1 (its causal PAST)
+    # changed completely — impossible unless the mask cut the link
+    np.testing.assert_array_equal(np.asarray(l1[:, CUT:]),
+                                  np.asarray(l2[:, CUT:]))
+    # ...and segment 1's own logits DID change
+    assert not np.allclose(np.asarray(l1[:, :CUT]), np.asarray(l2[:, :CUT]))
+    # sanity: WITHOUT segment ids the same perturbation leaks into
+    # segment 2 (proves the check has teeth)
+    u1, u2 = logits(toks, None), logits(toks2, None)
+    assert not np.allclose(np.asarray(u1[:, CUT:]), np.asarray(u2[:, CUT:]))
+
+    # gradient side: loss restricted to segment 2 is invariant to what
+    # segment 1 contained — identical param grads under both contents
+    def seg2_loss(params, t):
+        out, _ = model.module.apply(params, model.state, jnp.asarray(t),
+                                    segment_ids=seg)
+        return jnp.sum(jnp.square(out[:, CUT:].astype(jnp.float32)))
+
+    g1 = jax.grad(seg2_loss)(model.params, toks)
+    g2 = jax.grad(seg2_loss)(model.params, toks2)
+    for a, b in zip(jax.tree_util.tree_leaves(g1),
+                    jax.tree_util.tree_leaves(g2)):
+        d = np.abs(np.asarray(a) - np.asarray(b))
+        # embedding rows of the perturbed tokens legitimately differ in
+        # WHICH rows receive gradient; everything flowing through
+        # attention/mlp weights must match exactly
+        if a.shape == (V, 32):
+            continue
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+
+def test_segment_ids_through_remat_and_rejection():
+    """Containers forward segment_ids (Remat-wrapped block == bare
+    block); a stack with no accepting layer fails loudly."""
+    from distkeras_tpu.models import Sequential
+    from distkeras_tpu.models.attention import TransformerBlock
+    from distkeras_tpu.models.blocks import Remat
+    from distkeras_tpu.models.layers import Dense, Embedding
+
+    V, S = 16, 12
+    rs = np.random.RandomState(5)
+    toks = rs.randint(0, V, (2, S))
+    seg = jnp.asarray(np.sort(rs.randint(0, 3, (2, S)), axis=1))
+
+    def build(wrap):
+        blk = TransformerBlock(num_heads=2, mlp_ratio=2, causal=True)
+        layers = [Embedding(V, 16),
+                  Remat(blk) if wrap else blk, Dense(V)]
+        return Model.build(Sequential(layers), (S,), seed=3)
+
+    m_plain, m_remat = build(False), build(True)
+    # same seed -> same params; remat must not change masked numerics
+    o1, _ = m_plain.module.apply(m_plain.params, m_plain.state,
+                                 jnp.asarray(toks), segment_ids=seg)
+    o2, _ = m_remat.module.apply(m_remat.params, m_remat.state,
+                                 jnp.asarray(toks), segment_ids=seg)
+    np.testing.assert_allclose(np.asarray(o1), np.asarray(o2), atol=1e-6)
+    # and segment ids demonstrably took effect through the Remat wrapper
+    o3, _ = m_remat.module.apply(m_remat.params, m_remat.state,
+                                 jnp.asarray(toks))
+    assert not np.allclose(np.asarray(o2), np.asarray(o3))
+
+    mlp_only = Model.build(Sequential([Embedding(V, 8), Dense(V)]),
+                           (S,), seed=0)
+    with pytest.raises(ValueError, match="segment_ids"):
+        mlp_only.module.apply(mlp_only.params, mlp_only.state,
+                              jnp.asarray(toks), segment_ids=seg)
+
+
+def test_packed_batch_trains_with_masked_loss():
+    """End-to-end packed training: two sequences per row, padding labeled
+    -1, masked loss; training converges on a copy task."""
+    from distkeras_tpu.ops import apply_updates, get_optimizer
+
+    V, S = 16, 16
+    model = Model.build(zoo.transformer_lm(V, d_model=32, num_heads=4,
+                                           num_layers=1, mlp_ratio=2),
+                        (S,), seed=0)
+    rs = np.random.RandomState(3)
+    # rows: [seq A (7 tok) | seq B (6 tok) | pad (3)]
+    X = rs.randint(1, V, (32, S))
+    seg = np.zeros((32, S), np.int32)
+    seg[:, 7:13] = 1
+    seg[:, 13:] = -1
+    Y = X.copy()
+    Y[:, 13:] = -1                                     # padding ignored
+    loss_fn = get_loss("masked_sparse_categorical_crossentropy_from_logits")
+    opt = get_optimizer("adam", learning_rate=5e-3)
+    params = model.params
+    opt_state = opt.init(params)
+    segj = jnp.asarray(seg)
+
+    @jax.jit
+    def step(params, opt_state):
+        def lf(p):
+            out, _ = model.module.apply(p, model.state, jnp.asarray(X),
+                                        training=True, segment_ids=segj)
+            return loss_fn(jnp.asarray(Y), out)
+        l, g = jax.value_and_grad(lf)(params)
+        upd, opt_state2 = opt.update(g, opt_state, params)
+        return apply_updates(params, upd), opt_state2, l
+
+    first = None
+    for _ in range(120):
+        params, opt_state, l = step(params, opt_state)
+        if first is None:
+            first = float(l)
+    assert np.isfinite(float(l))
+    assert float(l) < 0.5 * first, (first, float(l))
+
+
+def test_masked_loss_ignores_negative_labels():
+    logits = jnp.asarray(np.random.RandomState(4).randn(2, 5, 7))
+    y = jnp.asarray([[1, 2, -1, -1, 3], [0, -1, 4, 5, -1]])
+    fn = get_loss("masked_sparse_categorical_crossentropy_from_logits")
+    full = get_loss("sparse_categorical_crossentropy_from_logits")
+    # equals the unmasked mean over ONLY the valid positions
+    valid = [(0, 0), (0, 1), (0, 4), (1, 0), (1, 2), (1, 3)]
+    ref = np.mean([float(full(y[i][j][None], logits[i][j][None]))
+                   for i, j in valid])
+    np.testing.assert_allclose(float(fn(y, logits)), ref, rtol=1e-6)
+
+
+def test_segment_ids_rejected_on_sequence_parallel_paths():
+    from distkeras_tpu.models.attention import MultiHeadAttention
+    mha = MultiHeadAttention(num_heads=2, attn_impl="ring",
+                             seq_axis_name="sp")
+    params, state, _ = mha.init(jax.random.PRNGKey(0), (8, 16))
+    with pytest.raises(ValueError, match="segment_ids"):
+        mha.apply(params, state, jnp.zeros((1, 8, 16)),
+                  segment_ids=jnp.zeros((1, 8), jnp.int32))
