@@ -153,10 +153,11 @@ def _sample(logits, temperature, top_k, rng):
     if top_k is not None:
         # mask from top_k's INDICES, not a value threshold — ties at the
         # k-th logit would otherwise admit more than k candidates (the MoE
-        # router masks the same way for the same reason)
+        # router masks the same way for the same reason). one_hot keeps
+        # this rank-agnostic: any leading batch dims work
         _, idx = lax.top_k(logits, top_k)
-        keep = jnp.zeros_like(logits, bool).at[
-            jnp.arange(logits.shape[0])[:, None], idx].set(True)
+        keep = jax.nn.one_hot(idx, logits.shape[-1],
+                              dtype=jnp.bool_).any(axis=-2)
         logits = jnp.where(keep, logits, NEG_INF)
     return jax.random.categorical(rng, logits, axis=-1)
 

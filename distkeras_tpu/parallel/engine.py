@@ -399,8 +399,21 @@ class DistributedEngine:
                 "per-commit serialization through the center)")
         self.amortized = (uniform and algo.amortizable) \
             if config.amortized is None else bool(config.amortized)
+        if (config.amortized is None and self.amortized
+                and bool((np.asarray(offsets) != 0).any())):
+            # auto-amortization changes staggered-async trajectories:
+            # in-window commits are no longer serialized — all workers
+            # commit at block boundaries. Opt out with amortized=False.
+            import warnings
+            warnings.warn(
+                "amortized two-level scan auto-enabled with nonzero "
+                "stagger offsets: commit interleaving differs from the "
+                "per-step path (same fixed point, different trajectory); "
+                "pass amortized=False to reproduce per-step numerics",
+                stacklevel=3)
         self._uniform_K = int(Ks[0]) if uniform else None
         self._epoch_fn = None  # built lazily (jitted shard_map)
+        self._reset_fn = None  # built lazily (parallelism_factor > 1)
 
     # -- state ------------------------------------------------------------
     def init_state(self, params: Pytree, model_state: Pytree,
@@ -424,6 +437,36 @@ class DistributedEngine:
         return {"worker": worker,
                 "center": {"params": params, "state": model_state},
                 "server": server}
+
+    def reset_workers(self, state: Dict) -> Dict:
+        """Re-initialize every worker from the CURRENT center: params,
+        pull snapshot, optimizer state, and algorithm extras reset; the
+        center, server aux, global step counter, and worker rng streams
+        carry on.
+
+        This is the reference's task boundary (``workers.py``: each Spark
+        partition builds a fresh Keras model + optimizer from the
+        serialized center) — used by ``parallelism_factor > 1``, where an
+        epoch is ``num_workers x factor`` partitions and each worker
+        consumes ``factor`` of them sequentially."""
+        if self._reset_fn is None:
+            n = self.config.num_workers
+
+            @partial(jax.jit, out_shardings=self.shardings())
+            def _reset(state):
+                center = state["center"]["params"]
+                stack = lambda tree: _tmap(
+                    lambda x: jnp.broadcast_to(x, (n,) + x.shape), tree)
+                worker = dict(state["worker"])
+                worker["params"] = stack(center)
+                worker["opt"] = jax.vmap(self.optimizer.init)(stack(center))
+                if self.algo.needs_pull:
+                    worker["pull"] = stack(center)
+                worker["extras"] = self.algo.init_worker_extras(n)
+                return {**state, "worker": worker}
+
+            self._reset_fn = _reset
+        return self._reset_fn(state)
 
     def shardings(self) -> Dict:
         """NamedShardings matching ``init_state`` for explicit device_put."""

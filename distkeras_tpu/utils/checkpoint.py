@@ -29,7 +29,11 @@ def _unflatten_like(template, flat):
     device placement (and any dtype policy) belongs to the trainer that
     restores, and converting through jax here would silently truncate
     f64 host arrays to f32 (x64 is disabled). Shapes are validated
-    against the template like the serialization helper."""
+    against the template like the serialization helper; a stored-vs-
+    template DTYPE mismatch is allowed but warned (a changed
+    mixed-precision policy between save and resume should be visible,
+    not silent)."""
+    import warnings
     paths_leaves = jax.tree_util.tree_flatten_with_path(template)
     leaves = []
     for path, leaf in paths_leaves[0]:
@@ -40,6 +44,13 @@ def _unflatten_like(template, flat):
             raise ValueError(
                 f"checkpoint leaf {key!r} shape {arr.shape} != expected "
                 f"{np.shape(leaf)}")
+        want = getattr(leaf, "dtype", None)
+        if want is not None and np.dtype(want) != arr.dtype:
+            warnings.warn(
+                f"checkpoint leaf {key!r} restores as stored dtype "
+                f"{arr.dtype} but the template expects {np.dtype(want)} "
+                f"(precision policy changed between save and resume?)",
+                stacklevel=3)
         leaves.append(np.asarray(arr))
     return jax.tree_util.tree_unflatten(paths_leaves[1], leaves)
 
