@@ -209,7 +209,10 @@ def generate(model: Model, prompts, max_new_tokens: int,
     cache stores no extra information while doubling the dominant read.
     ``weights_dtype="auto"`` pre-casts matrix weights to the same compute
     dtype once before the scan (see ``_serving_params``); ``None``
-    disables, a dtype forces."""
+    disables, a dtype forces, and ``"int8"`` serves weight-only int8
+    (``models.quantize`` per-channel symmetric): matrices live in HBM as
+    int8 and dequantize inside each step's matmul fusion — another ~2×
+    off the weight-read bound, at int8 weight accuracy."""
     module = model.module
     if not isinstance(module, Sequential):
         raise TypeError("generate() expects a Sequential LM "
@@ -235,21 +238,48 @@ def generate(model: Model, prompts, max_new_tokens: int,
         weights_dtype = compute_dt if (
             compute_dt is not None
             and compute_dt != jnp.dtype(jnp.float32)) else None
-    if weights_dtype is None:
+    # normalize: np.int8/jnp.int8 mean the quantized path, same as "int8"
+    # (a raw astype(int8) of float weights would zero them); other int
+    # dtypes are meaningless for weights
+    if weights_dtype is not None and weights_dtype != "int8":
+        dt = jnp.dtype(weights_dtype)
+        if dt == jnp.dtype(jnp.int8):
+            weights_dtype = "int8"
+        elif jnp.issubdtype(dt, jnp.integer):
+            raise ValueError(
+                f"weights_dtype {dt.name!r} unsupported: use a float "
+                "dtype, 'int8' (weight-only quantized serving), 'auto' "
+                "or None")
+    # serving-weight cache: one entry per dtype, each validated against
+    # the SOURCE params by identity (strong ref -> no id()-reuse hazard);
+    # a loop alternating dtypes must not re-pay full-tree conversion
+    cache_all = getattr(model, "_serving_params_cache", None)
+    if cache_all is None:
+        cache_all = model._serving_params_cache = {}
+    scales = None
+    if weights_dtype == "int8":
+        # weight-only int8 serving (models.quantize): matrices stored as
+        # {q: int8, scale: f32[out]}; dequant happens INSIDE the scan
+        # body so XLA fuses q*scale into each step's matmul reads — the
+        # weight HBM traffic per decoded token is int8, halving the
+        # dominant read again vs bf16 (docs/PERF.md roofline)
+        from distkeras_tpu.models.quantize import quantize_params
+        cached = cache_all.get("int8")
+        if cached is None or cached[0] is not model.params:
+            q, s = quantize_params(jax.device_get(model.params))
+            cached = (model.params, (jax.device_put(q), s))
+            cache_all["int8"] = cached
+        run_params, scales = cached[1]
+    elif weights_dtype is None:
         run_params = model.params
     else:
-        # cast once per (params identity, dtype): a pipelined serving loop
-        # must not re-pay the full-tree cast every call. The cache holds a
-        # strong reference to the SOURCE tree so an `is` check is a sound
-        # invalidation (no id()-reuse hazard after gc).
-        cached = getattr(model, "_serving_params_cache", None)
         dt_key = jnp.dtype(weights_dtype).name
-        if (cached is None or cached[0] is not model.params
-                or cached[1] != dt_key):
-            cached = (model.params, dt_key,
+        cached = cache_all.get(dt_key)
+        if cached is None or cached[0] is not model.params:
+            cached = (model.params,
                       _serving_params(model.params, weights_dtype))
-            model._serving_params_cache = cached
-        run_params = cached[2]
+            cache_all[dt_key] = cached
+        run_params = cached[1]
     cache = init_cache(module, b, total, cache_dtype)
 
     tokens0 = jnp.concatenate(
@@ -261,20 +291,36 @@ def generate(model: Model, prompts, max_new_tokens: int,
     # Model.predict's cached forward
     key = (b, p_len, int(max_new_tokens), float(temperature), top_k,
            jnp.dtype(cache_dtype).name, stop_token,
-           None if weights_dtype is None else jnp.dtype(weights_dtype).name)
+           None if weights_dtype is None
+           else ("int8" if weights_dtype == "int8"
+                 else jnp.dtype(weights_dtype).name))
     jit_cache = getattr(model, "_jit_generate", None)
     if jit_cache is None:
         jit_cache = model._jit_generate = {}
     run = jit_cache.get(key)
     if run is None:
+        int8 = scales is not None
+
         @jax.jit
-        def run(params, state, tokens, cache, rng):
+        def run(params, run_scales, state, tokens, cache, rng):
             done0 = jnp.zeros((b,), bool)
 
             def body(carry, t):
                 tokens, cache, rng, done = carry
+                if int8:
+                    # dequant INSIDE the body: q*scale fuses into each
+                    # step's matmul reads, so HBM traffic stays int8.
+                    # scales are TRACED args, not closure constants —
+                    # re-quantized params after a weight update must not
+                    # meet a stale baked-in scale tree (quantize.py's
+                    # predict makes the same choice)
+                    from distkeras_tpu.models.quantize import \
+                        dequantize_params
+                    p = dequantize_params(params, run_scales)
+                else:
+                    p = params
                 tok = lax.dynamic_slice_in_dim(tokens, t, 1, axis=1)[:, 0]
-                logits, cache = decode_step(module, params, state, cache,
+                logits, cache = decode_step(module, p, state, cache,
                                             tok, t)
                 rng, sub = jax.random.split(rng)
                 nxt = _sample(logits, temperature, top_k, sub)
@@ -297,8 +343,8 @@ def generate(model: Model, prompts, max_new_tokens: int,
 
         jit_cache[key] = run
 
-    out = run(run_params, model.state, tokens0, cache,
-              jax.random.PRNGKey(seed))
+    out = run(run_params, {} if scales is None else scales, model.state,
+              tokens0, cache, jax.random.PRNGKey(seed))
     # as_numpy=False skips the device->host sync: serving loops that
     # pipeline several generate calls only pay one round trip at the end
     # (on tunneled backends the per-call sync is ~100 ms — bench.py

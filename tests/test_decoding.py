@@ -258,3 +258,36 @@ def test_gqa_swa_rope_scale_compose():
     assert blk.attn.rope_scale == 2.0
     assert blk.attn.kv_heads == 2
     np.testing.assert_allclose(loaded.predict(toks), full, atol=1e-5)
+
+
+def test_generate_int8_weights_matches_bf16_mostly():
+    """weights_dtype='int8' (weight-only per-channel quantized serving):
+    the machinery runs end to end and greedy decoding agrees with the
+    full-precision path on a trained-ish model's confident logits."""
+    from distkeras_tpu.models import Model, zoo
+    from distkeras_tpu.models.decoding import generate
+
+    V, S = 32, 16
+    m = Model.build(zoo.transformer_lm(V, d_model=32, num_heads=4,
+                                       num_layers=2, mlp_ratio=2),
+                    (S,), seed=0)
+    p = np.random.RandomState(0).randint(0, V, (2, 4)).astype(np.int32)
+    o_ref = generate(m, p, max_new_tokens=8, weights_dtype=None)
+    o_i8 = generate(m, p, max_new_tokens=8, weights_dtype="int8")
+    assert o_i8.shape == o_ref.shape
+    np.testing.assert_array_equal(o_i8[:, :4], p)  # prompt preserved
+    # untrained logits are near-ties; require majority agreement, not
+    # bitwise (int8 weight rounding legitimately flips knife-edge argmax)
+    assert (o_ref == o_i8).mean() > 0.5
+    # the quantized tree is cached on the model (one quantization per
+    # params identity, per dtype slot)
+    assert "int8" in m._serving_params_cache
+    c0 = m._serving_params_cache["int8"]
+    generate(m, p, max_new_tokens=8, weights_dtype="int8")
+    assert m._serving_params_cache["int8"] is c0
+    # np.int8 normalizes to the quantized path (an astype would zero
+    # sub-1.0 float weights); other int dtypes are rejected
+    o_np = generate(m, p, max_new_tokens=8, weights_dtype=np.int8)
+    np.testing.assert_array_equal(o_np, o_i8)
+    with pytest.raises(ValueError, match="weights_dtype"):
+        generate(m, p, max_new_tokens=8, weights_dtype=np.int32)
