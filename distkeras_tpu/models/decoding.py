@@ -245,17 +245,25 @@ def generate(model: Model, prompts, max_new_tokens: int,
         dt = jnp.dtype(weights_dtype)
         if dt == jnp.dtype(jnp.int8):
             weights_dtype = "int8"
-        elif jnp.issubdtype(dt, jnp.integer):
+        elif not jnp.issubdtype(dt, jnp.floating):
+            # a raw astype to any non-float dtype would silently destroy
+            # sub-unity weights (bool/ints round them to 0/1)
             raise ValueError(
                 f"weights_dtype {dt.name!r} unsupported: use a float "
                 "dtype, 'int8' (weight-only quantized serving), 'auto' "
                 "or None")
     # serving-weight cache: one entry per dtype, each validated against
     # the SOURCE params by identity (strong ref -> no id()-reuse hazard);
-    # a loop alternating dtypes must not re-pay full-tree conversion
+    # a loop alternating dtypes must not re-pay full-tree conversion.
+    # Entries whose source tree is no longer model.params are purged on
+    # any lookup — without this, a weight update would pin every old
+    # params tree (plus its converted copy) in memory forever.
     cache_all = getattr(model, "_serving_params_cache", None)
     if cache_all is None:
         cache_all = model._serving_params_cache = {}
+    for k in [k for k, v in cache_all.items()
+              if v[0] is not model.params]:
+        del cache_all[k]
     scales = None
     if weights_dtype == "int8":
         # weight-only int8 serving (models.quantize): matrices stored as
@@ -265,9 +273,17 @@ def generate(model: Model, prompts, max_new_tokens: int,
         # dominant read again vs bf16 (docs/PERF.md roofline)
         from distkeras_tpu.models.quantize import quantize_params
         cached = cache_all.get("int8")
-        if cached is None or cached[0] is not model.params:
+        if cached is None:
             q, s = quantize_params(jax.device_get(model.params))
-            cached = (model.params, (jax.device_put(q), s))
+            # scales go to device too: per-call H2D of hundreds of small
+            # numpy leaves would reintroduce the per-call overhead this
+            # cache exists to avoid
+            cached = (model.params,
+                      (jax.device_put(q),
+                       jax.tree_util.tree_map(
+                           lambda x: None if x is None
+                           else jax.device_put(x), s,
+                           is_leaf=lambda x: x is None)))
             cache_all["int8"] = cached
         run_params, scales = cached[1]
     elif weights_dtype is None:
@@ -275,7 +291,7 @@ def generate(model: Model, prompts, max_new_tokens: int,
     else:
         dt_key = jnp.dtype(weights_dtype).name
         cached = cache_all.get(dt_key)
-        if cached is None or cached[0] is not model.params:
+        if cached is None:
             cached = (model.params,
                       _serving_params(model.params, weights_dtype))
             cache_all[dt_key] = cached

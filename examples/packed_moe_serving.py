@@ -1,0 +1,131 @@
+"""Round-3 feature tour: packed-sequence training of a dispatched-MoE LM
+with sliding-window attention, then quantized serving.
+
+One script exercises the four round-3 capabilities end to end:
+
+1. **Packed/variable-length sequences** — several short documents packed
+   per row with ``segment_ids``; attention never crosses a document
+   boundary (``ops/flash_attention.py`` / the XLA path both mask it) and
+   padding positions carry label -1 for the masked LM loss.
+2. **Dispatched MoE** — ``dispatch="tokens"``: per-token expert FLOPs are
+   ``top_k x capacity_factor`` MLPs instead of all ``num_experts``
+   (``models/moe.py``).
+3. **Sliding-window attention** — ``attn_window`` bounds each query's
+   reach; the kernel's window-remapped grids make the cost O(B.S.W) on
+   TPU (``docs/PERF.md``).
+4. **Serving dtype levers** — greedy generation with the bf16 cache +
+   pre-cast weights defaults, then ``weights_dtype="int8"`` weight-only
+   quantized serving.
+
+Run:
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+        python examples/packed_moe_serving.py
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def make_packed_copy_task(n_rows: int = 48, seq: int = 24, vocab: int = 24,
+                          seed: int = 0):
+    """Rows pack two short 'documents' plus padding. The task is a copy
+    LM (predict the current token), trivially learnable — the point is
+    the packing plumbing, not the modeling."""
+    rs = np.random.RandomState(seed)
+    X = np.zeros((n_rows, seq), np.int32)
+    seg = np.full((n_rows, seq), -1, np.int32)
+    labels = np.full((n_rows, seq), -1, np.int32)
+    for i in range(n_rows):
+        a = rs.randint(6, 12)                  # doc A length
+        b = rs.randint(6, seq - a - 1)         # doc B length
+        X[i, :a] = rs.randint(1, vocab, a)
+        X[i, a:a + b] = rs.randint(1, vocab, b)
+        seg[i, :a] = 0
+        seg[i, a:a + b] = 1
+        labels[i, :a + b] = X[i, :a + b]       # copy task; pad = -1
+    return X, seg, labels
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from distkeras_tpu.models import Model, zoo
+    from distkeras_tpu.models.decoding import generate
+    from distkeras_tpu.ops import apply_updates, get_loss, get_optimizer
+
+    vocab, seq = 24, 24
+    X, seg, labels = make_packed_copy_task(seq=seq, vocab=vocab)
+
+    # capacity_factor = num_experts / top_k (= 4/2) makes expert capacity
+    # equal the token count: PROVABLY drop-free dispatch, which is what
+    # keeps the cross-document isolation check below exact (a dropped
+    # slot's keep-flag can flip when another document's routing changes;
+    # with zero drops a token's expert output is slot-independent).
+    # dtype='bfloat16' makes the serving levers (bf16 cache + pre-cast
+    # weights) actually engage in generate() below.
+    model = Model.build(
+        zoo.transformer_lm(vocab, d_model=48, num_heads=4, num_layers=2,
+                           mlp_ratio=2, attn_window=8, dtype="bfloat16",
+                           moe_every=2, num_experts=4,
+                           moe_dispatch="tokens",
+                           moe_capacity_factor=2.0,
+                           moe_aux_loss_weight=0.01),
+        (seq,), seed=0)
+    loss_fn = get_loss("masked_sparse_categorical_crossentropy_from_logits")
+    opt = get_optimizer("adam", learning_rate=5e-3)
+
+    params, state = model.params, model.state
+    opt_state = opt.init(params)
+    xj, sj, yj = jnp.asarray(X), jnp.asarray(seg), jnp.asarray(labels)
+
+    @jax.jit
+    def step(params, state, opt_state):
+        def lf(p):
+            out, new_state = model.module.apply(p, state, xj, training=True,
+                                                segment_ids=sj)
+            return loss_fn(yj, out), new_state
+        (l, new_state), g = jax.value_and_grad(lf, has_aux=True)(params)
+        upd, opt_state2 = opt.update(g, opt_state, params)
+        return apply_updates(params, upd), new_state, opt_state2, l
+
+    first = None
+    for i in range(150):
+        params, state, opt_state, l = step(params, state, opt_state)
+        if first is None:
+            first = float(l)
+    print(f"packed MoE-SWA LM: masked loss {first:.3f} -> {float(l):.3f}")
+    assert float(l) < 0.5 * first, "packed training failed to converge"
+
+    # cross-segment isolation spot-check: perturb doc A, doc B's logits
+    # must not move (causality alone could NOT guarantee this direction)
+    row = X[:1].copy()
+    a_len = int((seg[0] == 0).sum())
+    b_span = seg[0] == 1
+    out1, _ = model.module.apply(params, state, jnp.asarray(row),
+                                 segment_ids=sj[:1])
+    row2 = row.copy()
+    row2[0, :a_len] = (row[0, :a_len] % (vocab - 1)) + 1
+    out2, _ = model.module.apply(params, state, jnp.asarray(row2),
+                                 segment_ids=sj[:1])
+    leak = float(np.abs(np.asarray(out1)[0, b_span]
+                        - np.asarray(out2)[0, b_span]).max())
+    print(f"cross-document logit leak after perturbing doc A: {leak}")
+    assert leak == 0.0
+
+    # serving: greedy continuation, full precision vs int8 weights
+    trained = model.replace(params=jax.device_get(params),
+                            state=jax.device_get(state))
+    prompts = X[:2, :4].astype(np.int32)
+    out_bf = generate(trained, prompts, max_new_tokens=8)
+    out_i8 = generate(trained, prompts, max_new_tokens=8,
+                      weights_dtype="int8")
+    agree = float((out_bf == out_i8).mean())
+    print(f"int8 vs full-precision greedy agreement: {agree:.2f}")
+    assert out_bf.shape == (2, 12) and agree > 0.6
+    print("OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -78,3 +78,9 @@ def test_higgs_physics_example(capsys):
     out = capsys.readouterr().out
     assert "ROC-AUC" in out
     assert acc > 0.8, acc
+
+
+def test_packed_moe_serving_example(capsys):
+    run_example("examples.packed_moe_serving")
+    out = capsys.readouterr().out
+    assert "cross-document logit leak" in out and "OK" in out
