@@ -267,22 +267,30 @@ def bench_generate(batch: int, new_tokens: int, n_passes: int,
     prompts = np.zeros((batch, 8), np.int32)
     out = generate(model, prompts, max_new_tokens=new_tokens)  # compile
     assert out.shape == (batch, 8 + new_tokens)
-    rates, single = [], []
-    for i in range(n_passes):
+    generate(model, prompts, max_new_tokens=new_tokens,
+             weights_dtype="int8")  # compile the int8 variant too
+
+    def passes(wd):
         t0 = time.perf_counter()
         outs = [generate(model, prompts, max_new_tokens=new_tokens,
-                         seed=j, as_numpy=False)
+                         seed=j, as_numpy=False, weights_dtype=wd)
                 for j in range(calls_per_pass)]
         _ = np.asarray(outs[-1][0, -1])  # one sync for the whole pass
-        dt = time.perf_counter() - t0
-        rates.append(batch * new_tokens * calls_per_pass / dt)
+        return batch * new_tokens * calls_per_pass / (
+            time.perf_counter() - t0)
+
+    rates, single, int8_rates = [], [], []
+    for i in range(n_passes):
+        rates.append(passes("auto"))
+        int8_rates.append(passes("int8"))
         t0 = time.perf_counter()
         _ = generate(model, prompts, max_new_tokens=new_tokens)
         single.append(batch * new_tokens / (time.perf_counter() - t0))
         print(f"pass {i}: {rates[-1]:.1f} tok/s pipelined, "
-              f"{single[-1]:.1f} tok/s single-call", file=sys.stderr,
+              f"{int8_rates[-1]:.1f} int8, "
+              f"{single[-1]:.1f} single-call", file=sys.stderr,
               flush=True)
-    return rates, single
+    return rates, single, int8_rates
 
 
 def main():
@@ -344,9 +352,9 @@ def _run_mode(mode, args, on_accel, peak, device_kind):
     if mode == "generate":
         batch = 8 if on_accel else 2
         new_tokens = 128 if on_accel else 8
-        rates, single = bench_generate(batch, new_tokens,
-                                       3 if on_accel else 1,
-                                       5 if on_accel else 2)
+        rates, single, int8_rates = bench_generate(batch, new_tokens,
+                                                   3 if on_accel else 1,
+                                                   5 if on_accel else 2)
         value = statistics.median(rates)
         print(json.dumps({
             "metric": "lm_generate_new_tokens_per_sec_per_chip",
@@ -358,6 +366,8 @@ def _run_mode(mode, args, on_accel, peak, device_kind):
             "best_pass": round(max(rates), 1),
             "single_call_tokens_per_sec": round(statistics.median(single),
                                                 1),
+            "int8_tokens_per_sec": round(statistics.median(int8_rates), 1),
+            "int8_best_pass": round(max(int8_rates), 1),
             "batch_size": batch,
             "new_tokens": new_tokens,
             "device_kind": device_kind,

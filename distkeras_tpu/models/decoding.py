@@ -277,13 +277,9 @@ def generate(model: Model, prompts, max_new_tokens: int,
             q, s = quantize_params(jax.device_get(model.params))
             # scales go to device too: per-call H2D of hundreds of small
             # numpy leaves would reintroduce the per-call overhead this
-            # cache exists to avoid
+            # cache exists to avoid (device_put preserves None leaves)
             cached = (model.params,
-                      (jax.device_put(q),
-                       jax.tree_util.tree_map(
-                           lambda x: None if x is None
-                           else jax.device_put(x), s,
-                           is_leaf=lambda x: x is None)))
+                      (jax.device_put(q), jax.device_put(s)))
             cache_all["int8"] = cached
         run_params, scales = cached[1]
     elif weights_dtype is None:
