@@ -18,8 +18,10 @@ tile never touches HBM. A blockwise XLA-scan backward is retained for
 interpreter/CPU runs and as a cross-check oracle (``bwd="xla"``). Current
 record on a v5e (``bench.py --model lm``, 218M LM, B8 H16 S2048 D64
 causal bf16, kernel backward + BHSD layer path + tuned blocks):
-**64.1K tokens/sec end to end, 2.13x the fused-XLA attention path**
-(36% MFU; history of the intermediate cuts in docs/PERF.md).
+**64.2K tokens/sec end to end, 2.15x the fused-XLA attention path**
+(36% MFU; repeat runs land 64.1-64.2K / 2.13-2.15x through the
+tunnel — docs/PERF.md is the authoritative record, with the history
+of the intermediate cuts).
 
 On non-TPU backends the kernel runs in Pallas interpreter mode (tests) or
 falls back to the fused-XLA reference (``ops.attention``) for speed.

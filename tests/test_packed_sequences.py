@@ -217,3 +217,39 @@ def test_segment_ids_rejected_on_sequence_parallel_paths():
     with pytest.raises(ValueError, match="segment_ids"):
         mha.apply(params, state, jnp.zeros((1, 8, 16)),
                   segment_ids=jnp.zeros((1, 8), jnp.int32))
+
+
+@pytest.mark.parametrize("bwd", ["pallas", "xla"])
+def test_segments_compose_with_sliding_window(bwd):
+    """segment_ids AND window on the same call: the masks must intersect
+    (both features edit the same score tile) — fwd and both backwards vs
+    the banded+segmented oracle, with remap-active blocks."""
+    window = 6
+    rs = np.random.RandomState(9)
+    B, S, H, D = 1, 64, 2, 8
+    q, k, v = (jnp.asarray(rs.randn(B, S, H, D), jnp.float32)
+               for _ in range(3))
+    seg = jnp.asarray(np.sort(rs.randint(0, 3, (B, S)), axis=1))
+    co = jnp.asarray(rs.randn(B, S, H, D), jnp.float32)
+
+    def oracle(q, k, v):
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * (D ** -0.5)
+        qp, kp = jnp.arange(S)[:, None], jnp.arange(S)[None, :]
+        allowed = (qp >= kp) & (kp > qp - window)
+        allowed = allowed[None] & (seg[:, :, None] == seg[:, None, :])
+        w = jax.nn.softmax(jnp.where(allowed[:, None], s, NEG_INF), -1)
+        return jnp.einsum("bhqk,bkhd->bqhd", w, v)
+
+    kw = dict(causal=True, window=window, segment_ids=seg, interpret=True,
+              block_q=16, block_k=8)
+    from distkeras_tpu.ops.flash_attention import _window_kblocks
+    assert _window_kblocks(16, 8, S // 8, window, S // 16) < S // 8
+    out = flash_attention(q, k, v, **kw)
+    np.testing.assert_allclose(out, oracle(q, k, v), atol=1e-5)
+    gr = jax.grad(lambda *a: jnp.sum(oracle(*a) * co),
+                  argnums=(0, 1, 2))(q, k, v)
+    gw = jax.grad(lambda *a: jnp.sum(
+        flash_attention(*a, bwd=bwd, **kw) * co),
+        argnums=(0, 1, 2))(q, k, v)
+    for x, y in zip(gw, gr):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y), atol=2e-5)
