@@ -15,6 +15,13 @@ from jax import shard_map
 from distkeras_tpu.models.moe import MoE, moe_all_to_all
 
 
+def _program_flops(moe, params, x):
+    """XLA cost-analysis FLOPs of the jitted apply (per-device program
+    when the inputs carry GSPMD shardings)."""
+    f = jax.jit(lambda p, xx: moe.apply(p, {}, xx)[0])
+    return f.lower(params, x).compile().cost_analysis()["flops"]
+
+
 def _mk(e=8, d=16, hid=32, k=2, **kw):
     moe = MoE(e, hid, top_k=k, **kw)
     params, state, _ = moe.init(jax.random.PRNGKey(0), (4, d))
@@ -102,11 +109,8 @@ def test_dispatched_expert_flops_proportional_to_topk():
     disp = MoE(e, hid, top_k=k, dispatch="tokens", capacity_factor=1.0)
     params, _, _ = dense.init(jax.random.PRNGKey(9), (256, d))
 
-    def flops(moe):
-        f = jax.jit(lambda p, xx: moe.apply(p, {}, xx)[0])
-        return f.lower(params, x).compile().cost_analysis()["flops"]
-
-    fd, fs = flops(dense), flops(disp)
+    fd = _program_flops(dense, params, x)
+    fs = _program_flops(disp, params, x)
     # expert matmuls dominate at this size; allow routing/scatter overhead
     assert fs < fd * (k / e + 0.15), (fs, fd, fs / fd)
 
@@ -137,3 +141,27 @@ def test_dispatch_config_roundtrip():
     assert moe2.dispatch == "tokens"
     with pytest.raises(ValueError, match="dispatch"):
         MoE(4, 8, dispatch="bogus")
+
+
+def test_dispatched_ep_per_device_flops_under_gspmd(devices):
+    """Expert-parallel compute sparsity end to end: on an 8-way ep mesh
+    with GSPMD-sharded expert weights, the PER-DEVICE program FLOPs of
+    the dispatched path must be a small fraction of the dense path's
+    (dense-EP already divides by A; dispatch must further cut top_k/E)."""
+    from jax.sharding import NamedSharding
+
+    n = len(devices)
+    mesh = Mesh(np.array(devices), ("ep",))
+    e, d, hid, k = 2 * n, 128, 512, 2
+    x = jax.random.normal(jax.random.PRNGKey(20), (4, 256, d))
+    dense = MoE(e, hid, top_k=k)
+    disp = MoE(e, hid, top_k=k, dispatch="tokens", capacity_factor=1.0)
+    params, _, _ = dense.init(jax.random.PRNGKey(21), (256, d))
+    shard = {"gate": P(), "w1": P("ep"), "b1": P("ep"),
+             "w2": P("ep"), "b2": P("ep")}
+    sharded = {kk: jax.device_put(v, NamedSharding(mesh, shard[kk]))
+               for kk, v in params.items()}
+
+    fd = _program_flops(dense, sharded, x)
+    fs = _program_flops(disp, sharded, x)
+    assert fs < fd * (k / e + 0.2), (fs, fd, fs / fd)
