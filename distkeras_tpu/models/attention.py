@@ -127,8 +127,15 @@ class PositionalEmbedding(Layer):
 
 
 def _attention_compute(q, k, v, *, causal, impl, axis_name=None,
-                       ring_block_size=None, window=None):
-    """Dispatch on attention implementation. q/k/v are BSHD."""
+                       ring_block_size=None, window=None,
+                       segment_ids=None):
+    """Dispatch on attention implementation. q/k/v are BSHD.
+
+    ``segment_ids`` (packed sequences) flows to EVERY impl (round 4):
+    flash/xla mask in-kernel; ring rotates the k-side ids with their K/V
+    shards; Ulysses all-gathers the ids alongside its head-scatter. For
+    the sequence-parallel impls the ids are the local [B, S_local] shard.
+    """
     if impl == "auto":
         # measured on TPU v5e (bench.py --model lm): the Pallas flash
         # kernel (in-kernel backward) trains 2.15x faster than fused XLA
@@ -137,7 +144,8 @@ def _attention_compute(q, k, v, *, causal, impl, axis_name=None,
         impl = "flash" if jax.default_backend() == "tpu" else "xla"
     if impl == "flash":
         from distkeras_tpu.ops.flash_attention import flash_attention
-        return flash_attention(q, k, v, causal=causal, window=window)
+        return flash_attention(q, k, v, causal=causal, window=window,
+                               segment_ids=segment_ids)
     if window is not None and impl in ("ring", "ulysses",
                                        "ulysses_flash"):
         raise ValueError(
@@ -152,7 +160,8 @@ def _attention_compute(q, k, v, *, causal, impl, axis_name=None,
                 "use shard-local coordinates")
         from distkeras_tpu.ops.ring_attention import ring_attention
         return ring_attention(q, k, v, axis_name=axis_name, causal=causal,
-                              block_size=ring_block_size)
+                              block_size=ring_block_size,
+                              segment_ids=segment_ids)
     if impl in ("ulysses", "ulysses_flash"):
         if not axis_name:
             raise ValueError(
@@ -163,8 +172,10 @@ def _attention_compute(q, k, v, *, causal, impl, axis_name=None,
         from distkeras_tpu.ops.ulysses import ulysses_attention
         return ulysses_attention(
             q, k, v, axis_name=axis_name, causal=causal,
-            impl="flash" if impl == "ulysses_flash" else "xla")
-    return dot_product_attention(q, k, v, causal=causal, window=window)
+            impl="flash" if impl == "ulysses_flash" else "xla",
+            segment_ids=segment_ids)
+    return dot_product_attention(q, k, v, causal=causal, window=window,
+                                 segment_ids=segment_ids)
 
 
 @register_layer
@@ -254,11 +265,6 @@ class MultiHeadAttention(Layer):
         impl = self.attn_impl
         if impl == "auto":
             impl = "flash" if jax.default_backend() == "tpu" else "xla"
-        if segment_ids is not None and impl not in ("flash", "xla"):
-            raise ValueError(
-                f"segment_ids (packed sequences) are supported by the "
-                f"'flash' and 'xla' attention paths, not attn_impl="
-                f"{impl!r}")
         positions = None
         if (self.use_rope
                 and impl in ("ring", "ulysses", "ulysses_flash")
@@ -295,16 +301,12 @@ class MultiHeadAttention(Layer):
             q = apply_rope(q, positions, scale=self.rope_scale)
             k = apply_rope(k, positions, scale=self.rope_scale)
         k, v = self._expand_kv(k, 2), self._expand_kv(v, 2)
-        if segment_ids is not None:
-            out = dot_product_attention(q, k, v, causal=self.causal,
-                                        window=self.attn_window,
-                                        segment_ids=segment_ids)
-        else:
-            out = _attention_compute(q, k, v, causal=self.causal,
-                                     impl=impl,
-                                     axis_name=self.seq_axis_name,
-                                     ring_block_size=self.ring_block_size,
-                                     window=self.attn_window)
+        out = _attention_compute(q, k, v, causal=self.causal,
+                                 impl=impl,
+                                 axis_name=self.seq_axis_name,
+                                 ring_block_size=self.ring_block_size,
+                                 window=self.attn_window,
+                                 segment_ids=segment_ids)
         y = jnp.einsum("bshe,hed->bsd", out, params["wo"].astype(dt))
         return y.astype(x.dtype), state
 

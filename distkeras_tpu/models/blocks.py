@@ -8,6 +8,7 @@ inside ``Sequential`` and serialize through the same registry.
 
 from __future__ import annotations
 
+from functools import partial
 from typing import Optional
 
 import jax
@@ -153,13 +154,39 @@ class Remat(Layer):
     long-context/deep models fit: wrap each transformer block (or any
     expensive sub-stack) and the peak activation footprint drops from
     O(layers) to O(1) per wrapped unit at the cost of one extra forward.
+
+    ``policy`` picks what XLA may keep instead of recomputing
+    (``jax.checkpoint_policies``): ``None``/"nothing" saves nothing
+    (maximum memory saving, one full extra forward), "dots" saves every
+    matmul output (recomputes only the cheap elementwise/norm glue — the
+    usual best trade on TPU where recomputing MXU work is the expensive
+    part), "dots_no_batch" saves only weight-side matmuls. An EXPLICIT
+    policy pins what is rematerialized; without one XLA's own
+    memory-pressure rematerialization chooses per-compile (the measured
+    batch-12 LM regression in docs/PERF.md was exactly that thrash).
     """
 
-    def __init__(self, inner: Layer = None, inner_spec=None):
+    POLICIES = ("nothing", "dots", "dots_no_batch")
+
+    def __init__(self, inner: Layer = None, inner_spec=None,
+                 policy: str = None):
         self.inner = inner if inner is not None else \
             layer_from_spec(inner_spec)
         if self.inner is None:
             raise ValueError("Remat needs an inner layer")
+        if policy is not None and policy not in self.POLICIES:
+            raise ValueError(f"unknown remat policy {policy!r}; "
+                             f"known: {self.POLICIES}")
+        self.policy = policy
+
+    def _jax_policy(self):
+        if self.policy in (None, "nothing"):
+            return None  # jax.checkpoint default: save nothing
+        return {
+            "dots": jax.checkpoint_policies.checkpoint_dots,
+            "dots_no_batch":
+                jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+        }[self.policy]
 
     @property
     def accepts_segment_ids(self) -> bool:
@@ -170,17 +197,19 @@ class Remat(Layer):
 
     def apply(self, params, state, x, *, training=False, rng=None,
               segment_ids=None):
+        ckpt = partial(jax.checkpoint, policy=self._jax_policy())
         if segment_ids is not None and self.accepts_segment_ids:
             def f(p, s, xb, r, seg):
                 return self.inner.apply(p, s, xb, training=training,
                                         rng=r, segment_ids=seg)
 
-            return jax.checkpoint(f)(params, state, x, rng, segment_ids)
+            return ckpt(f)(params, state, x, rng, segment_ids)
 
         def f(p, s, xb, r):
             return self.inner.apply(p, s, xb, training=training, rng=r)
 
-        return jax.checkpoint(f)(params, state, x, rng)
+        return ckpt(f)(params, state, x, rng)
 
     def get_config(self):
-        return {"inner_spec": layer_spec(self.inner)}
+        return {"inner_spec": layer_spec(self.inner),
+                "policy": self.policy}

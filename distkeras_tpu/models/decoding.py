@@ -40,10 +40,37 @@ from distkeras_tpu.models.layers import Dropout
 from distkeras_tpu.ops.attention import NEG_INF, apply_rope
 
 
+def _decode_block_of(layer):
+    """The TransformerBlock a decode step should run for ``layer``, or
+    None for position-wise layers. Unwraps ``Remat`` (a training-time
+    memory policy — decoding reads the inner block directly; round 4:
+    before this, a remat-wrapped model silently decoded GARBAGE because
+    the wrapper fell through to the position-wise branch, running
+    cache-less self-attention on single tokens)."""
+    from distkeras_tpu.models.blocks import Remat
+    if isinstance(layer, TransformerBlock):
+        return layer
+    if isinstance(layer, Remat) and isinstance(layer.inner,
+                                               TransformerBlock):
+        return layer.inner
+    return None
+
+
 def init_cache(module: Sequential, batch: int, max_len: int,
                dtype=jnp.float32):
     """Per-layer KV buffers ([B, max_len, H, Dh]) mirroring the Sequential;
-    non-attention layers get ``None``."""
+    non-attention layers get ``None``.
+
+    ``dtype="int8"`` (round 4) builds a QUANTIZED cache: int8 k/v plus f32
+    per-token-per-head scales ([B, max_len, H]) — each written entry
+    stores ``round(x / scale) * scale`` with ``scale = max|x| / 127`` over
+    its head vector. At long contexts the cache read dominates the decode
+    roofline (docs/PERF.md), so int8 halves the dominant term vs bf16;
+    the scale read is Dh=64x smaller than the payload. Composes with GQA
+    (scales are per KV head).
+    """
+    int8 = (isinstance(dtype, str) and dtype == "int8") or \
+        (not isinstance(dtype, str) and jnp.dtype(dtype) == jnp.int8)
     cache = []
     for layer in module.layers:
         # custom serving loops enter through here: out-of-range position
@@ -53,8 +80,9 @@ def init_cache(module: Sequential, batch: int, max_len: int,
             raise ValueError(
                 f"PositionalEmbedding(max_len={layer.max_len}) is too small "
                 f"for a {max_len}-position decode cache")
-        if isinstance(layer, TransformerBlock):
-            attn = layer.attn
+        block = _decode_block_of(layer)
+        if block is not None:
+            attn = block.attn
             # GQA: the cache stores only the kv heads — the whole point
             # of grouped queries at serving time
             h = attn.kv_heads
@@ -65,19 +93,75 @@ def init_cache(module: Sequential, batch: int, max_len: int,
                     "init_cache needs head_dim; build the model first "
                     "(Model.build resolves it) or pass head_dim explicitly")
             shape = (batch, max_len, h, dh)
-            cache.append({"k": jnp.zeros(shape, dtype),
-                          "v": jnp.zeros(shape, dtype)})
+            if int8:
+                cache.append({
+                    "k": jnp.zeros(shape, jnp.int8),
+                    "v": jnp.zeros(shape, jnp.int8),
+                    "k_scale": jnp.zeros(shape[:3], jnp.float32),
+                    "v_scale": jnp.zeros(shape[:3], jnp.float32)})
+            else:
+                cache.append({"k": jnp.zeros(shape, dtype),
+                              "v": jnp.zeros(shape, dtype)})
         else:
+            if getattr(layer, "accepts_segment_ids", False):
+                # the layer contains attention the decode loop does not
+                # know how to cache — applying it position-wise would
+                # silently decode garbage (each token attending only to
+                # itself), so refuse up front
+                raise ValueError(
+                    f"decode path does not support layer {layer!r}: it "
+                    "contains attention but is not a TransformerBlock "
+                    "(or Remat-wrapped TransformerBlock)")
             cache.append(None)
     return cache
+
+
+def _quantize_kv(x):
+    """[..., Dh] float -> (int8 payload, f32 [...] per-vector scale)."""
+    xf = x.astype(jnp.float32)
+    scale = jnp.max(jnp.abs(xf), axis=-1) / 127.0
+    safe = jnp.where(scale == 0.0, 1.0, scale)
+    q = jnp.round(xf / safe[..., None]).astype(jnp.int8)
+    return q, jnp.where(scale == 0.0, 0.0, safe)
+
+
+def _cache_write(kv, k, v, t):
+    """Write one [B, S_w, H, Dh] k/v slab at position ``t`` (S_w = 1 for
+    decode steps, P for prefill), quantizing if the cache is int8."""
+    if "k_scale" in kv:
+        qk, sk = _quantize_kv(k)
+        qv, sv = _quantize_kv(v)
+        return {
+            "k": lax.dynamic_update_slice_in_dim(kv["k"], qk, t, axis=1),
+            "v": lax.dynamic_update_slice_in_dim(kv["v"], qv, t, axis=1),
+            "k_scale": lax.dynamic_update_slice_in_dim(
+                kv["k_scale"], sk, t, axis=1),
+            "v_scale": lax.dynamic_update_slice_in_dim(
+                kv["v_scale"], sv, t, axis=1)}
+    return {"k": lax.dynamic_update_slice_in_dim(
+                kv["k"], k.astype(kv["k"].dtype), t, axis=1),
+            "v": lax.dynamic_update_slice_in_dim(
+                kv["v"], v.astype(kv["v"].dtype), t, axis=1)}
+
+
+def _cache_kv_f32(kv):
+    """The cache's (k, v) as f32 expressions. For an int8 cache the
+    dequant (``q * scale``) is built HERE but materializes nowhere: XLA
+    fuses it into the consuming einsum's reads, so HBM traffic stays
+    int8 + scales (the same fusion contract as int8 serving weights)."""
+    if "k_scale" in kv:
+        return (kv["k"].astype(jnp.float32) * kv["k_scale"][..., None],
+                kv["v"].astype(jnp.float32) * kv["v_scale"][..., None])
+    return kv["k"].astype(jnp.float32), kv["v"].astype(jnp.float32)
 
 
 def _resolve_head_dims(module: Sequential, params) -> None:
     """Fill in ``head_dim`` on each attention layer from its params (the
     layer leaves it None until init; decode needs it statically)."""
     for layer, p in zip(module.layers, params):
-        if isinstance(layer, TransformerBlock) and layer.attn.head_dim is None:
-            layer.attn.head_dim = int(p["attn"]["wq"].shape[-1])
+        block = _decode_block_of(layer)
+        if block is not None and block.attn.head_dim is None:
+            block.attn.head_dim = int(p["attn"]["wq"].shape[-1])
 
 
 def _decode_attn(attn: MultiHeadAttention, p, kv, x, t):
@@ -95,25 +179,21 @@ def _decode_attn(attn: MultiHeadAttention, p, kv, x, t):
         pos = jnp.full((1,), t)
         q = apply_rope(q, pos, scale=attn.rope_scale)
         k = apply_rope(k, pos, scale=attn.rope_scale)
-    kv = {"k": lax.dynamic_update_slice_in_dim(
-              kv["k"], k.astype(kv["k"].dtype), t, axis=1),
-          "v": lax.dynamic_update_slice_in_dim(
-              kv["v"], v.astype(kv["v"].dtype), t, axis=1)}
+    kv = _cache_write(kv, k, v, t)
     scale = (attn.head_dim or q.shape[-1]) ** -0.5
     b = q.shape[0]
     hkv = attn.kv_heads
     g = attn.num_heads // hkv
     qg = (q.astype(jnp.float32) * scale).reshape(
         b, 1, hkv, g, q.shape[-1])                       # [B, 1, Hkv, G, D]
-    s = jnp.einsum("bqhgd,bkhd->bhgqk", qg,
-                   kv["k"].astype(jnp.float32))          # [B, Hkv, G, 1, L]
+    kf, vf = _cache_kv_f32(kv)
+    s = jnp.einsum("bqhgd,bkhd->bhgqk", qg, kf)          # [B, Hkv, G, 1, L]
     valid = jnp.arange(kv["k"].shape[1]) <= t
     if attn.attn_window is not None:
         valid &= jnp.arange(kv["k"].shape[1]) > t - attn.attn_window
     s = jnp.where(valid[None, None, None, None, :], s, NEG_INF)
     w = jax.nn.softmax(s, axis=-1)
-    out = jnp.einsum("bhgqk,bkhd->bqhgd", w,
-                     kv["v"].astype(jnp.float32)).astype(dt)
+    out = jnp.einsum("bhgqk,bkhd->bqhgd", w, vf).astype(dt)
     out = out.reshape(b, 1, attn.num_heads, q.shape[-1])
     y = jnp.einsum("bshe,hed->bsd", out, p["wo"].astype(dt))
     return y.astype(x.dtype), kv
@@ -128,6 +208,72 @@ def _decode_block(block: TransformerBlock, p, s, kv, x, t):
     return x + m, kv
 
 
+def _prefill_block(block: TransformerBlock, p, s, kv, x, positions):
+    """Whole-prompt pass through one TransformerBlock: ONE causal
+    attention over [B, P] (flash kernel on TPU) instead of P sequential
+    decode steps, writing the block's K/V cache entries for every prompt
+    position at once. Attention inside the prompt uses the exact
+    (unquantized) K/V; an int8 cache quantizes what later DECODE steps
+    read — the standard serving contract."""
+    from distkeras_tpu.models.attention import _attention_compute
+
+    attn = block.attn
+    dt = jnp.dtype(attn.dtype)
+    h_, _ = block.norm1.apply(p["norm1"], s["norm1"], x)
+    xc = h_.astype(dt)
+    q = jnp.einsum("bsd,dhe->bshe", xc, p["attn"]["wq"].astype(dt))
+    k = jnp.einsum("bsd,dhe->bshe", xc, p["attn"]["wk"].astype(dt))
+    v = jnp.einsum("bsd,dhe->bshe", xc, p["attn"]["wv"].astype(dt))
+    if attn.use_rope:
+        q = apply_rope(q, positions, scale=attn.rope_scale)
+        k = apply_rope(k, positions, scale=attn.rope_scale)
+    kv = _cache_write(kv, k, v, 0)
+    ke, ve = attn._expand_kv(k, 2), attn._expand_kv(v, 2)
+    impl = "flash" if jax.default_backend() == "tpu" else "xla"
+    out = _attention_compute(q, ke, ve, causal=True, impl=impl,
+                             window=attn.attn_window)
+    y = jnp.einsum("bshe,hed->bsd", out.astype(dt), p["attn"]["wo"]
+                   .astype(dt))
+    x = x + y.astype(x.dtype)
+    h_, _ = block.norm2.apply(p["norm2"], s["norm2"], x)
+    m, _ = block.mlp.apply(p["mlp"], s["mlp"], h_, training=False)
+    return x + m, kv
+
+
+def prefill(module: Sequential, params, state, cache, prompts):
+    """Batched prompt ingestion (round 4): run the stack ONCE over the
+    [B, P] prompt, filling every attention layer's cache at positions
+    0..P-1, and return ``(last_logits [B, V], cache)``.
+
+    This replaces replaying the prompt through the sequential decode scan
+    — P compute-bound flash steps collapse into one kernel pass, which is
+    what makes long-context serving (P = 2048-16384) usable at all: an
+    8K-token prompt is ~250x fewer sequential device steps. The vocab
+    head is applied to the LAST position only (the [B, P, V] logits
+    tensor for a 32k vocab would be ~2 GB at P=8192 and is never
+    needed)."""
+    b, p_len = prompts.shape
+    x = prompts
+    new_cache = list(cache)
+    positions = jnp.arange(p_len)
+    last = len(module.layers) - 1
+    for i, layer in enumerate(module.layers):
+        p, s = params[i], state[i]
+        block = _decode_block_of(layer)
+        if block is not None:
+            x, new_cache[i] = _prefill_block(block, p, s, cache[i], x,
+                                             positions)
+        elif isinstance(layer, PositionalEmbedding):
+            x = x + p["embeddings"][:p_len][None].astype(x.dtype)
+        elif isinstance(layer, Dropout):
+            pass                                         # eval: identity
+        else:
+            if i == last and x.ndim == 3:
+                x = x[:, -1:]        # head on the final position only
+            x, _ = layer.apply(p, s, x, training=False)
+    return x[:, -1], new_cache
+
+
 def decode_step(module: Sequential, params, state, cache, tok, t):
     """One token through the stack. tok: [B] int; returns ([B, V] logits,
     cache)."""
@@ -135,8 +281,9 @@ def decode_step(module: Sequential, params, state, cache, tok, t):
     new_cache = list(cache)
     for i, layer in enumerate(module.layers):
         p, s, kv = params[i], state[i], cache[i]
-        if isinstance(layer, TransformerBlock):
-            x, new_cache[i] = _decode_block(layer, p, s, kv, x, t)
+        block = _decode_block_of(layer)
+        if block is not None:
+            x, new_cache[i] = _decode_block(block, p, s, kv, x, t)
         elif isinstance(layer, PositionalEmbedding):
             x = x + p["embeddings"][t][None, None, :].astype(x.dtype)
         elif isinstance(layer, Dropout):
@@ -166,8 +313,9 @@ def _attn_compute_dtype(module: Sequential):
     """The attention compute dtype of the first TransformerBlock (the
     LM-family convention: one dtype across the stack), or None."""
     for layer in module.layers:
-        if isinstance(layer, TransformerBlock):
-            return jnp.dtype(layer.attn.dtype)
+        block = _decode_block_of(layer)
+        if block is not None:
+            return jnp.dtype(block.attn.dtype)
     return None
 
 
@@ -294,13 +442,13 @@ def generate(model: Model, prompts, max_new_tokens: int,
         run_params = cached[1]
     cache = init_cache(module, b, total, cache_dtype)
 
-    tokens0 = jnp.concatenate(
-        [prompts, jnp.zeros((b, int(max_new_tokens)), prompts.dtype)],
-        axis=1)
-
-    # one compiled scan per (model, shape, sampling) configuration — cached
-    # on the Model so a serving loop pays trace+compile once, like
-    # Model.predict's cached forward
+    # one compiled program per (model, shape, sampling) configuration —
+    # cached on the Model so a serving loop pays trace+compile once, like
+    # Model.predict's cached forward. Round 4: the program is a batched
+    # PREFILL over the whole prompt (one flash pass; see ``prefill``)
+    # followed by a decode-only scan over the new tokens — replaying the
+    # prompt through the sequential scan made long prompts O(P) device
+    # steps instead of O(1) kernel passes.
     key = (b, p_len, int(max_new_tokens), float(temperature), top_k,
            jnp.dtype(cache_dtype).name, stop_token,
            None if weights_dtype is None
@@ -311,26 +459,39 @@ def generate(model: Model, prompts, max_new_tokens: int,
         jit_cache = model._jit_generate = {}
     run = jit_cache.get(key)
     if run is None:
-        int8 = scales is not None
+        int8w = scales is not None
+
+        def live_params(params, run_scales):
+            if not int8w:
+                return params
+            # dequant INSIDE the traced region that consumes it (prefill
+            # pass / each scan step): q*scale fuses into the matmul
+            # reads, so weight HBM traffic stays int8. scales are TRACED
+            # args, not closure constants — re-quantized params after a
+            # weight update must not meet a stale baked-in scale tree
+            from distkeras_tpu.models.quantize import dequantize_params
+            return dequantize_params(params, run_scales)
 
         @jax.jit
-        def run(params, run_scales, state, tokens, cache, rng):
-            done0 = jnp.zeros((b,), bool)
+        def run(params, run_scales, state, prompts, cache, rng):
+            last_logits, cache = prefill(module,
+                                         live_params(params, run_scales),
+                                         state, cache, prompts)
+            rng, sub = jax.random.split(rng)
+            first = _sample(last_logits, temperature, top_k, sub)
+            done = jnp.zeros((b,), bool)
+            if stop_token is not None:
+                done = first == stop_token
+            tokens = jnp.concatenate(
+                [prompts,
+                 jnp.zeros((b, int(max_new_tokens)), prompts.dtype)],
+                axis=1)
+            tokens = lax.dynamic_update_slice_in_dim(
+                tokens, first[:, None].astype(tokens.dtype), p_len, axis=1)
 
             def body(carry, t):
                 tokens, cache, rng, done = carry
-                if int8:
-                    # dequant INSIDE the body: q*scale fuses into each
-                    # step's matmul reads, so HBM traffic stays int8.
-                    # scales are TRACED args, not closure constants —
-                    # re-quantized params after a weight update must not
-                    # meet a stale baked-in scale tree (quantize.py's
-                    # predict makes the same choice)
-                    from distkeras_tpu.models.quantize import \
-                        dequantize_params
-                    p = dequantize_params(params, run_scales)
-                else:
-                    p = params
+                p = live_params(params, run_scales)
                 tok = lax.dynamic_slice_in_dim(tokens, t, 1, axis=1)[:, 0]
                 logits, cache = decode_step(module, p, state, cache,
                                             tok, t)
@@ -338,25 +499,21 @@ def generate(model: Model, prompts, max_new_tokens: int,
                 nxt = _sample(logits, temperature, top_k, sub)
                 if stop_token is not None:
                     nxt = jnp.where(done, stop_token, nxt)
-                # teacher-force inside the prompt; write samples after it
-                cur = lax.dynamic_slice_in_dim(tokens, t + 1, 1,
-                                               axis=1)[:, 0]
-                in_prompt = t + 1 < p_len
-                nxt = jnp.where(in_prompt, cur, nxt).astype(tokens.dtype)
-                if stop_token is not None:
-                    done = done | (~in_prompt & (nxt == stop_token))
+                    done = done | (nxt == stop_token)
                 tokens = lax.dynamic_update_slice_in_dim(
-                    tokens, nxt[:, None], t + 1, axis=1)
+                    tokens, nxt[:, None].astype(tokens.dtype), t + 1,
+                    axis=1)
                 return (tokens, cache, rng, done), None
 
             (tokens, _, _, _), _ = lax.scan(
-                body, (tokens, cache, rng, done0), jnp.arange(total - 1))
+                body, (tokens, cache, rng, done),
+                jnp.arange(p_len, total - 1))
             return tokens
 
         jit_cache[key] = run
 
     out = run(run_params, {} if scales is None else scales, model.state,
-              tokens0, cache, jax.random.PRNGKey(seed))
+              prompts, cache, jax.random.PRNGKey(seed))
     # as_numpy=False skips the device->host sync: serving loops that
     # pipeline several generate calls only pay one round trip at the end
     # (on tunneled backends the per-call sync is ~100 ms — bench.py

@@ -163,7 +163,8 @@ def transformer_lm(vocab_size: int, d_model: int = 512, num_heads: int = 8,
                    moe_expert_axis: Optional[str] = None,
                    moe_aux_loss_weight: float = 0.0,
                    moe_dispatch: str = "dense",
-                   moe_capacity_factor: float = 1.25) -> Sequential:
+                   moe_capacity_factor: float = 1.25,
+                   remat: Optional[str] = None) -> Sequential:
     """Decoder-only causal transformer LM — the long-context flagship.
 
     Absent from the reference (no attention models; SURVEY §5.7); this is
@@ -177,6 +178,10 @@ def transformer_lm(vocab_size: int, d_model: int = 512, num_heads: int = 8,
     of all ``num_experts`` — see ``models/moe.py``).
     ``num_kv_heads < num_heads`` builds a grouped-query (GQA) model — the
     KV cache at serving time shrinks by the group factor.
+    ``remat`` wraps every transformer block in ``blocks.Remat`` with that
+    checkpoint policy ("nothing" | "dots" | "dots_no_batch") — the
+    explicit activation-memory policy for deep/long-context training
+    (see ``Remat``'s docstring for the trade-offs).
     """
     from distkeras_tpu.models.attention import (
         LayerNorm, PositionalEmbedding, RMSNorm, TransformerBlock)
@@ -198,12 +203,16 @@ def transformer_lm(vocab_size: int, d_model: int = 512, num_heads: int = 8,
                             aux_loss_weight=moe_aux_loss_weight,
                             dispatch=moe_dispatch,
                             capacity_factor=moe_capacity_factor)
-        layers.append(TransformerBlock(
+        block = TransformerBlock(
             num_heads, mlp_ratio=mlp_ratio, causal=True, use_rope=use_rope,
             norm=norm, dtype=dtype, attn_impl=attn_impl,
             seq_axis_name=seq_axis_name, mlp_layer=mlp_layer,
             num_kv_heads=num_kv_heads, rope_scale=rope_scale,
-            attn_window=attn_window))
+            attn_window=attn_window)
+        if remat is not None:
+            from distkeras_tpu.models.blocks import Remat
+            block = Remat(block, policy=remat)
+        layers.append(block)
     layers.append(RMSNorm() if norm == "rmsnorm" else LayerNorm())
     layers.append(Dense(vocab_size, use_bias=False, dtype=dtype))
     return Sequential(layers)

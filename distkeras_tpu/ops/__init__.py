@@ -14,7 +14,8 @@ def __getattr__(name):
         return flash_attention
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 from distkeras_tpu.ops.losses import (  # noqa: F401
-    LOSSES, get_loss, with_class_weight, with_label_smoothing)
+    LOSSES, fused_linear_cross_entropy, get_loss, with_class_weight,
+    with_label_smoothing)
 from distkeras_tpu.ops.metrics import METRICS, get_metric  # noqa: F401
 from distkeras_tpu.ops.optimizers import (  # noqa: F401
     OPTIMIZERS, Optimizer, apply_updates, get_optimizer)

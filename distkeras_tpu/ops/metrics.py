@@ -20,7 +20,12 @@ def _class_vectors(y_true, y_pred):
     """Normalize (labels, predictions) to flat integer class vectors.
 
     Handles one-hot or integer ``y_true`` and probability/logit vectors,
-    sigmoid scores, or integer predictions in ``y_pred``. Binary float
+    sigmoid scores, or integer predictions in ``y_pred``. One-hot label
+    encodings must be FLOATING-point (what ``to_categorical`` produces):
+    an integer ``[B, C]`` label array is always read as per-position
+    class ids, never argmaxed — integer one-hot labels would be silently
+    misread, so cast them to float (or ``argmax`` them) first (ADVICE
+    r3). Binary float
     scores are thresholded at 0.5 when they look like probabilities (all
     values in [0, 1]) and at 0.0 otherwise (logits); the check is a traced
     scalar select, so it stays jit-compatible. Returns ``(t, p, k)`` where

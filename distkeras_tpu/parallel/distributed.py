@@ -142,6 +142,17 @@ class DistributedTrainer(Trainer):
                         # on a big model), matching shard_epoch_data's
                         # drop_remainder batching policy
                         chunk = S // pf
+                        if chunk * pf < S:
+                            import warnings
+                            warnings.warn(
+                                f"parallelism_factor={pf}: epoch has {S} "
+                                f"steps/worker; the trailing "
+                                f"{S - chunk * pf} steps are dropped every "
+                                "epoch (equal-length partitions avoid a "
+                                "second epoch-program compile). Size the "
+                                "dataset so steps/worker divides by "
+                                "parallelism_factor to train on all of "
+                                "it.", stacklevel=2)
                         l_acc, m_acc = [], []
                         for j in range(pf):
                             lo, hi = j * chunk, (j + 1) * chunk

@@ -459,10 +459,18 @@ class PipelineTrainer:
                 f"data axes {self.data_axes} (size {dp})")
         local_b = self.batch_size // dp
         if local_b % self.lm.num_microbatches:
+            hint = ""
+            if self.lm.num_microbatches == 4 and local_b % 2 == 0:
+                # targeted migration error: the default changed 2 -> 4 in
+                # round 3 (ADVICE r3) — callers sized for the old default
+                # get told exactly what to pass instead of a bare reshape
+                hint = (" (note: PipelinedLM's num_microbatches DEFAULT "
+                        "changed 2 -> 4; pass num_microbatches=2 to keep "
+                        "the old behavior)")
             raise ValueError(
                 f"per-worker batch {local_b} (batch_size {self.batch_size} "
                 f"/ dp {dp}) must divide into num_microbatches="
-                f"{self.lm.num_microbatches}")
+                f"{self.lm.num_microbatches}{hint}")
         if self.seq_axis:
             sp = self.mesh.shape[self.seq_axis]
             if X.shape[1] % sp:

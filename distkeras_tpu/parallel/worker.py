@@ -36,10 +36,49 @@ class TrainCarry(NamedTuple):
     rng: jax.Array
 
 
+def _fused_head_parts(module, loss_fn, metric_fns):
+    """Validate + split a model for ``fused_vocab_head`` training.
+
+    Returns ``(trunk, ignore_index, compute_dtype)`` where ``trunk`` is
+    the model minus its final vocab projection (whose kernel,
+    ``params[-1]["kernel"]``, feeds the fused loss directly).
+    """
+    from distkeras_tpu.models.core import Sequential
+    from distkeras_tpu.models.layers import Dense
+    from distkeras_tpu.ops import losses as L
+
+    if metric_fns:
+        raise ValueError(
+            "fused_vocab_head=True cannot compute per-batch metric_fns: "
+            "the logits tensor is never materialized. Evaluate metrics "
+            "separately (inference.evaluators) or disable the fusion.")
+    if not isinstance(module, Sequential) or not module.layers:
+        raise ValueError("fused_vocab_head needs a Sequential model")
+    head = module.layers[-1]
+    if not (isinstance(head, Dense) and not head.use_bias
+            and head.activation is None):
+        raise ValueError(
+            "fused_vocab_head needs the final layer to be "
+            "Dense(use_bias=False, activation=None); got "
+            f"{head!r}")
+    if loss_fn is L.sparse_categorical_crossentropy_from_logits:
+        ignore_index = None
+    elif loss_fn is L.masked_sparse_categorical_crossentropy_from_logits:
+        ignore_index = -1
+    else:
+        raise ValueError(
+            "fused_vocab_head supports loss="
+            "'sparse_categorical_crossentropy_from_logits' or its "
+            "masked_ variant; got "
+            f"{getattr(loss_fn, '__name__', loss_fn)!r}")
+    return Sequential(module.layers[:-1]), ignore_index, head.dtype
+
+
 def make_train_step(module, loss_fn: Callable, optimizer: Optimizer,
                     metric_fns: Optional[dict] = None,
                     accum_steps: int = 1,
-                    param_mask=None, state_mask=None) -> Callable:
+                    param_mask=None, state_mask=None,
+                    fused_vocab_head=False) -> Callable:
     """Build the per-minibatch step: grad -> optimizer update -> new carry.
 
     Equivalent role to one ``model.train_on_batch`` call in the reference
@@ -67,13 +106,44 @@ def make_train_step(module, loss_fn: Callable, optimizer: Optimizer,
     not fit HBM. Identical math to the full-batch step (the mean of equal
     microbatch means is the batch mean); model state (BN stats) threads
     through the microbatches in order.
+
+    ``fused_vocab_head=True`` (or an int = explicit token-chunk count)
+    fuses the model's FINAL bias-free ``Dense``
+    projection into a chunked cross-entropy
+    (``ops.losses.fused_linear_cross_entropy``) so the ``[B*S, vocab]``
+    logits tensor is never materialized — the memory/bandwidth lever for
+    large-vocab LMs. Requires a ``Sequential`` ending in
+    ``Dense(use_bias=False, activation=None)`` and a sparse-from-logits
+    loss (plain or masked); per-batch ``metric_fns`` are unavailable in
+    this mode (there are no logits to evaluate them on).
     """
     accum_steps = int(accum_steps)
     if accum_steps < 1:
         raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
 
+    fused = None
+    if fused_vocab_head:
+        fused = _fused_head_parts(module, loss_fn, metric_fns)
+        # fused_vocab_head=True -> default chunking; an int picks the
+        # token-chunk count explicitly (perf knob, see docs/PERF.md)
+        fused_chunks = (8 if fused_vocab_head is True
+                        else int(fused_vocab_head))
+
     def grad_of(params, state, xb, yb, sub):
         def objective(params):
+            if fused is not None:
+                trunk, ignore_index, cdt = fused
+                hidden, t_state = trunk.apply(
+                    params[:-1], state[:-1], xb, training=True, rng=sub)
+                from distkeras_tpu.ops.losses import \
+                    fused_linear_cross_entropy
+                loss = fused_linear_cross_entropy(
+                    hidden, params[-1]["kernel"], yb,
+                    num_chunks=fused_chunks,
+                    ignore_index=ignore_index, compute_dtype=cdt)
+                new_state = list(t_state) + [state[-1]]
+                return loss + collect_aux_losses(new_state), \
+                    (new_state, None)
             out, new_state = module.apply(params, state, xb,
                                           training=True, rng=sub)
             # layer-published auxiliary losses (models.core.AUX_LOSS_KEY,

@@ -1,0 +1,249 @@
+"""Fused vocab-projection + chunked cross-entropy (round 4).
+
+The fused path (``ops.losses.fused_linear_cross_entropy`` +
+``make_train_step(fused_vocab_head=True)``) must be EXACTLY the same math
+as the unfused Dense-then-CE path — only the materialization schedule
+changes. Oracles here are the unfused registry losses and an unfused
+train step run in f32 (where chunked f32 accumulation vs one-shot
+log_softmax agree to float rounding).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distkeras_tpu.models import Dense, Model, Sequential, zoo
+from distkeras_tpu.ops import get_loss, get_optimizer
+from distkeras_tpu.ops.losses import (
+    fused_linear_cross_entropy,
+    masked_sparse_categorical_crossentropy_from_logits,
+    sparse_categorical_crossentropy_from_logits)
+from distkeras_tpu.parallel.worker import TrainCarry, make_train_step
+
+
+def _problem(B=2, S=16, D=8, V=37, seed=0):
+    rs = np.random.RandomState(seed)
+    h = jnp.asarray(rs.randn(B, S, D), jnp.float32)
+    w = jnp.asarray(rs.randn(D, V) * 0.1, jnp.float32)
+    y = jnp.asarray(rs.randint(0, V, (B, S)))
+    return h, w, y
+
+
+@pytest.mark.parametrize("num_chunks", [1, 4, 7])
+def test_fused_ce_matches_unfused_value_and_grads(num_chunks):
+    h, w, y = _problem()
+
+    def fused(h, w):
+        return fused_linear_cross_entropy(h, w, y, num_chunks=num_chunks,
+                                          compute_dtype=jnp.float32)
+
+    def unfused(h, w):
+        return sparse_categorical_crossentropy_from_logits(
+            y, jnp.einsum("bsd,dv->bsv", h, w))
+
+    np.testing.assert_allclose(float(fused(h, w)), float(unfused(h, w)),
+                               rtol=1e-6)
+    gf = jax.grad(fused, argnums=(0, 1))(h, w)
+    gu = jax.grad(unfused, argnums=(0, 1))(h, w)
+    for a, b in zip(gf, gu):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+
+def test_fused_ce_masked_matches_and_counts_only_live_tokens():
+    h, w, y = _problem(seed=3)
+    ym = np.asarray(y).copy()
+    ym[0, :9] = -1          # straddles chunk boundaries at num_chunks=4
+    ym = jnp.asarray(ym)
+
+    def fused(h, w):
+        return fused_linear_cross_entropy(h, w, ym, num_chunks=4,
+                                          ignore_index=-1,
+                                          compute_dtype=jnp.float32)
+
+    def unfused(h, w):
+        return masked_sparse_categorical_crossentropy_from_logits(
+            ym, jnp.einsum("bsd,dv->bsv", h, w))
+
+    np.testing.assert_allclose(float(fused(h, w)), float(unfused(h, w)),
+                               rtol=1e-6)
+    gf = jax.grad(fused, argnums=(0, 1))(h, w)
+    gu = jax.grad(unfused, argnums=(0, 1))(h, w)
+    for a, b in zip(gf, gu):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+    # fully-ignored input: finite zero loss, zero grads (no NaN from 0/0)
+    all_ig = jnp.full_like(ym, -1)
+    lz, gz = jax.value_and_grad(
+        lambda h: fused_linear_cross_entropy(
+            h, w, all_ig, ignore_index=-1, compute_dtype=jnp.float32))(h)
+    assert float(lz) == 0.0
+    assert float(jnp.max(jnp.abs(gz))) == 0.0
+
+
+def test_fused_ce_bf16_close_to_f32_oracle():
+    h, w, y = _problem(B=2, S=32, D=16, V=64, seed=1)
+    lb = fused_linear_cross_entropy(h.astype(jnp.bfloat16), w, y,
+                                    compute_dtype=jnp.bfloat16)
+    lf = sparse_categorical_crossentropy_from_logits(
+        y, jnp.einsum("bsd,dv->bsv", h, w))
+    assert abs(float(lb) - float(lf)) < 0.05
+
+
+def test_fused_ce_chunk_padding_on_indivisible_n():
+    """N = 30 tokens at num_chunks=8 pads to 32 with label -1 (never
+    degrades the chunk count — review r4 finding): value AND grads match
+    the unfused oracle exactly, pads contribute nothing."""
+    h, w, y = _problem(B=2, S=15, D=8, V=11, seed=2)
+
+    def fused(h, w):
+        return fused_linear_cross_entropy(h, w, y, num_chunks=8,
+                                          compute_dtype=jnp.float32)
+
+    def unfused(h, w):
+        return sparse_categorical_crossentropy_from_logits(
+            y, jnp.einsum("bsd,dv->bsv", h, w))
+
+    np.testing.assert_allclose(float(fused(h, w)), float(unfused(h, w)),
+                               rtol=1e-6)
+    gf = jax.grad(fused, argnums=(0, 1))(h, w)
+    gu = jax.grad(unfused, argnums=(0, 1))(h, w)
+    for a, b in zip(gf, gu):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+
+def test_fused_ce_ignores_any_negative_label_like_masked_loss():
+    """The masked contract is labels < 0 (not just == -1): a -100
+    padding convention must be dropped identically to the unfused
+    masked loss (review r4 finding)."""
+    h, w, y = _problem(seed=5)
+    ym = np.asarray(y).copy()
+    ym[0, :5] = -100
+    ym[1, 3:7] = -1
+    ym = jnp.asarray(ym)
+
+    def fused(h, w):
+        return fused_linear_cross_entropy(h, w, ym, num_chunks=4,
+                                          ignore_index=-1,
+                                          compute_dtype=jnp.float32)
+
+    def unfused(h, w):
+        return masked_sparse_categorical_crossentropy_from_logits(
+            ym, jnp.einsum("bsd,dv->bsv", h, w))
+
+    np.testing.assert_allclose(float(fused(h, w)), float(unfused(h, w)),
+                               rtol=1e-6)
+    gf = jax.grad(fused, argnums=(0, 1))(h, w)
+    gu = jax.grad(unfused, argnums=(0, 1))(h, w)
+    for a, b in zip(gf, gu):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+    with pytest.raises(ValueError, match="negative sentinel"):
+        fused_linear_cross_entropy(h, w, ym, ignore_index=7)
+
+
+def _lm_fixture(dtype="float32", remat=None, V=64, S=16):
+    module = zoo.transformer_lm(V, d_model=32, num_heads=4, num_layers=2,
+                                mlp_ratio=2, use_rope=True, dtype=dtype,
+                                attn_impl="xla", remat=remat)
+    model = Model.build(module, (S,), seed=0)
+    rs = np.random.RandomState(0)
+    xb = jnp.asarray(rs.randint(0, V, (4, S)))
+    yb = jnp.asarray(rs.randint(0, V, (4, S)))
+    return module, model, xb, yb
+
+
+def _run_steps(module, model, xb, yb, n=3, **kw):
+    opt = get_optimizer("adam", learning_rate=1e-3)
+    loss = get_loss("sparse_categorical_crossentropy_from_logits")
+    step = jax.jit(make_train_step(module, loss, opt, **kw))
+    c = TrainCarry(model.params, model.state, opt.init(model.params),
+                   jax.random.PRNGKey(0))
+    losses = []
+    for _ in range(n):
+        c, l = step(c, (xb, yb))
+        losses.append(float(l))
+    return losses, c.params
+
+
+def test_train_step_fused_head_matches_unfused_trajectory():
+    module, model, xb, yb = _lm_fixture()
+    lu, pu = _run_steps(module, model, xb, yb, fused_vocab_head=False)
+    lf, pf = _run_steps(module, model, xb, yb, fused_vocab_head=True)
+    np.testing.assert_allclose(lu, lf, rtol=1e-5)
+    for a, b in zip(jax.tree_util.tree_leaves(pu),
+                    jax.tree_util.tree_leaves(pf)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+@pytest.mark.parametrize("policy", ["nothing", "dots", "dots_no_batch"])
+def test_remat_policies_match_no_remat_trajectory(policy):
+    module, model, xb, yb = _lm_fixture()
+    mr, modelr, _, _ = _lm_fixture(remat=policy)
+    lu, pu = _run_steps(module, model, xb, yb, fused_vocab_head=True)
+    lr, pr = _run_steps(mr, modelr, xb, yb, fused_vocab_head=True)
+    np.testing.assert_allclose(lu, lr, rtol=1e-5)
+    for a, b in zip(jax.tree_util.tree_leaves(pu),
+                    jax.tree_util.tree_leaves(pr)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+def test_remat_policy_serialization_roundtrip():
+    from distkeras_tpu.models.blocks import Remat
+    from distkeras_tpu.models.core import layer_from_spec, layer_spec
+    r = Remat(Dense(8, use_bias=False), policy="dots")
+    r2 = layer_from_spec(layer_spec(r))
+    assert r2.policy == "dots"
+    with pytest.raises(ValueError, match="unknown remat policy"):
+        Remat(Dense(8), policy="everything")
+
+
+def test_fused_head_validation_errors():
+    module, model, xb, yb = _lm_fixture()
+    opt = get_optimizer("adam", learning_rate=1e-3)
+    ce = get_loss("sparse_categorical_crossentropy_from_logits")
+    with pytest.raises(ValueError, match="metric_fns"):
+        make_train_step(module, ce, opt, fused_vocab_head=True,
+                        metric_fns={"acc": lambda a, b: 0.0})
+    with pytest.raises(ValueError, match="sparse"):
+        make_train_step(module, get_loss("mse"), opt,
+                        fused_vocab_head=True)
+    biased = Sequential([Dense(8), Dense(11)])  # head has a bias
+    with pytest.raises(ValueError, match="use_bias=False"):
+        make_train_step(biased, ce, opt, fused_vocab_head=True)
+
+
+def test_fused_head_masked_loss_ignores_padding():
+    module, model, xb, yb = _lm_fixture()
+    opt = get_optimizer("sgd", learning_rate=1e-2)
+    mce = get_loss("masked_sparse_categorical_crossentropy_from_logits")
+    ym = np.asarray(yb).copy()
+    ym[:, -5:] = -1
+    ym = jnp.asarray(ym)
+    step_f = jax.jit(make_train_step(module, mce, opt,
+                                     fused_vocab_head=True))
+    step_u = jax.jit(make_train_step(module, mce, opt,
+                                     fused_vocab_head=False))
+    c0 = TrainCarry(model.params, model.state, opt.init(model.params),
+                    jax.random.PRNGKey(0))
+    _, lf = step_f(c0, (xb, ym))
+    _, lu = step_u(c0, (xb, ym))
+    np.testing.assert_allclose(float(lf), float(lu), rtol=1e-5)
+
+
+def test_fused_head_under_dp_pjit():
+    """GSPMD compatibility: batch-sharded fused loss on the 8-device mesh."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    module, model, xb, yb = _lm_fixture()
+    opt = get_optimizer("adam", learning_rate=1e-3)
+    ce = get_loss("sparse_categorical_crossentropy_from_logits")
+    step = make_train_step(module, ce, opt, fused_vocab_head=True)
+    mesh = Mesh(np.array(jax.devices()[:4]), ("dp",))
+    with mesh:
+        sh = NamedSharding(mesh, P("dp"))
+        xs = jax.device_put(xb, sh)
+        ys = jax.device_put(yb, sh)
+        c = TrainCarry(model.params, model.state, opt.init(model.params),
+                       jax.random.PRNGKey(0))
+        c, l = jax.jit(step)(c, (xs, ys))
+    lu, _ = _run_steps(module, model, xb, yb, n=1, fused_vocab_head=True)
+    np.testing.assert_allclose(float(l), lu[0], rtol=1e-5)
