@@ -58,11 +58,15 @@ def _decode_block_of(layer):
 
 def init_cache(module: Sequential, batch: int, max_len: int,
                dtype=jnp.float32):
-    """Per-layer KV buffers ([B, max_len, H, Dh]) mirroring the Sequential;
-    non-attention layers get ``None``.
+    """Per-layer KV buffers ([B, H, max_len, Dh]) mirroring the Sequential;
+    non-attention layers get ``None``. The HEAD-major layout (round 4)
+    keeps each head's [L, Dh] plane contiguous, so the per-step cache
+    einsums read full DMA lines — the token-major [B, L, H, Dh] layout
+    made every head read a 128-byte strided gather (~1/4 effective HBM
+    bandwidth measured at L=2113 on v5e).
 
     ``dtype="int8"`` (round 4) builds a QUANTIZED cache: int8 k/v plus f32
-    per-token-per-head scales ([B, max_len, H]) — each written entry
+    per-token-per-head scales ([B, H, max_len]) — each written entry
     stores ``round(x / scale) * scale`` with ``scale = max|x| / 127`` over
     its head vector. At long contexts the cache read dominates the decode
     roofline (docs/PERF.md), so int8 halves the dominant term vs bf16;
@@ -92,7 +96,7 @@ def init_cache(module: Sequential, batch: int, max_len: int,
                 raise ValueError(
                     "init_cache needs head_dim; build the model first "
                     "(Model.build resolves it) or pass head_dim explicitly")
-            shape = (batch, max_len, h, dh)
+            shape = (batch, h, max_len, dh)
             if int8:
                 cache.append({
                     "k": jnp.zeros(shape, jnp.int8),
@@ -126,33 +130,67 @@ def _quantize_kv(x):
 
 
 def _cache_write(kv, k, v, t):
-    """Write one [B, S_w, H, Dh] k/v slab at position ``t`` (S_w = 1 for
-    decode steps, P for prefill), quantizing if the cache is int8."""
+    """Write one [B, S_w, H, Dh] k/v slab (BSHD, as projected) at
+    position ``t`` (S_w = 1 for decode steps, P for prefill) into the
+    head-major [B, H, L, Dh] cache, quantizing if it is int8."""
+    kh = k.transpose(0, 2, 1, 3)
+    vh = v.transpose(0, 2, 1, 3)
     if "k_scale" in kv:
-        qk, sk = _quantize_kv(k)
-        qv, sv = _quantize_kv(v)
+        qk, sk = _quantize_kv(kh)
+        qv, sv = _quantize_kv(vh)
         return {
-            "k": lax.dynamic_update_slice_in_dim(kv["k"], qk, t, axis=1),
-            "v": lax.dynamic_update_slice_in_dim(kv["v"], qv, t, axis=1),
+            "k": lax.dynamic_update_slice_in_dim(kv["k"], qk, t, axis=2),
+            "v": lax.dynamic_update_slice_in_dim(kv["v"], qv, t, axis=2),
             "k_scale": lax.dynamic_update_slice_in_dim(
-                kv["k_scale"], sk, t, axis=1),
+                kv["k_scale"], sk, t, axis=2),
             "v_scale": lax.dynamic_update_slice_in_dim(
-                kv["v_scale"], sv, t, axis=1)}
+                kv["v_scale"], sv, t, axis=2)}
     return {"k": lax.dynamic_update_slice_in_dim(
-                kv["k"], k.astype(kv["k"].dtype), t, axis=1),
+                kv["k"], kh.astype(kv["k"].dtype), t, axis=2),
             "v": lax.dynamic_update_slice_in_dim(
-                kv["v"], v.astype(kv["v"].dtype), t, axis=1)}
+                kv["v"], vh.astype(kv["v"].dtype), t, axis=2)}
 
 
-def _cache_kv_f32(kv):
-    """The cache's (k, v) as f32 expressions. For an int8 cache the
-    dequant (``q * scale``) is built HERE but materializes nowhere: XLA
-    fuses it into the consuming einsum's reads, so HBM traffic stays
-    int8 + scales (the same fusion contract as int8 serving weights)."""
+def _int8_mm_dtype():
+    """Matmul dtype for the int8-dequant cache contractions: bf16 on TPU
+    (native MXU mode), f32 elsewhere (CPU XLA's dot runtime has no
+    bf16xbf16->f32 kernel)."""
+    return jnp.bfloat16 if jax.default_backend() == "tpu" else jnp.float32
+
+
+def _decode_scores(qg, kv):
+    """[B, 1, Hkv, G, D] f32 queries x cache -> [B, Hkv, G, 1, L] f32
+    scores, matmul'ing in the cache's STORAGE dtype with f32 accumulation.
+    Casting the cache itself up to f32 (the round-3 form) materializes a
+    full-cache f32 copy per layer per step — 3x the HBM traffic the
+    cache was shrunk to avoid. For int8 the per-token scale factors out
+    of the D-contraction (s = kscale_k * <qg, k_int8>), so the payload
+    read stays int8 and the scale applies on the tiny [.., L] scores."""
     if "k_scale" in kv:
-        return (kv["k"].astype(jnp.float32) * kv["k_scale"][..., None],
-                kv["v"].astype(jnp.float32) * kv["v_scale"][..., None])
-    return kv["k"].astype(jnp.float32), kv["v"].astype(jnp.float32)
+        mdt = _int8_mm_dtype()
+        s = jnp.einsum("bqhgd,bhkd->bhgqk", qg.astype(mdt),
+                       kv["k"].astype(mdt),
+                       preferred_element_type=jnp.float32)
+        return s * kv["k_scale"][:, :, None, None, :]
+    cdt = kv["k"].dtype
+    return jnp.einsum("bqhgd,bhkd->bhgqk", qg.astype(cdt), kv["k"],
+                      preferred_element_type=jnp.float32)
+
+
+def _decode_mix(w, kv):
+    """[B, Hkv, G, 1, L] f32 probabilities x cached values ->
+    [B, 1, Hkv, G, D] f32, same storage-dtype contract as
+    ``_decode_scores`` (for int8 the value scale folds into the
+    probabilities BEFORE the matmul: <w * vscale, v_int8>)."""
+    if "v_scale" in kv:
+        mdt = _int8_mm_dtype()
+        ws = w * kv["v_scale"][:, :, None, None, :]
+        return jnp.einsum("bhgqk,bhkd->bqhgd", ws.astype(mdt),
+                          kv["v"].astype(mdt),
+                          preferred_element_type=jnp.float32)
+    cdt = kv["v"].dtype
+    return jnp.einsum("bhgqk,bhkd->bqhgd", w.astype(cdt), kv["v"],
+                      preferred_element_type=jnp.float32)
 
 
 def _resolve_head_dims(module: Sequential, params) -> None:
@@ -186,14 +224,13 @@ def _decode_attn(attn: MultiHeadAttention, p, kv, x, t):
     g = attn.num_heads // hkv
     qg = (q.astype(jnp.float32) * scale).reshape(
         b, 1, hkv, g, q.shape[-1])                       # [B, 1, Hkv, G, D]
-    kf, vf = _cache_kv_f32(kv)
-    s = jnp.einsum("bqhgd,bkhd->bhgqk", qg, kf)          # [B, Hkv, G, 1, L]
-    valid = jnp.arange(kv["k"].shape[1]) <= t
+    s = _decode_scores(qg, kv)                           # [B, Hkv, G, 1, L]
+    valid = jnp.arange(kv["k"].shape[2]) <= t
     if attn.attn_window is not None:
-        valid &= jnp.arange(kv["k"].shape[1]) > t - attn.attn_window
+        valid &= jnp.arange(kv["k"].shape[2]) > t - attn.attn_window
     s = jnp.where(valid[None, None, None, None, :], s, NEG_INF)
     w = jax.nn.softmax(s, axis=-1)
-    out = jnp.einsum("bhgqk,bkhd->bqhgd", w, vf).astype(dt)
+    out = _decode_mix(w, kv).astype(dt)
     out = out.reshape(b, 1, attn.num_heads, q.shape[-1])
     y = jnp.einsum("bshe,hed->bsd", out, p["wo"].astype(dt))
     return y.astype(x.dtype), kv
@@ -368,8 +405,16 @@ def generate(model: Model, prompts, max_new_tokens: int,
     prompts = jnp.asarray(prompts)
     if prompts.ndim != 2:
         raise ValueError(f"prompts must be [B, P], got {prompts.shape}")
+    max_new_tokens = int(max_new_tokens)
+    if max_new_tokens < 0:
+        raise ValueError(f"max_new_tokens must be >= 0, "
+                         f"got {max_new_tokens}")
+    if max_new_tokens == 0:
+        # nothing to generate; never run the clamped first-token write
+        # (it would overwrite the final prompt position — review r4)
+        return np.asarray(prompts) if as_numpy else prompts
     b, p_len = prompts.shape
-    total = p_len + int(max_new_tokens)
+    total = p_len + max_new_tokens
     _resolve_head_dims(module, model.params)
     for layer in module.layers:
         # out-of-range position gathers CLAMP under jit (silent wrong-
@@ -440,7 +485,9 @@ def generate(model: Model, prompts, max_new_tokens: int,
                       _serving_params(model.params, weights_dtype))
             cache_all[dt_key] = cached
         run_params = cached[1]
-    cache = init_cache(module, b, total, cache_dtype)
+    # shape/capacity validation runs eagerly (fail loudly BEFORE tracing);
+    # the actual buffers are created inside the compiled program below
+    init_cache(module, b, 1, cache_dtype)
 
     # one compiled program per (model, shape, sampling) configuration —
     # cached on the Model so a serving loop pays trace+compile once, like
@@ -473,7 +520,14 @@ def generate(model: Model, prompts, max_new_tokens: int,
             return dequantize_params(params, run_scales)
 
         @jax.jit
-        def run(params, run_scales, state, prompts, cache, rng):
+        def run(params, run_scales, state, prompts, rng):
+            # the cache is created INSIDE the compiled program (shapes
+            # are static): no multi-GB host-side zeros allocation per
+            # call, and XLA sees a single dead-on-exit buffer instead of
+            # distinct input+output copies — at P=8192 the bf16 cache is
+            # 3.2 GB, and the in+out pair was what pushed the long-
+            # context MHA program over the compile/memory edge (round 4)
+            cache = init_cache(module, b, total, cache_dtype)
             last_logits, cache = prefill(module,
                                          live_params(params, run_scales),
                                          state, cache, prompts)
@@ -513,7 +567,7 @@ def generate(model: Model, prompts, max_new_tokens: int,
         jit_cache[key] = run
 
     out = run(run_params, {} if scales is None else scales, model.state,
-              prompts, cache, jax.random.PRNGKey(seed))
+              prompts, jax.random.PRNGKey(seed))
     # as_numpy=False skips the device->host sync: serving loops that
     # pipeline several generate calls only pay one round trip at the end
     # (on tunneled backends the per-call sync is ~100 ms — bench.py

@@ -83,6 +83,44 @@ def _k_base(qi, block_q: int, block_k: int, nkw: int):
     return jnp.maximum(0, end - (nkw - 1))
 
 
+def _needs_mask(qi, kb, block_q: int, block_k: int, causal: bool,
+                window, k_len: int, has_seg: bool):
+    """Does the (qi, kb) tile intersect any mask edge? Returns Python
+    ``True`` when masking is unconditionally required (segment ids are
+    data-dependent), else a traced bool over the program ids. A causal
+    tile is mask-free when every query position >= every key position
+    (min q_pos >= max k_pos); a windowed tile when every key is within
+    every query's reach; the pad mask only touches the final key block.
+    """
+    if has_seg:
+        return True
+    need = None
+    if causal:
+        need = qi * block_q < kb * block_k + block_k - 1
+    if window is not None:
+        w_edge = kb * block_k <= qi * block_q + block_q - 1 - window
+        need = w_edge if need is None else (need | w_edge)
+    if k_len % block_k:
+        pad_edge = (kb + 1) * block_k > k_len
+        need = pad_edge if need is None else (need | pad_edge)
+    if need is None:
+        return False        # non-causal, no window, no padding: clear
+    return need
+
+
+def _mask_dispatch(run, need, masked_fn, clear_fn):
+    """Emit the masked and/or clear tile bodies under ``pl.when`` guards
+    per ``_needs_mask``'s verdict (Python bool = one static body; traced
+    bool = both bodies, selected per tile at run time)."""
+    if need is True:
+        pl.when(run)(masked_fn)
+    elif need is False:
+        pl.when(run)(clear_fn)
+    else:
+        pl.when(jnp.logical_and(run, need))(masked_fn)
+        pl.when(jnp.logical_and(run, jnp.logical_not(need)))(clear_fn)
+
+
 def _fwd_kernel(*refs, scale: float, causal: bool, k_len: int,
                 window=None, nkw=None, has_seg: bool = False):
     """One (batch*head, q_block, k_block) program.
@@ -124,16 +162,17 @@ def _fwd_kernel(*refs, scale: float, causal: bool, k_len: int,
         run = jnp.logical_and(
             run, kb * block_k + block_k - 1 > qi * block_q - window)
 
-    @pl.when(run)
-    def _compute():
+    def _scores():
         # matmul inputs stay in the STORED dtype (bf16 for bf16 models)
         # with f32 accumulation — the MXU's native mode. Upcasting inputs
         # to f32 forces multi-pass f32 matmuls (~3-6x slower); round 4
         # measured the f32-input kernel at ~22% MXU on v5e. Scale is
         # applied to the f32 scores, not the bf16 q, so no precision is
         # lost relative to the old `q.astype(f32) * scale` form.
-        s = lax.dot_general(q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32) * scale
+        return lax.dot_general(q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
+                               preferred_element_type=jnp.float32) * scale
+
+    def _mask(s):
         q_pos = (qi * block_q +
                  lax.broadcasted_iota(jnp.int32, s.shape, 0))
         k_pos = (kb * block_k +
@@ -148,6 +187,9 @@ def _fwd_kernel(*refs, scale: float, causal: bool, k_len: int,
         if qseg_ref is not None:
             same = qseg_ref[0, :, 0][:, None] == kseg_ref[0, :, 0][None, :]
             s = jnp.where(same, s, NEG_INF)
+        return s
+
+    def _merge(s):
         m_prev, l_prev, acc_prev = m_ref[:], l_ref[:], acc_ref[:]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
         alpha = jnp.exp(m_prev - m_new)
@@ -160,6 +202,18 @@ def _fwd_kernel(*refs, scale: float, causal: bool, k_len: int,
         acc_ref[:] = acc_prev * alpha + lax.dot_general(
             p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
+
+    # tile-static mask specialization (round 4): the kernels are
+    # VPU-bound, not MXU-bound (measured — the bf16-input change moved
+    # nothing), so interior tiles skip the whole iota/compare/select
+    # chain. A tile needs masking only if the causal diagonal, the
+    # window's trailing edge, or the key padding actually intersects it
+    # — a predicate of the program ids.
+    need = _needs_mask(qi, kb, block_q, block_k, causal, window, k_len,
+                       has_seg)
+    _mask_dispatch(run, need,
+                   lambda: _merge(_mask(_scores())),
+                   lambda: _merge(_scores()))
 
     @pl.when(ki == nk - 1)
     def _finalize():
@@ -319,12 +373,7 @@ def _bwd_dq_kernel(*refs, scale: float, causal: bool, k_len: int,
             run, kb * block_k + block_k - 1
             > qi * block_q - window)
 
-    @pl.when(run)
-    def _compute():
-        # bf16 matmul inputs + f32 accumulation throughout (see
-        # _fwd_kernel); scale folds into the f32 score/grad tensors
-        s = lax.dot_general(q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32) * scale
+    def _mask(s):
         q_pos = qi * block_q + lax.broadcasted_iota(jnp.int32, s.shape, 0)
         k_pos = kb * block_k + lax.broadcasted_iota(jnp.int32, s.shape, 1)
         if causal:
@@ -336,6 +385,15 @@ def _bwd_dq_kernel(*refs, scale: float, causal: bool, k_len: int,
         if qseg_ref is not None:
             same = qseg_ref[0, :, 0][:, None] == kseg_ref[0, :, 0][None, :]
             s = jnp.where(same, s, NEG_INF)
+        return s
+
+    def _compute(mask):
+        # bf16 matmul inputs + f32 accumulation throughout (see
+        # _fwd_kernel); scale folds into the f32 score/grad tensors
+        s = lax.dot_general(q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+        if mask:
+            s = _mask(s)
         p = jnp.exp(s - lse_ref[0])                        # [bq, bk]
         dp = lax.dot_general(g_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
                              preferred_element_type=jnp.float32)
@@ -343,6 +401,11 @@ def _bwd_dq_kernel(*refs, scale: float, causal: bool, k_len: int,
         dq_acc[:] += lax.dot_general(
             ds, k_ref[0], (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
+
+    need = _needs_mask(qi, kb, block_q, block_k, causal, window, k_len,
+                       qseg_ref is not None)
+    _mask_dispatch(run, need,
+                   lambda: _compute(True), lambda: _compute(False))
 
     @pl.when(ki == nk - 1)
     def _finalize():
@@ -404,13 +467,7 @@ def _bwd_dkv_kernel(*refs, scale: float, causal: bool, k_len: int,
             run, qb * block_q
             < ki * block_k + block_k - 1 + window)
 
-    @pl.when(run)
-    def _compute():
-        # bf16 matmul inputs + f32 accumulation (see _fwd_kernel); the
-        # dk contribution applies scale to the f32 accumulator instead of
-        # pre-scaling q (dot(ds, q*scale) == scale * dot(ds, q))
-        s = lax.dot_general(q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32) * scale
+    def _mask(s):
         q_pos = qb * block_q + lax.broadcasted_iota(jnp.int32, s.shape, 0)
         k_pos = ki * block_k + lax.broadcasted_iota(jnp.int32, s.shape, 1)
         if causal:
@@ -422,6 +479,16 @@ def _bwd_dkv_kernel(*refs, scale: float, causal: bool, k_len: int,
         if qseg_ref is not None:
             same = qseg_ref[0, :, 0][:, None] == kseg_ref[0, :, 0][None, :]
             s = jnp.where(same, s, NEG_INF)
+        return s
+
+    def _compute(mask):
+        # bf16 matmul inputs + f32 accumulation (see _fwd_kernel); the
+        # dk contribution applies scale to the f32 accumulator instead of
+        # pre-scaling q (dot(ds, q*scale) == scale * dot(ds, q))
+        s = lax.dot_general(q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+        if mask:
+            s = _mask(s)
         p = jnp.exp(s - lse_ref[0])                        # [bq, bk]
         dv_acc[:] += lax.dot_general(
             p.astype(g_ref.dtype), g_ref[0], (((0,), (0,)), ((), ())),
@@ -432,6 +499,11 @@ def _bwd_dkv_kernel(*refs, scale: float, causal: bool, k_len: int,
         dk_acc[:] += lax.dot_general(
             ds, q_ref[0], (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
+
+    need = _needs_mask(qb, ki, block_q, block_k, causal, window, k_len,
+                       qseg_ref is not None)
+    _mask_dispatch(run, need,
+                   lambda: _compute(True), lambda: _compute(False))
 
     @pl.when(qi == pl.num_programs(2) - 1)
     def _finalize():

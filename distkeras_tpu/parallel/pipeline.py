@@ -68,14 +68,36 @@ def init_stacked_blocks(block: Layer, rng: jax.Array,
 
 def make_pipeline_fn(block: Layer, axis_name: str = "pp",
                      state: Optional[Pytree] = None,
-                     remat: bool = False) -> Callable:
+                     remat: bool = False,
+                     virtual_stages: int = 1) -> Callable:
     """Returns ``fn(stacked_local_params, x_mb) -> y_mb`` for use under
     ``shard_map``: ``x_mb`` is ``[M, mb, ...]`` microbatched input
     (replicated over the pp axis), result likewise. ``state`` is the block's
     (leafless) state-structure template from ``init_stacked_blocks``.
     ``remat=True`` recomputes each layer's activations in the backward pass
-    (peak memory O(1) per stage instead of O(layers/stage))."""
+    (peak memory O(1) per stage instead of O(layers/stage)).
+
+    ``virtual_stages`` = v (round 4): the INTERLEAVED schedule. Each
+    device's layers split into v chunks; global chunk j lives on device
+    ``j % P``, so consecutive chunks are ring neighbors and the SAME
+    ppermute ring carries the flow. Chunk j of microbatch m (grouped
+    g = m//P, r = m%P; q = j//P) runs at tick
+
+        T(m, j) = g*v*P + q*P + r + (j % P)
+
+    — each activation is produced exactly one tick before its consumer
+    needs it (T(m, j+1) - T(m, j) = 1 for both same-device wrap and
+    cross-device hops), so no waiting buffers exist anywhere. Ticks
+    total ``M*v + P - 1`` with each tick 1/v of a GPipe stage, giving
+    bubble ``(P-1)/(M*v + P - 1)`` vs GPipe's ``(P-1)/(M + P - 1)``.
+    v=1 IS the GPipe schedule (the formulas degenerate: q=0, m=t-d) —
+    one code path serves both. Requires ``M % P == 0`` for v > 1
+    (microbatches inject in groups of P; validated in make_train_step).
+    """
     state = {} if state is None else state
+    v = int(virtual_stages)
+    if v < 1:
+        raise ValueError(f"virtual_stages must be >= 1, got {v}")
 
     def layer_apply(p, h):
         return block.apply(p, state, h, training=False)[0]
@@ -83,29 +105,47 @@ def make_pipeline_fn(block: Layer, axis_name: str = "pp",
     if remat:
         layer_apply = jax.checkpoint(layer_apply)
 
-    def stage(local_params, h):
+    def stage(chunk_params, h):
         def body(h, p):
             return layer_apply(p, h), None
-        h, _ = lax.scan(body, h, local_params)
+        h, _ = lax.scan(body, h, chunk_params)
         return h
 
     def fn(local_params, x_mb):
         nstages = lax.axis_size(axis_name)
         idx = lax.axis_index(axis_name)
         M = x_mb.shape[0]
-        ticks = M + nstages - 1
+        ticks = M * v + nstages - 1
         ring = [(j, (j + 1) % nstages) for j in range(nstages)]
+        layers_local = jax.tree_util.tree_leaves(local_params)[0].shape[0]
+        if layers_local % v:
+            raise ValueError(
+                f"per-device layer count {layers_local} must divide by "
+                f"virtual_stages={v} (trailing layers would be silently "
+                "skipped)")
+        lpc = layers_local // v                       # layers per chunk
+
+        def chunk_of(p, q):
+            return jax.tree_util.tree_map(
+                lambda leaf: lax.dynamic_slice_in_dim(leaf, q * lpc, lpc,
+                                                      axis=0), p)
 
         def tick(carry, t):
             buf, outs = carry
-            # stage 0 injects microbatch t (clamped; garbage ticks beyond
-            # M-1 never reach a valid output slot)
-            inp = jnp.where(idx == 0, x_mb[jnp.clip(t, 0, M - 1)], buf)
-            h = stage(local_params, inp)
-            # last stage drains microbatch t-(P-1)
-            oidx = t - (nstages - 1)
-            cidx = jnp.clip(oidx, 0, M - 1)
-            valid = (oidx >= 0) & (idx == nstages - 1)
+            s = t - idx
+            # mixed-radix decode of s = (g*v + q)*P + r  (garbage for the
+            # bubble slots s < 0 / m >= M; masked below, and the clamps
+            # keep every index in range)
+            r = jnp.where(s >= 0, s % nstages, 0)
+            gq = jnp.where(s >= 0, s // nstages, 0)
+            q = gq % v
+            m = (gq // v) * nstages + r
+            inject = (idx == 0) & (q == 0)
+            inp = jnp.where(inject, x_mb[jnp.clip(m, 0, M - 1)], buf)
+            h = stage(chunk_of(local_params, q), inp)
+            valid = ((s >= 0) & (m < M) & (q == v - 1)
+                     & (idx == nstages - 1))
+            cidx = jnp.clip(m, 0, M - 1)
             outs = outs.at[cidx].set(jnp.where(valid, h, outs[cidx]))
             buf = lax.ppermute(h, axis_name, ring)
             return (buf, outs), None
@@ -135,25 +175,33 @@ class PipelinedLM:
 
     def __init__(self, embed: Layer, block: Layer, head: Layer,
                  num_layers: int, num_microbatches: int = 4,
-                 remat: bool = False):
+                 remat: bool = False, virtual_stages: int = 1):
         self.embed = embed
         self.block = block
         self.head = head
         self.num_layers = int(num_layers)
         self.num_microbatches = int(num_microbatches)
         self.remat = bool(remat)
+        self.virtual_stages = int(virtual_stages)
+        if self.virtual_stages < 1:
+            raise ValueError(
+                f"virtual_stages must be >= 1, got {virtual_stages}")
         self._estate = self._bstate = self._hstate = {}  # set by init()
 
     def bubble_fraction(self, pp: int) -> float:
-        """Idle fraction of the GPipe schedule: (P-1)/(M+P-1) of the ticks
-        are fill/drain on each of the forward and backward sweeps (autodiff
-        replays the tick scan in reverse, so the fractions match). The
-        lever is ``num_microbatches``; a 1F1B reordering would NOT shrink
-        this bubble (it equals GPipe's at equal M) — 1F1B's real advantage
-        is O(P) activation memory, which ``remat=True`` already provides
-        at O(1) per stage. See docs/parallelism.md."""
+        """Idle fraction of the schedule: (P-1)/(M*v + P-1) — with v
+        virtual stages per device each tick is 1/v of a full stage, so
+        the (P-1)-tick fill/drain shrinks accordingly (round 4; at v=1
+        this is GPipe's (P-1)/(M+P-1)). The same fraction applies to the
+        forward and backward sweeps (autodiff replays the tick scan in
+        reverse). A 1F1B reordering at v=1 would NOT shrink the bubble
+        (it equals GPipe's at equal M) — 1F1B's real advantage is O(P)
+        activation memory, which ``remat=True`` already provides at O(1)
+        per stage; interleaving attacks the bubble itself at the price
+        of one params-permutation gather per step and P | M. See
+        docs/parallelism.md."""
         m = self.num_microbatches
-        return (pp - 1) / (m + pp - 1)
+        return (pp - 1) / (m * self.virtual_stages + pp - 1)
 
     # -- init ---------------------------------------------------------------
     def init(self, rng: jax.Array, input_shape: Tuple[int, ...]):
@@ -201,12 +249,35 @@ class PipelinedLM:
         batch (same psum accounting as the loss).
         """
         M = self.num_microbatches
-        if self.num_layers % mesh.shape[pp_axis]:
+        v = self.virtual_stages
+        pp = mesh.shape[pp_axis]
+        if self.num_layers % (pp * v):
             raise ValueError(
                 f"num_layers {self.num_layers} must divide evenly over "
-                f"pp axis {pp_axis!r} (size {mesh.shape[pp_axis]})")
+                f"pp axis {pp_axis!r} (size {pp}) x virtual_stages {v}")
+        if v > 1 and M % pp:
+            raise ValueError(
+                f"the interleaved schedule injects microbatches in groups "
+                f"of P: num_microbatches {M} must divide by the pp axis "
+                f"size {pp} when virtual_stages > 1")
         pipeline = make_pipeline_fn(self.block, pp_axis, self._bstate,
-                                    remat=self.remat)
+                                    remat=self.remat, virtual_stages=v)
+        # interleaved layer->device map: global chunk j (layers
+        # [j*lpc, (j+1)*lpc)) lives on device j % P, but GSPMD tiles the
+        # stacked axis CONTIGUOUSLY — so the step permutes the canonical
+        # layer order into device-major/chunk-minor order at the jit
+        # boundary (params and optimizer state stay canonical; the
+        # gather + its scatter transpose cost one params-shuffle per
+        # step, noise next to a pipelined batch)
+        if v > 1:
+            lpc = self.num_layers // (pp * v)
+            perm = np.array([(q * pp + d) * lpc + l
+                             for d in range(pp)
+                             for q in range(v)
+                             for l in range(lpc)])
+            inv_perm = np.argsort(perm)
+        else:
+            perm = inv_perm = None
         embed, head = self.embed, self.head
         estate, hstate = self._estate, self._hstate
         d_axes = tuple(data_axes)
@@ -262,7 +333,16 @@ class PipelinedLM:
         def step(carry, batch):
             params, opt_state = carry
             x, y = batch
-            grads, loss, mets = grads_fn(params, x, y)
+            if perm is not None:
+                px = dict(params, blocks=jax.tree_util.tree_map(
+                    lambda l: jnp.take(l, perm, axis=0), params["blocks"]))
+            else:
+                px = params
+            grads, loss, mets = grads_fn(px, x, y)
+            if perm is not None:
+                grads = dict(grads, blocks=jax.tree_util.tree_map(
+                    lambda g: jnp.take(g, inv_perm, axis=0),
+                    grads["blocks"]))
             updates, opt_state = optimizer.update(grads, opt_state, params)
             params = apply_updates(params, updates)
             return (params, opt_state), (loss, mets) if metric_fns else loss

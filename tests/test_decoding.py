@@ -197,7 +197,7 @@ def test_gqa_decode_matches_full_forward_and_shrinks_cache():
     assert m.params[i]["attn"]["wk"].shape == (32, 2, 8)
     cache = init_cache(m.module, 2, S)
     kv = next(c for c in cache if c is not None)
-    assert kv["k"].shape == (2, S, 2, 8)
+    assert kv["k"].shape == (2, 2, S, 8)
 
     rs = np.random.RandomState(0)
     toks = rs.randint(0, V, (2, S))
@@ -417,3 +417,14 @@ def test_prefill_writes_cache_identical_to_decode_steps():
             np.testing.assert_allclose(np.asarray(ca[key], np.float32),
                                        np.asarray(cb[key], np.float32),
                                        atol=2e-5)
+
+
+def test_generate_zero_new_tokens_returns_prompts_unchanged():
+    """max_new_tokens=0 must be an identity (review r4: the clamped
+    first-token write used to overwrite the final prompt position)."""
+    m = lm()
+    prompts = np.array([[3, 1, 4, 1, 5, 9]], np.int32)
+    out = generate(m, prompts, max_new_tokens=0, temperature=0.0)
+    np.testing.assert_array_equal(out, prompts)
+    with pytest.raises(ValueError, match=">= 0"):
+        generate(m, prompts, max_new_tokens=-1)
