@@ -327,8 +327,7 @@ def bench_moe(batch_candidates, steps: int, n_passes: int,
                            jax.random.PRNGKey(0))
         fpt = None
         try:
-            cost = jax.jit(lambda c, x, y: step(c, (x, y))) \
-                .lower(carry, xb, yb).compile().cost_analysis()
+            cost = jstep.lower(carry, xb, yb).compile().cost_analysis()
             fpt = float(cost.get("flops", 0.0)) / (batch_size * cfg["seq"])
         except Exception:
             pass
@@ -377,6 +376,12 @@ def bench_moe(batch_candidates, steps: int, n_passes: int,
             print(f"moe {label}: {out[label]}", file=sys.stderr, flush=True)
         except Exception:
             traceback.print_exc(file=sys.stderr)
+        finally:
+            # a 470M-param MoE model + adam state is ~6 GB of HBM; drop
+            # it before building the next config (measured
+            # RESOURCE_EXHAUSTED without this)
+            import gc
+            gc.collect()
     return out
 
 
@@ -571,7 +576,10 @@ def _run_mode(mode, args, on_accel, peak, device_kind):
         if not on_accel:
             prompt_lens, batch, new_tokens = (64,), 2, 8
         else:
-            prompt_lens, batch, new_tokens = (2048, 8192), 8, 64
+            # 256 marginal tokens: with the fused decode kernel a step is
+            # sub-ms, and the t(1+N)-t(1) difference must clear prefill
+            # run-to-run noise (~±50 ms) by a wide margin
+            prompt_lens, batch, new_tokens = (2048, 8192), 8, 256
         # median of 3: the tunneled backend's first timed pass after a
         # compile can pay a one-off multi-second lazy-init (docs/PERF.md)
         results = bench_generate_long(batch, new_tokens,
@@ -604,9 +612,11 @@ def _run_mode(mode, args, on_accel, peak, device_kind):
             "variants": results,
             "batch_size": batch,
             "new_tokens": new_tokens,
-            "note": "ttft_s = prefill (batched, one causal pass) + 1 "
-                    "token; decode_tok_s = marginal rate of the next "
-                    "64 tokens against the deep cache",
+            "note": f"ttft_s = prefill (batched, one causal pass) + 1 "
+                    f"token; decode_tok_s = marginal rate of the next "
+                    f"{new_tokens} tokens against the deep cache; "
+                    "per-variant 'batch' is authoritative (p>=8192 "
+                    "halves it)",
             "device_kind": device_kind,
         }))
         return

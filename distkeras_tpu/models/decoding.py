@@ -4,23 +4,26 @@ Capability ADD with no reference analogue (dist-keras predates generative
 models; its Predictor is batch-scoring only — SURVEY §3.4). TPU-first
 design:
 
-  * The whole generation loop is ONE jitted ``lax.scan`` over time steps —
-    no per-token Python dispatch, static shapes throughout (the cache is a
-    preallocated ``[B, P+N, H, Dh]`` buffer written with
-    ``dynamic_update_slice``).
-  * Prompt prefill reuses the same scan (tokens before the prompt length
-    are teacher-forced from the prompt buffer), so there is exactly one
-    compiled program regardless of prompt length.
-  * Per-step attention reads the cache with a causal validity mask — the
+  * One compiled program per configuration: a batched PREFILL over the
+    whole prompt (one causal flash pass per layer writing all cache
+    positions at once — round 4; an 8K prompt is one kernel sweep, not
+    8K sequential steps) followed by ONE jitted ``lax.scan`` over the
+    new tokens — no per-token Python dispatch, static shapes throughout.
+  * The cache is a head-major ``[B, Hkv, cap, Dh]`` buffer created
+    INSIDE the compiled program and written with
+    ``dynamic_update_slice``; ``cache_dtype="int8"`` stores quantized
+    payloads with per-token-per-head scales.
+  * Per-step attention is the fused Pallas kernel
+    (``ops.decode_attention``) for deep caches on TPU, or a
+    storage-dtype einsum with a causal validity mask otherwise — the
     [S, S] score matrix never exists; each step is O(L) like flash
     decoding.
 
 Works on ``zoo.transformer_lm``-shaped models: a ``Sequential`` of
-Embedding / PositionalEmbedding / TransformerBlock / norm / Dense. MoE
-blocks decode fine (dense routing is per-token already). Sequence-parallel
-``attn_impl`` settings are ignored at decode time — generation is a
-single-device (or TP-sharded) path; the cache layout is the same BSHD as
-training.
+Embedding / PositionalEmbedding / TransformerBlock (optionally
+Remat-wrapped) / norm / Dense. MoE blocks decode fine (dense routing is
+per-token already). Sequence-parallel ``attn_impl`` settings are ignored
+at decode time — generation is a single-device (or TP-sharded) path.
 """
 
 from __future__ import annotations
@@ -57,7 +60,7 @@ def _decode_block_of(layer):
 
 
 def init_cache(module: Sequential, batch: int, max_len: int,
-               dtype=jnp.float32):
+               dtype=jnp.float32, check_len: int = None):
     """Per-layer KV buffers ([B, H, max_len, Dh]) mirroring the Sequential;
     non-attention layers get ``None``. The HEAD-major layout (round 4)
     keeps each head's [L, Dh] plane contiguous, so the per-step cache
@@ -80,10 +83,11 @@ def init_cache(module: Sequential, batch: int, max_len: int,
         # custom serving loops enter through here: out-of-range position
         # gathers CLAMP under jit (silently wrong-position logits), so the
         # capacity check must fail loudly at cache construction too
-        if isinstance(layer, PositionalEmbedding) and max_len > layer.max_len:
+        need = max_len if check_len is None else check_len
+        if isinstance(layer, PositionalEmbedding) and need > layer.max_len:
             raise ValueError(
                 f"PositionalEmbedding(max_len={layer.max_len}) is too small "
-                f"for a {max_len}-position decode cache")
+                f"for a {need}-position decode cache")
         block = _decode_block_of(layer)
         if block is not None:
             attn = block.attn
@@ -222,16 +226,43 @@ def _decode_attn(attn: MultiHeadAttention, p, kv, x, t):
     b = q.shape[0]
     hkv = attn.kv_heads
     g = attn.num_heads // hkv
-    qg = (q.astype(jnp.float32) * scale).reshape(
-        b, 1, hkv, g, q.shape[-1])                       # [B, 1, Hkv, G, D]
-    s = _decode_scores(qg, kv)                           # [B, Hkv, G, 1, L]
-    valid = jnp.arange(kv["k"].shape[2]) <= t
-    if attn.attn_window is not None:
-        valid &= jnp.arange(kv["k"].shape[2]) > t - attn.attn_window
-    s = jnp.where(valid[None, None, None, None, :], s, NEG_INF)
-    w = jax.nn.softmax(s, axis=-1)
-    out = _decode_mix(w, kv).astype(dt)
-    out = out.reshape(b, 1, attn.num_heads, q.shape[-1])
+    dh = q.shape[-1]
+    L = kv["k"].shape[2]
+    from distkeras_tpu.ops.decode_attention import (block_of,
+                                                    decode_attention)
+    if jax.default_backend() == "tpu" and L >= 1024 \
+            and block_of(L) is not None:
+        # deep caches only: at L < 1024 the per-program overhead of the
+        # kernel's grid outweighs its single-pass read (measured — the
+        # einsum path wins at the 136-position headline config), while
+        # at depth the kernel is a clear multiple over the einsum
+        # lowering's materialized broadcast product
+        # fused Pallas path (round 4): one kernel per layer streams the
+        # cache once — the XLA einsum lowering materializes the f32
+        # broadcast product of every cache plane in HBM (~3x the bytes;
+        # measured 0.37 ms/layer-step at L=2113). generate() sizes the
+        # cache to a block multiple so serving always takes this path.
+        qr = q[:, 0].astype(dt).reshape(b, hkv, g, dh)             .reshape(b * hkv, g, dh)
+        kr = kv["k"].reshape(b * hkv, L, dh)
+        vr = kv["v"].reshape(b * hkv, L, dh)
+        sc = {}
+        if "k_scale" in kv:
+            sc = {"k_scale": kv["k_scale"].reshape(b * hkv, L),
+                  "v_scale": kv["v_scale"].reshape(b * hkv, L)}
+        o = decode_attention(qr, kr, vr, t, scale=scale,
+                             window=attn.attn_window, **sc)
+        out = o.reshape(b, hkv, g, dh).reshape(b, 1, attn.num_heads, dh)             .astype(dt)
+    else:
+        qg = (q.astype(jnp.float32) * scale).reshape(
+            b, 1, hkv, g, dh)                            # [B, 1, Hkv, G, D]
+        s = _decode_scores(qg, kv)                       # [B, Hkv, G, 1, L]
+        valid = jnp.arange(L) <= t
+        if attn.attn_window is not None:
+            valid &= jnp.arange(L) > t - attn.attn_window
+        s = jnp.where(valid[None, None, None, None, :], s, NEG_INF)
+        w = jax.nn.softmax(s, axis=-1)
+        out = _decode_mix(w, kv).astype(dt)
+        out = out.reshape(b, 1, attn.num_heads, dh)
     y = jnp.einsum("bshe,hed->bsd", out, p["wo"].astype(dt))
     return y.astype(x.dtype), kv
 
@@ -526,8 +557,19 @@ def generate(model: Model, prompts, max_new_tokens: int,
             # call, and XLA sees a single dead-on-exit buffer instead of
             # distinct input+output copies — at P=8192 the bf16 cache is
             # 3.2 GB, and the in+out pair was what pushed the long-
-            # context MHA program over the compile/memory edge (round 4)
-            cache = init_cache(module, b, total, cache_dtype)
+            # context MHA program over the compile/memory edge (round 4).
+            # Capacity rounds up to the decode kernel's block size on
+            # TPU so every serving call takes the fused Pallas path
+            # (the margin is masked; models position checks use `total`)
+            if jax.default_backend() == "tpu" and total >= 1024:
+                from distkeras_tpu.ops.decode_attention import \
+                    choose_block
+                bl = choose_block(total)
+                cap = -(-total // bl) * bl
+            else:
+                cap = total
+            cache = init_cache(module, b, cap, cache_dtype,
+                               check_len=total)
             last_logits, cache = prefill(module,
                                          live_params(params, run_scales),
                                          state, cache, prompts)

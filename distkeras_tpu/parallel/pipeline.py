@@ -117,6 +117,12 @@ def make_pipeline_fn(block: Layer, axis_name: str = "pp",
         M = x_mb.shape[0]
         ticks = M * v + nstages - 1
         ring = [(j, (j + 1) % nstages) for j in range(nstages)]
+        if v > 1 and M % nstages:
+            raise ValueError(
+                f"interleaved schedule (virtual_stages={v}) injects "
+                f"microbatches in groups of P: M={M} must divide by the "
+                f"pp axis size {nstages} (trailing microbatches would "
+                "silently drain as zeros)")
         layers_local = jax.tree_util.tree_leaves(local_params)[0].shape[0]
         if layers_local % v:
             raise ValueError(

@@ -1,0 +1,100 @@
+"""Fused decode-attention Pallas kernel vs the einsum oracle (round 4).
+
+The kernel runs in interpreter mode on the CPU mesh; the oracle is the
+einsum decode path (``_decode_scores``/``_decode_mix`` + masked softmax)
+that off-TPU serving uses. f32 everywhere (CPU XLA has no bf16 dot).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distkeras_tpu.models.decoding import (_decode_mix, _decode_scores,
+                                           _quantize_kv)
+from distkeras_tpu.ops.attention import NEG_INF
+from distkeras_tpu.ops.decode_attention import decode_attention
+
+
+def _mk(bh=3, g=4, d=16, L=32, seed=0):
+    rs = np.random.RandomState(seed)
+    q = jnp.asarray(rs.randn(bh, g, d), jnp.float32)
+    k = jnp.asarray(rs.randn(bh, L, d), jnp.float32)
+    v = jnp.asarray(rs.randn(bh, L, d), jnp.float32)
+    return q, k, v
+
+
+@pytest.mark.parametrize("t", [0, 7, 31])
+@pytest.mark.parametrize("g", [1, 4])
+def test_kernel_matches_oracle(t, g):
+    q, k, v = _mk(g=g)
+    scale = q.shape[-1] ** -0.5
+    out = decode_attention(q, k, v, t, scale=scale, block_l=8,
+                           interpret=True)
+    # oracle directly: masked softmax attention over positions <= t
+    s = jnp.einsum("bgd,bld->bgl", q * scale, k)
+    s = jnp.where((jnp.arange(k.shape[1]) <= t)[None, None], s, NEG_INF)
+    w = jax.nn.softmax(s, -1)
+    ref = jnp.einsum("bgl,bld->bgd", w, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-5)
+
+
+def test_kernel_window_masking():
+    q, k, v = _mk(seed=1)
+    scale = q.shape[-1] ** -0.5
+    t, win = 20, 6
+    out = decode_attention(q, k, v, t, scale=scale, window=win,
+                           block_l=8, interpret=True)
+    s = jnp.einsum("bgd,bld->bgl", q * scale, k)
+    pos = jnp.arange(k.shape[1])
+    ok = (pos <= t) & (pos > t - win)
+    s = jnp.where(ok[None, None], s, NEG_INF)
+    ref = jnp.einsum("bgl,bld->bgd", jax.nn.softmax(s, -1), v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-5)
+
+
+def test_kernel_int8_dequant_matches_dequantized_oracle():
+    q, k, v = _mk(seed=2)
+    scale = q.shape[-1] ** -0.5
+    t = 17
+    qk, ks = _quantize_kv(k)
+    qv, vs = _quantize_kv(v)
+    out = decode_attention(q, qk, qv, t, scale=scale, block_l=8,
+                           k_scale=ks, v_scale=vs, interpret=True)
+    kd = qk.astype(jnp.float32) * ks[..., None]
+    vd = qv.astype(jnp.float32) * vs[..., None]
+    s = jnp.einsum("bgd,bld->bgl", q * scale, kd)
+    s = jnp.where((jnp.arange(k.shape[1]) <= t)[None, None], s, NEG_INF)
+    ref = jnp.einsum("bgl,bld->bgd", jax.nn.softmax(s, -1), vd)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-4)
+
+
+def test_kernel_rejects_unaligned_cache():
+    q, k, v = _mk(L=30)
+    with pytest.raises(ValueError, match="multiple of block_l"):
+        decode_attention(q, k, v, 3, block_l=8, interpret=True)
+    with pytest.raises(ValueError, match="no supported tile"):
+        decode_attention(q, k, v, 3, interpret=True)
+
+
+def test_kernel_under_scan_with_traced_t():
+    """t is a traced scalar inside the decode scan — the scalar-prefetch
+    operand must accept it."""
+    q, k, v = _mk(seed=3)
+    scale = q.shape[-1] ** -0.5
+
+    def body(_, t):
+        return None, decode_attention(q, k, v, t, scale=scale, block_l=8,
+                                      interpret=True)
+
+    _, outs = jax.lax.scan(body, None, jnp.arange(4, 8))
+    for i, t in enumerate(range(4, 8)):
+        s = jnp.einsum("bgd,bld->bgl", q * scale, k)
+        s = jnp.where((jnp.arange(k.shape[1]) <= t)[None, None], s,
+                      NEG_INF)
+        ref = jnp.einsum("bgl,bld->bgd", jax.nn.softmax(s, -1), v)
+        np.testing.assert_allclose(np.asarray(outs[i]), np.asarray(ref),
+                                   atol=1e-5)

@@ -31,6 +31,15 @@ def _category(op_name: str) -> str:
     # FLOPs from BN stats, so the buckets describe the fusion shapes
     if "convert_reduce" in n:
         return "fused conv + stats-reduce blocks"
+    # Pallas kernels surface as custom-call ops named after the traced
+    # function: the flash-attention fwd kernel lowers as "%jvp__.N" under
+    # autodiff and the two backward kernels as "%transpose_jvp___.N"
+    # (round 4 — they were previously mis-bucketed as data movement,
+    # hiding 35% of the LM step behind "transposes")
+    if re.match(r"%?(transpose_)?jvp_", n):
+        return "pallas kernels (flash attention)"
+    if "custom-call" in n or "pallas" in n:
+        return "pallas kernels (other custom calls)"
     if "convolution" in n or re.match(r"%?(conv(?!ert)|dot)", n):
         return "unfused conv/matmul"
     if "reduce" in n and "window" not in n and "scatter" not in n:
