@@ -228,9 +228,10 @@ def _decode_attn(attn: MultiHeadAttention, p, kv, x, t):
     g = attn.num_heads // hkv
     dh = q.shape[-1]
     L = kv["k"].shape[2]
-    from distkeras_tpu.ops.decode_attention import (block_of,
+    from distkeras_tpu.ops.decode_attention import (MIN_KERNEL_LEN,
+                                                    block_of,
                                                     decode_attention)
-    if jax.default_backend() == "tpu" and L >= 1024 \
+    if jax.default_backend() == "tpu" and L >= MIN_KERNEL_LEN \
             and block_of(L) is not None:
         # deep caches only: at L < 1024 the per-program overhead of the
         # kernel's grid outweighs its single-pass read (measured — the
@@ -561,9 +562,10 @@ def generate(model: Model, prompts, max_new_tokens: int,
             # Capacity rounds up to the decode kernel's block size on
             # TPU so every serving call takes the fused Pallas path
             # (the margin is masked; models position checks use `total`)
-            if jax.default_backend() == "tpu" and total >= 1024:
+            if jax.default_backend() == "tpu":
                 from distkeras_tpu.ops.decode_attention import \
-                    choose_block
+                    MIN_KERNEL_LEN, choose_block
+            if jax.default_backend() == "tpu" and total >= MIN_KERNEL_LEN:
                 bl = choose_block(total)
                 cap = -(-total // bl) * bl
             else:

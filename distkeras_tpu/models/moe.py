@@ -14,16 +14,18 @@ for the Spark design) — this is a TPU-native addition. Design:
       exact — but every token pays ALL experts' FLOPs (E/top_k× the
       dispatched cost). Kept as the numerics oracle and for tiny shapes
       where dispatch bookkeeping dominates.
-    - ``dispatch="tokens"`` (round 3): capacity-based sort dispatch — the
-      GShard/Switch construction with static shapes. Token slots are
-      stably sorted by expert id, each expert takes its first
-      ``capacity`` arrivals (choice-major priority: every token's first
-      choice outranks all second choices), dropped slots contribute
-      nothing. Per-token expert FLOPs are ``top_k * capacity_factor``
-      MLPs instead of ``E`` — the compute-sparse economics the name
-      promises. Sort/gather/scatter are memory ops (O(N·d) traffic), so
-      the MXU work is exactly the expert matmuls at [E, C, d] — static
-      shapes throughout.
+    - ``dispatch="tokens"`` (round 3; round 4 made it sort-free): the
+      capacity-based GShard/Switch construction with static shapes.
+      Each slot's position within its expert comes from an exclusive
+      cumsum over one-hot masks in choice-major order (every token's
+      first choice outranks all second choices); each expert takes its
+      first ``capacity`` arrivals, dropped slots contribute nothing.
+      Per-token expert FLOPs are ``top_k * capacity_factor`` MLPs
+      instead of ``E`` — the compute-sparse economics the name
+      promises. Gather/scatter are memory ops (O(N·d) traffic), so the
+      MXU work is exactly the expert matmuls at [E, C, d] — static
+      shapes throughout; the measured single-chip price of the dispatch
+      machinery is in docs/PERF.md §MoE.
 
   * Expert parallelism: under GSPMD (``SPMDTrainer``) the stacked expert
     einsums partition on the expert axis automatically from the weight
@@ -53,7 +55,8 @@ def _dispatch_plan(experts, gates, num_experts: int, capacity: int):
 
     experts/gates: [N, K] top-k expert ids / combine weights per token.
     Returns (dest, token, weight, keep) flat [N*K] slot arrays in
-    expert-sorted order: ``dest`` indexes an [E*C (+1 overflow)] buffer.
+    choice-major slot order: ``dest`` indexes an [E*C (+1 overflow)]
+    buffer.
     Priority is choice-major (slot s = k*N + n): all first choices beat
     all second choices, ties broken by token order — the GShard rule.
     """
@@ -61,14 +64,19 @@ def _dispatch_plan(experts, gates, num_experts: int, capacity: int):
     slot_e = experts.T.reshape(-1)                      # [K*N] choice-major
     slot_t = jnp.tile(jnp.arange(n, dtype=jnp.int32), k)
     slot_g = gates.T.reshape(-1)
-    order = jnp.argsort(slot_e, stable=True)
-    se, st, sg = slot_e[order], slot_t[order], slot_g[order]
-    counts = jnp.zeros((num_experts,), jnp.int32).at[slot_e].add(1)
-    starts = jnp.cumsum(counts) - counts                # exclusive cumsum
-    pos = jnp.arange(n * k, dtype=jnp.int32) - starts[se]
+    # position-in-expert via an exclusive cumsum over one-hot masks (the
+    # GShard/Switch construction) — round 4: this replaced a stable
+    # argsort over the [K*N] slot keys, which on TPU lowers to a
+    # many-pass bitonic sort and dominated the dispatch wall clock; the
+    # cumsum is a cheap log-depth scan and needs no reordering at all
+    # (slots stay in choice-major order, which IS the priority order).
+    onehot = jax.nn.one_hot(slot_e, num_experts, dtype=jnp.int32)
+    ranks = jnp.cumsum(onehot, axis=0) - onehot         # [K*N, E] exclusive
+    pos = jnp.take_along_axis(ranks, slot_e[:, None], axis=1)[:, 0]
     keep = pos < capacity
-    dest = jnp.where(keep, se * capacity + pos, num_experts * capacity)
-    return dest, st, sg, keep
+    dest = jnp.where(keep, slot_e * capacity + pos,
+                     num_experts * capacity)
+    return dest, slot_t, slot_g, keep
 
 
 @register_layer
@@ -199,8 +207,11 @@ class MoE(Layer):
 
         if self.expert_axis_name is None:
             ye = self._expert_mlp(xe.reshape(e, c, d), params)
-            ye_flat = jnp.pad(ye.reshape(e * c, d).astype(jnp.float32),
-                              ((0, 1), (0, 0)))
+            # combine in the COMPUTE dtype (round 4): the f32 combine
+            # buffers ([E*C, d] twice per layer) doubled the dispatch
+            # HBM traffic and fed XLA's memory-pressure remat; at most
+            # top_k contributions sum per token, well within bf16
+            ye_flat = jnp.pad(ye.reshape(e * c, d), ((0, 1), (0, 0)))
         else:
             # tokens are replicated across the axis: each shard runs only
             # its pre-sliced experts on its rows of the dispatch buffer,
@@ -210,12 +221,12 @@ class MoE(Layer):
             xe_l = lax.dynamic_slice_in_dim(
                 xe.reshape(e, c, d), idx * el, el, 0)
             ye_l = self._expert_mlp(xe_l, params)
-            ye_flat = jnp.zeros((e * c + 1, d), jnp.float32) \
+            ye_flat = jnp.zeros((e * c + 1, d), dt) \
                 .at[jnp.arange(el * c, dtype=jnp.int32) + idx * el * c] \
-                .set(ye_l.reshape(el * c, d).astype(jnp.float32))
+                .set(ye_l.reshape(el * c, d))
             ye_flat = lax.psum(ye_flat, self.expert_axis_name)
-        contrib = ye_flat[dest] * (sg * keep)[:, None]
-        out = jnp.zeros((n, d), jnp.float32).at[st].add(contrib)
+        contrib = ye_flat[dest] * (sg * keep)[:, None].astype(dt)
+        out = jnp.zeros((n, d), dt).at[st].add(contrib)
         return out.reshape(b, s, d), full, mask
 
     def apply(self, params, state, x, *, training=False, rng=None):

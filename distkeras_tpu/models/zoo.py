@@ -173,7 +173,7 @@ def transformer_lm(vocab_size: int, d_model: int = 512, num_heads: int = 8,
 
     ``moe_every=k`` (with ``num_experts``) swaps every k-th block's MLP for
     a mixture-of-experts layer (expert-parallel over ``moe_expert_axis``);
-    ``moe_dispatch="tokens"`` uses the capacity-based sort dispatch
+    ``moe_dispatch="tokens"`` uses the capacity-based cumsum dispatch
     (per-token expert FLOPs ~ top_k x ``moe_capacity_factor`` MLPs instead
     of all ``num_experts`` — see ``models/moe.py``).
     ``num_kv_heads < num_heads`` builds a grouped-query (GQA) model — the

@@ -61,6 +61,12 @@ from distkeras_tpu.ops.attention import NEG_INF
 #: candidate L tile sizes, largest first — `choose_block` picks per length
 BLOCK_CANDIDATES = (1024, 512, 256, 128)
 
+#: caches shorter than this stay on the einsum path (measured: the
+#: kernel's per-program overhead outweighs its single-pass read below
+#: ~1K positions). generate()'s capacity rounding and _decode_attn's
+#: dispatch share this one gate.
+MIN_KERNEL_LEN = 1024
+
 
 def choose_block(total_len: int) -> int:
     """The L tile size for a cache serving ``total_len`` positions —
