@@ -84,3 +84,10 @@ def test_packed_moe_serving_example(capsys):
     run_example("examples.packed_moe_serving")
     out = capsys.readouterr().out
     assert "cross-document logit leak" in out and "OK" in out
+
+
+def test_long_context_serving_example(capsys):
+    run_example("examples.long_context_serving")
+    out = capsys.readouterr().out
+    assert "int8 KV cache greedy match vs bf16: 1.00" in out
+    assert "ring attention + packed segment_ids" in out and "OK" in out
