@@ -178,7 +178,7 @@ class HostAsyncTrainer(Trainer):
             *[out[i]["state"] for i in range(n)])
 
     def train(self, dataset: Dataset) -> Model:
-        self._reject_grad_accum()
+        self._reject_step_options()
         model = self.master_model
         X, y = self._training_arrays(dataset)
         n = self.num_workers

@@ -74,7 +74,7 @@ class DistributedTrainer(Trainer):
         return self.communication_window
 
     def train(self, dataset: Dataset) -> Model:
-        self._reject_grad_accum()
+        self._reject_step_options()
         model = self.master_model
         X, y = self._training_arrays(dataset)
 

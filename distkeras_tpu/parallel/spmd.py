@@ -294,7 +294,8 @@ class SPMDTrainer(Trainer):
         step = make_train_step(model.module, self.loss, self.worker_optimizer,
                                self._metric_fns(), self.grad_accum_steps,
                                param_mask=self._param_mask(model),
-                               state_mask=self._state_mask(model))
+                               state_mask=self._state_mask(model),
+                               fused_vocab_head=self.fused_vocab_head)
 
         # pin the carry's layout across epochs: GSPMD is otherwise free to
         # re-shard unconstrained outputs (e.g. row-shard a replicated

@@ -54,7 +54,8 @@ class Trainer:
                  validation_data=None,
                  callbacks: Optional[Sequence] = None,
                  clip_grad_norm: Optional[float] = None,
-                 class_weight: Optional[dict] = None):
+                 class_weight: Optional[dict] = None,
+                 fused_vocab_head: bool = False):
         self.master_model = keras_model
         opt_kwargs = dict(optimizer_kwargs or {})
         if learning_rate is not None and not isinstance(worker_optimizer,
@@ -111,17 +112,36 @@ class Trainer:
         # capability ADD; the reference leaves all of this to Keras, which
         # its bare train_on_batch worker loop never invokes
         self.callbacks = list(callbacks or [])
+        # fuse the final vocab projection into a chunked cross-entropy
+        # (ops.losses.fused_linear_cross_entropy) — the large-vocab LM
+        # memory lever; honored by SingleTrainer and SPMDTrainer (the
+        # trainers that train LM-shaped models), rejected loudly by the
+        # rest (mirrors grad_accum_steps). True = default chunking; an
+        # int picks the token-chunk count (passed through verbatim to
+        # make_train_step, same contract).
+        if fused_vocab_head and class_weight is not None:
+            raise ValueError(
+                "fused_vocab_head does not compose with class_weight: "
+                "the fused loss never materializes the per-sample logits "
+                "the class-weight wrapper scales. Drop one of the two.")
+        self.fused_vocab_head = fused_vocab_head
         self.stop_training = False
         self._weights_fn = None       # bound by trainers during train()
         self._pending_weights = None  # set via set_weights()
 
-    def _reject_grad_accum(self):
-        """Trainers whose step semantics don't compose with accumulation
-        (the engine family counts WINDOW steps; ensembles/host-async have
-        their own loops) must fail loudly rather than silently ignore it."""
+    def _reject_step_options(self):
+        """Trainers whose step semantics don't compose with the
+        SingleTrainer/SPMDTrainer-only step options (gradient
+        accumulation, the fused vocab head) must fail loudly rather than
+        silently ignore them — the engine family counts WINDOW steps;
+        ensembles/host-async have their own loops."""
         if self.grad_accum_steps != 1:
             raise ValueError(
                 f"{type(self).__name__} does not support grad_accum_steps "
+                "(only SingleTrainer and SPMDTrainer do)")
+        if self.fused_vocab_head:
+            raise ValueError(
+                f"{type(self).__name__} does not support fused_vocab_head "
                 "(only SingleTrainer and SPMDTrainer do)")
 
     def _param_mask(self, model):
@@ -387,7 +407,8 @@ class SingleTrainer(Trainer):
         step = make_train_step(model.module, self.loss, self.worker_optimizer,
                                self._metric_fns(), self.grad_accum_steps,
                                param_mask=self._param_mask(model),
-                               state_mask=self._state_mask(model))
+                               state_mask=self._state_mask(model),
+                               fused_vocab_head=self.fused_vocab_head)
         runner = make_epoch_runner(step)
 
         # SingleTrainer checkpoints the FULL carry (params + model state +
@@ -479,7 +500,7 @@ class EnsembleTrainer(Trainer):
         self.models_: List[Model] = []
 
     def train(self, dataset: Dataset) -> List[Model]:
-        self._reject_grad_accum()
+        self._reject_step_options()
         self._reject_callbacks()
         if self.validation_data is not None:
             raise ValueError(

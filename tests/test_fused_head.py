@@ -247,3 +247,67 @@ def test_fused_head_under_dp_pjit():
         c, l = jax.jit(step)(c, (xs, ys))
     lu, _ = _run_steps(module, model, xb, yb, n=1, fused_vocab_head=True)
     np.testing.assert_allclose(float(l), lu[0], rtol=1e-5)
+
+
+def test_trainer_level_fused_head():
+    """fused_vocab_head exposed Keras-style on the trainer family:
+    SingleTrainer/SPMDTrainer honor it (same converged loss as unfused),
+    the engine family rejects it loudly (mirrors grad_accum_steps)."""
+    from distkeras_tpu.data import Dataset
+    from distkeras_tpu.parallel import AEASGD, SingleTrainer, SPMDTrainer
+    from distkeras_tpu.parallel.mesh import make_mesh_2d
+
+    V, S = 32, 12
+    rs = np.random.RandomState(0)
+    pat = rs.randint(0, V, S + 1)
+    X = np.tile(pat[:-1], (64, 1))
+    Y = np.tile(pat[1:], (64, 1))
+    ds = Dataset({"features": X, "label": Y})
+    kw = dict(batch_size=32, num_epoch=6, worker_optimizer="adam",
+              optimizer_kwargs={"learning_rate": 3e-3},
+              loss="sparse_categorical_crossentropy_from_logits",
+              shuffle_each_epoch=False)
+
+    losses = {}
+    for fused in (False, True):
+        m = Model.build(zoo.transformer_lm(V, d_model=32, num_heads=4,
+                                           num_layers=2, mlp_ratio=2),
+                        (S,), seed=0)
+        tr = SingleTrainer(m, fused_vocab_head=fused, **kw)
+        tr.train(ds)
+        losses[fused] = tr.get_history().losses()
+    np.testing.assert_allclose(losses[False], losses[True], rtol=2e-4,
+                               atol=2e-4)
+
+    m = Model.build(zoo.transformer_lm(V, d_model=32, num_heads=4,
+                                       num_layers=2, mlp_ratio=2),
+                    (S,), seed=0)
+    tr = SPMDTrainer(m, mesh=make_mesh_2d({"workers": 2, "tp": 4}),
+                     tp_axis="tp", fused_vocab_head=True,
+                     **{**kw, "num_epoch": 2})
+    tr.train(ds)
+    # SAME math under tp sharding: the loss history must match the
+    # SingleTrainer fused run epoch for epoch (shuffle off, same seed)
+    np.testing.assert_allclose(
+        np.asarray(tr.get_history().losses()).ravel()[:2],
+        np.asarray(losses[True]).ravel()[:2], rtol=2e-4, atol=2e-4)
+    # int chunk-count form passes through (not coerced to bool)
+    m_nc = Model.build(zoo.transformer_lm(V, d_model=32, num_heads=4,
+                                          num_layers=2, mlp_ratio=2),
+                       (S,), seed=0)
+    tr_nc = SingleTrainer(m_nc, fused_vocab_head=2,
+                          **{**kw, "num_epoch": 1})
+    tr_nc.train(ds)
+    nc_hist = np.asarray(tr_nc.get_history().losses()).ravel()
+    np.testing.assert_allclose(
+        nc_hist, np.asarray(losses[True]).ravel()[:len(nc_hist)],
+        rtol=2e-4, atol=2e-4)
+    with pytest.raises(ValueError, match="class_weight"):
+        SingleTrainer(m_nc, fused_vocab_head=True,
+                      class_weight={0: 2.0}, **kw)
+
+    m2 = Model.build(zoo.transformer_lm(V, d_model=32, num_heads=4,
+                                        num_layers=2, mlp_ratio=2),
+                     (S,), seed=0)
+    with pytest.raises(ValueError, match="fused_vocab_head"):
+        AEASGD(m2, num_workers=8, fused_vocab_head=True, **kw).train(ds)
