@@ -362,7 +362,7 @@ def decode_step(module: Sequential, params, state, cache, tok, t):
     return x[:, 0], new_cache                            # [B, V]
 
 
-def _sample(logits, temperature, top_k, rng):
+def _sample(logits, temperature, top_k, rng, top_p=None):
     if temperature == 0.0:
         return jnp.argmax(logits, axis=-1)
     logits = logits.astype(jnp.float32) / temperature
@@ -375,6 +375,23 @@ def _sample(logits, temperature, top_k, rng):
         keep = jax.nn.one_hot(idx, logits.shape[-1],
                               dtype=jnp.bool_).any(axis=-2)
         logits = jnp.where(keep, logits, NEG_INF)
+    if top_p is not None:
+        # nucleus sampling (round 4): keep the smallest probability-sorted
+        # prefix whose mass reaches top_p. Token i survives iff the mass
+        # STRICTLY ABOVE it is < top_p (so the boundary token that crosses
+        # the threshold is included, per the standard construction).
+        # Logit-value ties at the boundary admit their whole tie class —
+        # the probability-identical analogue of the top_k caveat, accepted
+        # because a value threshold keeps this one sort + one compare
+        # (composes with top_k: applied after its mask, like HF).
+        sorted_logits = jnp.flip(jnp.sort(logits, axis=-1), axis=-1)
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        exclusive = jnp.cumsum(probs, axis=-1) - probs
+        keep_sorted = exclusive < top_p
+        thresh = jnp.min(
+            jnp.where(keep_sorted, sorted_logits, jnp.inf),
+            axis=-1, keepdims=True)
+        logits = jnp.where(logits >= thresh, logits, NEG_INF)
     return jax.random.categorical(rng, logits, axis=-1)
 
 
@@ -406,12 +423,18 @@ def _serving_params(params, dtype):
 
 def generate(model: Model, prompts, max_new_tokens: int,
              temperature: float = 0.0, top_k: Optional[int] = None,
+             top_p: Optional[float] = None,
              seed: int = 0, cache_dtype=None,
              stop_token: Optional[int] = None,
              weights_dtype="auto", as_numpy: bool = True) -> np.ndarray:
     """Autoregressive continuation: ``[B, P]`` int prompts ->
     ``[B, P + max_new_tokens]`` tokens. ``temperature=0`` is greedy;
     otherwise softmax sampling (optionally top-k-truncated).
+
+    Sampling: ``temperature=0`` is greedy; otherwise softmax sampling,
+    optionally truncated by ``top_k`` (index-exact) and/or ``top_p``
+    (nucleus: smallest probability prefix whose mass reaches ``top_p``;
+    applied after the top_k mask when both are given).
 
     ``stop_token``: once a sequence emits it, every later position is
     filled with it too (the compiled scan always runs ``max_new_tokens``
@@ -441,6 +464,8 @@ def generate(model: Model, prompts, max_new_tokens: int,
     if max_new_tokens < 0:
         raise ValueError(f"max_new_tokens must be >= 0, "
                          f"got {max_new_tokens}")
+    if top_p is not None and not 0.0 < top_p <= 1.0:
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
     if max_new_tokens == 0:
         # nothing to generate; never run the clamped first-token write
         # (it would overwrite the final prompt position — review r4)
@@ -529,6 +554,7 @@ def generate(model: Model, prompts, max_new_tokens: int,
     # prompt through the sequential scan made long prompts O(P) device
     # steps instead of O(1) kernel passes.
     key = (b, p_len, int(max_new_tokens), float(temperature), top_k,
+           None if top_p is None else float(top_p),
            jnp.dtype(cache_dtype).name, stop_token,
            None if weights_dtype is None
            else ("int8" if weights_dtype == "int8"
@@ -576,7 +602,7 @@ def generate(model: Model, prompts, max_new_tokens: int,
                                          live_params(params, run_scales),
                                          state, cache, prompts)
             rng, sub = jax.random.split(rng)
-            first = _sample(last_logits, temperature, top_k, sub)
+            first = _sample(last_logits, temperature, top_k, sub, top_p)
             done = jnp.zeros((b,), bool)
             if stop_token is not None:
                 done = first == stop_token
@@ -594,7 +620,7 @@ def generate(model: Model, prompts, max_new_tokens: int,
                 logits, cache = decode_step(module, p, state, cache,
                                             tok, t)
                 rng, sub = jax.random.split(rng)
-                nxt = _sample(logits, temperature, top_k, sub)
+                nxt = _sample(logits, temperature, top_k, sub, top_p)
                 if stop_token is not None:
                     nxt = jnp.where(done, stop_token, nxt)
                     done = done | (nxt == stop_token)

@@ -428,3 +428,40 @@ def test_generate_zero_new_tokens_returns_prompts_unchanged():
     np.testing.assert_array_equal(out, prompts)
     with pytest.raises(ValueError, match=">= 0"):
         generate(m, prompts, max_new_tokens=-1)
+
+
+def test_top_p_confines_samples_to_the_nucleus():
+    """Nucleus sampling (round 4): every draw lies in the smallest
+    probability-sorted prefix reaching mass p; boundary construction
+    matches the standard 'include the crossing token' rule."""
+    from distkeras_tpu.models.decoding import _sample
+
+    logits = jnp.asarray([[2.0, 1.0, 0.0, -1.0, -8.0]])
+    probs = np.asarray(jax.nn.softmax(logits, -1))[0]
+    order = np.argsort(-probs)
+    cum = np.cumsum(probs[order])
+    p = 0.8
+    nucleus = set(order[:int(np.searchsorted(cum, p) + 1)].tolist())
+    draws = {int(_sample(logits, 1.0, None, jax.random.PRNGKey(s),
+                         top_p=p)[0]) for s in range(300)}
+    assert draws <= nucleus and len(draws) > 1, (draws, nucleus)
+    # p=1.0 keeps everything reachable; composes with top_k
+    draws_all = {int(_sample(logits, 1.0, None, jax.random.PRNGKey(s),
+                             top_p=1.0)[0]) for s in range(400)}
+    assert len(draws_all) >= 4
+    draws_k = {int(_sample(logits, 1.0, 2, jax.random.PRNGKey(s),
+                           top_p=0.99)[0]) for s in range(200)}
+    assert draws_k <= {0, 1}
+
+
+def test_generate_top_p_end_to_end():
+    m = lm()
+    prompts = np.array([[1, 2, 3]])
+    out = generate(m, prompts, max_new_tokens=4, temperature=1.0,
+                   top_p=0.9, seed=3)
+    assert out.shape == (1, 7)
+    out2 = generate(m, prompts, max_new_tokens=4, temperature=1.0,
+                    top_p=0.9, seed=3)
+    np.testing.assert_array_equal(out, out2)     # same seed, same draw
+    with pytest.raises(ValueError, match="top_p"):
+        generate(m, prompts, max_new_tokens=2, temperature=1.0, top_p=1.5)
