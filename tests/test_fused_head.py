@@ -140,12 +140,13 @@ def test_fused_ce_ignores_any_negative_label_like_masked_loss():
         fused_linear_cross_entropy(h, w, ym, ignore_index=7)
 
 
-def _lm_fixture(dtype="float32", remat=None, V=64, S=16):
+def _lm_fixture(dtype="float32", remat=None, V=64, S=16, seed=0,
+                **lm_kwargs):
     module = zoo.transformer_lm(V, d_model=32, num_heads=4, num_layers=2,
                                 mlp_ratio=2, use_rope=True, dtype=dtype,
-                                attn_impl="xla", remat=remat)
+                                attn_impl="xla", remat=remat, **lm_kwargs)
     model = Model.build(module, (S,), seed=0)
-    rs = np.random.RandomState(0)
+    rs = np.random.RandomState(seed)
     xb = jnp.asarray(rs.randint(0, V, (4, S)))
     yb = jnp.asarray(rs.randint(0, V, (4, S)))
     return module, model, xb, yb
@@ -311,3 +312,25 @@ def test_trainer_level_fused_head():
                      (S,), seed=0)
     with pytest.raises(ValueError, match="fused_vocab_head"):
         AEASGD(m2, num_workers=8, fused_vocab_head=True, **kw).train(ds)
+
+
+def test_fused_head_carries_moe_aux_loss():
+    """The MoE router balance loss flows through the AUX_LOSS_KEY state
+    channel in the FUSED objective too (the trunk's new_state is what
+    collect_aux_losses scans): fused and unfused trajectories match on
+    an MoE LM with a nonzero aux weight."""
+    module, model, xb, yb = _lm_fixture(
+        V=48, seed=1, moe_every=2, num_experts=4,
+        moe_aux_loss_weight=0.05)
+    lu, pu = _run_steps(module, model, xb, yb, fused_vocab_head=False)
+    lf, pf = _run_steps(module, model, xb, yb, fused_vocab_head=True)
+    np.testing.assert_allclose(lu, lf, rtol=1e-5)
+    for a, b in zip(jax.tree_util.tree_leaves(pu),
+                    jax.tree_util.tree_leaves(pf)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-5)
+    # the aux term is actually in the optimized loss (not silently zero)
+    out, _ = module.apply(model.params, model.state, xb, training=True,
+                          rng=jax.random.PRNGKey(0))
+    plain = float(sparse_categorical_crossentropy_from_logits(yb, out))
+    assert lf[0] > plain + 1e-6, (lf[0], plain)
