@@ -185,10 +185,16 @@ def decode_attention(q, k, v, t, *, scale: Optional[float] = None,
     if g % 8:
         q = jnp.pad(q, ((0, 0), (0, 8 - g % 8), (0, 0)))
         g = q.shape[1]
-    # rows per program: amortizes per-program overhead; BH must divide
-    while bh % bh_block:
-        bh_block //= 2
-    bh_block = max(1, bh_block)
+    # rows per program: amortizes per-program overhead; BH must divide.
+    # Round 5 (advisor): validate up front (<=0 used to ZeroDivisionError)
+    # and round non-divisors to the LARGEST divisor of bh <= bh_block —
+    # the old halving loop silently degraded e.g. bh_block=6, bh=8 to 1,
+    # losing the amortization the parameter exists for.
+    bh_block = int(bh_block)
+    if bh_block < 1:
+        raise ValueError(f"bh_block must be >= 1, got {bh_block}")
+    bh_block = max(d for d in range(1, min(bh, bh_block) + 1)
+                   if bh % d == 0)
     grid = (bh // bh_block, L // block_l)
     kernel = functools.partial(_kernel, scale=float(scale),
                                block_l=int(block_l),

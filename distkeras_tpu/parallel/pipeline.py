@@ -93,6 +93,15 @@ def make_pipeline_fn(block: Layer, axis_name: str = "pp",
     v=1 IS the GPipe schedule (the formulas degenerate: q=0, m=t-d) —
     one code path serves both. Requires ``M % P == 0`` for v > 1
     (microbatches inject in groups of P; validated in make_train_step).
+
+    **Params layout contract for v > 1** (advisor r4): GSPMD tiles the
+    stacked layer axis CONTIGUOUSLY over the pp axis, so the stacked
+    params this function receives must already be permuted into
+    device-major/chunk-minor order — device d's slice holds its v chunks
+    back to back, NOT the canonical layer order. Build the permutation
+    with :func:`interleaved_params_perm` (``PipelinedLM.make_train_step``
+    applies it at the jit boundary); passing canonically ordered stacked
+    params with v > 1 silently assigns the wrong layers to each chunk.
     """
     state = {} if state is None else state
     v = int(virtual_stages)
@@ -164,6 +173,30 @@ def make_pipeline_fn(block: Layer, axis_name: str = "pp",
         return outs
 
     return fn
+
+
+def interleaved_params_perm(num_layers: int, pp: int,
+                            virtual_stages: int) -> "np.ndarray":
+    """Index permutation taking CANONICALLY stacked layer params (layer 0
+    first) into the device-major/chunk-minor order
+    :func:`make_pipeline_fn` requires when ``virtual_stages > 1``:
+    position ``(d, q, l)`` of the permuted stack holds canonical layer
+    ``(q*pp + d)*lpc + l`` (global chunk ``j = q*pp + d`` lives on device
+    ``j % pp``; ``lpc = num_layers // (pp*virtual_stages)``). Apply with
+    ``jnp.take(leaf, perm, axis=0)``; invert with ``np.argsort(perm)``
+    for the gradient scatter. Exposed (advisor r4) so direct shard_map
+    callers of ``make_pipeline_fn`` can honor the layout contract —
+    ``PipelinedLM.make_train_step`` applies it at the jit boundary."""
+    v = int(virtual_stages)
+    if num_layers % (pp * v):
+        raise ValueError(
+            f"num_layers {num_layers} must divide evenly over pp={pp} x "
+            f"virtual_stages={v}")
+    lpc = num_layers // (pp * v)
+    return np.array([(q * pp + d) * lpc + l
+                     for d in range(pp)
+                     for q in range(v)
+                     for l in range(lpc)])
 
 
 class PipelinedLM:
@@ -276,11 +309,7 @@ class PipelinedLM:
         # gather + its scatter transpose cost one params-shuffle per
         # step, noise next to a pipelined batch)
         if v > 1:
-            lpc = self.num_layers // (pp * v)
-            perm = np.array([(q * pp + d) * lpc + l
-                             for d in range(pp)
-                             for q in range(v)
-                             for l in range(lpc)])
+            perm = interleaved_params_perm(self.num_layers, pp, v)
             inv_perm = np.argsort(perm)
         else:
             perm = inv_perm = None

@@ -65,7 +65,10 @@ def main():
     assert acc > 0.9, out_bf[0]
     match = float((np.asarray(out_bf) == np.asarray(out_i8)).mean())
     print(f"int8 KV cache greedy match vs bf16: {match:.2f}")
-    assert match == 1.0
+    # int8 quantization can legitimately flip argmax on near-tied logits,
+    # so exact cross-variant equality would be brittle to seed/shape
+    # changes; a high match fraction is the honest contract (advisor r4)
+    assert match >= 0.95, match
 
     # --- the same model under sequence-parallel ring attention ----------
     devs = jax.devices()

@@ -35,9 +35,17 @@ def _category(op_name: str) -> str:
     # function: the flash-attention fwd kernel lowers as "%jvp__.N" under
     # autodiff and the two backward kernels as "%transpose_jvp___.N"
     # (round 4 — they were previously mis-bucketed as data movement,
-    # hiding 35% of the LM step behind "transposes")
+    # hiding 35% of the LM step behind "transposes"). Round 5 (advisor):
+    # the jvp_ prefix alone also matches jvp-named FUSIONS from other
+    # rematerialized/custom-vjp code (fused CE, ring attention backward),
+    # so fusion ops are excluded here — they fall through to the
+    # "elementwise fusions" bucket where their time belongs.
     if re.match(r"%?(transpose_)?jvp_", n):
-        return "pallas kernels (flash attention)"
+        # route excluded jvp-named fusions to their true bucket HERE —
+        # falling through would hit the "transpose" substring check first
+        # and land transpose_jvp_* fusions back in "data movement"
+        return ("elementwise fusions" if "fusion" in n
+                else "pallas kernels (flash attention)")
     if "custom-call" in n or "pallas" in n:
         return "pallas kernels (other custom calls)"
     if "convolution" in n or re.match(r"%?(conv(?!ert)|dot)", n):
