@@ -89,7 +89,8 @@ class MoE(Layer):
                  kernel_init: str = "glorot_uniform",
                  aux_loss_weight: float = 0.0,
                  dispatch: str = "dense",
-                 capacity_factor: float = 1.25):
+                 capacity_factor: float = 1.25,
+                 expert_unroll: bool = False):
         self.num_experts = int(num_experts)
         self.hidden_dim = int(hidden_dim)
         self.top_k = int(top_k)
@@ -111,6 +112,21 @@ class MoE(Layer):
         # at 1.0 a perfectly balanced router drops nothing; the default
         # headroom absorbs imbalance while training the balance loss down
         self.capacity_factor = float(capacity_factor)
+        # round 5, measured on v5e and left OPT-IN: the stacked
+        # [E, C, d] x [E, d, f] einsum lowers to XLA's batched-dot
+        # emitter (EmitAllBatchInSublanes), ~40% MXU; statically
+        # unrolling into groups of small clean dots microbenches 25-32%
+        # faster (3.1 vs 3.9-4.4 ms fwd at E=8/C=4096) — but in the
+        # 12-layer training graph the per-group slices + concat defeat
+        # XLA's buffer aliasing and the step OOMs by ~1 GB at batch 8
+        # (both 2 and 4 groups; full unroll also blows the compile
+        # helper). Default stays False; the option remains for shapes
+        # with spare HBM. Also keep False under GSPMD expert-axis
+        # sharding (SPMDTrainer): per-expert slices of a sharded stacked
+        # axis force cross-shard resharding — the shard_map path
+        # (expert_axis_name) is unaffected, its weights arrive
+        # pre-sliced.
+        self.expert_unroll = bool(expert_unroll)
 
     def init(self, rng, input_shape):
         d = input_shape[-1]
@@ -181,16 +197,56 @@ class MoE(Layer):
         """Run the stacked expert MLP on [E(_local), C, d]. Under
         shard_map expert parallelism the weights arrive pre-sliced to the
         shard's experts; under GSPMD the einsums partition on ``e`` from
-        the weight shardings automatically."""
+        the weight shardings automatically (set ``expert_unroll=False``
+        there — see __init__)."""
         dt = jnp.dtype(self.dtype)
         act = get_activation(self.activation)
-        h = act(jnp.einsum("ecd,edf->ecf", xe, params["w1"].astype(dt))
-                + params["b1"].astype(dt)[:, None, :])
-        return jnp.einsum("ecf,efd->ecd", h, params["w2"].astype(dt)) \
-            + params["b2"].astype(dt)[:, None, :]
+        w1 = params["w1"].astype(dt)
+        b1 = params["b1"].astype(dt)
+        w2 = params["w2"].astype(dt)
+        b2 = params["b2"].astype(dt)
+        e_here = xe.shape[0]
+        if self.expert_unroll and e_here > 1:
+            # static unroll into small groups of batched dots: measured
+            # sweep on v5e (E=8, C=4096) — 4 groups 3.1/3.4 ms fwd/f+g
+            # vs 3.9/4.0 for the single batched dot; FULL unroll (8
+            # groups) microbenches the same but its 12-layer training
+            # graph blows past the compile helper / HBM (round 5), so
+            # groups are capped at 4
+            ng = 4 if e_here % 4 == 0 else (2 if e_here % 2 == 0 else 1)
+            gsz = e_here // ng
+            outs = []
+            for g in range(ng):
+                sl = slice(g * gsz, (g + 1) * gsz)
+                if gsz == 1:
+                    h = act(xe[g * gsz] @ w1[g * gsz] + b1[g * gsz])
+                    outs.append((h @ w2[g * gsz] + b2[g * gsz])[None])
+                else:
+                    h = act(jnp.einsum("ecd,edf->ecf", xe[sl], w1[sl])
+                            + b1[sl][:, None, :])
+                    outs.append(jnp.einsum("ecf,efd->ecd", h, w2[sl])
+                                + b2[sl][:, None, :])
+            return jnp.concatenate(outs, axis=0)
+        h = act(jnp.einsum("ecd,edf->ecf", xe, w1) + b1[:, None, :])
+        return jnp.einsum("ecf,efd->ecd", h, w2) + b2[:, None, :]
 
     def _apply_dispatched(self, params, x):
-        """Capacity-based sort dispatch (static shapes; see module doc)."""
+        """Capacity-based sort dispatch (static shapes; see module doc).
+
+        Round 5 (dispatch-traffic restructure, measured in docs/PERF.md
+        §MoE): slot ``s = k*N + n`` is CHOICE-major, so the slot->token
+        map is ``tile(arange(N), K)`` — pure structure. Exploiting it:
+
+          * the slot-input build is a free ``broadcast_to`` (round 4
+            gathered ``xt[st]``, a real [K*N, d] gather whose transpose
+            was a real scatter-add);
+          * the combine is ``reshape(K, N, d).sum(0)`` (round 4
+            scatter-added into ``zeros.at[st]``, whose transpose was
+            another gather).
+
+        One [K*N, d] scatter (buffer build) + one gather (combine read)
+        remain per direction — half the round-4 traffic; their cost is
+        the dispatch's irreducible price on one chip."""
         dt = jnp.dtype(self.dtype)
         b, s, d = x.shape
         n = b * s
@@ -198,12 +254,16 @@ class MoE(Layer):
         c = self._capacity(n)
         full, topi, gates, mask = self._route(x, params["gate"])
 
-        dest, st, sg, keep = _dispatch_plan(
+        dest, _st, sg, keep = _dispatch_plan(
             topi.reshape(n, k), gates.reshape(n, k), e, c)
         xt = x.reshape(n, d).astype(dt)
-        # row E*C is the overflow bin for dropped slots (sliced off before
-        # compute; reads as zeros in the combine)
-        xe = jnp.zeros((e * c + 1, d), dt).at[dest].set(xt[st])[:e * c]
+        src = jnp.broadcast_to(xt[None], (k, n, d)).reshape(k * n, d)
+        # dropped slots (dest == E*C) fall off via mode="drop";
+        # unique_indices lets XLA skip collision handling (the overflow-
+        # row form made every dropped slot collide on one row: measured
+        # 3.15 -> 2.46 ms for the [32K, 1024] scatter on v5e, round 5)
+        xe = jnp.zeros((e * c, d), dt).at[dest].set(
+            src, mode="drop", unique_indices=True)
 
         if self.expert_axis_name is None:
             ye = self._expert_mlp(xe.reshape(e, c, d), params)
@@ -211,7 +271,7 @@ class MoE(Layer):
             # buffers ([E*C, d] twice per layer) doubled the dispatch
             # HBM traffic and fed XLA's memory-pressure remat; at most
             # top_k contributions sum per token, well within bf16
-            ye_flat = jnp.pad(ye.reshape(e * c, d), ((0, 1), (0, 0)))
+            ye_flat = ye.reshape(e * c, d)
         else:
             # tokens are replicated across the axis: each shard runs only
             # its pre-sliced experts on its rows of the dispatch buffer,
@@ -221,12 +281,14 @@ class MoE(Layer):
             xe_l = lax.dynamic_slice_in_dim(
                 xe.reshape(e, c, d), idx * el, el, 0)
             ye_l = self._expert_mlp(xe_l, params)
-            ye_flat = jnp.zeros((e * c + 1, d), dt) \
+            ye_flat = jnp.zeros((e * c, d), dt) \
                 .at[jnp.arange(el * c, dtype=jnp.int32) + idx * el * c] \
                 .set(ye_l.reshape(el * c, d))
             ye_flat = lax.psum(ye_flat, self.expert_axis_name)
+        # dropped slots' dest clamps into range on the gather; their
+        # garbage rows multiply by keep == 0
         contrib = ye_flat[dest] * (sg * keep)[:, None].astype(dt)
-        out = jnp.zeros((n, d), dt).at[st].add(contrib)
+        out = contrib.reshape(k, n, d).sum(axis=0)
         return out.reshape(b, s, d), full, mask
 
     def apply(self, params, state, x, *, training=False, rng=None):
@@ -279,7 +341,8 @@ class MoE(Layer):
                 "kernel_init": self.kernel_init,
                 "aux_loss_weight": self.aux_loss_weight,
                 "dispatch": self.dispatch,
-                "capacity_factor": self.capacity_factor}
+                "capacity_factor": self.capacity_factor,
+                "expert_unroll": self.expert_unroll}
 
 
 def moe_all_to_all(moe: MoE, params, x, *, axis_name: str):
@@ -315,10 +378,14 @@ def moe_all_to_all(moe: MoE, params, x, *, axis_name: str):
 
     full, topi, gates, mask = moe._route(x, params["gate"])
 
-    dest, st, sg, keep = _dispatch_plan(
+    dest, _st, sg, keep = _dispatch_plan(
         topi.reshape(n, k), gates.reshape(n, k), e, cs)
     xt = x.reshape(n, d).astype(dt)
-    xe = jnp.zeros((e * cs + 1, d), dt).at[dest].set(xt[st])[:e * cs]
+    # choice-major structure exploited as in _apply_dispatched (round 5):
+    # broadcast build + drop/unique scatter + reshape-sum combine
+    src = jnp.broadcast_to(xt[None], (k, n, d)).reshape(k * n, d)
+    xe = jnp.zeros((e * cs, d), dt).at[dest].set(
+        src, mode="drop", unique_indices=True)
     # [E, Cs, d] -> exchange: send expert-block a' to shard a', receive
     # one block per source concatenated on the capacity axis
     xe = xe.reshape(e, cs, d)
@@ -327,8 +394,7 @@ def moe_all_to_all(moe: MoE, params, x, *, axis_name: str):
     ye_l = moe._expert_mlp(recv, params)            # local experts
     back = lax.all_to_all(ye_l, axis_name, split_axis=1, concat_axis=0,
                           tiled=True)               # [E, Cs, d]
-    ye_flat = jnp.pad(back.reshape(e * cs, d).astype(jnp.float32),
-                      ((0, 1), (0, 0)))
+    ye_flat = back.reshape(e * cs, d).astype(jnp.float32)
     contrib = ye_flat[dest] * (sg * keep)[:, None]
-    out = jnp.zeros((n, d), jnp.float32).at[st].add(contrib)
+    out = contrib.reshape(k, n, d).sum(axis=0)
     return out.reshape(b, s, d).astype(x.dtype), (full, mask)

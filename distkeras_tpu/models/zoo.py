@@ -164,6 +164,7 @@ def transformer_lm(vocab_size: int, d_model: int = 512, num_heads: int = 8,
                    moe_aux_loss_weight: float = 0.0,
                    moe_dispatch: str = "dense",
                    moe_capacity_factor: float = 1.25,
+                   moe_expert_unroll: bool = False,
                    remat: Optional[str] = None) -> Sequential:
     """Decoder-only causal transformer LM — the long-context flagship.
 
@@ -176,6 +177,10 @@ def transformer_lm(vocab_size: int, d_model: int = 512, num_heads: int = 8,
     ``moe_dispatch="tokens"`` uses the capacity-based cumsum dispatch
     (per-token expert FLOPs ~ top_k x ``moe_capacity_factor`` MLPs instead
     of all ``num_experts`` — see ``models/moe.py``).
+    ``moe_expert_unroll=True`` unrolls the expert dots into small groups
+    (a measured per-op MXU win that OOMs the 12-layer training graph at
+    batch 8 and forces resharding under GSPMD expert sharding — opt-in
+    only; see ``MoE.__init__``).
     ``num_kv_heads < num_heads`` builds a grouped-query (GQA) model — the
     KV cache at serving time shrinks by the group factor.
     ``remat`` wraps every transformer block in ``blocks.Remat`` with that
@@ -202,7 +207,8 @@ def transformer_lm(vocab_size: int, d_model: int = 512, num_heads: int = 8,
                             dtype=dtype, expert_axis_name=moe_expert_axis,
                             aux_loss_weight=moe_aux_loss_weight,
                             dispatch=moe_dispatch,
-                            capacity_factor=moe_capacity_factor)
+                            capacity_factor=moe_capacity_factor,
+                            expert_unroll=moe_expert_unroll)
         block = TransformerBlock(
             num_heads, mlp_ratio=mlp_ratio, causal=True, use_rope=use_rope,
             norm=norm, dtype=dtype, attn_impl=attn_impl,

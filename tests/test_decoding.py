@@ -465,3 +465,107 @@ def test_generate_top_p_end_to_end():
     np.testing.assert_array_equal(out, out2)     # same seed, same draw
     with pytest.raises(ValueError, match="top_p"):
         generate(m, prompts, max_new_tokens=2, temperature=1.0, top_p=1.5)
+
+
+# --- chunked prefill (round 5) ---------------------------------------------
+
+def test_merge_attention_is_exact():
+    """The lse merge of two disjoint-key partials must equal one softmax
+    attention over the union (algebraic identity, checked to fp)."""
+    from distkeras_tpu.models.decoding import _merge_attention
+    rs = np.random.RandomState(0)
+    q = rs.randn(2, 3, 5, 8).astype(np.float32)   # [B, H, S, D]
+    k = rs.randn(2, 3, 16, 8).astype(np.float32)
+    v = rs.randn(2, 3, 16, 8).astype(np.float32)
+
+    def attn(q, k, v):
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k)
+        lse = jax.scipy.special.logsumexp(s, axis=-1)
+        o = jnp.einsum("bhqk,bhkd->bhqd", jnp.exp(s - lse[..., None]), v)
+        return o, lse
+
+    o_full, _ = attn(q, k, v)
+    o_a, l_a = attn(q, k[:, :, :7], v[:, :, :7])
+    o_b, l_b = attn(q, k[:, :, 7:], v[:, :, 7:])
+    merged = _merge_attention(o_a, l_a, o_b, l_b)
+    np.testing.assert_allclose(np.asarray(merged), np.asarray(o_full),
+                               atol=1e-5)
+
+
+@pytest.mark.parametrize("kv_heads,cache_dtype", [
+    (None, None), (2, None), (None, "int8")])
+def test_chunked_prefill_matches_one_pass(kv_heads, cache_dtype):
+    """generate(prefill_chunk=...) must reproduce the one-pass prefill's
+    greedy tokens (the merge is exact; bf16 cache stores the same values
+    either way). Covers MHA, GQA, and the int8 cache — for int8 the
+    chunked prefix attends to QUANTIZED earlier entries (the standard
+    serving contract), so logits differ slightly and the assertion is on
+    continuation tokens of a memorized pattern, not bitwise logits."""
+    m = Model.build(
+        zoo.transformer_lm(V, d_model=32, num_heads=4,
+                           num_kv_heads=kv_heads, num_layers=2,
+                           mlp_ratio=2, use_rope=True),
+        (S,), seed=3)
+    X = np.tile(PATTERN, (256, 1))
+    m.fit(X[:, :-1], X[:, 1:], optimizer="adam", learning_rate=5e-3,
+          batch_size=64, epochs=20,
+          loss="sparse_categorical_crossentropy_from_logits")
+    p_len = 28                     # not a multiple of chunk: ragged tail
+    prompts = np.tile(PATTERN, (2, 3))[:, :p_len]
+    kw = {} if cache_dtype is None else {"cache_dtype": cache_dtype}
+    one = generate(m, prompts, max_new_tokens=9, temperature=0.0, **kw)
+    chunked = generate(m, prompts, max_new_tokens=9, temperature=0.0,
+                       prefill_chunk=8, **kw)
+    match = float((np.asarray(one) == np.asarray(chunked)).mean())
+    assert match >= (1.0 if cache_dtype is None else 0.95), \
+        (one, chunked)
+
+
+@pytest.mark.parametrize("kv_heads", [None, 2])
+def test_chunked_prefill_cache_identical_to_one_pass(kv_heads):
+    """The cache AND last-position logits the chunked prefill leaves
+    behind must match the one-pass prefill's (same projections, same
+    write positions — up to dot-tiling fp reassociation: the chunked
+    projections contract over differently shaped operands). The GQA
+    variant pins the prefix lse head-order flatten at tight tolerance —
+    a memorized-pattern greedy match survives large attention errors
+    and missed exactly this (review r5)."""
+    from distkeras_tpu.models.decoding import (_resolve_head_dims,
+                                               prefill, prefill_chunked)
+    m = Model.build(
+        zoo.transformer_lm(V, d_model=32, num_heads=4,
+                           num_kv_heads=kv_heads, num_layers=2,
+                           mlp_ratio=2, use_rope=True), (S,), seed=5)
+    _resolve_head_dims(m.module, m.params)
+    prompts = jnp.asarray(
+        np.random.RandomState(1).randint(0, V, (2, 20)), jnp.int32)
+    c0 = init_cache(m.module, 2, 24)
+    logits_a, cache_a = prefill(m.module, m.params, m.state, c0, prompts)
+    logits_b, cache_b = prefill_chunked(m.module, m.params, m.state, c0,
+                                        prompts, 8)
+    np.testing.assert_allclose(np.asarray(logits_a),
+                               np.asarray(logits_b), atol=2e-5)
+    for a, b in zip(cache_a, cache_b):
+        if a is None:
+            assert b is None
+            continue
+        for key in a:
+            np.testing.assert_allclose(np.asarray(a[key]),
+                                       np.asarray(b[key]), atol=1e-5)
+
+
+def test_chunked_prefill_rejects_sliding_window():
+    m = Model.build(
+        zoo.transformer_lm(V, d_model=32, num_heads=4, num_layers=1,
+                           mlp_ratio=2, use_rope=True, attn_window=8),
+        (S,), seed=0)
+    with pytest.raises(NotImplementedError, match="window"):
+        generate(m, np.zeros((1, 20), np.int32), max_new_tokens=2,
+                 prefill_chunk=8)
+
+
+def test_generate_validates_prefill_chunk():
+    m = lm()
+    with pytest.raises(ValueError, match="prefill_chunk"):
+        generate(m, np.zeros((1, 8), np.int32), max_new_tokens=2,
+                 prefill_chunk=0)

@@ -155,7 +155,10 @@ def test_dispatched_ep_per_device_flops_under_gspmd(devices):
     e, d, hid, k = 2 * n, 128, 512, 2
     x = jax.random.normal(jax.random.PRNGKey(20), (4, 256, d))
     dense = MoE(e, hid, top_k=k)
-    disp = MoE(e, hid, top_k=k, dispatch="tokens", capacity_factor=1.0)
+    # expert_unroll=False: the GSPMD contract (round 5) — unrolled
+    # per-expert slicing of a sharded stacked axis defeats partitioning
+    disp = MoE(e, hid, top_k=k, dispatch="tokens", capacity_factor=1.0,
+               expert_unroll=False)
     params, _, _ = dense.init(jax.random.PRNGKey(21), (256, d))
     shard = {"gate": P(), "w1": P("ep"), "b1": P("ep"),
              "w2": P("ep"), "b2": P("ep")}
