@@ -161,11 +161,14 @@ def bench_resnet50(batch_size: int, steps: int, n_passes: int,
 LM_CFG = dict(d_model=1024, num_heads=16, num_layers=12, mlp_ratio=4,
               vocab=32768, seq=2048)
 
-#: compute-dense LM shape (round 5, VERDICT r4 #2): ~0.94B params
-#: (d_model 2048, d_head 128, 16 layers) — the biggest dense config that
-#: fits one v5e with Adam (f32 params + m + v = 11.3 GB), where matmul
-#: share rises and the fused vocab head plays in its home regime.
-LM_BIG_CFG = dict(d_model=2048, num_heads=16, num_layers=16, mlp_ratio=4,
+#: compute-dense LM shape (round 5, VERDICT r4 #2): 838M params
+#: (d_model 2048, d_head 128, 14 layers) — the biggest dense config that
+#: trains on one v5e with Adam at batch >= 4 (f32 params+m+v = 10.1 GB;
+#: the 16-layer/0.94B variant fits only at batch 2 — measured 17.7K
+#: tok/s / 49.4% MFU there — and its in-process batch ladder poisons
+#: the tunneled backend's HBM, so 14L/b4 is both the faster point and
+#: the robust bench config).
+LM_BIG_CFG = dict(d_model=2048, num_heads=16, num_layers=14, mlp_ratio=4,
                   vocab=32768, seq=2048)
 
 
@@ -882,10 +885,20 @@ def _run_mode(mode, args, on_accel, peak, device_kind):
                     kvh, cdt, p_top, (4, 8, 16), new_tokens, 2)
             except Exception:
                 traceback.print_exc(file=sys.stderr)
+        # the curve can expose a better batch for the winning variant
+        # than the footprint-sized grid point (measured: the b8 knee
+        # beats the b16 maximum-that-fits by ~10% at P=8192) — the
+        # headline is the variant's best MEASURED point, batch named
+        headline_batch = (results.get(headline_variant) or {}).get("batch")
+        for bk, cv in (curve or {}).items():
+            if cv.get("decode_tok_s") and cv["decode_tok_s"] > headline:
+                headline = cv["decode_tok_s"]
+                headline_batch = int(bk)
         rec = {
             "metric": f"lm_generate_p{p_top}_decode_tokens_per_sec_per_chip",
             "value": headline,
             "headline_variant": headline_variant,
+            "headline_batch": headline_batch,
             "unit": "tokens/sec",
             # anchor: MHA bf16-cache at the same depth — the GQA x int8
             # lines show the cache-shrinking levers where the cache read
@@ -934,33 +947,34 @@ def _run_mode(mode, args, on_accel, peak, device_kind):
         return rec
 
     if mode == "lm_big":
-        # compute-dense shape (round 5, VERDICT r4 #2): ~0.94B dense
+        # compute-dense shape (round 5, VERDICT r4 #2): 838M dense
         # params — d_model 2048, d_head 128 — where matmul share rises
         # and the 218M shape's VPU-bound attention kernels stop setting
-        # the MFU ceiling. Fused vocab head first (its chunked CE is the
-        # memory lever built for exactly this regime); the unfused path
-        # is then measured at the same batch to price the fused-head win
-        # in its home regime.
+        # the MFU ceiling. Fused vocab head first (the capacity lever;
+        # the 0.94B/L16 variant only fits with it, at batch 2); the
+        # unfused path is then measured at the same batch to price the
+        # head choice at this scale.
         # off-accelerator this mode is a code-path smoke only: the real
-        # 0.94B shape takes tens of minutes to even compile on CPU
+        # 838M shape takes tens of minutes to even compile on CPU
         cfg = LM_BIG_CFG if on_accel else dict(
             d_model=128, num_heads=2, num_layers=2, mlp_ratio=4,
             vocab=512, seq=128)
         steps = 10 if on_accel else 2
         n_passes = 2 if on_accel else 1
-        batches = [8, 4, 2] if on_accel else [2]
+        # start at the measured-fitting batch: a failed bigger attempt
+        # poisons this backend's HBM for the rest of the process (the
+        # round-5 L16 run OOM'd at b2 only because b8/b4 failed first)
+        batches = [4, 2] if on_accel else [2]
         (rates_f, fpt), bs = _with_fallbacks(
             lambda b: bench_lm("flash", b, steps, n_passes, args.profile,
                                fused_head=True, cfg=cfg),
             batches, "lm_big/fused")
         med_f = statistics.median(rates_f)
-        unfused = unfused_note = None
+        unfused = unfused_note = fpt_u = None
         try:
             rates_u, fpt_u = bench_lm("flash", bs, steps, n_passes,
                                       fused_head=False, cfg=cfg)
             unfused = statistics.median(rates_u)
-            if fpt_u:
-                fpt = fpt or fpt_u
         except Exception as e:
             msg = str(e).lower()
             unfused_note = ("does not fit (OOM) at this batch"
@@ -969,6 +983,11 @@ def _run_mode(mode, args, on_accel, peak, device_kind):
             traceback.print_exc(file=sys.stderr)
         value = max(med_f, unfused or 0.0)
         winner = "fused_vocab_head" if value == med_f else "unfused"
+        # MFU must use the WINNER's XLA-counted flops (the two heads
+        # count the vocab projection differently)
+        if winner == "unfused" and fpt_u:
+            fpt = fpt_u
+        fpt = fpt or fpt_u
         mfu = (value * fpt / peak) if (peak and fpt and on_accel) else None
         rec = {
             "metric": "lm_big_train_tokens_per_sec_per_chip",

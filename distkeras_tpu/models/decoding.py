@@ -438,12 +438,20 @@ def prefill_chunked(module: Sequential, params, state, cache, prompts,
     b, p_len = prompts.shape
     new_cache = list(cache)
     last_x = None
+    # layers past the deepest attention block (final norm + vocab head)
+    # only matter for the LAST chunk's logits — earlier chunks exist to
+    # fill the cache and stop after their final block (review r5)
+    last_block = max((i for i, l in enumerate(module.layers)
+                      if _decode_block_of(l) is not None), default=-1)
+    last = len(module.layers) - 1
     for t0 in range(0, p_len, chunk_len):
         q_len = min(chunk_len, p_len - t0)
+        final_chunk = t0 + q_len >= p_len
         x = prompts[:, t0:t0 + q_len]
         positions = jnp.arange(t0, t0 + q_len)
-        last = len(module.layers) - 1
         for i, layer in enumerate(module.layers):
+            if not final_chunk and i > last_block:
+                break
             p, s = params[i], state[i]
             block = _decode_block_of(layer)
             if block is not None:
@@ -568,7 +576,15 @@ def _fuse_qkv_params(module: Sequential, params):
     Exact: each output column of the concatenated matmul is the same
     d-length dot product as in the separate matmuls. Applied to FLOAT
     serving trees only — the int8 path's per-Dh scales differ across
-    q/k/v and cannot share one concatenated payload."""
+    q/k/v and cannot share one concatenated payload. SHARDED weights
+    (GSPMD/Megatron TP: wq/wk/wv split on the head axis) are left
+    unfused — concatenating differently-sharded head axes would re-split
+    the fused tensor across q/kv shard boundaries and pay resharding
+    collectives every step (review r5)."""
+    def replicated(leaf):
+        sh = getattr(leaf, "sharding", None)
+        return sh is None or getattr(sh, "is_fully_replicated", True)
+
     fused = list(params)
     for i, layer in enumerate(module.layers):
         block = _decode_block_of(layer)
@@ -576,6 +592,8 @@ def _fuse_qkv_params(module: Sequential, params):
             continue
         p = dict(fused[i])
         pa = dict(p["attn"])
+        if not all(replicated(pa[k]) for k in ("wq", "wk", "wv")):
+            continue
         pa["wqkv"] = jnp.concatenate(
             [pa.pop("wq"), pa.pop("wk"), pa.pop("wv")], axis=1)
         p["attn"] = pa

@@ -285,9 +285,14 @@ class MoE(Layer):
                 .at[jnp.arange(el * c, dtype=jnp.int32) + idx * el * c] \
                 .set(ye_l.reshape(el * c, d))
             ye_flat = lax.psum(ye_flat, self.expert_axis_name)
-        # dropped slots' dest clamps into range on the gather; their
-        # garbage rows multiply by keep == 0
-        contrib = ye_flat[dest] * (sg * keep)[:, None].astype(dt)
+        # dropped slots' dest clamps into range on the gather; the WHERE
+        # (not a bare keep-multiply) forces their contribution to exact
+        # zero even if the clamped-into expert row is inf/NaN (inf * 0
+        # would poison the dropped token — review r5); it fuses into the
+        # gather's consumer
+        contrib = jnp.where(keep[:, None],
+                            ye_flat[dest] * sg[:, None].astype(dt),
+                            jnp.zeros((), dt))
         out = contrib.reshape(k, n, d).sum(axis=0)
         return out.reshape(b, s, d), full, mask
 
@@ -395,6 +400,8 @@ def moe_all_to_all(moe: MoE, params, x, *, axis_name: str):
     back = lax.all_to_all(ye_l, axis_name, split_axis=1, concat_axis=0,
                           tiled=True)               # [E, Cs, d]
     ye_flat = back.reshape(e * cs, d).astype(jnp.float32)
-    contrib = ye_flat[dest] * (sg * keep)[:, None]
+    # where, not keep-multiply: exact zero for dropped slots even when
+    # the clamped gather row is non-finite (see _apply_dispatched)
+    contrib = jnp.where(keep[:, None], ye_flat[dest] * sg[:, None], 0.0)
     out = contrib.reshape(k, n, d).sum(axis=0)
     return out.reshape(b, s, d).astype(x.dtype), (full, mask)

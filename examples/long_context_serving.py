@@ -70,6 +70,17 @@ def main():
     # changes; a high match fraction is the honest contract (advisor r4)
     assert match >= 0.95, match
 
+    # chunked prefill (round 5): same greedy tokens, O(chunk) prefill
+    # activation memory — the >= 32K-prompt serving lever. Match
+    # fraction, not bitwise equality: the lse merge is algebraically
+    # exact but fp-reassociated vs the one-pass softmax, so a near-tied
+    # argmax could legitimately flip (same contract as the int8 check)
+    out_ck = generate(model, prompts, max_new_tokens=16, temperature=0.0,
+                      prefill_chunk=16)
+    ck_match = float((np.asarray(out_bf) == np.asarray(out_ck)).mean())
+    print(f"chunked prefill greedy match vs one-pass: {ck_match:.2f}")
+    assert ck_match >= 0.95, ck_match
+
     # --- the same model under sequence-parallel ring attention ----------
     devs = jax.devices()
     mesh = Mesh(np.array(devs), ("sp",))
