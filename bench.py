@@ -984,10 +984,10 @@ def _run_mode(mode, args, on_accel, peak, device_kind):
         value = max(med_f, unfused or 0.0)
         winner = "fused_vocab_head" if value == med_f else "unfused"
         # MFU must use the WINNER's XLA-counted flops (the two heads
-        # count the vocab projection differently)
-        if winner == "unfused" and fpt_u:
+        # count the vocab projection differently); no cross-head
+        # fallback — a missing count yields mfu=None, not a wrong one
+        if winner == "unfused":
             fpt = fpt_u
-        fpt = fpt or fpt_u
         mfu = (value * fpt / peak) if (peak and fpt and on_accel) else None
         rec = {
             "metric": "lm_big_train_tokens_per_sec_per_chip",

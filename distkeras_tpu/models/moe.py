@@ -14,7 +14,8 @@ for the Spark design) — this is a TPU-native addition. Design:
       exact — but every token pays ALL experts' FLOPs (E/top_k× the
       dispatched cost). Kept as the numerics oracle and for tiny shapes
       where dispatch bookkeeping dominates.
-    - ``dispatch="tokens"`` (round 3; round 4 made it sort-free): the
+    - ``dispatch="tokens"`` (round 3; round 4 made it sort-free; round 5
+      took the dispatch traffic to its primitive floor): the
       capacity-based GShard/Switch construction with static shapes.
       Each slot's position within its expert comes from an exclusive
       cumsum over one-hot masks in choice-major order (every token's
@@ -22,10 +23,13 @@ for the Spark design) — this is a TPU-native addition. Design:
       first ``capacity`` arrivals, dropped slots contribute nothing.
       Per-token expert FLOPs are ``top_k * capacity_factor`` MLPs
       instead of ``E`` — the compute-sparse economics the name
-      promises. Gather/scatter are memory ops (O(N·d) traffic), so the
-      MXU work is exactly the expert matmuls at [E, C, d] — static
-      shapes throughout; the measured single-chip price of the dispatch
-      machinery is in docs/PERF.md §MoE.
+      promises. Round 5 exploits the choice-major slot structure
+      (slot->token map = ``tile(arange(N), K)``): the buffer build is a
+      free broadcast into ONE drop-mode unique-indices scatter, and the
+      combine is a gather + reshape-sum — one big scatter and one big
+      gather per direction, measured at the chip's gather/scatter
+      primitive rate (docs/PERF.md §MoE has the per-category table and
+      the measured-negative ragged_dot/unroll alternatives).
 
   * Expert parallelism: under GSPMD (``SPMDTrainer``) the stacked expert
     einsums partition on the expert axis automatically from the weight
@@ -288,11 +292,12 @@ class MoE(Layer):
         # dropped slots' dest clamps into range on the gather; the WHERE
         # (not a bare keep-multiply) forces their contribution to exact
         # zero even if the clamped-into expert row is inf/NaN (inf * 0
-        # would poison the dropped token — review r5); it fuses into the
-        # gather's consumer
-        contrib = jnp.where(keep[:, None],
-                            ye_flat[dest] * sg[:, None].astype(dt),
-                            jnp.zeros((), dt))
+        # would poison the dropped token — review r5). Masking the
+        # GATHERED ROWS, then multiplying by the gate, keeps the
+        # backward clean too: where(keep, row*sg, 0) would still send
+        # d(sg) = 0 * inf = NaN into the router gradient.
+        safe = jnp.where(keep[:, None], ye_flat[dest], jnp.zeros((), dt))
+        contrib = safe * sg[:, None].astype(dt)
         out = contrib.reshape(k, n, d).sum(axis=0)
         return out.reshape(b, s, d), full, mask
 
@@ -400,8 +405,9 @@ def moe_all_to_all(moe: MoE, params, x, *, axis_name: str):
     back = lax.all_to_all(ye_l, axis_name, split_axis=1, concat_axis=0,
                           tiled=True)               # [E, Cs, d]
     ye_flat = back.reshape(e * cs, d).astype(jnp.float32)
-    # where, not keep-multiply: exact zero for dropped slots even when
-    # the clamped gather row is non-finite (see _apply_dispatched)
-    contrib = jnp.where(keep[:, None], ye_flat[dest] * sg[:, None], 0.0)
+    # mask the gathered rows BEFORE the gate multiply: exact zero for
+    # dropped slots in forward AND backward even when the clamped gather
+    # row is non-finite (see _apply_dispatched)
+    contrib = jnp.where(keep[:, None], ye_flat[dest], 0.0) * sg[:, None]
     out = contrib.reshape(k, n, d).sum(axis=0)
     return out.reshape(b, s, d).astype(x.dtype), (full, mask)

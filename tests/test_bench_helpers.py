@@ -1,0 +1,88 @@
+"""Driver-facing bench.py helpers: the serving footprint model, batch
+sizing, spreads, and the cumulative summary line. These shape the
+BENCH record the driver captures — regressions here silently corrupt
+the round's evidence, so they get unit coverage even though bench.py
+itself only runs on the chip."""
+
+import json
+import os
+import sys
+
+# repo root (bench.py is not in the package) — cwd-independent
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import bench
+
+
+def test_serving_footprint_monotonic_in_batch():
+    f4 = bench._serving_footprint_gb(4, 16, 8192, 256, False, bench.LM_CFG)
+    f8 = bench._serving_footprint_gb(8, 16, 8192, 256, False, bench.LM_CFG)
+    assert f8 > f4 > 0
+
+
+def test_serving_batch_reproduces_round4_edge():
+    """The footprint budget was calibrated so MHA-bf16 P=8192 sizes to
+    batch 4 (the measured round-4 OOM edge) while gqa4-int8 gets the
+    headroom its 16x smaller cache earns."""
+    mha = bench._serving_batch(16, 8192, 256, False, bench.LM_CFG)
+    gqa_i8 = bench._serving_batch(4, 8192, 256, True, bench.LM_CFG)
+    assert mha == 4
+    assert gqa_i8 >= 8
+    # max_batch caps the ladder (the CPU smoke path)
+    assert bench._serving_batch(4, 8192, 256, True, bench.LM_CFG,
+                                max_batch=2) == 2
+
+
+def test_serving_cap_matches_generate_rounding():
+    """Footprint cache sizes must mirror generate()'s block rounding, or
+    the batch choice is for a different buffer than the one allocated."""
+    from distkeras_tpu.ops.decode_attention import (MIN_KERNEL_LEN,
+                                                    choose_block)
+    total = 8192 + 257
+    bl = choose_block(total)
+    assert bench._serving_cap(total) == -(-total // bl) * bl
+    assert bench._serving_cap(MIN_KERNEL_LEN - 1) == MIN_KERNEL_LEN - 1
+
+
+def test_lm_param_count_against_known_configs():
+    # 218M headline config and the 838M lm_big config (docs/PERF.md)
+    assert round(bench._lm_param_count(bench.LM_CFG) / 1e6) == 218
+    assert round(bench._lm_param_count(bench.LM_BIG_CFG) / 1e6) == 839
+    # GQA shrinks only the kv projections
+    full = bench._lm_param_count(bench.LM_CFG)
+    gqa = bench._lm_param_count(bench.LM_CFG, kv_heads=4)
+    assert 0 < full - gqa < full * 0.1
+
+
+def test_spread_is_min_median_max():
+    assert bench._spread([3.0, 1.0, 2.0]) == [1.0, 2.0, 3.0]
+
+
+def test_summary_line_carries_every_headline_and_stays_compact():
+    records = [
+        {"metric": "resnet50_train_imgs_per_sec_per_chip", "value": 2571.0,
+         "vs_baseline": 2.571, "unit": "imgs/sec", "mfu": 0.313},
+        {"metric": "lm_train_tokens_per_sec_per_chip", "value": 64156.0,
+         "vs_baseline": 2.14, "mfu": 0.363},
+        {"metric": "lm_generate_new_tokens_per_sec_per_chip",
+         "value": 6809.0, "vs_baseline": 1.0},
+        {"metric": "lm_generate_p8192_decode_tokens_per_sec_per_chip",
+         "value": 4449.0, "vs_baseline": 6.2,
+         "headline_variant": "gqa4_p8192_int8"},
+        {"metric": "moe_lm_train_tokens_per_sec_per_chip",
+         "value": 47218.0, "vs_baseline": 0.73},
+        {"metric": "lm_big_train_tokens_per_sec_per_chip",
+         "value": 20679.0, "vs_baseline": 1.54, "mfu": 0.559},
+    ]
+    line = bench._summary_line(records, "TPU v5 lite")
+    parsed = json.loads(line)
+    assert len(parsed["headlines"]) == 6
+    assert parsed["headlines"][
+        "lm_generate_p8192_decode_tokens_per_sec_per_chip"][
+        "headline_variant"] == "gqa4_p8192_int8"
+    # the whole point: the line must fit the driver's 2,000-char tail
+    # capture window with room for the preceding family line
+    assert len(line) < 1500, len(line)
+    # first record doubles as the line's own metric fields
+    assert parsed["value"] == 2571.0 and parsed["unit"] == "imgs/sec"
