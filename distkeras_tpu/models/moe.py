@@ -159,6 +159,10 @@ class MoE(Layer):
         and the top-k slot mask for the balance loss (None at k == E).
         Top-k INDICES, not a >= kth-value test: on tied logits the value
         test would admit every tied expert."""
+        # f32 router on purpose: routing decisions deserve full
+        # precision, and a bf16-input variant was MEASURED at identical
+        # wall clock (47.2K tok/s both ways, round 5) — the f32 upcast
+        # is off the critical path, so there is no speed to buy here
         logits = jnp.einsum("bsd,de->bse", x.astype(jnp.float32),
                             gate.astype(jnp.float32))
         full = jax.nn.softmax(logits, axis=-1)
