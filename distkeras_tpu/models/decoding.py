@@ -759,17 +759,35 @@ def generate(model: Model, prompts, max_new_tokens: int,
     elif weights_dtype is None:
         run_params = model.params
     else:
+        # fuse q/k/v into one wqkv matmul only for DEEP caches (round 5;
+        # same LENGTH threshold as the fused decode kernel, though the
+        # fusion applies on every backend — it is exact everywhere): at
+        # P=8192/b4 the fusion takes the step 1.59 -> ~1.0 ms, but at a
+        # short cache it REGRESSES decode 23% (measured 6,967 -> 5,350
+        # tok/s at the 136-position headline config — the three
+        # separate projections fuse better with their neighbors there)
+        from distkeras_tpu.ops.decode_attention import MIN_KERNEL_LEN
+        fuse_qkv = total >= MIN_KERNEL_LEN
         dt_key = jnp.dtype(weights_dtype).name
-        cached = cache_all.get(dt_key)
-        if cached is None:
-            # pre-cast + fuse q/k/v once per dtype (round 5): the fused
-            # wqkv projection cuts each decode step's three projection
-            # launches to one (see _fuse_qkv_params)
-            cached = (model.params,
-                      _fuse_qkv_params(module, _serving_params(
-                          model.params, weights_dtype)))
-            cache_all[dt_key] = cached
-        run_params = cached[1]
+        base = cache_all.get(dt_key)
+        if base is None:
+            base = (model.params,
+                    _serving_params(model.params, weights_dtype))
+            cache_all[dt_key] = base
+        if fuse_qkv:
+            # derive the fused tree FROM the cached base so every
+            # non-attention leaf is shared — a server alternating short
+            # and long prompts holds one weight tree plus the fused
+            # attention deltas, not two full copies
+            fused_key = dt_key + "+wqkv"
+            cached = cache_all.get(fused_key)
+            if cached is None:
+                cached = (model.params,
+                          _fuse_qkv_params(module, base[1]))
+                cache_all[fused_key] = cached
+            run_params = cached[1]
+        else:
+            run_params = base[1]
     # shape/capacity validation runs eagerly (fail loudly BEFORE tracing);
     # the actual buffers are created inside the compiled program below
     init_cache(module, b, 1, cache_dtype)

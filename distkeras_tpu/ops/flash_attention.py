@@ -768,7 +768,7 @@ def flash_attention(q, k, v, *, causal: bool = False,
     oracle for the kernel backward's numerics).
 
     ``block_q``/``block_k`` default adaptively: 512/1024 for full
-    attention at d_head < 128, 1024/1024 at d_head >= 128 (both
+    attention, except 1024/1024 at exactly d_head 128 causal (both
     measured optima — module header and the round-5 D=128 sweep),
     512/512 under a sliding ``window`` at every d_head — the remapped
     k-grid covers ``~window + block_q + block_k`` keys per q block, so
@@ -784,17 +784,21 @@ def flash_attention(q, k, v, *, causal: bool = False,
     if layout not in ("bshd", "bhsd"):
         raise ValueError(f"layout must be 'bshd' or 'bhsd', got {layout!r}")
     if block_q is None:
-        # d_head >= 128 prefers the square 1024 tile for FULL causal
+        # d_head == 128 prefers the square 1024 tile for FULL causal
         # attention: measured fwd+bwd at B4 H16 S2048 D128 (the lm_big
         # shape, round 5) — 1024/1024 4.58 ms vs the d64-tuned 512/1024
         # default's 6.05 (24% faster; 512/512 5.10, 2048-sized tiles
-        # fail to compile). At d64 the 512/1024 optimum stands (module
-        # header). WINDOWED attention keeps 512/512 at every d_head —
-        # its remapped k-grid covers ~window + block_q + block_k keys
-        # per q block, and the bigger q tile widens exactly the
-        # overscan 512/512 was measured to avoid.
-        block_q = 1024 if (q.shape[-1] >= 128 and window is None) \
-            else DEFAULT_BLOCK_Q
+        # fail to compile). Deliberately NARROW: exactly d_head 128 and
+        # causal — D=256 would double the measured VMEM footprint into
+        # the range that failed to compile at D=128, and non-causal
+        # shapes were not swept; both keep the 512/1024 default
+        # (documented safe through D=256). WINDOWED attention keeps
+        # 512/512 at every d_head — its remapped k-grid covers
+        # ~window + block_q + block_k keys per q block, and the bigger
+        # q tile widens exactly the overscan 512/512 was measured to
+        # avoid.
+        block_q = 1024 if (q.shape[-1] == 128 and causal
+                           and window is None) else DEFAULT_BLOCK_Q
     if block_k is None:
         block_k = DEFAULT_BLOCK_K if window is None else DEFAULT_BLOCK_Q
     bhsd = layout == "bhsd"

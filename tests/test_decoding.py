@@ -569,3 +569,53 @@ def test_generate_validates_prefill_chunk():
     with pytest.raises(ValueError, match="prefill_chunk"):
         generate(m, np.zeros((1, 8), np.int32), max_new_tokens=2,
                  prefill_chunk=0)
+
+
+# --- fused wqkv serving projection (round 5) -------------------------------
+
+def test_fused_qkv_projection_matches_separate_gqa():
+    """_project_qkv on a fused tree must reproduce the three separate
+    projections exactly — the GQA slice offsets (q: [:H], k: [H:H+Hkv],
+    v: [H+Hkv:]) are the part a refactor would silently break."""
+    from distkeras_tpu.models.attention import TransformerBlock
+    from distkeras_tpu.models.decoding import (_fuse_qkv_params,
+                                               _project_qkv)
+    from distkeras_tpu.models import Sequential
+
+    block = TransformerBlock(num_heads=4, num_kv_heads=2, mlp_ratio=2,
+                             causal=True, use_rope=True)
+    module = Sequential([block])
+    params, _, _ = module.init(jax.random.PRNGKey(0), (8, 32))
+    block.attn.head_dim = int(params[0]["attn"]["wq"].shape[-1])
+    fused = _fuse_qkv_params(module, params)
+    assert "wqkv" in fused[0]["attn"] and "wq" not in fused[0]["attn"]
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 3, 32))
+    q0, k0, v0 = _project_qkv(block.attn, params[0]["attn"], x)
+    q1, k1, v1 = _project_qkv(block.attn, fused[0]["attn"], x)
+    np.testing.assert_allclose(np.asarray(q0), np.asarray(q1), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(k0), np.asarray(k1), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(v0), np.asarray(v1), atol=1e-6)
+
+
+def test_generate_deep_cache_takes_fused_tree_and_matches_unfused():
+    """Suite-level coverage of the fused serving path (review r5: the
+    depth gate means no other test reaches it): total >= 1024 positions
+    with weights_dtype='float32' (an identity cast, so fused-vs-master
+    greedy tokens must agree) on a GQA model."""
+    m = Model.build(
+        zoo.transformer_lm(V, d_model=16, num_heads=4, num_kv_heads=2,
+                           num_layers=1, mlp_ratio=2, use_rope=True),
+        (S,), seed=7)
+    p = np.tile(PATTERN, (1, 90))[:, :1040].astype(np.int32)
+    out_master = generate(m, p, max_new_tokens=4, temperature=0.0,
+                          weights_dtype=None)
+    out_fused = generate(m, p, max_new_tokens=4, temperature=0.0,
+                         weights_dtype="float32")
+    # the fused tree must actually be in play at this depth
+    assert any("+wqkv" in k for k in m._serving_params_cache)
+    match = float((np.asarray(out_master)[:, 1040:]
+                   == np.asarray(out_fused)[:, 1040:]).mean())
+    assert match >= 0.75, (out_master[:, 1040:], out_fused[:, 1040:])
+    # short prompts at the same dtype stay on the UNFUSED base tree
+    generate(m, p[:, :64], max_new_tokens=2, weights_dtype="float32")
+    assert "float32" in m._serving_params_cache
