@@ -239,7 +239,8 @@ class MoE(Layer):
         return jnp.einsum("ecf,efd->ecd", h, w2) + b2[:, None, :]
 
     def _apply_dispatched(self, params, x):
-        """Capacity-based sort dispatch (static shapes; see module doc).
+        """Capacity-based (sort-free) dispatch — static shapes; see
+        module doc.
 
         Round 5 (dispatch-traffic restructure, measured in docs/PERF.md
         §MoE): slot ``s = k*N + n`` is CHOICE-major, so the slot->token
