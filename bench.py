@@ -994,8 +994,9 @@ def _run_mode(mode, args, on_accel, peak, device_kind):
             "value": round(value, 1),
             "unit": "tokens/sec",
             # anchor: the 218M shape's measured 36.3% MFU ceiling — the
-            # claim under test is that MFU rises with compute density
-            "vs_baseline": round(mfu / 0.363, 4) if mfu else 1.0,
+            # claim under test is that MFU rises with compute density;
+            # None (not a fabricated 1.0) when MFU is unavailable
+            "vs_baseline": round(mfu / 0.363, 4) if mfu else None,
             "head_impl": winner,
             "fused_head_tokens_per_sec": round(med_f, 1),
             "unfused_head_tokens_per_sec":

@@ -325,8 +325,11 @@ def _attn_lse(q, k, v, *, causal: bool, scale: float, layout: str):
     Layouts as in ``ops.flash_attention`` ('bshd'/'bhsd')."""
     from distkeras_tpu.ops.flash_attention import _flash_forward
     if jax.default_backend() == "tpu":
+        # mirror flash_attention's adaptive default (round 5): the
+        # square 1024 tile wins at exactly d_head 128, causal
+        bq = 1024 if (q.shape[-1] == 128 and causal) else 512
         return _flash_forward(q, k, v, scale, causal,
-                              512, 1024, False, layout == "bhsd")
+                              bq, 1024, False, layout == "bhsd")
     if layout == "bshd":
         qh = q.transpose(0, 2, 1, 3)
         kh = k.transpose(0, 2, 1, 3)

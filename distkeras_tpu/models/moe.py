@@ -78,8 +78,14 @@ def _dispatch_plan(experts, gates, num_experts: int, capacity: int):
     ranks = jnp.cumsum(onehot, axis=0) - onehot         # [K*N, E] exclusive
     pos = jnp.take_along_axis(ranks, slot_e[:, None], axis=1)[:, 0]
     keep = pos < capacity
+    # dropped slots get UNIQUE out-of-range sentinels (E*C + slot index),
+    # not one shared overflow value: the consumers scatter with
+    # unique_indices=True, a promise a shared sentinel would break
+    # (implementation-defined behavior per the XLA scatter contract —
+    # review r5); mode="drop" discards every OOB row either way
     dest = jnp.where(keep, slot_e * capacity + pos,
-                     num_experts * capacity)
+                     num_experts * capacity
+                     + jnp.arange(n * k, dtype=pos.dtype))
     return dest, slot_t, slot_g, keep
 
 

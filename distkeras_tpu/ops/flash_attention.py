@@ -798,7 +798,8 @@ def flash_attention(q, k, v, *, causal: bool = False,
         # q tile widens exactly the overscan 512/512 was measured to
         # avoid.
         block_q = 1024 if (q.shape[-1] == 128 and causal
-                           and window is None) else DEFAULT_BLOCK_Q
+                           and window is None
+                           and segment_ids is None) else DEFAULT_BLOCK_Q
     if block_k is None:
         block_k = DEFAULT_BLOCK_K if window is None else DEFAULT_BLOCK_Q
     bhsd = layout == "bhsd"

@@ -74,7 +74,10 @@ def main():
     # activation memory — the >= 32K-prompt serving lever. Match
     # fraction, not bitwise equality: the lse merge is algebraically
     # exact but fp-reassociated vs the one-pass softmax, so a near-tied
-    # argmax could legitimately flip (same contract as the int8 check)
+    # argmax could legitimately flip (same contract as the int8 check;
+    # on this MEMORIZED model a flip re-locks onto the pattern within a
+    # token or two, so the cascade risk the threshold can't cover for
+    # arbitrary models does not apply here)
     out_ck = generate(model, prompts, max_new_tokens=16, temperature=0.0,
                       prefill_chunk=16)
     ck_match = float((np.asarray(out_bf) == np.asarray(out_ck)).mean())

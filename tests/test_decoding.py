@@ -613,9 +613,12 @@ def test_generate_deep_cache_takes_fused_tree_and_matches_unfused():
                          weights_dtype="float32")
     # the fused tree must actually be in play at this depth
     assert any("+wqkv" in k for k in m._serving_params_cache)
-    match = float((np.asarray(out_master)[:, 1040:]
-                   == np.asarray(out_fused)[:, 1040:]).mean())
-    assert match >= 0.75, (out_master[:, 1040:], out_fused[:, 1040:])
+    # compare the FIRST new token only: greedy comparisons cascade on a
+    # near-tie flip, so later positions are not independent evidence
+    # (the fused projection's exact numerics are pinned by
+    # test_fused_qkv_projection_matches_separate_gqa above)
+    np.testing.assert_array_equal(np.asarray(out_master)[:, 1040],
+                                  np.asarray(out_fused)[:, 1040])
     # short prompts at the same dtype stay on the UNFUSED base tree
     generate(m, p[:, :64], max_new_tokens=2, weights_dtype="float32")
     assert "float32" in m._serving_params_cache
