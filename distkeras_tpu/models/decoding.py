@@ -318,7 +318,8 @@ def _merge_attention(o_a, lse_a, o_b, lse_b):
         / (wa + wb)
 
 
-def _attn_lse(q, k, v, *, causal: bool, scale: float, layout: str):
+def _attn_lse(q, k, v, *, causal: bool, scale: float, layout: str,
+              window=None):
     """Attention WITH its log-sum-exp: the real flash kernel on TPU, a
     plain XLA softmax path elsewhere (the chunked-prefill building block;
     interpreter-mode Pallas is too slow for long-prefix CPU tests).
@@ -326,10 +327,12 @@ def _attn_lse(q, k, v, *, causal: bool, scale: float, layout: str):
     from distkeras_tpu.ops.flash_attention import _flash_forward
     if jax.default_backend() == "tpu":
         # mirror flash_attention's adaptive default (round 5): the
-        # square 1024 tile wins at exactly d_head 128, causal
-        bq = 1024 if (q.shape[-1] == 128 and causal) else 512
-        return _flash_forward(q, k, v, scale, causal,
-                              bq, 1024, False, layout == "bhsd")
+        # square 1024 tile wins at exactly d_head 128, causal unwindowed
+        bq = 1024 if (q.shape[-1] == 128 and causal
+                      and window is None) else 512
+        bk = 1024 if window is None else 512
+        return _flash_forward(q, k, v, scale, causal, bq, bk, False,
+                              layout == "bhsd", window)
     if layout == "bshd":
         qh = q.transpose(0, 2, 1, 3)
         kh = k.transpose(0, 2, 1, 3)
@@ -342,6 +345,9 @@ def _attn_lse(q, k, v, *, causal: bool, scale: float, layout: str):
         sq, sk = s.shape[-2], s.shape[-1]
         qpos = jnp.arange(sq)[:, None] + (sk - sq)
         s = jnp.where(qpos >= jnp.arange(sk)[None, :], s, NEG_INF)
+        if window is not None:
+            s = jnp.where(jnp.arange(sk)[None, :] > qpos - window, s,
+                          NEG_INF)
     lse = jax.scipy.special.logsumexp(s, axis=-1)
     o = jnp.einsum("bhqk,bhkd->bhqd", jnp.exp(s - lse[..., None]),
                    vh.astype(jnp.float32))
@@ -350,18 +356,43 @@ def _attn_lse(q, k, v, *, causal: bool, scale: float, layout: str):
     return o.astype(q.dtype), lse
 
 
-def _cache_prefix(kv, upto: int, dt):
-    """The first ``upto`` cache positions as dense [B, Hkv, upto, D]
-    k/v in the compute dtype (int8 payloads dequantize here — the
-    chunked prefill attends to what later decode steps will read, the
-    standard quantized-cache serving contract)."""
-    k = kv["k"][:, :, :upto]
-    v = kv["v"][:, :, :upto]
+def _banded_prefix_attn(q, kp, vp, t0: int, lo: int, window: int,
+                        scale: float):
+    """Chunk queries against the sliding-window PREFIX BAND
+    ``[lo, t0)`` (at most ``window - 1`` keys): plain masked attention
+    with its lse — global query position ``t0 + i`` attends band key
+    ``j`` iff ``j > t0 + i - window`` (causality ``j < t0 <= t0+i`` is
+    structural). Queries whose window lies entirely inside the chunk
+    get a fully-masked row; with the finite ``NEG_INF`` its lse is
+    ~-1e30, so the lse merge weights that partial to exactly 0 — no
+    special-casing needed. q: [B, Q, H, D]; kp/vp: [B, H, Lb, D]
+    (already head-expanded; the band is < window keys, so the
+    expansion is small)."""
+    qh = q.transpose(0, 2, 1, 3)                         # [B, H, Q, D]
+    s = jnp.einsum("bhqd,bhkd->bhqk", qh.astype(jnp.float32) * scale,
+                   kp.astype(jnp.float32))
+    jpos = lo + jnp.arange(s.shape[-1])[None, :]         # band keys
+    gi = t0 + jnp.arange(s.shape[-2])[:, None]           # global q pos
+    s = jnp.where(jpos > gi - window, s, NEG_INF)
+    lse = jax.scipy.special.logsumexp(s, axis=-1)
+    o = jnp.einsum("bhqk,bhkd->bhqd", jnp.exp(s - lse[..., None]),
+                   vp.astype(jnp.float32))
+    return o.transpose(0, 2, 1, 3).astype(q.dtype), lse
+
+
+def _cache_prefix(kv, upto: int, dt, lo: int = 0):
+    """Cache positions ``[lo, upto)`` as dense [B, Hkv, upto-lo, D] k/v
+    in the compute dtype (int8 payloads dequantize here — the chunked
+    prefill attends to what later decode steps will read, the standard
+    quantized-cache serving contract). Slicing BEFORE the dequant keeps
+    the SWA band path O(window), not O(prefix)."""
+    k = kv["k"][:, :, lo:upto]
+    v = kv["v"][:, :, lo:upto]
     if "k_scale" in kv:
         k = (k.astype(jnp.float32)
-             * kv["k_scale"][:, :, :upto, None]).astype(dt)
+             * kv["k_scale"][:, :, lo:upto, None]).astype(dt)
         v = (v.astype(jnp.float32)
-             * kv["v_scale"][:, :, :upto, None]).astype(dt)
+             * kv["v_scale"][:, :, lo:upto, None]).astype(dt)
     return k.astype(dt), v.astype(dt)
 
 
@@ -372,8 +403,11 @@ def _prefill_block_chunked(block: TransformerBlock, p, s, kv, x, positions,
     [0, t0) — one non-causal flash pass, with the GQA group folded into
     the query rows so the shared K/V heads are never expanded — and (b)
     the chunk itself, causally; the two partials merge exactly through
-    their log-sum-exps. Activation memory is O(chunk), not O(P): the
-    [B, P, H, D] per-layer q/k/v of the one-pass prefill never exist."""
+    their log-sum-exps. Sliding-window models use a windowed diagonal
+    pass plus a masked PREFIX BAND of the last ``window - 1`` positions
+    (``_banded_prefix_attn``). Activation memory is O(chunk), not O(P):
+    the [B, P, H, D] per-layer q/k/v of the one-pass prefill never
+    exist."""
     attn = block.attn
     dt = jnp.dtype(attn.dtype)
     h_, _ = block.norm1.apply(p["norm1"], s["norm1"], x)
@@ -383,37 +417,53 @@ def _prefill_block_chunked(block: TransformerBlock, p, s, kv, x, positions,
         q = apply_rope(q, positions, scale=attn.rope_scale)
         k = apply_rope(k, positions, scale=attn.rope_scale)
     kv = _cache_write(kv, k, v, t0)
-    if attn.attn_window is not None:
-        raise NotImplementedError(
-            "chunked prefill does not support sliding-window attention "
-            "yet; use the one-pass prefill (prefill_chunk=None)")
     b, q_len, nh, dh = q.shape
     hkv = attn.kv_heads
     g = nh // hkv
     scale = (attn.head_dim or dh) ** -0.5
-    # (b) causal within the chunk (small: kv expansion is chunk-sized)
+    window = attn.attn_window
+    # (b) causal within the chunk (small: kv expansion is chunk-sized);
+    # sliding-window models window the diagonal pass too
     ke, ve = attn._expand_kv(k, 2), attn._expand_kv(v, 2)
     o_diag, lse_diag = _attn_lse(q, ke, ve, causal=True, scale=scale,
-                                 layout="bshd")     # [B,Q,H,D], [B,H,Q]
-    if t0 > 0:
-        # (a) chunk vs prefix: no causal structure (every chunk query is
-        # newer than every prefix key), so the G query heads sharing one
-        # KV head fold into the ROW axis — [B*Hkv, G*Q, D] against
-        # [B*Hkv, t0, D] — and the cache is read in its native head-major
-        # layout with no expansion
-        kp, vp = _cache_prefix(kv, t0, dt)
-        qg = q.reshape(b, q_len, hkv, g, dh) \
-              .transpose(0, 2, 3, 1, 4) \
-              .reshape(b * hkv, 1, g * q_len, dh)
-        o_pre, lse_pre = _attn_lse(
-            qg, kp.reshape(b * hkv, 1, t0, dh),
-            vp.reshape(b * hkv, 1, t0, dh),
-            causal=False, scale=scale, layout="bhsd")
-        o_pre = o_pre.reshape(b, hkv, g, q_len, dh) \
-                     .transpose(0, 3, 1, 2, 4).reshape(b, q_len, nh, dh)
-        # (hkv, g) are already adjacent in head order h = hkv_i*g + g_i:
-        # flatten directly — a transpose here would scramble (pos, group)
-        lse_pre = lse_pre.reshape(b, hkv, g, q_len).reshape(b, nh, q_len)
+                                 layout="bshd", window=window)
+    # prefix reach: everything before the chunk for full attention; only
+    # the last window-1 positions for SWA (older keys are out of every
+    # chunk query's reach)
+    lo = 0 if window is None else max(0, t0 - window + 1)
+    if t0 > lo:
+        kp, vp = _cache_prefix(kv, t0, dt, lo=lo)
+        if window is None:
+            # (a) chunk vs prefix: no causal structure (every chunk
+            # query is newer than every prefix key), so the G query
+            # heads sharing one KV head fold into the ROW axis —
+            # [B*Hkv, G*Q, D] against [B*Hkv, t0, D] — and the cache is
+            # read in its native head-major layout with no expansion
+            qg = q.reshape(b, q_len, hkv, g, dh) \
+                  .transpose(0, 2, 3, 1, 4) \
+                  .reshape(b * hkv, 1, g * q_len, dh)
+            o_pre, lse_pre = _attn_lse(
+                qg, kp.reshape(b * hkv, 1, t0, dh),
+                vp.reshape(b * hkv, 1, t0, dh),
+                causal=False, scale=scale, layout="bhsd")
+            o_pre = o_pre.reshape(b, hkv, g, q_len, dh) \
+                         .transpose(0, 3, 1, 2, 4) \
+                         .reshape(b, q_len, nh, dh)
+            # (hkv, g) are already adjacent in head order
+            # h = hkv_i*g + g_i: flatten directly — a transpose here
+            # would scramble (pos, group)
+            lse_pre = lse_pre.reshape(b, hkv, g, q_len) \
+                             .reshape(b, nh, q_len)
+        else:
+            # (a') SWA prefix BAND [lo, t0): the window edge crosses the
+            # band per query, so this is masked attention (the GQA fold
+            # would break the per-position mask); the band is < window
+            # keys, so expanding its kv heads in place (axis 1 of the
+            # native [B, Hkv, Lb, D] layout) is small. Round 5: closes
+            # the chunked-prefill SWA gap.
+            o_pre, lse_pre = _banded_prefix_attn(
+                q, attn._expand_kv(kp, 1), attn._expand_kv(vp, 1),
+                t0, lo, window, scale)
         out = _merge_attention(
             o_pre.transpose(0, 2, 1, 3), lse_pre,
             o_diag.transpose(0, 2, 1, 3), lse_diag).transpose(0, 2, 1, 3)

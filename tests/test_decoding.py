@@ -554,14 +554,39 @@ def test_chunked_prefill_cache_identical_to_one_pass(kv_heads):
                                        np.asarray(b[key]), atol=1e-5)
 
 
-def test_chunked_prefill_rejects_sliding_window():
+@pytest.mark.parametrize("attn_window,chunk", [
+    (8, 8),    # window == chunk: band spans into the previous chunk
+    (8, 4),    # window > chunk: band reaches two chunks back
+    (3, 8),    # window < chunk: most queries never touch the band
+])
+def test_chunked_prefill_sliding_window_matches_one_pass(attn_window,
+                                                         chunk):
+    """SWA chunked prefill (round 5): windowed diagonal + masked prefix
+    band must reproduce the one-pass windowed prefill's cache and
+    logits (the band mask and the fully-masked-row merge are the parts
+    a refactor would break)."""
+    from distkeras_tpu.models.decoding import (_resolve_head_dims,
+                                               prefill, prefill_chunked)
     m = Model.build(
-        zoo.transformer_lm(V, d_model=32, num_heads=4, num_layers=1,
-                           mlp_ratio=2, use_rope=True, attn_window=8),
-        (S,), seed=0)
-    with pytest.raises(NotImplementedError, match="window"):
-        generate(m, np.zeros((1, 20), np.int32), max_new_tokens=2,
-                 prefill_chunk=8)
+        zoo.transformer_lm(V, d_model=32, num_heads=4, num_kv_heads=2,
+                           num_layers=2, mlp_ratio=2, use_rope=True,
+                           attn_window=attn_window),
+        (S,), seed=6)
+    _resolve_head_dims(m.module, m.params)
+    prompts = jnp.asarray(
+        np.random.RandomState(3).randint(0, V, (2, 27)), jnp.int32)
+    c0 = init_cache(m.module, 2, 30)
+    logits_a, cache_a = prefill(m.module, m.params, m.state, c0, prompts)
+    logits_b, cache_b = prefill_chunked(m.module, m.params, m.state, c0,
+                                        prompts, chunk)
+    np.testing.assert_allclose(np.asarray(logits_a),
+                               np.asarray(logits_b), atol=2e-5)
+    for a, b in zip(cache_a, cache_b):
+        if a is None:
+            continue
+        for key in a:
+            np.testing.assert_allclose(np.asarray(a[key]),
+                                       np.asarray(b[key]), atol=1e-5)
 
 
 def test_generate_validates_prefill_chunk():
