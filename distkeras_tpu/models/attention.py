@@ -26,6 +26,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+from distkeras_tpu.compat import axis_size, backend_is_tpu
 from distkeras_tpu.models.core import (Layer, layer_from_spec, layer_spec,
                                        register_layer)
 from distkeras_tpu.models.layers import Dropout, get_activation, init_weights
@@ -98,7 +99,7 @@ class PositionalEmbedding(Layer):
         if self.seq_axis_name and self._axis_bound():
             # fail loudly if the table can't cover the GLOBAL sequence —
             # dynamic_slice would silently clamp out-of-range shard starts
-            global_len = s * jax.lax.axis_size(self.seq_axis_name)
+            global_len = s * axis_size(self.seq_axis_name)
             if global_len > self.max_len:
                 raise ValueError(
                     f"PositionalEmbedding(max_len={self.max_len}) is too "
@@ -116,7 +117,7 @@ class PositionalEmbedding(Layer):
         (e.g. unsharded eval via model.predict) the input holds the FULL
         sequence, so shard-local slicing is the correct behavior."""
         try:
-            jax.lax.axis_size(self.seq_axis_name)
+            axis_size(self.seq_axis_name)
             return True
         except NameError:
             return False
@@ -141,7 +142,7 @@ def _attention_compute(q, k, v, *, causal, impl, axis_name=None,
         # kernel (in-kernel backward) trains 2.15x faster than fused XLA
         # attention at seq 2048; off-TPU the kernel only runs in
         # interpreter mode, where XLA wins
-        impl = "flash" if jax.default_backend() == "tpu" else "xla"
+        impl = "flash" if backend_is_tpu() else "xla"
     if impl == "flash":
         from distkeras_tpu.ops.flash_attention import flash_attention
         return flash_attention(q, k, v, causal=causal, window=window,
@@ -264,7 +265,7 @@ class MultiHeadAttention(Layer):
         xc = x.astype(dt)
         impl = self.attn_impl
         if impl == "auto":
-            impl = "flash" if jax.default_backend() == "tpu" else "xla"
+            impl = "flash" if backend_is_tpu() else "xla"
         positions = None
         if (self.use_rope
                 and impl in ("ring", "ulysses", "ulysses_flash")

@@ -35,6 +35,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from distkeras_tpu.compat import backend_is_tpu
 from distkeras_tpu.models.attention import (MultiHeadAttention,
                                             PositionalEmbedding,
                                             TransformerBlock)
@@ -159,7 +160,7 @@ def _int8_mm_dtype():
     """Matmul dtype for the int8-dequant cache contractions: bf16 on TPU
     (native MXU mode), f32 elsewhere (CPU XLA's dot runtime has no
     bf16xbf16->f32 kernel)."""
-    return jnp.bfloat16 if jax.default_backend() == "tpu" else jnp.float32
+    return jnp.bfloat16 if backend_is_tpu() else jnp.float32
 
 
 def _decode_scores(qg, kv):
@@ -229,7 +230,7 @@ def _decode_attn(attn: MultiHeadAttention, p, kv, x, t):
     from distkeras_tpu.ops.decode_attention import (MIN_KERNEL_LEN,
                                                     block_of,
                                                     decode_attention)
-    if jax.default_backend() == "tpu" and L >= MIN_KERNEL_LEN \
+    if backend_is_tpu() and L >= MIN_KERNEL_LEN \
             and block_of(L) is not None:
         # deep caches only: at L < 1024 the per-program overhead of the
         # kernel's grid outweighs its single-pass read (measured — the
@@ -294,7 +295,7 @@ def _prefill_block(block: TransformerBlock, p, s, kv, x, positions):
         k = apply_rope(k, positions, scale=attn.rope_scale)
     kv = _cache_write(kv, k, v, 0)
     ke, ve = attn._expand_kv(k, 2), attn._expand_kv(v, 2)
-    impl = "flash" if jax.default_backend() == "tpu" else "xla"
+    impl = "flash" if backend_is_tpu() else "xla"
     out = _attention_compute(q, ke, ve, causal=True, impl=impl,
                              window=attn.attn_window)
     y = jnp.einsum("bshe,hed->bsd", out.astype(dt), p["attn"]["wo"]
@@ -325,7 +326,7 @@ def _attn_lse(q, k, v, *, causal: bool, scale: float, layout: str,
     interpreter-mode Pallas is too slow for long-prefix CPU tests).
     Layouts as in ``ops.flash_attention`` ('bshd'/'bhsd')."""
     from distkeras_tpu.ops.flash_attention import _flash_forward
-    if jax.default_backend() == "tpu":
+    if backend_is_tpu():
         # mirror flash_attention's adaptive default (round 5): the
         # square 1024 tile wins at exactly d_head 128, causal unwindowed
         bq = 1024 if (q.shape[-1] == 128 and causal
@@ -724,7 +725,18 @@ def generate(model: Model, prompts, max_new_tokens: int,
     many positions (see :func:`prefill_chunked`) — peak prefill
     activation memory becomes O(chunk) instead of O(P), the enabler for
     >= 32K prompts; TTFT stays quadratic-compute-bound. ``None`` (the
-    default) is the one-pass prefill."""
+    default) is the one-pass prefill.
+
+    Backend contract (``compat.backend_is_tpu`` — the repo-wide
+    convention every Pallas-vs-XLA fork follows, including the fused
+    MoE dispatch): kernel selection keys off the TRACE-TIME default
+    backend, not the runtime device of the inputs. The traced program
+    assumes it executes on ``jax.default_backend()``; to serve from a
+    non-default device (e.g. CPU inside a TPU-backed process), wrap the
+    call in ``jax.default_device(...)`` so trace-time agrees with
+    run-time — per-input device dispatch is deliberately NOT supported
+    (it would fork every jitted serving program on an attribute jit
+    erases)."""
     module = model.module
     if not isinstance(module, Sequential):
         raise TypeError("generate() expects a Sequential LM "
@@ -888,10 +900,10 @@ def generate(model: Model, prompts, max_new_tokens: int,
             # Capacity rounds up to the decode kernel's block size on
             # TPU so every serving call takes the fused Pallas path
             # (the margin is masked; models position checks use `total`)
-            if jax.default_backend() == "tpu":
+            if backend_is_tpu():
                 from distkeras_tpu.ops.decode_attention import \
                     MIN_KERNEL_LEN, choose_block
-            if jax.default_backend() == "tpu" and total >= MIN_KERNEL_LEN:
+            if backend_is_tpu() and total >= MIN_KERNEL_LEN:
                 bl = choose_block(total)
                 cap = -(-total // bl) * bl
             else:

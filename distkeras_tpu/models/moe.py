@@ -30,6 +30,18 @@ for the Spark design) — this is a TPU-native addition. Design:
       gather per direction, measured at the chip's gather/scatter
       primitive rate (docs/PERF.md §MoE has the per-category table and
       the measured-negative ragged_dot/unroll alternatives).
+    - ``dispatch="fused"`` (round 6): the Pallas fused path
+      (``ops/moe_kernels.py``) — the dispatch gather happens INSIDE the
+      expert up-projection kernel (token rows are DMA'd from the
+      residual stream straight into contiguous VMEM tiles, MegaBlocks-
+      style), so the ``tokens`` path's [K*N, d] scatter and [E*C, d]
+      HBM dispatch buffer never materialize; the backward pass is the
+      gather's transpose in a custom VJP (also gathers — see the kernel
+      module doc). Identical routing/drop/tie-break/NaN semantics to
+      ``tokens`` (both consume one ``_dispatch_plan``). Off-TPU the
+      layer automatically falls back to the ``tokens`` XLA floor
+      (``compat.backend_is_tpu`` — the repo's one backend convention);
+      tests force the interpreter via ``moe_kernels.force_interpret``.
 
   * Expert parallelism: under GSPMD (``SPMDTrainer``) the stacked expert
     einsums partition on the expert axis automatically from the weight
@@ -114,9 +126,10 @@ class MoE(Layer):
         # pushing the router away from expert collapse. Published via the
         # AUX_LOSS_KEY state channel (parallel.worker picks it up).
         self.aux_loss_weight = float(aux_loss_weight)
-        if dispatch not in ("dense", "tokens"):
+        if dispatch not in ("dense", "tokens", "fused"):
             raise ValueError(
-                f"dispatch must be 'dense' or 'tokens', got {dispatch!r}")
+                "dispatch must be 'dense', 'tokens' or 'fused', "
+                f"got {dispatch!r}")
         self.dispatch = dispatch
         # expert capacity = ceil(top_k * tokens / E) * capacity_factor:
         # at 1.0 a perfectly balanced router drops nothing; the default
@@ -207,6 +220,25 @@ class MoE(Layer):
         per = -(-self.top_k * n_tokens // self.num_experts)  # ceil
         return max(1, int(per * self.capacity_factor))
 
+    @staticmethod
+    def _expert_axis_sharded(w) -> bool:
+        """Best-effort: True when a CONCRETE stacked expert weight
+        carries a non-replicated GSPMD sharding on its leading (expert)
+        axis — the configuration where ``expert_unroll``'s per-expert
+        slices force cross-shard resharding collectives every step
+        (see ``__init__``). Mirrors the ``replicated()`` probe in
+        ``decoding._fuse_qkv_params``; inside jit/shard_map the weights
+        are tracers with no sharding attribute and this stays False
+        (the shard_map path's weights arrive pre-sliced and are safe;
+        the GSPMD-trainer path is covered at SETUP time instead, where
+        ``parallel.sharding._rule_MoE`` warns on the concrete
+        layer-config x expert-axis combination)."""
+        sh = getattr(w, "sharding", None)
+        if sh is None or getattr(sh, "is_fully_replicated", True):
+            return False
+        spec = getattr(sh, "spec", None)
+        return bool(spec) and spec[0] is not None
+
     def _expert_mlp(self, xe, params):
         """Run the stacked expert MLP on [E(_local), C, d]. Under
         shard_map expert parallelism the weights arrive pre-sliced to the
@@ -220,7 +252,19 @@ class MoE(Layer):
         w2 = params["w2"].astype(dt)
         b2 = params["b2"].astype(dt)
         e_here = xe.shape[0]
-        if self.expert_unroll and e_here > 1:
+        unroll = self.expert_unroll
+        if unroll and self._expert_axis_sharded(params["w1"]):
+            import warnings
+            warnings.warn(
+                "MoE(expert_unroll=True) with expert-axis-sharded "
+                "stacked weights (GSPMD): per-expert slices of a "
+                "sharded axis pay cross-shard resharding collectives "
+                "every step — falling back to the batched expert dot "
+                "for this call. Replicate the expert weights or use "
+                "shard_map expert parallelism (expert_axis_name) to "
+                "unroll.", stacklevel=3)
+            unroll = False
+        if unroll and e_here > 1:
             # static unroll into small groups of batched dots: measured
             # sweep on v5e (E=8, C=4096) — 4 groups 3.1/3.4 ms fwd/f+g
             # vs 3.9/4.0 for the single batched dot; FULL unroll (8
@@ -244,7 +288,7 @@ class MoE(Layer):
         h = act(jnp.einsum("ecd,edf->ecf", xe, w1) + b1[:, None, :])
         return jnp.einsum("ecf,efd->ecd", h, w2) + b2[:, None, :]
 
-    def _apply_dispatched(self, params, x):
+    def _apply_dispatched(self, params, x, *, fused=False):
         """Capacity-based (sort-free) dispatch — static shapes; see
         module doc.
 
@@ -261,7 +305,11 @@ class MoE(Layer):
 
         One [K*N, d] scatter (buffer build) + one gather (combine read)
         remain per direction — half the round-4 traffic; their cost is
-        the dispatch's irreducible price on one chip."""
+        the dispatch's irreducible price on one chip — UNLESS the
+        Pallas fused path takes over (``fused=True``, round 6): there
+        the SAME plan's indices drive in-kernel row DMA instead, and
+        neither the scatter nor the [E*C, d] buffer exists
+        (``ops/moe_kernels.py``)."""
         dt = jnp.dtype(self.dtype)
         b, s, d = x.shape
         n = b * s
@@ -272,6 +320,40 @@ class MoE(Layer):
         dest, _st, sg, keep = _dispatch_plan(
             topi.reshape(n, k), gates.reshape(n, k), e, c)
         xt = x.reshape(n, d).astype(dt)
+
+        if fused:
+            from distkeras_tpu.ops import moe_kernels
+            w1 = params["w1"].astype(dt)
+            b1 = params["b1"].astype(dt)
+            w2 = params["w2"].astype(dt)
+            b2 = params["b2"].astype(dt)
+            if self.expert_axis_name is None:
+                out = moe_kernels.fused_moe_apply(
+                    xt, w1, b1, w2, b2, sg, dest, keep,
+                    capacity=c, activation=self.activation)
+            else:
+                # tokens replicated across the axis (as in the XLA path
+                # below): each shard runs the kernel over ITS experts
+                # only. The global plan localizes by offsetting ``dest``
+                # into this shard's rows; slots belonging to other
+                # shards get unique OUT-OF-RANGE sentinels (negative
+                # indices would WRAP in the plan-inversion scatter) and
+                # a cleared ``keep``, so they contribute exact zeros and
+                # the psum over the axis reassembles the full combine.
+                el = params["w1"].shape[0]
+                idx = lax.axis_index(self.expert_axis_name)
+                dest_l = dest - idx * el * c
+                keep_l = jnp.logical_and(
+                    keep, jnp.logical_and(dest_l >= 0, dest_l < el * c))
+                dest_l = jnp.where(
+                    keep_l, dest_l,
+                    el * c + jnp.arange(n * k, dtype=dest.dtype))
+                out = moe_kernels.fused_moe_apply(
+                    xt, w1, b1, w2, b2, sg, dest_l, keep_l,
+                    capacity=c, activation=self.activation)
+                out = lax.psum(out, self.expert_axis_name)
+            return out.reshape(b, s, d), full, mask
+
         src = jnp.broadcast_to(xt[None], (k, n, d)).reshape(k * n, d)
         # dropped slots (dest == E*C) fall off via mode="drop";
         # unique_indices lets XLA skip collision handling (the overflow-
@@ -315,8 +397,17 @@ class MoE(Layer):
     def apply(self, params, state, x, *, training=False, rng=None):
         dt = jnp.dtype(self.dtype)
 
-        if self.dispatch == "tokens":
-            out, full, mask = self._apply_dispatched(params, x)
+        if self.dispatch in ("tokens", "fused"):
+            use_fused = False
+            if self.dispatch == "fused":
+                # one backend convention repo-wide (compat.backend_is_tpu,
+                # consulted inside fused_supported): kernels on TPU or
+                # under a test's force_interpret; the XLA-floor tokens
+                # path — same plan, same numerics — everywhere else
+                from distkeras_tpu.ops import moe_kernels
+                use_fused = moe_kernels.fused_supported()
+            out, full, mask = self._apply_dispatched(params, x,
+                                                     fused=use_fused)
             new_state = state
             if self.aux_loss_weight and training:
                 new_state = dict(state)
@@ -384,8 +475,12 @@ def moe_all_to_all(moe: MoE, params, x, *, axis_name: str):
     balance loss (which must then be ``lax.pmean``'d over ``axis_name`` —
     shards see different tokens).
     """
-    if moe.dispatch != "tokens":
-        raise ValueError("moe_all_to_all requires dispatch='tokens'")
+    if moe.dispatch not in ("tokens", "fused"):
+        raise ValueError(
+            "moe_all_to_all requires dispatch='tokens' (or 'fused', "
+            "which composes identically here: the exchange buffer is "
+            "materialized BY the all_to_all, so there is no dispatch "
+            "scatter for the fused kernel to remove)")
     dt = jnp.dtype(moe.dtype)
     b, s, d = x.shape
     n = b * s                                       # LOCAL tokens

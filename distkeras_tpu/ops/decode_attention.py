@@ -56,6 +56,7 @@ try:
 except ImportError:  # pragma: no cover
     pltpu = None
 
+from distkeras_tpu.compat import backend_is_tpu
 from distkeras_tpu.ops.attention import NEG_INF
 
 #: candidate L tile sizes, largest first — `choose_block` picks per length
@@ -177,7 +178,7 @@ def decode_attention(q, k, v, t, *, scale: Optional[float] = None,
     if scale is None:
         scale = d ** -0.5
     if interpret is None:
-        interpret = jax.default_backend() != "tpu"
+        interpret = not backend_is_tpu()
     quantized = k_scale is not None
     # Mosaic tiling wants block second-to-last dims % 8 == 0: pad the G
     # row axis to 8 (zero rows cost nothing — the kernel is read-bound)

@@ -43,6 +43,7 @@ try:
 except ImportError:  # pragma: no cover
     pltpu = None
 
+from distkeras_tpu.compat import backend_is_tpu
 from distkeras_tpu.ops.attention import (NEG_INF, causal_mask,
                                          dot_product_attention)
 
@@ -825,7 +826,7 @@ def flash_attention(q, k, v, *, causal: bool = False,
 
     if pltpu is None:  # no Pallas TPU support in this jax build
         return _xla_fallback()
-    on_tpu = jax.default_backend() == "tpu"
+    on_tpu = backend_is_tpu()
     if interpret is None:
         interpret = not on_tpu
         if interpret and q.shape[seq_axis] * k.shape[seq_axis] > 256 * 256:

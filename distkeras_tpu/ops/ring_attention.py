@@ -74,11 +74,18 @@ def _merge_block(m, l, acc, qf, ks, vs, q_pos, k_pos, causal,
 
 def _vary(x, axis_name):
     """Tag initial loop carries with the axis's varying type (jax >= 0.7
-    shard_map vma check)."""
+    shard_map vma check). On jax versions predating the vma machinery
+    (no ``pcast``/``pvary``) there is nothing to tag — the experimental
+    shard_map runs with the replication check off (see ``compat``) —
+    so the identity is the correct no-op."""
     try:
         return lax.pcast(x, axis_name, to="varying")
     except (AttributeError, TypeError):
+        pass
+    try:
         return lax.pvary(x, axis_name)
+    except AttributeError:
+        return x
 
 
 def _check_block(block_size, s_local):
@@ -102,26 +109,31 @@ def _ring_forward(q, k, v, scale, causal, block_size, axis_name,
     the k-side copy rotates around the ring with its K/V blocks.
     """
     n = lax.psum(1, axis_name)
-    idx = lax.axis_index(axis_name)
     b, s_local, h, d = q.shape
     perm = [(j, (j + 1) % n) for j in range(n)]
 
     qf = q.astype(jnp.float32) * scale
-    q_pos = idx * s_local + jnp.arange(s_local)
+    # global positions exist ONLY for the causal mask. Computing them
+    # unconditionally plants a dead `axis_index` in the non-causal body,
+    # which the custom_vjp call shields from DCE — and older XLA SPMD
+    # partitioners hard-error on the orphaned partition-id op.
+    idx = lax.axis_index(axis_name) if causal else None
+    q_pos = None if idx is None else idx * s_local + jnp.arange(s_local)
     block, nblk = _check_block(block_size, s_local)
     q_seg = None if segment_ids is None \
         else jnp.asarray(segment_ids, jnp.int32)
 
     def body(t, carry):
         m, l, acc, kc, vc, sc = carry
-        src = (idx - t) % n                                  # block owner
-        shard_pos0 = src * s_local
+        # block owner (position bookkeeping, causal only)
+        shard_pos0 = None if idx is None else ((idx - t) % n) * s_local
 
         def inner(inner_carry, kb):
             m, l, acc = inner_carry
             ks = lax.dynamic_slice_in_dim(kc, kb * block, block, axis=1)
             vs = lax.dynamic_slice_in_dim(vc, kb * block, block, axis=1)
-            k_pos = shard_pos0 + kb * block + jnp.arange(block)
+            k_pos = None if shard_pos0 is None \
+                else shard_pos0 + kb * block + jnp.arange(block)
             k_seg = None if sc is None else \
                 lax.dynamic_slice_in_dim(sc, kb * block, block, axis=1)
             return _merge_block(m, l, acc, qf, ks, vs, q_pos, k_pos,
@@ -174,7 +186,6 @@ def _ring_bwd_rule(scale, causal, block_size, axis_name, res, g):
     with their K/V blocks and arrive home after n hops."""
     q, k, v, out, lse, segment_ids = res
     n = lax.psum(1, axis_name)
-    idx = lax.axis_index(axis_name)
     b, s_local, h, d = q.shape
     perm = [(j, (j + 1) % n) for j in range(n)]
 
@@ -183,15 +194,16 @@ def _ring_bwd_rule(scale, causal, block_size, axis_name, res, g):
     # delta_i = rowsum(dO * O) (flash trick), shaped like lse [B, H, Sl, 1]
     delta = jnp.sum(gf * out.astype(jnp.float32), axis=-1) \
         .transpose(0, 2, 1)[..., None]
-    q_pos = idx * s_local + jnp.arange(s_local)
+    # positions causal-only, as in the forward (dead-axis_index hazard)
+    idx = lax.axis_index(axis_name) if causal else None
+    q_pos = None if idx is None else idx * s_local + jnp.arange(s_local)
     block, nblk = _check_block(block_size, s_local)
     q_seg = None if segment_ids is None \
         else jnp.asarray(segment_ids, jnp.int32)
 
     def body(t, carry):
         dq, kc, vc, dkc, dvc, sc = carry
-        src = (idx - t) % n
-        shard_pos0 = src * s_local
+        shard_pos0 = None if idx is None else ((idx - t) % n) * s_local
 
         def inner(inner_carry, kb):
             dq, dkc, dvc = inner_carry
@@ -199,7 +211,8 @@ def _ring_bwd_rule(scale, causal, block_size, axis_name, res, g):
                 .astype(jnp.float32)
             vs = lax.dynamic_slice_in_dim(vc, kb * block, block, axis=1) \
                 .astype(jnp.float32)
-            k_pos = shard_pos0 + kb * block + jnp.arange(block)
+            k_pos = None if shard_pos0 is None \
+                else shard_pos0 + kb * block + jnp.arange(block)
             s = jnp.einsum("bqhd,bkhd->bhqk", qf, ks,
                            preferred_element_type=jnp.float32)
             if causal:

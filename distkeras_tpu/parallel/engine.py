@@ -73,6 +73,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from distkeras_tpu.compat import shard_map
 from distkeras_tpu.ops.optimizers import Optimizer
 from distkeras_tpu.parallel.worker import (  # noqa: F401  (re-export)
     TrainCarry, make_train_step, shard_epoch_data)
@@ -480,7 +481,7 @@ class DistributedEngine:
             else self._make_inner_perstep()
         axis = self.config.axis_name
         state_specs = {"worker": P(axis), "center": P(), "server": P()}
-        mapped = jax.shard_map(
+        mapped = shard_map(
             inner, mesh=self.mesh,
             in_specs=(state_specs, P(None, axis), P(None, axis)),
             out_specs=(state_specs, P(None, axis)),

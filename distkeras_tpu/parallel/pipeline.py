@@ -37,6 +37,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from distkeras_tpu.compat import axis_size, shard_map
 from distkeras_tpu.models.core import Layer
 from distkeras_tpu.ops.optimizers import Optimizer, apply_updates
 
@@ -121,7 +122,7 @@ def make_pipeline_fn(block: Layer, axis_name: str = "pp",
         return h
 
     def fn(local_params, x_mb):
-        nstages = lax.axis_size(axis_name)
+        nstages = axis_size(axis_name)
         idx = lax.axis_index(axis_name)
         M = x_mb.shape[0]
         ticks = M * v + nstages - 1
@@ -336,7 +337,7 @@ class PipelinedLM:
                 # loss -> ring -> stage params -> first rank's embed) is
                 # handled by the collective transposes inside jax.grad.
                 is_last = (lax.axis_index(pp_axis)
-                           == lax.axis_size(pp_axis) - 1)
+                           == axis_size(pp_axis) - 1)
                 # scaled so that psum over data+pp axes == global mean loss
                 return loss_fn(y, logits) * is_last / div, (logits, is_last)
 
@@ -359,7 +360,7 @@ class PipelinedLM:
         seq_entry = (seq_axis,) if seq_axis else (None,)
         data_spec = P(d_axes, *seq_entry)
         pspecs = {"embed": P(), "blocks": P(pp_axis), "head": P()}
-        grads_fn = jax.shard_map(
+        grads_fn = shard_map(
             local_grads, mesh=mesh,
             in_specs=(pspecs, data_spec, data_spec),
             out_specs=(pspecs, P(), {n: P() for n in metric_fns}),
@@ -556,7 +557,7 @@ class PipelineTrainer:
 
         data_spec = P(self.data_axes, self.seq_axis)
         pspecs = {"embed": P(), "blocks": P(), "head": P()}
-        sharded = jax.jit(jax.shard_map(
+        sharded = jax.jit(shard_map(
             evalf, mesh=self.mesh,
             in_specs=(pspecs, data_spec, data_spec),
             out_specs={"val_loss": P(),
@@ -657,9 +658,27 @@ class PipelineTrainer:
                   if isinstance(opt_shapes, dict) else rmap(opt_shapes))
         params = lm.shard_variables(params, self.mesh, self.pp_axis)
         if resumed:
+            # REMATERIALIZE the restored trees through a non-donated
+            # jitted copy before anything donates them: a SHARDED
+            # device_put of a host numpy array zero-copy-aliases the
+            # numpy buffer on this CPU client (each shard's device
+            # pointer is a slice of the host allocation — verified), so
+            # the np.load'd checkpoint tree would enter the donating
+            # run_epoch backed by memory XLA does not own; reuse then
+            # corrupts the values nondeterministically (resume-exactness
+            # drifted run to run before this copy; same hazard class as
+            # SPMDTrainer's restored carry, see spmd.py). The jitted
+            # copy's outputs are XLA-allocated, which makes the first
+            # donation safe. One-time cost at resume.
+            params = jax.jit(
+                lambda t: jax.tree_util.tree_map(jnp.copy, t),
+                out_shardings=param_sh)(params)
             opt_state = jax.tree_util.tree_map(
                 lambda host, sh: jax.device_put(host, sh),
                 opt_state, opt_sh)
+            opt_state = jax.jit(
+                lambda t: jax.tree_util.tree_map(jnp.copy, t),
+                out_shardings=opt_sh)(opt_state)
         else:
             opt_state = jax.jit(self.optimizer.init,
                                 out_shardings=opt_sh)(params)

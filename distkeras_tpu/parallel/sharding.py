@@ -197,6 +197,22 @@ class ShardingRules:
         e = params["w1"].shape[0]
         hidden = params["w1"].shape[-1]
         ep, tp = self._ep(e), self._tp(hidden)
+        if ep is not None and getattr(layer, "expert_unroll", False):
+            # Warn HERE, at spec-derivation time (trainer setup), because
+            # this is where layer config and expert-axis sharding meet on
+            # concrete values: inside the jitted train step the layer's
+            # own guard sees only tracers (no .sharding) and cannot fire,
+            # so the unroll WILL run there and pay per-expert cross-shard
+            # resharding collectives every step.
+            import warnings
+            warnings.warn(
+                "MoE(expert_unroll=True) with GSPMD expert-axis sharding "
+                f"(axis {self.ep!r}): per-expert slices of the "
+                "expert-sharded stacked weights force cross-shard "
+                "resharding collectives every step. Set "
+                "expert_unroll=False for GSPMD expert parallelism, or "
+                "use shard_map EP (expert_axis_name) where the unroll "
+                "is safe.", stacklevel=2)
         return {
             "gate": P(),
             "w1": P(ep, None, tp),

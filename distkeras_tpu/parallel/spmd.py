@@ -306,6 +306,22 @@ class SPMDTrainer(Trainer):
             param_sh, rmap(model.state),
             self._opt_shardings(model.params, param_sh, repl), repl)
 
+        if restored is not None:
+            # A restored carry can hold leaves whose device buffers ALIAS
+            # host numpy memory: a sharded device_put of a host array
+            # zero-copy-aliases the numpy buffer on this CPU client (each
+            # shard's device pointer is a slice of the host allocation —
+            # verified), and both restore paths device_put np.load'd
+            # trees. run_epoch donates the carry, so XLA would reuse/free
+            # buffers it does not own — intermittent heap corruption
+            # (`free(): corrupted unsorted chunks` aborts on the resume
+            # path; ~3-in-4 before this copy, 0 after). A non-donated
+            # jitted copy rematerializes every leaf into XLA-owned
+            # buffers once, before anything is donated.
+            carry = jax.jit(
+                lambda c: jax.tree_util.tree_map(jnp.copy, c),
+                out_shardings=carry_sh)(carry)
+
         @partial(jax.jit, donate_argnums=(0,), out_shardings=(carry_sh, None))
         def run_epoch(carry, Xs, Ys):
             return jax.lax.scan(step, carry, (Xs, Ys))

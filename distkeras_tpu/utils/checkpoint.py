@@ -198,10 +198,18 @@ def _encode_index(index, shape) -> str:
 
 
 def _decode_index(s: str) -> tuple:
+    """'0:4,8:16' -> ((0, 4), (8, 16)). Plain int pairs, not slices:
+    these tuples key the per-leaf piece dicts, and ``slice`` is only
+    hashable from Python 3.12."""
     if not s:
         return ()
-    return tuple(slice(int(a), int(b))
+    return tuple((int(a), int(b))
                  for a, b in (part.split(":") for part in s.split(",")))
+
+
+def _as_slices(idx) -> tuple:
+    """((lo, hi), ...) piece index -> numpy basic-indexing slices."""
+    return tuple(slice(lo, hi) for lo, hi in idx)
 
 
 class ShardedCheckpointManager(CheckpointManager):
@@ -320,8 +328,7 @@ class ShardedCheckpointManager(CheckpointManager):
                                 np.lib.format.read_array_header_1_0(f)
                         specs[leaf_key] = {"shape": list(shp),
                                            "dtype": str(dt)}
-                    idx = tuple(slice(0, d)
-                                for d in specs[leaf_key]["shape"])
+                    idx = tuple((0, d) for d in specs[leaf_key]["shape"])
                 pieces.setdefault(leaf_key, {})[idx] = \
                     (lambda a=arrays, key=k: a[key])
         return pieces, specs
@@ -338,12 +345,12 @@ class ShardedCheckpointManager(CheckpointManager):
         avoid; a piece overlapping several target shards pays
         re-decompression instead). A gap (the stored tiling does not
         cover the request) is a loud error, not zeros."""
-        out = np.empty(tuple(s.stop - s.start for s in norm), dtype)
+        out = np.empty(tuple(hi - lo for lo, hi in norm), dtype)
         got = 0
         for sidx, loader in stored.items():
             inter = []
             for a, b in zip(sidx, norm):
-                lo, hi = max(a.start, b.start), min(a.stop, b.stop)
+                lo, hi = max(a[0], b[0]), min(a[1], b[1])
                 if lo >= hi:
                     inter = None
                     break
@@ -352,9 +359,9 @@ class ShardedCheckpointManager(CheckpointManager):
                 continue
             piece = loader()
             src = piece[tuple(
-                slice(lo - a.start, hi - a.start)
+                slice(lo - a[0], hi - a[0])
                 for (lo, hi), a in zip(inter, sidx))]
-            out[tuple(slice(lo - b.start, hi - b.start)
+            out[tuple(slice(lo - b[0], hi - b[0])
                       for (lo, hi), b in zip(inter, norm))] = src
             got += src.size
             del piece
@@ -396,12 +403,12 @@ class ShardedCheckpointManager(CheckpointManager):
             dtype = np.dtype(leaves[key]["dtype"])
             stored = pieces[key]
             arrays = []
-            full = tuple(slice(0, d) for d in shape)
+            full = tuple((0, d) for d in shape)
             cache = {}  # one decompression per distinct piece per leaf
             for dev, index in sharding.addressable_devices_indices_map(
                     shape).items():
                 norm = tuple(
-                    slice(*s.indices(d)[:2]) for s, d in zip(index, shape))
+                    s.indices(d)[:2] for s, d in zip(index, shape))
                 if norm in stored:
                     if norm not in cache:
                         cache[norm] = stored[norm]()
@@ -411,7 +418,7 @@ class ShardedCheckpointManager(CheckpointManager):
                     # stored full copy (still one shard on device)
                     if full not in cache:
                         cache[full] = stored[full]()
-                    piece = cache[full][norm]
+                    piece = cache[full][_as_slices(norm)]
                 else:
                     # mesh-change restore: stitch the request from the
                     # overlapping stored pieces
@@ -438,7 +445,7 @@ class ShardedCheckpointManager(CheckpointManager):
             dtype = np.dtype(leaves[key]["dtype"])
             full = np.empty(shape, dtype)
             for idx, piece in stored.items():
-                full[idx] = piece()
+                full[_as_slices(idx)] = piece()
             flat[key] = full
         return _unflatten_like(template, flat)
 

@@ -32,7 +32,7 @@ import numpy as np
 def main():
     import jax
     import jax.numpy as jnp
-    from jax import shard_map
+    from distkeras_tpu.compat import shard_map
     from jax.sharding import Mesh, PartitionSpec as P
 
     from distkeras_tpu.models import Model, zoo

@@ -6,6 +6,7 @@ import numpy as np
 import pytest
 from jax.sharding import PartitionSpec as P
 
+from distkeras_tpu.compat import shard_map
 from distkeras_tpu.data import Dataset, from_iterable, from_torch
 from distkeras_tpu.ops.attention import dot_product_attention
 from distkeras_tpu.ops.ring_attention import ring_attention
@@ -71,7 +72,7 @@ def test_from_torch_dataset_and_loader():
 
 def ring_out(q, k, v, causal, block_size):
     mesh = make_mesh(4, axis_name="sp")
-    fn = jax.shard_map(
+    fn = shard_map(
         lambda q, k, v: ring_attention(q, k, v, axis_name="sp",
                                        causal=causal,
                                        block_size=block_size),

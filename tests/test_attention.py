@@ -8,7 +8,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+from distkeras_tpu.compat import shard_map
 
 from distkeras_tpu.models import Model, Sequential, TransformerBlock, zoo
 from distkeras_tpu.models.attention import MultiHeadAttention
@@ -459,7 +459,7 @@ def test_positional_embedding_global_under_seq_sharding(devices):
     ref, _ = pe_global.apply(params, {}, x)
 
     mesh = Mesh(np.array(devices[:n]), ("sp",))
-    fn = jax.shard_map(
+    fn = shard_map(
         lambda p, xx: pe_sharded.apply(p, {}, xx)[0],
         mesh=mesh, in_specs=(P(), P(None, "sp")), out_specs=P(None, "sp"))
     out = jax.jit(fn)(params, x)
@@ -485,7 +485,7 @@ def test_positional_embedding_undersized_table_raises(devices):
     params, _, _ = pe.init(jax.random.PRNGKey(0), (32, 4))
     x = jnp.zeros((1, 32, 4))
     mesh = Mesh(np.array(devices[:8]), ("sp",))
-    fn = jax.shard_map(
+    fn = shard_map(
         lambda p, xx: pe.apply(p, {}, xx)[0],
         mesh=mesh, in_specs=(P(), P(None, "sp")), out_specs=P(None, "sp"))
     with pytest.raises(ValueError, match="too small"):

@@ -8,6 +8,7 @@ import textwrap
 import numpy as np
 import pytest
 
+from distkeras_tpu.compat import shard_map
 from distkeras_tpu.deploy import (Job, JobSpec, Punchcard, PunchcardClient,
                                   initialize_from_env, ssh_commands)
 
@@ -31,7 +32,8 @@ def test_job_runs_multiprocess_psum(tmp_path):
         import jax, jax.numpy as jnp, numpy as np
         from jax.sharding import Mesh, PartitionSpec as P
         mesh = Mesh(np.array(jax.devices()).reshape(-1), ("w",))
-        total = jax.shard_map(lambda a: jax.lax.psum(a, "w"), mesh=mesh,
+        from distkeras_tpu.compat import shard_map
+        total = shard_map(lambda a: jax.lax.psum(a, "w"), mesh=mesh,
                               in_specs=P("w"), out_specs=P())(
             jnp.arange(float(jax.device_count())))
         print(f"RESULT {info['process_id']} {float(total[0])}")
@@ -263,7 +265,8 @@ def test_job_remote_executes_over_transport(tmp_path):
         import jax, jax.numpy as jnp, numpy as np
         from jax.sharding import Mesh, PartitionSpec as P
         mesh = Mesh(np.array(jax.devices()).reshape(-1), ("w",))
-        total = jax.shard_map(lambda a: jax.lax.psum(a, "w"), mesh=mesh,
+        from distkeras_tpu.compat import shard_map
+        total = shard_map(lambda a: jax.lax.psum(a, "w"), mesh=mesh,
                               in_specs=P("w"), out_specs=P())(
             jnp.arange(float(jax.device_count())))
         print(f"RESULT {info['process_id']} {float(total[0])}")

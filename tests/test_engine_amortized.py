@@ -67,11 +67,14 @@ def _subjaxprs(eqn):
 
 def count_dynamic_psums(jaxpr, trips=1):
     """Total psum *executions* per call: each psum eqn weighted by the
-    product of enclosing scan lengths."""
+    product of enclosing scan lengths. Weighted by outvars because some
+    jax versions batch one ``lax.psum(tree)`` call into a single
+    multi-output eqn while others emit one eqn per leaf — per-leaf
+    reductions crossing the mesh is the invariant under test."""
     total = 0
     for eqn in jaxpr.eqns:
         if "psum" in eqn.primitive.name:
-            total += trips
+            total += trips * len(eqn.outvars)
         for sub, mult in _subjaxprs(eqn):
             total += count_dynamic_psums(sub, trips * mult)
     return total

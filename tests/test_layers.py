@@ -143,7 +143,7 @@ def test_batchnorm_cross_replica_grads_match_full_batch():
     custom backward's psum path)."""
     from functools import partial
     from jax.sharding import Mesh, PartitionSpec as P
-    from jax import shard_map
+    from distkeras_tpu.compat import shard_map
 
     layer = BatchNorm(momentum=0.9)
     layer_sp = BatchNorm(momentum=0.9, axis_name="dp")

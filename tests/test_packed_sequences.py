@@ -218,7 +218,7 @@ def test_ring_attention_with_segments_matches_oracle(causal):
     shard boundaries by construction here)."""
     import functools
 
-    from jax import shard_map
+    from distkeras_tpu.compat import shard_map
     from jax.sharding import Mesh, PartitionSpec as P
 
     from distkeras_tpu.ops.ring_attention import ring_attention
@@ -264,7 +264,7 @@ def test_ulysses_attention_with_segments_matches_oracle():
     alongside the head scatter. fwd + bwd vs the dense segmented oracle."""
     import functools
 
-    from jax import shard_map
+    from distkeras_tpu.compat import shard_map
     from jax.sharding import Mesh, PartitionSpec as P
 
     from distkeras_tpu.ops.ulysses import ulysses_attention
@@ -309,7 +309,7 @@ def test_mha_layer_segments_on_ring_path():
     segment_ids matches the same layer on the xla path unsharded."""
     import functools
 
-    from jax import shard_map
+    from distkeras_tpu.compat import shard_map
     from jax.sharding import Mesh, PartitionSpec as P
 
     from distkeras_tpu.models.attention import MultiHeadAttention

@@ -12,6 +12,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from distkeras_tpu.compat import shard_map
 from distkeras_tpu.data import Dataset
 from distkeras_tpu.models.attention import TransformerBlock
 from distkeras_tpu.models.layers import Dense, Embedding
@@ -52,7 +53,7 @@ def test_pipeline_forward_matches_sequential():
     y_ref = np.asarray(jax.vmap(seq_apply)(x))
 
     pipe = make_pipeline_fn(block, "pp", bstate)
-    fn = jax.jit(jax.shard_map(
+    fn = jax.jit(shard_map(
         pipe, mesh=mesh, in_specs=(P("pp"), P()), out_specs=P(),
         check_vma=False))
     y_pipe = np.asarray(fn(stacked, x))
@@ -256,7 +257,7 @@ def test_interleaved_forward_matches_sequential():
     perm = _interleave_perm(8, 4, 2)
     permuted = jax.tree_util.tree_map(lambda l: l[perm], stacked)
     pipe = make_pipeline_fn(block, "pp", bstate, virtual_stages=2)
-    fn = jax.jit(jax.shard_map(
+    fn = jax.jit(shard_map(
         pipe, mesh=mesh, in_specs=(P("pp"), P()), out_specs=P(),
         check_vma=False))
     y_pipe = np.asarray(fn(permuted, x))

@@ -175,9 +175,13 @@ def test_parallelism_factor_partition_semantics():
 
     model = Model.build(Sequential([Dense(32, activation="relu"),
                                     Dense(3)]), (12,), seed=0)
+    # 22 epochs: the partition-reset trajectory lands at ~0.83 acc by 14
+    # epochs on some jax/XLA versions (float-trajectory drift, not a
+    # semantics change) and ~0.90 by 22 — keep the 0.85 bar honest
+    # instead of lowering it
     tr = AEASGD(model, num_workers=8, batch_size=8,
                 communication_window=2, parallelism_factor=2,
-                num_epoch=14, worker_optimizer="adam",
+                num_epoch=22, worker_optimizer="adam",
                 optimizer_kwargs={"learning_rate": 5e-3},
                 loss="sparse_categorical_crossentropy_from_logits")
     trained = tr.train(ds)
