@@ -24,6 +24,8 @@ Package layout:
     data/       columnar dataset + feature transformers (the reference's
                 Spark-DataFrame ingest + transformers.py equivalent)
     inference/  predictors + evaluators (reference predictors.py/evaluators.py)
+    serving/    continuous-batching LM serving engine (slot scheduler +
+                pooled KV cache over the models/decoding machinery)
     utils/      serialization, checkpointing, history, profiling
 """
 

@@ -203,10 +203,29 @@ class StreamingPredictor(Predictor):
                 put(SENTINEL)
 
         t = threading.Thread(target=stage, daemon=True)
+        # NOTE: this loop and utils.prefetch.Prefetcher carry parallel
+        # copies of the polling shutdown protocol — a fix to either
+        # must be mirrored until predict_stream is folded onto a
+        # lazy-iterable Prefetcher (docs/serving.md follow-ups)
+        # exposed for shutdown tests: callers (and the test suite) can
+        # assert the producer actually terminated after gen.close()
+        self._stage_thread = t
         t.start()
         try:
             while True:
-                item = q.get()
+                try:
+                    # polling get (this PR, same shutdown contract as
+                    # utils.prefetch.Prefetcher): a blocking get() could
+                    # wait forever if the stage thread died between its
+                    # last successful put and the SENTINEL put while the
+                    # consumer held the queue full — poll and re-check
+                    # liveness so shutdown can never deadlock the
+                    # consumer
+                    item = q.get(timeout=0.05)
+                except queue.Empty:
+                    if not t.is_alive() and q.empty():
+                        break        # producer gone, stream fully drained
+                    continue
                 if item is SENTINEL:
                     break
                 dev, pad = item
@@ -216,6 +235,9 @@ class StreamingPredictor(Predictor):
             if err:
                 raise err[0]
         finally:
-            # early break / close(): unblock and reap the stage thread
+            # early break / close(): unblock and reap the stage thread.
+            # The thread's puts poll ``stop`` every 100 ms, so a put
+            # blocked on the full double-buffer exits on its own — no
+            # queue draining, no dropped already-staged results.
             stop.set()
             t.join(timeout=5.0)

@@ -478,6 +478,45 @@ def _prefill_block_chunked(block: TransformerBlock, p, s, kv, x, positions,
     return x + m, kv
 
 
+def prefill_chunk_step(module: Sequential, params, state, cache, chunk,
+                       t0: int, *, final: bool):
+    """ONE ``[B, q_len]`` chunk through the whole stack — the resumable
+    unit of :func:`prefill_chunked`, factored out (this PR) so the
+    serving engine can interleave prompt chunks between decode
+    iterations instead of stalling in-flight streams for a whole
+    prompt. ``t0`` is the chunk's global start position (STATIC — the
+    per-layer chunk pass branches on it in Python); positions
+    ``[0, t0)`` of ``cache`` must already be written. Returns
+    ``(last_logits [B, V] if final else None, cache)`` — non-final
+    chunks stop after the deepest attention block: the final norm +
+    vocab head only matter for the last chunk's logits (review r5)."""
+    new_cache = list(cache)
+    last_block = max((i for i, l in enumerate(module.layers)
+                      if _decode_block_of(l) is not None), default=-1)
+    last = len(module.layers) - 1
+    q_len = chunk.shape[1]
+    x = chunk
+    positions = jnp.arange(t0, t0 + q_len)
+    for i, layer in enumerate(module.layers):
+        if not final and i > last_block:
+            break
+        p, s = params[i], state[i]
+        block = _decode_block_of(layer)
+        if block is not None:
+            x, new_cache[i] = _prefill_block_chunked(
+                block, p, s, new_cache[i], x, positions, t0)
+        elif isinstance(layer, PositionalEmbedding):
+            x = x + p["embeddings"][t0:t0 + q_len][None] \
+                .astype(x.dtype)
+        elif isinstance(layer, Dropout):
+            pass                                         # eval: identity
+        else:
+            if i == last and x.ndim == 3:
+                x = x[:, -1:]        # head on the final position only
+            x, _ = layer.apply(p, s, x, training=False)
+    return (x[:, -1] if final else None), new_cache
+
+
 def prefill_chunked(module: Sequential, params, state, cache, prompts,
                     chunk_len: int):
     """Block-by-block prompt ingestion (round 5): like :func:`prefill`
@@ -490,38 +529,14 @@ def prefill_chunked(module: Sequential, params, state, cache, prompts,
     one-pass prefill exactly up to blockwise-softmax fp reassociation
     (the merge is algebraically exact)."""
     b, p_len = prompts.shape
-    new_cache = list(cache)
-    last_x = None
-    # layers past the deepest attention block (final norm + vocab head)
-    # only matter for the LAST chunk's logits — earlier chunks exist to
-    # fill the cache and stop after their final block (review r5)
-    last_block = max((i for i, l in enumerate(module.layers)
-                      if _decode_block_of(l) is not None), default=-1)
-    last = len(module.layers) - 1
+    new_cache = cache
+    last_logits = None
     for t0 in range(0, p_len, chunk_len):
         q_len = min(chunk_len, p_len - t0)
-        final_chunk = t0 + q_len >= p_len
-        x = prompts[:, t0:t0 + q_len]
-        positions = jnp.arange(t0, t0 + q_len)
-        for i, layer in enumerate(module.layers):
-            if not final_chunk and i > last_block:
-                break
-            p, s = params[i], state[i]
-            block = _decode_block_of(layer)
-            if block is not None:
-                x, new_cache[i] = _prefill_block_chunked(
-                    block, p, s, new_cache[i], x, positions, t0)
-            elif isinstance(layer, PositionalEmbedding):
-                x = x + p["embeddings"][t0:t0 + q_len][None] \
-                    .astype(x.dtype)
-            elif isinstance(layer, Dropout):
-                pass                                     # eval: identity
-            else:
-                if i == last and x.ndim == 3:
-                    x = x[:, -1:]    # head on the final position only
-                x, _ = layer.apply(p, s, x, training=False)
-        last_x = x
-    return last_x[:, -1], new_cache
+        last_logits, new_cache = prefill_chunk_step(
+            module, params, state, new_cache, prompts[:, t0:t0 + q_len],
+            t0, final=t0 + q_len >= p_len)
+    return last_logits, new_cache
 
 
 def prefill(module: Sequential, params, state, cache, prompts):
@@ -577,6 +592,105 @@ def decode_step(module: Sequential, params, state, cache, tok, t):
     return x[:, 0], new_cache                            # [B, V]
 
 
+# --- slot-level decode (serving engine, this PR) ---------------------------
+#
+# Continuous batching runs ONE compiled step over a fixed pool of S slots
+# whose sequences are at DIFFERENT positions: ``t`` becomes a [S] vector.
+# The per-slot variants below mirror the scalar-``t`` functions exactly —
+# same projections, same storage-dtype contractions — with three changes:
+# the cache write selects each slot's own position (a one-hot select, so a
+# slot whose ``t`` is out of range, the engine's free-slot sentinel,
+# writes NOTHING and cannot corrupt a neighbour), the validity mask is
+# per-slot, and rope positions are per-slot. The fused Pallas decode
+# kernel takes a scalar step and is not used here; the einsum path's
+# per-slot masks cost nothing extra (the mask was already materialized).
+
+
+def _cache_write_slots(kv, k, v, t):
+    """Write one [S, 1, H, D] k/v decode slab at PER-SLOT positions
+    ``t`` ([S] int) into the head-major [S, H, L, D] cache. Slot ``s``
+    writes position ``t[s]``; ``t[s] >= L`` (the engine's free/prefilling
+    sentinel) writes nothing."""
+    kh = k.transpose(0, 2, 1, 3)                         # [S, H, 1, D]
+    vh = v.transpose(0, 2, 1, 3)
+    L = kv["k"].shape[2]
+    hit = (jnp.arange(L)[None, :] == t[:, None])         # [S, L]
+    hit4 = hit[:, None, :, None]                         # [S, 1, L, 1]
+    if "k_scale" in kv:
+        qk, sk = _quantize_kv(kh)
+        qv, sv = _quantize_kv(vh)
+        hit3 = hit[:, None, :]                           # [S, 1, L]
+        return {"k": jnp.where(hit4, qk, kv["k"]),
+                "v": jnp.where(hit4, qv, kv["v"]),
+                "k_scale": jnp.where(hit3, sk, kv["k_scale"]),
+                "v_scale": jnp.where(hit3, sv, kv["v_scale"])}
+    return {"k": jnp.where(hit4, kh.astype(kv["k"].dtype), kv["k"]),
+            "v": jnp.where(hit4, vh.astype(kv["v"].dtype), kv["v"])}
+
+
+def _decode_attn_slots(attn: MultiHeadAttention, p, kv, x, t):
+    """One-token attention against the pooled cache at per-slot
+    positions. x: [S, 1, d]; t: [S]. The einsum/storage-dtype path of
+    ``_decode_attn`` with a [S, L] validity mask."""
+    dt = jnp.dtype(attn.dtype)
+    xc = x.astype(dt)
+    q, k, v = _project_qkv(attn, p, xc)
+    if attn.use_rope:
+        q = apply_rope(q, t[:, None], scale=attn.rope_scale)
+        k = apply_rope(k, t[:, None], scale=attn.rope_scale)
+    kv = _cache_write_slots(kv, k, v, t)
+    scale = (attn.head_dim or q.shape[-1]) ** -0.5
+    b = q.shape[0]
+    hkv = attn.kv_heads
+    g = attn.num_heads // hkv
+    dh = q.shape[-1]
+    L = kv["k"].shape[2]
+    qg = (q.astype(jnp.float32) * scale).reshape(
+        b, 1, hkv, g, dh)                                # [S, 1, Hkv, G, D]
+    s = _decode_scores(qg, kv)                           # [S, Hkv, G, 1, L]
+    valid = jnp.arange(L)[None, :] <= t[:, None]         # [S, L]
+    if attn.attn_window is not None:
+        valid &= jnp.arange(L)[None, :] > (t - attn.attn_window)[:, None]
+    s = jnp.where(valid[:, None, None, None, :], s, NEG_INF)
+    w = jax.nn.softmax(s, axis=-1)
+    out = _decode_mix(w, kv).astype(dt)
+    out = out.reshape(b, 1, attn.num_heads, dh)
+    y = jnp.einsum("bshe,hed->bsd", out, p["wo"].astype(dt))
+    return y.astype(x.dtype), kv
+
+
+def _decode_block_slots(block: TransformerBlock, p, s, kv, x, t):
+    h, _ = block.norm1.apply(p["norm1"], s["norm1"], x)
+    a, kv = _decode_attn_slots(block.attn, p["attn"], kv, h, t)
+    x = x + a
+    h, _ = block.norm2.apply(p["norm2"], s["norm2"], x)
+    m, _ = block.mlp.apply(p["mlp"], s["mlp"], h, training=False)
+    return x + m, kv
+
+
+def decode_step_slots(module: Sequential, params, state, cache, tok, t):
+    """One token through the stack at PER-SLOT positions: tok [S] int,
+    t [S] int; returns ([S, V] logits, cache). Slots whose ``t`` is out
+    of cache range (the serving engine's free-slot sentinel) produce
+    garbage logits and write nothing — the engine discards them
+    host-side. The position-table gather clamps for such slots, which
+    is safe exactly because their output is never consumed."""
+    x = tok[:, None]                                     # [S, 1]
+    new_cache = list(cache)
+    for i, layer in enumerate(module.layers):
+        p, s, kv = params[i], state[i], cache[i]
+        block = _decode_block_of(layer)
+        if block is not None:
+            x, new_cache[i] = _decode_block_slots(block, p, s, kv, x, t)
+        elif isinstance(layer, PositionalEmbedding):
+            x = x + p["embeddings"][t][:, None, :].astype(x.dtype)
+        elif isinstance(layer, Dropout):
+            pass                                         # eval: identity
+        else:
+            x, _ = layer.apply(p, s, x, training=False)
+    return x[:, 0], new_cache                            # [S, V]
+
+
 def _sample(logits, temperature, top_k, rng, top_p=None):
     if temperature == 0.0:
         return jnp.argmax(logits, axis=-1)
@@ -608,6 +722,75 @@ def _sample(logits, temperature, top_k, rng, top_p=None):
             axis=-1, keepdims=True)
         logits = jnp.where(logits >= thresh, logits, NEG_INF)
     return jax.random.categorical(rng, logits, axis=-1)
+
+
+# --- per-sequence sampling state (serving engine + generate arrays) --------
+
+
+def _sample_vec(logits, temperature, top_k, top_p, rng):
+    """Per-SEQUENCE sampling: every knob is a [B] vector, so requests
+    with heterogeneous sampling settings coexist in one batch (the
+    serving engine's per-slot sampling state; ``generate()`` routes
+    per-sequence arrays here too). Disabled sentinels: ``temperature
+    0`` = greedy for that row, ``top_k <= 0`` = no truncation,
+    ``top_p >= 1`` = no nucleus cut.
+
+    ``rng`` is either one key (the whole batch draws from it, as in
+    ``generate``'s scan) or a [B] batch of per-slot keys (the engine:
+    each slot's stream must be reproducible regardless of which other
+    requests share the batch).
+
+    top_k here masks by RANK from a stable descending argsort — ties at
+    the k-th logit resolve lowest-index-first, the same order
+    ``lax.top_k`` uses, so the vector path admits exactly the scalar
+    path's candidate set."""
+    greedy = jnp.argmax(logits, axis=-1)
+    lf = logits.astype(jnp.float32)
+    safe_t = jnp.where(temperature > 0.0, temperature, 1.0)
+    lf = lf / safe_t[:, None]
+    # top_k by rank (stable argsort == lax.top_k tie order)
+    order = jnp.argsort(-lf, axis=-1)
+    ranks = jnp.argsort(order, axis=-1)
+    keep = (top_k[:, None] <= 0) | (ranks < top_k[:, None])
+    lf = jnp.where(keep, lf, NEG_INF)
+    # nucleus, same boundary construction as the scalar path
+    sorted_logits = jnp.flip(jnp.sort(lf, axis=-1), axis=-1)
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    exclusive = jnp.cumsum(probs, axis=-1) - probs
+    keep_sorted = exclusive < top_p[:, None]
+    thresh = jnp.min(jnp.where(keep_sorted, sorted_logits, jnp.inf),
+                     axis=-1, keepdims=True)
+    lf = jnp.where((top_p >= 1.0)[:, None] | (lf >= thresh), lf, NEG_INF)
+    if rng.ndim > 1:                                     # per-slot keys
+        sampled = jax.vmap(jax.random.categorical)(rng, lf)
+    else:
+        sampled = jax.random.categorical(rng, lf, axis=-1)
+    return jnp.where(temperature > 0.0, sampled, greedy)
+
+
+def _per_seq_vec(value, b, dtype, none_sentinel, name):
+    """Normalize a scalar-or-[B]-array sampling knob to a [B] vector
+    (``None`` -> the disabled sentinel; scalars broadcast)."""
+    if value is None:
+        value = none_sentinel
+    arr = jnp.asarray(value, dtype)
+    if arr.ndim == 0:
+        return jnp.full((b,), arr)
+    if arr.shape != (b,):
+        raise ValueError(
+            f"per-sequence {name} must have shape ({b},) to match the "
+            f"prompt batch, got {arr.shape}")
+    return arr
+
+
+def _is_per_seq(value) -> bool:
+    """True when a sampling knob was passed as a per-sequence array
+    (list/tuple or an ndarray with a batch dim) rather than a scalar."""
+    if value is None or isinstance(value, (int, float)):
+        return False
+    if isinstance(value, (list, tuple)):
+        return True
+    return getattr(value, "ndim", 0) >= 1
 
 
 def _attn_compute_dtype(module: Sequential):
@@ -703,6 +886,16 @@ def generate(model: Model, prompts, max_new_tokens: int,
     (nucleus: smallest probability prefix whose mass reaches ``top_p``;
     applied after the top_k mask when both are given).
 
+    ``temperature``/``top_k``/``top_p``/``stop_token`` also accept
+    PER-SEQUENCE ``[B]`` arrays (this PR — the same plumbing the serving
+    engine's per-slot sampling uses), so heterogeneous requests share
+    one batch: row sentinels ``temperature 0`` = greedy, ``top_k 0`` =
+    no truncation, ``top_p 1.0`` = no nucleus cut, ``stop_token -1`` =
+    never stop. Scalars broadcast (the scalar API compiles the exact
+    pre-existing program); when ANY knob is an array, all four become
+    traced [B] vectors, so ONE compiled program serves every
+    per-sequence sampling configuration at that shape.
+
     ``stop_token``: once a sequence emits it, every later position is
     filled with it too (the compiled scan always runs ``max_new_tokens``
     steps — static shapes — so "stopping" is per-sequence padding, which
@@ -748,7 +941,9 @@ def generate(model: Model, prompts, max_new_tokens: int,
     if max_new_tokens < 0:
         raise ValueError(f"max_new_tokens must be >= 0, "
                          f"got {max_new_tokens}")
-    if top_p is not None and not 0.0 < top_p <= 1.0:
+    per_seq = any(_is_per_seq(v)
+                  for v in (temperature, top_k, top_p, stop_token))
+    if not per_seq and top_p is not None and not 0.0 < top_p <= 1.0:
         raise ValueError(f"top_p must be in (0, 1], got {top_p}")
     if prefill_chunk is not None:
         prefill_chunk = int(prefill_chunk)
@@ -761,6 +956,20 @@ def generate(model: Model, prompts, max_new_tokens: int,
         return np.asarray(prompts) if as_numpy else prompts
     b, p_len = prompts.shape
     total = p_len + max_new_tokens
+    samp = {}
+    if per_seq:
+        samp = {
+            "temperature": _per_seq_vec(temperature, b, jnp.float32, 0.0,
+                                        "temperature"),
+            "top_k": _per_seq_vec(top_k, b, jnp.int32, 0, "top_k"),
+            "top_p": _per_seq_vec(top_p, b, jnp.float32, 1.0, "top_p"),
+            "stop": _per_seq_vec(stop_token, b, jnp.int32, -1,
+                                 "stop_token"),
+        }
+        topp_h = np.asarray(samp["top_p"])
+        if ((topp_h <= 0.0) | (topp_h > 1.0)).any():
+            raise ValueError(
+                f"top_p entries must be in (0, 1], got {topp_h}")
     _resolve_head_dims(module, model.params)
     for layer in module.layers:
         # out-of-range position gathers CLAMP under jit (silent wrong-
@@ -864,13 +1073,19 @@ def generate(model: Model, prompts, max_new_tokens: int,
     # followed by a decode-only scan over the new tokens — replaying the
     # prompt through the sequential scan made long prompts O(P) device
     # steps instead of O(1) kernel passes.
-    key = (b, p_len, int(max_new_tokens), float(temperature), top_k,
-           None if top_p is None else float(top_p),
-           jnp.dtype(cache_dtype).name, stop_token,
-           None if weights_dtype is None
-           else ("int8" if weights_dtype == "int8"
-                 else jnp.dtype(weights_dtype).name),
-           prefill_chunk)
+    if per_seq:
+        # the vectors are TRACED args: one program per shape serves every
+        # per-sequence sampling configuration
+        samp_key = ("per-seq",)
+    else:
+        samp_key = (float(temperature), top_k,
+                    None if top_p is None else float(top_p), stop_token)
+    key = (b, p_len, int(max_new_tokens)) + samp_key + (
+        jnp.dtype(cache_dtype).name,
+        None if weights_dtype is None
+        else ("int8" if weights_dtype == "int8"
+              else jnp.dtype(weights_dtype).name),
+        prefill_chunk)
     jit_cache = getattr(model, "_jit_generate", None)
     if jit_cache is None:
         jit_cache = model._jit_generate = {}
@@ -889,8 +1104,15 @@ def generate(model: Model, prompts, max_new_tokens: int,
             from distkeras_tpu.models.quantize import dequantize_params
             return dequantize_params(params, run_scales)
 
+        def sample_next(logits, run_samp, sub):
+            if per_seq:
+                return _sample_vec(logits, run_samp["temperature"],
+                                   run_samp["top_k"], run_samp["top_p"],
+                                   sub)
+            return _sample(logits, temperature, top_k, sub, top_p)
+
         @jax.jit
-        def run(params, run_scales, state, prompts, rng):
+        def run(params, run_scales, state, prompts, rng, run_samp):
             # the cache is created INSIDE the compiled program (shapes
             # are static): no multi-GB host-side zeros allocation per
             # call, and XLA sees a single dead-on-exit buffer instead of
@@ -918,9 +1140,12 @@ def generate(model: Model, prompts, max_new_tokens: int,
                 last_logits, cache = prefill(module, live, state, cache,
                                              prompts)
             rng, sub = jax.random.split(rng)
-            first = _sample(last_logits, temperature, top_k, sub, top_p)
+            first = sample_next(last_logits, run_samp, sub)
             done = jnp.zeros((b,), bool)
-            if stop_token is not None:
+            if per_seq:
+                stop_v = run_samp["stop"]
+                done = (first == stop_v) & (stop_v >= 0)
+            elif stop_token is not None:
                 done = first == stop_token
             tokens = jnp.concatenate(
                 [prompts,
@@ -936,8 +1161,13 @@ def generate(model: Model, prompts, max_new_tokens: int,
                 logits, cache = decode_step(module, p, state, cache,
                                             tok, t)
                 rng, sub = jax.random.split(rng)
-                nxt = _sample(logits, temperature, top_k, sub, top_p)
-                if stop_token is not None:
+                nxt = sample_next(logits, run_samp, sub)
+                if per_seq:
+                    stop_v = run_samp["stop"]
+                    # rows already done have stop_v >= 0 by construction
+                    nxt = jnp.where(done, stop_v.astype(nxt.dtype), nxt)
+                    done = done | ((nxt == stop_v) & (stop_v >= 0))
+                elif stop_token is not None:
                     nxt = jnp.where(done, stop_token, nxt)
                     done = done | (nxt == stop_token)
                 tokens = lax.dynamic_update_slice_in_dim(
@@ -953,7 +1183,7 @@ def generate(model: Model, prompts, max_new_tokens: int,
         jit_cache[key] = run
 
     out = run(run_params, {} if scales is None else scales, model.state,
-              prompts, jax.random.PRNGKey(seed))
+              prompts, jax.random.PRNGKey(seed), samp)
     # as_numpy=False skips the device->host sync: serving loops that
     # pipeline several generate calls only pay one round trip at the end
     # (on tunneled backends the per-call sync is ~100 ms — bench.py

@@ -1,0 +1,31 @@
+"""Continuous-batching serving engine (this PR): iteration-level
+scheduling over ``generate()``'s prefill/decode machinery.
+
+The single-call ``generate()`` path decodes one fixed batch to
+completion: a straggler request holds every batch row until
+``max_new_tokens``, and new arrivals wait for the whole batch to drain.
+This package is the Orca/vLLM-style fix — the missing layer between the
+per-step decode kernels and an actual serving workload:
+
+    kv_pool.py     pooled ``[S, max_len]`` KV cache, resident across
+                   requests; batch-1 prefill caches insert into a slot
+    scheduler.py   FIFO admission queue + per-request state machine
+                   (queued -> prefilling -> decoding -> finished) with
+                   slot allocation/release
+    engine.py      the slot-based decode loop: ONE compiled
+                   ``decode_step_slots`` over all slots per iteration
+                   (static shapes, jit compiled once), chunked prefill
+                   interleaved between decode iterations, per-slot
+                   sampling state
+    metrics.py     TTFT, request latency, queue depth, slot occupancy,
+                   tokens/s — the numbers ``bench.py --model serving``
+                   records
+
+See ``docs/serving.md`` for the architecture and scheduling policy.
+"""
+
+from distkeras_tpu.serving.engine import ServingEngine  # noqa: F401
+from distkeras_tpu.serving.kv_pool import KVPool  # noqa: F401
+from distkeras_tpu.serving.metrics import ServingMetrics  # noqa: F401
+from distkeras_tpu.serving.scheduler import (FIFOScheduler,  # noqa: F401
+                                             Request, RequestState)

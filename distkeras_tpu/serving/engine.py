@@ -1,0 +1,382 @@
+"""Slot-based continuous-batching engine over the LM decode path.
+
+The Orca/vLLM iteration-level serving pattern on this repo's
+prefill/decode machinery:
+
+  * A fixed pool of ``S`` KV-cache slots stays resident on device
+    (``kv_pool.KVPool``); every iteration runs ONE compiled
+    ``decode_step_slots`` over ALL slots — shapes are static, the jit
+    compiles once per engine per sampler variant (argmax-only for
+    all-greedy batches, the full per-slot sampler for mixed ones), and
+    requests at different sequence positions coexist because ``t`` is
+    a per-slot vector.
+  * Requests admit FIFO into free slots; a new request's prompt
+    prefills into a batch-1 staging cache — chunked
+    (``prefill_chunk``), one chunk per engine iteration, interleaved
+    between decode steps so a long prompt never stalls in-flight
+    streams — then the filled rows INSERT into the request's pool slot
+    and it joins the decode batch.
+  * Per-slot sampling state (temperature / top_k / top_p / stop_token
+    vectors through ``_sample_vec``, per-slot PRNG keys) lets greedy
+    and sampled requests with different stop tokens share one batch.
+  * ``ServingMetrics`` records TTFT, request latency, queue depth,
+    slot occupancy and the per-iteration decode rate.
+
+Greedy outputs are token-identical per request to a standalone
+``generate()`` call on the same prompt (the oracle contract:
+``tests/test_serving.py``): prefill runs the very same ``prefill`` /
+``prefill_chunk_step`` programs at batch 1, and the per-slot decode
+step is the same storage-dtype einsum attention with a per-slot mask.
+
+Deliberate scope (docs/serving.md spells out the follow-ups): the
+decode loop syncs next-token ids to the host every iteration (the
+scheduler needs them for stop detection) — on-device stop handling and
+cache-buffer donation are TPU-latency follow-ups; weight trees support
+``weights_dtype="auto"``-style pre-casting but not int8; prompts longer
+than ``max_len - max_new_tokens`` are rejected at submit.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distkeras_tpu.models.core import Model, Sequential
+from distkeras_tpu.models.decoding import (_attn_compute_dtype,
+                                           _resolve_head_dims,
+                                           _sample_vec, _serving_params,
+                                           decode_step_slots, prefill,
+                                           prefill_chunk_step)
+from distkeras_tpu.serving.kv_pool import KVPool
+from distkeras_tpu.serving.metrics import ServingMetrics
+from distkeras_tpu.serving.scheduler import FIFOScheduler, Request
+
+
+class ServingEngine:
+    """Continuous-batching serving over one ``zoo.transformer_lm``-shaped
+    model. ``submit()`` enqueues requests; ``step()`` advances the world
+    one scheduler iteration; ``run()`` drains to completion (the
+    synchronous driver — an async transport wraps these two calls).
+
+    ``max_len`` is the per-slot cache capacity: every request needs
+    ``len(prompt) + max_new_tokens <= max_len``.
+    """
+
+    def __init__(self, model: Model, *, num_slots: int = 4,
+                 max_len: int = 256,
+                 prefill_chunk: Optional[int] = None,
+                 cache_dtype=None, weights_dtype="auto",
+                 metrics: Optional[ServingMetrics] = None):
+        module = model.module
+        if not isinstance(module, Sequential):
+            raise TypeError("ServingEngine expects a Sequential LM "
+                            f"(got {type(module).__name__})")
+        self.model = model
+        self.module = module
+        _resolve_head_dims(module, model.params)
+        self.num_slots = int(num_slots)
+        self.max_len = int(max_len)
+        if prefill_chunk is not None:
+            prefill_chunk = int(prefill_chunk)
+            if prefill_chunk < 1:
+                raise ValueError(
+                    f"prefill_chunk must be >= 1, got {prefill_chunk}")
+        self.prefill_chunk = prefill_chunk
+
+        compute_dt = _attn_compute_dtype(module)
+        if cache_dtype is None:
+            cache_dtype = (compute_dt if compute_dt is not None
+                           else jnp.float32)
+        # same "auto" weight policy as generate(): pre-cast matrix
+        # weights to the compute dtype once (free for bf16 models, a
+        # no-op for f32); int8 weight serving is a documented non-goal
+        # of this engine revision
+        if weights_dtype == "auto":
+            weights_dtype = compute_dt if (
+                compute_dt is not None
+                and compute_dt != jnp.dtype(jnp.float32)) else None
+        self._params = (model.params if weights_dtype is None
+                        else _serving_params(model.params, weights_dtype))
+        self._state = model.state
+
+        self.pool = KVPool(module, self.num_slots, self.max_len,
+                           cache_dtype)
+        # ONE reusable batch-1 prefill staging cache: positions past the
+        # current prompt hold a previous request's stale entries, which
+        # is safe — insert() copies the whole row, and the occupant's
+        # decode writes position t before the mask ever admits it
+        self._staging = self.pool.make_request_cache()
+        self.scheduler = FIFOScheduler(self.num_slots)
+        self.metrics = metrics if metrics is not None else ServingMetrics()
+        self._requests: Dict[int, Request] = {}
+        self._rid = itertools.count()
+
+        # per-slot decode vectors (host mirrors of the traced args)
+        s = self.num_slots
+        self._tok = np.zeros(s, np.int32)
+        #: max_len is the free-slot sentinel: the one-hot cache write
+        #: misses every position and the slot's logits are discarded
+        self._t = np.full(s, self.max_len, np.int32)
+        self._temp = np.zeros(s, np.float32)
+        self._topk = np.zeros(s, np.int32)
+        self._topp = np.ones(s, np.float32)
+        self._keys = np.stack(
+            [np.array(jax.random.PRNGKey(0))] * s)       # [S, key]
+
+        self._step_fns = {}                  # greedy_only -> jit
+        self._prefill_fns = {}
+        self._first_fn = None
+
+    # --- request intake ---------------------------------------------------
+
+    def submit(self, prompt, max_new_tokens: int, *,
+               temperature: float = 0.0, top_k: Optional[int] = None,
+               top_p: Optional[float] = None,
+               stop_token: Optional[int] = None, seed: int = 0) -> int:
+        """Enqueue one request; returns its id. Sampling defaults match
+        ``generate()`` (greedy); ``None`` knobs mean disabled."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.size < 1:
+            raise ValueError("prompt must hold at least one token")
+        max_new_tokens = int(max_new_tokens)
+        if max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {max_new_tokens}")
+        if prompt.size + max_new_tokens > self.max_len:
+            raise ValueError(
+                f"prompt ({prompt.size}) + max_new_tokens "
+                f"({max_new_tokens}) exceeds the slot capacity "
+                f"max_len={self.max_len}")
+        if top_p is not None and not 0.0 < top_p <= 1.0:
+            raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+        req = Request(
+            rid=next(self._rid), prompt=prompt,
+            max_new_tokens=max_new_tokens,
+            temperature=float(temperature),
+            top_k=0 if top_k is None else int(top_k),
+            top_p=1.0 if top_p is None else float(top_p),
+            stop_token=-1 if stop_token is None else int(stop_token),
+            seed=int(seed))
+        req.rng = jax.random.PRNGKey(req.seed)
+        self._requests[req.rid] = req
+        self.scheduler.submit(req)
+        self.metrics.record_submit(req.rid)
+        return req.rid
+
+    def __getitem__(self, rid: int) -> Request:
+        """IN-FLIGHT request lookup (queued/prefilling/decoding).
+        Finished requests are returned by ``step()``/``run()`` and
+        evicted from the engine — a long-lived server must not
+        accumulate one prompt array per request ever served."""
+        return self._requests[rid]
+
+    # --- compiled programs ------------------------------------------------
+
+    def _decode_fn(self, greedy_only: bool):
+        """Two compiled step variants, chosen per iteration by the
+        host: ALL-GREEDY batches (the common serving default) take a
+        pure-argmax step — the vector sampler's rank/nucleus masks cost
+        two [S, V] argsorts plus a sort per step that greedy never
+        needs, a material tax at real vocab sizes. A mixed batch takes
+        the full per-slot sampler; sampled requests only ever decode
+        under the mixed variant (their temperature forces it while they
+        occupy a slot), so their per-request key streams stay
+        schedule-independent."""
+        fn = self._step_fns.get(greedy_only)
+        if fn is None:
+            module = self.module
+
+            if greedy_only:
+                @jax.jit
+                def fn(params, state, cache, tok, t):
+                    logits, cache = decode_step_slots(
+                        module, params, state, cache, tok, t)
+                    return jnp.argmax(logits, axis=-1), cache
+            else:
+                @jax.jit
+                def fn(params, state, cache, tok, t, temp, topk, topp,
+                       keys):
+                    logits, cache = decode_step_slots(
+                        module, params, state, cache, tok, t)
+                    # per-slot key streams: a request's draws depend
+                    # only on its own seed, not on which neighbours
+                    # share the batch
+                    split = jax.vmap(jax.random.split)(keys)
+                    nxt = _sample_vec(logits, temp, topk, topp,
+                                      split[:, 1])
+                    return nxt, cache, split[:, 0]
+
+            self._step_fns[greedy_only] = fn
+        return fn
+
+    #: prefill-program cache cap: every DISTINCT (q_len, t0, final)
+    #: triple is its own XLA program (the final chunk's key differs for
+    #: every prompt length, so a varied-length workload compiles one
+    #: program per novel length — compilation runs inline in ``step()``
+    #: and does stall in-flight streams for that iteration; production
+    #: deployments should pre-warm or bucket prompt lengths,
+    #: docs/serving.md follow-ups). The LRU cap bounds host memory at
+    #: O(cap) retained executables instead of O(distinct lengths).
+    MAX_PREFILL_PROGRAMS = 64
+
+    def _prefill_fn(self, q_len: int, t0: int, final: bool):
+        """Jitted prefill unit. A whole-prompt chunk (t0=0, final) is
+        the SAME one-pass ``prefill`` program ``generate()`` runs, so
+        staging caches match generate's bit-for-bit; interior chunks are
+        ``prefill_chunk_step``. With a fixed ``prefill_chunk`` the
+        interior chunks share ceil(max_len/chunk) programs; the ragged
+        FINAL chunk is per-prompt-length (see MAX_PREFILL_PROGRAMS)."""
+        key = (q_len, t0, final)
+        fn = self._prefill_fns.pop(key, None)
+        if fn is None:
+            module = self.module
+            if t0 == 0 and final:
+                def f(params, state, cache, chunk):
+                    return prefill(module, params, state, cache, chunk)
+            else:
+                def f(params, state, cache, chunk):
+                    return prefill_chunk_step(module, params, state,
+                                              cache, chunk, t0,
+                                              final=final)
+            fn = jax.jit(f)
+        # re-insert at the back: dict order is the LRU order
+        self._prefill_fns[key] = fn
+        while len(self._prefill_fns) > self.MAX_PREFILL_PROGRAMS:
+            self._prefill_fns.pop(next(iter(self._prefill_fns)))
+        return fn
+
+    def _sample_first_fn(self):
+        """First-token sampler from prefill logits — mirrors generate's
+        ``rng, sub = split(rng)`` order so a request's key stream does
+        not depend on engine scheduling."""
+        if self._first_fn is None:
+            @jax.jit
+            def f(logits, temp, topk, topp, rng):
+                rng, sub = jax.random.split(rng)
+                tok = _sample_vec(logits, temp[None], topk[None],
+                                  topp[None], sub)
+                return tok[0], rng
+
+            self._first_fn = f
+        return self._first_fn
+
+    # --- the scheduler iteration ------------------------------------------
+
+    def step(self) -> List[Request]:
+        """One iteration: admit, advance ONE prefill chunk, run one
+        decode step over all slots. Returns requests finished during
+        this iteration."""
+        finished: List[Request] = []
+        self.scheduler.admit()
+
+        req = self.scheduler.next_prefill()
+        if req is not None:
+            with self.metrics.timer.phase("prefill"):
+                self._advance_prefill(req, finished)
+
+        running = self.scheduler.running
+        if running:
+            with self.metrics.timer.phase("decode"):
+                self._advance_decode(finished)
+
+        self.metrics.record_iteration(self.scheduler.queue_depth,
+                                      self.scheduler.occupied,
+                                      self.num_slots)
+        return finished
+
+    def run(self, max_steps: Optional[int] = None) -> Dict[int, np.ndarray]:
+        """Drive ``step()`` until every submitted request finishes;
+        returns ``{rid: tokens}`` for requests finished during this
+        call."""
+        out: Dict[int, np.ndarray] = {}
+        steps = 0
+        while self.scheduler.pending:
+            for r in self.step():
+                out[r.rid] = r.tokens
+            steps += 1
+            if max_steps is not None and steps >= max_steps \
+                    and self.scheduler.pending:
+                raise RuntimeError(
+                    f"engine made no full drain in {max_steps} steps "
+                    f"(queue={self.scheduler.queue_depth}, "
+                    f"occupied={self.scheduler.occupied})")
+        return out
+
+    # --- internals --------------------------------------------------------
+
+    def _advance_prefill(self, req: Request, finished: List[Request]):
+        p_len = len(req.prompt)
+        chunk = self.prefill_chunk
+        if chunk is None or p_len <= chunk:
+            t0, q_len, final = 0, p_len, True
+        else:
+            t0 = req.prefill_pos
+            q_len = min(chunk, p_len - t0)
+            final = t0 + q_len >= p_len
+        fn = self._prefill_fn(q_len, t0, final)
+        chunk_toks = jnp.asarray(req.prompt[None, t0:t0 + q_len])
+        logits, self._staging = fn(self._params, self._state,
+                                   self._staging, chunk_toks)
+        req.prefill_pos = t0 + q_len
+        self.metrics.record_prefill_chunk()
+        if not final:
+            return
+        self.pool.insert(self._staging, req.slot)
+        first, req.rng = self._sample_first_fn()(
+            logits, jnp.float32(req.temperature),
+            jnp.int32(req.top_k), jnp.float32(req.top_p), req.rng)
+        token = int(first)
+        req.generated.append(token)
+        self.metrics.record_first_token(req.rid)
+        if req.done:
+            self._finish(req, finished)
+            return
+        self.scheduler.to_decoding(req)
+        s = req.slot
+        self._tok[s] = token
+        self._t[s] = p_len          # where the next decode step writes it
+        self._temp[s] = req.temperature
+        self._topk[s] = req.top_k
+        self._topp[s] = req.top_p
+        self._keys[s] = np.array(req.rng)
+
+    def _advance_decode(self, finished: List[Request]):
+        t0 = self.metrics.clock()
+        n_active = len(self.scheduler.running)
+        greedy_only = all(r.temperature <= 0.0
+                          for r in self.scheduler.running.values())
+        if greedy_only:
+            nxt, self.pool.cache = self._decode_fn(True)(
+                self._params, self._state, self.pool.cache,
+                self._tok, self._t)
+        else:
+            nxt, self.pool.cache, keys = self._decode_fn(False)(
+                self._params, self._state, self.pool.cache,
+                self._tok, self._t, self._temp, self._topk, self._topp,
+                self._keys)
+            self._keys = np.array(keys)
+        # the per-iteration host sync: the scheduler must see token ids
+        # to detect stops and free slots (docs/serving.md, follow-ups)
+        nxt = np.asarray(nxt)
+        for slot, req in list(self.scheduler.running.items()):
+            token = int(nxt[slot])
+            req.generated.append(token)
+            self._tok[slot] = token
+            self._t[slot] += 1
+            if req.done:
+                self._finish(req, finished)
+        self.metrics.record_decode(n_active, self.metrics.clock() - t0)
+
+    def _finish(self, req: Request, finished: List[Request]):
+        slot = req.slot
+        self.scheduler.release(req)
+        self._t[slot] = self.max_len          # sentinel: slot inert
+        self.metrics.record_finish(req.rid, len(req.generated))
+        # evict: the caller owns the finished Request from here —
+        # otherwise every prompt ever served stays resident
+        del self._requests[req.rid]
+        finished.append(req)

@@ -1,0 +1,75 @@
+"""Pooled KV cache: one resident ``[S, max_len]`` buffer set shared by
+every request the engine ever serves.
+
+``generate()`` creates its cache inside each compiled program and drops
+it on exit — correct for one call, hopeless for serving, where cache
+allocation per request would dominate short decodes and fragment HBM.
+The pool is allocated ONCE (slot-major: the same head-major
+``[S, Hkv, max_len, Dh]`` per-layer layout ``init_cache`` builds, with
+the batch axis reinterpreted as slots) and stays on device; a finished
+request's slot is simply reused — stale positions are never read
+because the per-slot decode masks attention at ``<= t`` and the next
+occupant's prefill overwrites the whole row.
+
+Composes with the int8 quantized cache (``dtype="int8"``): the payload
+and per-token-per-head scale planes all carry the slot axis and insert
+together.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from distkeras_tpu.models.decoding import init_cache
+
+
+@jax.jit
+def _insert_row(pool, req_cache, slot):
+    """Write a batch-1 request cache into pool row ``slot`` (``slot``
+    is traced — one compiled program serves every slot index)."""
+    def write(pl, rq):
+        return lax.dynamic_update_slice_in_dim(
+            pl, rq.astype(pl.dtype), slot, axis=0)
+    return jax.tree_util.tree_map(write, pool, req_cache)
+
+
+class KVPool:
+    """S-slot pooled KV cache over ``module``'s attention layers.
+
+    ``cache`` is the live device pytree (the exact structure
+    ``decode_step_slots`` consumes); ``insert`` replaces it — callers
+    must not hold on to the old value."""
+
+    def __init__(self, module, num_slots: int, max_len: int,
+                 dtype=jnp.float32):
+        if num_slots < 1:
+            raise ValueError(f"num_slots must be >= 1, got {num_slots}")
+        if max_len < 1:
+            raise ValueError(f"max_len must be >= 1, got {max_len}")
+        self._module = module
+        self.num_slots = int(num_slots)
+        self.max_len = int(max_len)
+        self.dtype = dtype
+        # init_cache validates max_len against the position table up
+        # front (out-of-range gathers CLAMP under jit — silent wrong-
+        # position logits otherwise)
+        self.cache = init_cache(module, self.num_slots, self.max_len,
+                                dtype)
+
+    def make_request_cache(self):
+        """A batch-1 cache with the pool's exact per-position layout —
+        what per-request prefill fills and ``insert`` consumes."""
+        return init_cache(self._module, 1, self.max_len, self.dtype)
+
+    def insert(self, req_cache, slot: int) -> None:
+        """Copy a batch-1 request cache (layout of
+        ``make_request_cache``) into row ``slot``. The whole row is
+        written — any stale tail beyond the new request's prompt is
+        overwritten by its own decode steps before the attention mask
+        ever reaches it."""
+        if not 0 <= slot < self.num_slots:
+            raise ValueError(
+                f"slot {slot} out of range [0, {self.num_slots})")
+        self.cache = _insert_row(self.cache, req_cache, slot)

@@ -1,0 +1,133 @@
+"""Serving metrics: the numbers that describe a serving workload, none
+of which a single ``generate()`` call can even express.
+
+Per request: TTFT (submit -> first token — prefill queueing + prompt
+ingestion) and end-to-end latency. Per engine iteration: queue depth,
+slot occupancy, decoding-slot count and decode wall time (the
+steady-state tokens/s series ``bench.py --model serving`` reduces).
+Phase wall-clock (prefill vs decode) rides on
+``utils.profiling.StepTimer``; percentile summaries use
+``utils.profiling.percentiles`` — one latency-summary convention across
+the repo.
+
+Per-request state is STREAMING: submit timestamps live only while a
+request is in flight (popped into the ttft/latency sample lists as it
+progresses), so a long-lived engine holds O(in-flight) dict state, not
+O(requests ever served). The sample lists themselves grow one float per
+request / iteration — a server that runs forever should treat a
+ServingMetrics as a measurement window and swap in a fresh one per
+reporting interval (``engine.metrics = ServingMetrics()``, the
+``bench.py`` per-pass pattern).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+from distkeras_tpu.utils.profiling import StepTimer, percentiles
+
+
+class ServingMetrics:
+    """Host-side counters; negligible overhead (dict writes and two
+    ``perf_counter`` calls per phase). ``clock`` is injectable so tests
+    can drive deterministic time."""
+
+    def __init__(self, clock=time.perf_counter):
+        self.clock = clock
+        self.timer = StepTimer()                 # "prefill" / "decode"
+        self.submit_ts: Dict[int, float] = {}    # in-flight only
+        self._ttfts: List[float] = []
+        self._latencies: List[float] = []
+        self.requests_finished = 0
+        self.tokens_generated = 0
+        self._t_first_submit: Optional[float] = None
+        self._t_last_finish: Optional[float] = None
+        self.queue_depth: List[int] = []         # per engine iteration
+        self.occupancy: List[float] = []         # occupied slots / S
+        self.decode_samples: List = []           # (decoding slots, dt)
+        self.prefill_chunks = 0
+
+    # --- per-request ------------------------------------------------------
+
+    def record_submit(self, rid: int) -> None:
+        now = self.clock()
+        self.submit_ts[rid] = now
+        if self._t_first_submit is None:
+            self._t_first_submit = now
+
+    def record_first_token(self, rid: int) -> None:
+        t0 = self.submit_ts.get(rid)
+        if t0 is not None:
+            self._ttfts.append(self.clock() - t0)
+
+    def record_finish(self, rid: int, n_generated: int) -> None:
+        now = self.clock()
+        t0 = self.submit_ts.pop(rid, None)
+        if t0 is not None:
+            self._latencies.append(now - t0)
+        self.requests_finished += 1
+        self.tokens_generated += int(n_generated)
+        self._t_last_finish = now
+
+    # --- per-iteration ----------------------------------------------------
+
+    def record_prefill_chunk(self) -> None:
+        self.prefill_chunks += 1
+
+    def record_iteration(self, queue_depth: int, occupied: int,
+                         num_slots: int) -> None:
+        self.queue_depth.append(int(queue_depth))
+        self.occupancy.append(occupied / num_slots)
+
+    def record_decode(self, n_decoding: int, dt: float) -> None:
+        self.decode_samples.append((int(n_decoding), float(dt)))
+
+    # --- reductions -------------------------------------------------------
+
+    def ttfts(self) -> List[float]:
+        return list(self._ttfts)
+
+    def latencies(self) -> List[float]:
+        return list(self._latencies)
+
+    def decode_tokens_per_sec(self,
+                              min_occupancy: int = 0) -> Optional[float]:
+        """Marginal decode throughput over iterations with at least
+        ``min_occupancy`` decoding slots — ``min_occupancy = S`` is the
+        steady-state full-batch rate the acceptance criterion compares
+        against a raw batched decode loop."""
+        toks = sum(n for n, _ in self.decode_samples
+                   if n >= min_occupancy)
+        secs = sum(dt for n, dt in self.decode_samples
+                   if n >= min_occupancy)
+        return toks / secs if secs > 0 else None
+
+    def summary(self) -> Dict:
+        """The metrics glossary of docs/serving.md, as one dict."""
+        elapsed = (self._t_last_finish - self._t_first_submit
+                   if self._t_first_submit is not None
+                   and self._t_last_finish is not None else 0.0)
+        return {
+            "requests_finished": self.requests_finished,
+            "tokens_generated": self.tokens_generated,
+            # request-level throughput: all generated tokens over the
+            # first-submit -> last-finish span (includes queueing +
+            # prefill)
+            "tokens_per_sec": (self.tokens_generated / elapsed
+                               if elapsed > 0 else None),
+            # marginal decode rate, all iterations / full batch only
+            "decode_tokens_per_sec": self.decode_tokens_per_sec(),
+            "ttft_s": percentiles(self._ttfts),
+            "latency_s": percentiles(self._latencies),
+            "queue_depth": ({"mean": sum(self.queue_depth)
+                             / len(self.queue_depth),
+                             "max": max(self.queue_depth)}
+                            if self.queue_depth else None),
+            "slot_occupancy": ({"mean": sum(self.occupancy)
+                                / len(self.occupancy),
+                                "max": max(self.occupancy)}
+                               if self.occupancy else None),
+            "prefill_chunks": self.prefill_chunks,
+            "phases": self.timer.summary(),
+        }

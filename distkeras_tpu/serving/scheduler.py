@@ -1,0 +1,142 @@
+"""Request scheduling for the continuous-batching engine: FIFO
+admission, per-request state machine, slot allocation/release.
+
+The scheduler is pure host-side bookkeeping — it never touches device
+arrays. Policy (deliberately simple, documented in docs/serving.md):
+
+  * FCFS admission: queued requests take free slots in arrival order.
+  * ONE prefill stream: the oldest admitted-but-not-yet-decoding
+    request advances one prompt chunk per engine iteration, interleaved
+    between decode steps (long prompts therefore do not stall in-flight
+    decode streams; they just take several iterations to come online).
+  * Slots release on finish (stop token or length limit) and are
+    immediately reusable by the next queued request.
+"""
+
+from __future__ import annotations
+
+import enum
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import numpy as np
+
+
+class RequestState(enum.Enum):
+    QUEUED = "queued"            # submitted, waiting for a slot
+    PREFILLING = "prefilling"    # slot assigned, prompt chunks running
+    DECODING = "decoding"        # in the slot-batched decode loop
+    FINISHED = "finished"        # stop token or length limit reached
+
+
+@dataclass
+class Request:
+    """One serving request and its mutable progress state. Sampling
+    knobs use the engine's per-slot sentinels (``temperature 0`` =
+    greedy, ``top_k 0`` = no truncation, ``top_p 1.0`` = no nucleus
+    cut, ``stop_token -1`` = never stop) so they can be placed directly
+    into the per-slot sampling vectors."""
+
+    rid: int
+    prompt: np.ndarray                   # [P] int32
+    max_new_tokens: int
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 1.0
+    stop_token: int = -1
+    seed: int = 0
+    state: RequestState = RequestState.QUEUED
+    slot: Optional[int] = None
+    prefill_pos: int = 0                 # prompt positions ingested
+    generated: List[int] = field(default_factory=list)
+    rng: object = None                   # per-request PRNG key (engine)
+
+    @property
+    def stopped(self) -> bool:
+        return (self.stop_token >= 0 and bool(self.generated)
+                and self.generated[-1] == self.stop_token)
+
+    @property
+    def done(self) -> bool:
+        return self.stopped or len(self.generated) >= self.max_new_tokens
+
+    @property
+    def tokens(self) -> np.ndarray:
+        """Prompt + generated continuation (ends AT the stop token when
+        one fired — no padding, unlike ``generate()``'s fixed-shape
+        output)."""
+        return np.concatenate(
+            [self.prompt, np.asarray(self.generated, self.prompt.dtype)])
+
+
+class FIFOScheduler:
+    """FIFO queue + slot allocator + state machine transitions."""
+
+    def __init__(self, num_slots: int):
+        if num_slots < 1:
+            raise ValueError(f"num_slots must be >= 1, got {num_slots}")
+        self.num_slots = int(num_slots)
+        self.waiting: deque = deque()          # QUEUED, FIFO
+        self.prefilling: deque = deque()       # PREFILLING, FIFO
+        self.running: Dict[int, Request] = {}  # slot -> DECODING request
+        # pop() hands out slot 0 first — deterministic placement makes
+        # oracle tests and trace reading reproducible
+        self._free = list(range(self.num_slots))[::-1]
+
+    # --- queue ------------------------------------------------------------
+
+    def submit(self, req: Request) -> None:
+        req.state = RequestState.QUEUED
+        self.waiting.append(req)
+
+    def admit(self) -> List[Request]:
+        """Move queued requests into free slots (FCFS) and mark them
+        PREFILLING; returns the newly admitted requests."""
+        admitted = []
+        while self.waiting and self._free:
+            req = self.waiting.popleft()
+            req.slot = self._free.pop()
+            req.state = RequestState.PREFILLING
+            req.prefill_pos = 0
+            self.prefilling.append(req)
+            admitted.append(req)
+        return admitted
+
+    def next_prefill(self) -> Optional[Request]:
+        """The single request whose prompt chunks currently advance (the
+        oldest admitted one; FCFS)."""
+        return self.prefilling[0] if self.prefilling else None
+
+    # --- transitions ------------------------------------------------------
+
+    def to_decoding(self, req: Request) -> None:
+        assert req is self.prefilling[0], "prefill completes FCFS"
+        self.prefilling.popleft()
+        req.state = RequestState.DECODING
+        self.running[req.slot] = req
+
+    def release(self, req: Request) -> None:
+        """Finish a request from either in-flight state and free its
+        slot."""
+        if req.state is RequestState.DECODING:
+            del self.running[req.slot]
+        elif req.state is RequestState.PREFILLING:
+            self.prefilling.remove(req)
+        req.state = RequestState.FINISHED
+        self._free.append(req.slot)
+
+    # --- introspection ----------------------------------------------------
+
+    @property
+    def queue_depth(self) -> int:
+        return len(self.waiting)
+
+    @property
+    def occupied(self) -> int:
+        return self.num_slots - len(self._free)
+
+    @property
+    def pending(self) -> bool:
+        """Any request not yet FINISHED."""
+        return bool(self.waiting or self.prefilling or self.running)

@@ -69,7 +69,25 @@ class Prefetcher:
     def __iter__(self) -> Iterator[Tuple[T, U]]:
         try:
             while True:
-                got = self._q.get()
+                try:
+                    # POLLING get (this PR): a blocking get() deadlocked
+                    # forever when close() ran mid-iteration — the old
+                    # close() drained the queue (stealing queued results
+                    # and the SENTINEL) to unblock the producer, and the
+                    # consumer's next() then waited on a queue nothing
+                    # would ever fill again
+                    got = self._q.get(timeout=0.05)
+                except queue.Empty:
+                    # the q.empty() re-check closes a drop race: the
+                    # producer may complete one last put between our
+                    # get() timeout and its own stop-flag check — once
+                    # the thread is dead AND the queue is empty, nothing
+                    # can arrive anymore
+                    if self._stopped.is_set() \
+                            and not self._thread.is_alive() \
+                            and self._q.empty():
+                        return       # closed mid-stream and fully drained
+                    continue
                 if got is _SENTINEL:
                     return
                 item, value, err = got
@@ -88,11 +106,10 @@ class Prefetcher:
 
     def close(self):
         """Stop the producer and reap its thread; idempotent, never blocks
-        indefinitely (the producer's puts poll the stop flag)."""
+        indefinitely (the producer's puts poll the stop flag every 50 ms,
+        so a put blocked on a full queue exits on its own — close() does
+        NOT drain the queue: results computed before the close stay
+        consumable, and the consumer's polling get() above terminates
+        iteration once they are gone)."""
         self._stopped.set()
-        while self._thread.is_alive():
-            try:  # drain so a blocked put can finish and observe the flag
-                self._q.get_nowait()
-            except queue.Empty:
-                pass
-            self._thread.join(timeout=0.05)
+        self._thread.join(timeout=5.0)

@@ -58,6 +58,18 @@ class StepTimer:
         }
 
 
+def percentiles(values, ps=(50.0, 99.0)) -> Optional[Dict[str, float]]:
+    """``{"p50": ..., "p99": ...}`` of a sample list via linear
+    interpolation (numpy's default) — the latency-summary convention the
+    serving metrics and ``bench.py --model serving`` share. None for an
+    empty sample."""
+    if not len(values):
+        return None
+    import numpy as np
+    arr = np.asarray(list(values), np.float64)
+    return {f"p{g:g}": float(np.percentile(arr, g)) for g in ps}
+
+
 def device_memory_stats() -> Optional[List[Dict]]:
     """Per-device memory stats where the backend exposes them (TPU does;
     virtual CPU devices usually return None)."""

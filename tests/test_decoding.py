@@ -596,6 +596,118 @@ def test_generate_validates_prefill_chunk():
                  prefill_chunk=0)
 
 
+def test_chunked_prefill_stop_on_first_token_pads_identically(
+        memorized_lm):
+    """prefill_chunked x stop_token interplay (this PR): when the very
+    FIRST generated token — the one sampled from the prefill's last
+    logits, before the decode scan runs — is the stop token, the
+    chunked and one-pass prefills must produce identical padding (the
+    done flag must be seeded from the first token on both paths)."""
+    m = memorized_lm
+    p_len = 9                              # not a chunk multiple
+    prompts = np.tile(PATTERN[:p_len], (2, 1))
+    # the memorized continuation's first token (inside the trained
+    # horizon, so both prefill paths agree on it with a huge margin) —
+    # make it the stop token: generation stops on token 1 and every
+    # generated position must be the pad
+    first = int(generate(m, prompts, max_new_tokens=1,
+                         temperature=0.0)[0, p_len])
+    assert first == PATTERN[p_len]         # margins are real
+    one = generate(m, prompts, max_new_tokens=6, temperature=0.0,
+                   stop_token=first)
+    chunked = generate(m, prompts, max_new_tokens=6, temperature=0.0,
+                       stop_token=first, prefill_chunk=4)
+    np.testing.assert_array_equal(one, chunked)
+    assert (np.asarray(one)[:, p_len:] == first).all()
+
+
+# --- per-sequence sampling arrays (this PR) --------------------------------
+
+
+def test_generate_per_seq_greedy_matches_scalar(memorized_lm):
+    """A temperature VECTOR of zeros must reproduce the scalar greedy
+    path token-for-token (same program semantics, traced knobs)."""
+    prompts = np.tile(PATTERN[:4], (2, 1))
+    ref = generate(memorized_lm, prompts, max_new_tokens=7,
+                   temperature=0.0)
+    vec = generate(memorized_lm, prompts, max_new_tokens=7,
+                   temperature=np.zeros(2))
+    np.testing.assert_array_equal(ref, vec)
+
+
+def test_generate_per_seq_stop_token_pads_per_row(memorized_lm):
+    """Row 0 stops on 9 (padding from there), row 1 never stops (-1
+    sentinel) — the same call."""
+    prompts = np.tile(PATTERN[:4], (2, 1))
+    out = memorized_lm.generate(prompts, max_new_tokens=7,
+                                temperature=0.0,
+                                stop_token=np.array([9, -1]))
+    np.testing.assert_array_equal(out[0, :6], PATTERN[:6])   # ...,5,9
+    np.testing.assert_array_equal(out[0, 6:], np.full(5, 9))  # padded
+    np.testing.assert_array_equal(out[1], PATTERN[:11])       # unstopped
+
+
+def test_generate_per_seq_sampling_one_program_many_configs():
+    """Per-sequence knobs are TRACED: different vector values reuse one
+    compiled program; heterogeneous rows sample within their own
+    truncation sets; scalar stop broadcasts alongside."""
+    m = lm()
+    prompts = np.array([[1, 2, 3], [4, 5, 6], [7, 8, 9]])
+    out = generate(m, prompts, max_new_tokens=4,
+                   temperature=np.array([0.0, 1.0, 1.0]),
+                   top_k=np.array([0, 5, 2]), seed=7)
+    assert out.shape == (3, 7)
+    n_keys = len(m._jit_generate)
+    out2 = generate(m, prompts, max_new_tokens=4,
+                    temperature=np.array([0.0, 0.5, 2.0]),
+                    top_k=np.array([0, 3, 1]), seed=7)
+    assert len(m._jit_generate) == n_keys            # same program
+    # greedy row is deterministic across configs
+    np.testing.assert_array_equal(out[0], out2[0])
+    # same call twice: same draws
+    out3 = generate(m, prompts, max_new_tokens=4,
+                    temperature=np.array([0.0, 0.5, 2.0]),
+                    top_k=np.array([0, 3, 1]), seed=7)
+    np.testing.assert_array_equal(out2, out3)
+
+
+def test_generate_per_seq_validation():
+    m = lm()
+    prompts = np.array([[1, 2, 3], [4, 5, 6]])
+    with pytest.raises(ValueError, match="temperature"):
+        generate(m, prompts, max_new_tokens=2,
+                 temperature=np.zeros(3))            # batch mismatch
+    with pytest.raises(ValueError, match="top_p"):
+        generate(m, prompts, max_new_tokens=2, temperature=1.0,
+                 top_p=np.array([0.5, 1.5]))
+
+
+def test_sample_vec_top_k_rank_mask_matches_top_k_ties():
+    """The vector sampler's rank-based top_k admits exactly the scalar
+    path's index-exact candidate set, ties included."""
+    from distkeras_tpu.models.decoding import _sample_vec
+
+    logits = jnp.asarray([[0.0, 5.0, 5.0, 5.0, -1.0]])  # 3-way tie, k=2
+    idx = set(jax.device_get(jax.lax.top_k(logits, 2)[1][0]).tolist())
+    draws = {
+        int(_sample_vec(logits, jnp.ones(1), jnp.full((1,), 2),
+                        jnp.ones(1), jax.random.PRNGKey(s))[0])
+        for s in range(200)
+    }
+    assert draws == idx, f"sampled outside the top-2 set: {draws - idx}"
+    # sentinel rows: top_k 0 keeps everything reachable, temperature 0
+    # is greedy regardless of rng
+    all_draws = {
+        int(_sample_vec(logits, jnp.ones(1), jnp.zeros(1, jnp.int32),
+                        jnp.ones(1), jax.random.PRNGKey(s))[0])
+        for s in range(300)
+    }
+    assert len(all_draws) >= 4
+    g = _sample_vec(logits, jnp.zeros(1), jnp.zeros(1, jnp.int32),
+                    jnp.ones(1), jax.random.PRNGKey(0))
+    assert int(g[0]) == 1                            # lowest tied index
+
+
 # --- fused wqkv serving projection (round 5) -------------------------------
 
 def test_fused_qkv_projection_matches_separate_gqa():

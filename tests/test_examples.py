@@ -35,6 +35,13 @@ def test_lm_generate_example(capsys):
     assert acc > 0.9, acc
 
 
+def test_continuous_batching_example(capsys):
+    matches = run_example("examples.continuous_batching")
+    out = capsys.readouterr().out
+    assert "token-identical to generate()" in out
+    assert matches >= 3       # every greedy request passed its oracle
+
+
 def test_vit_finetune_callbacks_example(capsys):
     acc = run_example("examples.vit_finetune_callbacks")
     out = capsys.readouterr().out

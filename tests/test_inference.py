@@ -179,6 +179,49 @@ def test_streaming_predictor_ragged_and_early_break():
         list(pred.predict_stream(bad()))
 
 
+def test_streaming_predictor_close_midstream_terminates_producer():
+    """Shutdown contract (this PR): gen.close() mid-stream must reap
+    the staging thread promptly — even while it is BLOCKED in a put on
+    the full double-buffer — without deadlock, and a full consumption
+    run must deliver every batch in order (nothing dropped by the
+    shutdown plumbing)."""
+    import time
+
+    from distkeras_tpu.inference import StreamingPredictor
+    from distkeras_tpu.models import Dense, Model, Sequential
+
+    model = Model.build(Sequential([Dense(3)]), (4,), seed=0)
+    pred = StreamingPredictor(model, batch_size=8)
+    rs = np.random.RandomState(1)
+    pulled = []
+
+    def source(n=200):
+        for i in range(n):
+            pulled.append(i)
+            yield np.full((8, 4), float(i))
+
+    gen = pred.predict_stream(source())
+    next(gen)
+    next(gen)
+    time.sleep(0.3)          # staging thread fills the queue and BLOCKS
+    gen.close()
+    t = pred._stage_thread
+    t.join(timeout=5)
+    assert not t.is_alive(), "staging thread survived close()"
+    n_at_close = len(pulled)
+    assert n_at_close < 200  # source abandoned mid-stream, not drained
+    time.sleep(0.2)
+    assert len(pulled) == n_at_close   # and it STAYS abandoned
+
+    # full consumption: every batch comes back, in order (in-flight
+    # items are never dropped on the normal path)
+    outs = list(pred.predict_stream(source(7)))
+    assert len(outs) == 7
+    for i, o in enumerate(outs):
+        np.testing.assert_allclose(
+            o, model.predict(np.full((8, 4), float(i))), rtol=1e-5)
+
+
 def test_bilstm_batched_inference():
     """BASELINE config 5: batch-sharded BiLSTM inference over the mesh."""
     from distkeras_tpu.inference import ModelPredictor

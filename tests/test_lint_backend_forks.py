@@ -1,0 +1,55 @@
+"""tools/lint_backend_forks.py wired into tier-1: the repo must stay
+free of backend/platform sniffs outside compat.py (the PR-1
+``compat.backend_is_tpu`` convention), and the checker itself must
+actually detect the patterns it claims to."""
+
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO / "tools"))
+
+from lint_backend_forks import ALLOW_MARK, check_source, check_tree  # noqa: E402
+
+
+def test_repo_is_free_of_backend_sniffs():
+    findings = check_tree(REPO)
+    assert not findings, "\n".join(
+        f"{f}:{ln}: {msg}" for f, ln, msg in findings)
+
+
+def test_checker_flags_default_backend_calls():
+    src = "import jax\nok = 1\nbad = jax.default_backend() == 'tpu'\n"
+    findings = check_source(src, "x.py")
+    assert [(f, ln) for f, ln, _ in findings] == [("x.py", 3)]
+
+
+def test_checker_flags_platform_sniffs():
+    src = "import jax\nif jax.devices()[0].platform == 'tpu':\n    pass\n"
+    findings = check_source(src, "x.py")
+    assert len(findings) == 1 and findings[0][1] == 2
+
+
+def test_checker_skips_docstrings_comments_and_marked_lines():
+    src = (
+        '"""jax.default_backend() in a docstring is prose, not a '
+        'fork."""\n'
+        "# jax.default_backend() in a comment\n"
+        "import jax\n"
+        f"ok = jax.default_backend()  # {ALLOW_MARK}: harness sizing\n"
+    )
+    assert check_source(src, "x.py") == []
+
+
+def test_checker_exempts_stdlib_platform_lookalikes():
+    src = (
+        "import sys, platform\n"
+        "a = sys.platform == 'win32'\n"
+        "b = platform.platform()\n"
+    )
+    assert check_source(src, "x.py") == []
+
+
+def test_checker_reports_syntax_errors_as_findings():
+    findings = check_source("def broken(:\n", "x.py")
+    assert len(findings) == 1 and "syntax" in findings[0][2]

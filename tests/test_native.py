@@ -134,6 +134,46 @@ def test_prefetcher_orders_and_propagates_errors():
     assert items == [0, 1]
 
 
+def test_prefetcher_close_midstream_no_deadlock_no_dropped_items():
+    """close() mid-iteration (this PR): the producer thread must
+    terminate, and CONTINUING to iterate must yield every result that
+    was already computed, then terminate — the old close() drained the
+    queue (dropping queued results and the SENTINEL), so the consumer's
+    next() blocked forever on a queue nothing would refill."""
+    import threading
+    import time
+    from distkeras_tpu.utils.prefetch import Prefetcher
+
+    produced = []
+
+    def fn(i):
+        produced.append(i)
+        return i * 10
+
+    pf = Prefetcher(fn, range(50), depth=3)
+    it = iter(pf)
+    got = [next(it) for _ in range(2)]
+    assert got == [(0, 0), (1, 10)]
+    time.sleep(0.2)                    # let the producer fill its depth
+    pf.close()
+    deadline = time.time() + 5
+    while pf._thread.is_alive() and time.time() < deadline:
+        time.sleep(0.02)
+    assert not pf._thread.is_alive()   # producer reaped, no deadlock
+    n_computed = len(produced)
+    assert n_computed < 50             # actually stopped mid-stream
+    # every already-computed result still comes through, in order, and
+    # iteration then ENDS instead of hanging (run it on a worker so a
+    # regression fails the test rather than deadlocking the suite)
+    tail = []
+    t = threading.Thread(target=lambda: tail.extend(it), daemon=True)
+    t.start()
+    t.join(timeout=10)
+    assert not t.is_alive(), "iteration deadlocked after close()"
+    assert got + tail == [(i, i * 10) for i in range(len(got) + len(tail))]
+    assert len(got) + len(tail) <= n_computed
+
+
 def test_prefetcher_cleans_up_on_break_and_close():
     import threading
     from distkeras_tpu.utils.prefetch import Prefetcher

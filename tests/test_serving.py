@@ -1,0 +1,422 @@
+"""Continuous-batching serving engine (this PR): the oracle contract —
+greedy outputs under iteration-level batching must be token-identical
+per request to standalone ``generate()`` — plus scheduler/state-machine,
+pooled-cache, per-slot-sampling, interleaved-prefill and metrics
+coverage."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from distkeras_tpu.models import Model, zoo
+from distkeras_tpu.models.decoding import (decode_step, decode_step_slots,
+                                           generate, init_cache,
+                                           _resolve_head_dims)
+from distkeras_tpu.serving import (FIFOScheduler, KVPool, Request,
+                                   RequestState, ServingEngine,
+                                   ServingMetrics)
+
+V, S = 29, 12
+PATTERN = np.array([3, 1, 4, 1, 5, 9, 2, 6, 5, 3, 5, 8])
+
+
+@pytest.fixture(scope="module")
+def memorized_lm():
+    """Overfit on one repeating sequence: greedy decode has huge argmax
+    margins everywhere, so token-identity assertions are robust to the
+    fp-reassociation differences between batch shapes (the same fixture
+    idiom as test_decoding)."""
+    X = np.tile(PATTERN, (256, 1))
+    m = Model.build(
+        zoo.transformer_lm(V, d_model=32, num_heads=4, num_layers=2,
+                           mlp_ratio=2, use_rope=True), (S,), seed=2)
+    m.fit(X[:, :-1], X[:, 1:], optimizer="adam", learning_rate=5e-3,
+          batch_size=64, epochs=30,
+          loss="sparse_categorical_crossentropy_from_logits")
+    return m
+
+
+# --- the oracle: continuous batching == generate(), per request ------------
+
+
+def test_oracle_staggered_arrivals_match_generate(memorized_lm):
+    """Requests arriving at staggered times with mixed prompt lengths
+    and budgets, more requests than slots (so slots recycle and the
+    queue is exercised): every request's greedy tokens must equal its
+    own standalone generate() call."""
+    m = memorized_lm
+    eng = ServingEngine(m, num_slots=3, max_len=32)
+    prompts = [PATTERN[:4], PATTERN[:6], PATTERN[:3], PATTERN[:5],
+               PATTERN[:4], PATTERN[:7]]
+    budgets = [7, 5, 9, 6, 8, 4]
+    rids = [eng.submit(prompts[i], budgets[i]) for i in range(2)]
+    eng.step()
+    eng.step()                     # in-flight work before later arrivals
+    rids += [eng.submit(prompts[i], budgets[i]) for i in range(2, 6)]
+    out = eng.run(max_steps=500)
+    assert sorted(out) == sorted(rids)
+    for i, rid in enumerate(rids):
+        ref = generate(m, prompts[i][None], max_new_tokens=budgets[i],
+                       temperature=0.0)
+        np.testing.assert_array_equal(out[rid], ref[0])
+
+
+def test_oracle_chunked_prefill_matches_generate(memorized_lm):
+    """The interleaved chunked prefill must hand decode the same cache
+    the one-shot path builds: greedy tokens equal generate() with the
+    matching prefill_chunk (prompt not a multiple of the chunk)."""
+    m = memorized_lm
+    eng = ServingEngine(m, num_slots=2, max_len=48, prefill_chunk=4)
+    prompt = np.tile(PATTERN, 3)[:26]
+    rid = eng.submit(prompt, 6)
+    out = eng.run(max_steps=300)
+    ref = generate(m, prompt[None], max_new_tokens=6, temperature=0.0,
+                   prefill_chunk=4)
+    np.testing.assert_array_equal(out[rid], ref[0])
+
+
+def test_oracle_int8_pooled_cache_matches_generate(memorized_lm):
+    m = memorized_lm
+    eng = ServingEngine(m, num_slots=2, max_len=32, cache_dtype="int8")
+    rid = eng.submit(PATTERN[:4], 7)
+    out = eng.run(max_steps=300)
+    ref = generate(m, PATTERN[None, :4], max_new_tokens=7,
+                   temperature=0.0, cache_dtype="int8")
+    np.testing.assert_array_equal(out[rid], ref[0])
+
+
+def test_stop_token_frees_slot_early(memorized_lm):
+    """A stop-token request releases its slot before max_new_tokens;
+    the engine result ends AT the stop token (no padding — unlike
+    generate()'s static-shape tail fill)."""
+    m = memorized_lm
+    eng = ServingEngine(m, num_slots=1, max_len=32)
+    rid = eng.submit(PATTERN[:4], 7, stop_token=9)     # pattern hits 9
+    out = eng.run(max_steps=300)
+    ref = generate(m, PATTERN[None, :4], max_new_tokens=7,
+                   temperature=0.0, stop_token=9)
+    got = out[rid]
+    assert got[-1] == 9 and len(got) < 4 + 7
+    np.testing.assert_array_equal(got, ref[0, :len(got)])
+    # the tail generate() padded must be exactly the stop token — the
+    # engine simply does not emit it
+    assert (ref[0, len(got):] == 9).all()
+
+
+def test_heterogeneous_sampling_coexists(memorized_lm):
+    """Per-slot sampling state: a greedy request sharing the batch with
+    sampled neighbours must produce exactly its solo-greedy tokens, and
+    a sampled request must be reproducible from its seed regardless of
+    neighbours."""
+    m = memorized_lm
+
+    def run_engine(extra_first):
+        eng = ServingEngine(m, num_slots=3, max_len=32)
+        if extra_first:
+            eng.submit(PATTERN[:3], 8, temperature=1.3, top_k=4, seed=11)
+        g = eng.submit(PATTERN[:4], 7)                   # greedy
+        s = eng.submit(PATTERN[:5], 6, temperature=0.9, top_p=0.95,
+                       seed=5)
+        out = eng.run(max_steps=500)
+        return out[g], out[s]
+
+    greedy_a, sampled_a = run_engine(extra_first=False)
+    greedy_b, sampled_b = run_engine(extra_first=True)
+    ref = generate(m, PATTERN[None, :4], max_new_tokens=7,
+                   temperature=0.0)
+    np.testing.assert_array_equal(greedy_a, ref[0])
+    np.testing.assert_array_equal(greedy_b, ref[0])
+    # per-slot PRNG keys: the sampled request's draws depend only on its
+    # own seed, not on which neighbours shared the batch
+    np.testing.assert_array_equal(sampled_a, sampled_b)
+    assert (sampled_a[5:] < V).all() and (sampled_a[5:] >= 0).all()
+
+
+def test_long_prefill_does_not_stall_inflight_decode(memorized_lm):
+    """The scheduling property chunked prefill exists for: while a long
+    prompt ingests chunk-by-chunk, an already-decoding request keeps
+    emitting tokens every iteration."""
+    m = memorized_lm
+    eng = ServingEngine(m, num_slots=2, max_len=64, prefill_chunk=2)
+    fast = eng.submit(PATTERN[:3], 20)
+    while not eng.scheduler.running:                     # fast decoding
+        eng.step()
+    tokens_before = len(eng[fast].generated)
+    slow = eng.submit(np.tile(PATTERN, 3)[:24], 4)       # 12 chunks
+    for _ in range(6):                                   # mid-prefill
+        eng.step()
+    assert eng[slow].state is RequestState.PREFILLING
+    assert 0 < eng[slow].prefill_pos < 24
+    # the in-flight stream advanced ~1 token per iteration, not zero
+    assert len(eng[fast].generated) >= tokens_before + 6
+    out = eng.run(max_steps=500)
+    ref = generate(m, np.tile(PATTERN, 3)[None, :24], max_new_tokens=4,
+                   temperature=0.0, prefill_chunk=2)
+    np.testing.assert_array_equal(out[slow], ref[0])
+
+
+def test_decode_jit_compiles_once_across_requests(memorized_lm):
+    """The engine's whole point: static shapes, compiled decode
+    programs reused across every request mix — one argmax variant for
+    all-greedy batches, one sampler variant for mixed batches, each
+    traced exactly once."""
+    m = memorized_lm
+    eng = ServingEngine(m, num_slots=2, max_len=32)
+    eng.submit(PATTERN[:4], 5)
+    eng.run(max_steps=300)
+    assert set(eng._step_fns) == {True}          # all-greedy so far
+    fn = eng._step_fns[True]
+    assert fn._cache_size() == 1
+    eng.submit(PATTERN[:6], 7, temperature=1.0, top_k=3, seed=1)
+    eng.submit(PATTERN[:2], 4, stop_token=9)
+    eng.run(max_steps=300)
+    assert eng._step_fns[True] is fn and fn._cache_size() == 1
+    assert eng._step_fns[False]._cache_size() == 1  # mixed variant
+
+
+# --- slot-level decode path -------------------------------------------------
+
+
+def test_decode_step_slots_staggered_positions_match_scalar():
+    """decode_step_slots at HETEROGENEOUS positions must agree with
+    per-sequence scalar decode_step runs: two sequences advanced to
+    different depths, stepped together with a vector t."""
+    m = Model.build(
+        zoo.transformer_lm(V, d_model=32, num_heads=4, num_layers=2,
+                           mlp_ratio=2, use_rope=True), (S,), seed=4)
+    _resolve_head_dims(m.module, m.params)
+    rs = np.random.RandomState(0)
+    toks = rs.randint(0, V, (2, 8)).astype(np.int32)
+
+    # scalar oracle: advance sequence 0 to position 5, sequence 1 to 3
+    caches = []
+    refs = []
+    for row, depth in ((0, 5), (1, 3)):
+        c = init_cache(m.module, 1, S)
+        logits = None
+        for t in range(depth):
+            logits, c = decode_step(m.module, m.params, m.state, c,
+                                    jnp.asarray(toks[row:row + 1, t]), t)
+        caches.append(c)
+        refs.append(np.asarray(logits))
+
+    # pooled: same per-row caches side by side, one vector-t step
+    pool = [None if a is None else
+            {k: jnp.concatenate([a[k], b[k]], axis=0) for k in a}
+            for a, b in zip(*caches)]
+    t_prev = np.array([4, 2])          # the last written positions were
+    tok_prev = np.stack([toks[0, 4], toks[1, 2]])
+    # re-run the LAST step of each row in pooled form to compare logits
+    pool_before = [None if a is None else
+                   {k: jnp.concatenate([a[k], b[k]], axis=0) for k in a}
+                   for a, b in zip(*[
+                       _advance(m, toks[r:r + 1], d - 1)
+                       for r, d in ((0, 5), (1, 3))])]
+    logits, _ = decode_step_slots(m.module, m.params, m.state,
+                                  pool_before, jnp.asarray(tok_prev),
+                                  jnp.asarray(t_prev))
+    np.testing.assert_allclose(np.asarray(logits),
+                               np.concatenate(refs, axis=0), atol=2e-5)
+
+
+def _advance(m, row_toks, depth):
+    """Scalar-decode a single row ``depth`` steps; returns its cache."""
+    c = init_cache(m.module, 1, S)
+    for t in range(depth):
+        _, c = decode_step(m.module, m.params, m.state, c,
+                           jnp.asarray(row_toks[:, t]), t)
+    return c
+
+
+def test_decode_step_slots_sentinel_t_writes_nothing():
+    """A slot whose t is out of range (the engine's free-slot sentinel)
+    must not touch the cache — the one-hot write misses everywhere."""
+    m = Model.build(
+        zoo.transformer_lm(V, d_model=16, num_heads=2, num_layers=1,
+                           mlp_ratio=2, use_rope=True), (S,), seed=0)
+    _resolve_head_dims(m.module, m.params)
+    cache = init_cache(m.module, 2, S)
+    kv0 = next(c for c in cache if c is not None)
+    before = np.array(kv0["k"])
+    _, cache2 = decode_step_slots(
+        m.module, m.params, m.state, cache,
+        jnp.asarray([3, 5], jnp.int32), jnp.asarray([S, S], jnp.int32))
+    kv1 = next(c for c in cache2 if c is not None)
+    np.testing.assert_array_equal(np.asarray(kv1["k"]), before)
+
+
+def test_prefill_program_cache_is_lru_capped(memorized_lm):
+    """Varied prompt lengths each compile their own ragged-tail prefill
+    program; the engine must bound how many it retains."""
+    eng = ServingEngine(memorized_lm, num_slots=1, max_len=32)
+    eng.MAX_PREFILL_PROGRAMS = 3
+    for n in (2, 3, 4, 5, 6):                  # 5 distinct lengths
+        eng.submit(PATTERN[:n], 2)
+        eng.run(max_steps=200)
+    assert len(eng._prefill_fns) == 3
+    # most-recent lengths retained (dict order = LRU order)
+    assert sorted(k[0] for k in eng._prefill_fns) == [4, 5, 6]
+    # reuse refreshes recency and does not recompile
+    fn6 = eng._prefill_fns[(6, 0, True)]
+    eng.submit(PATTERN[:6], 2)
+    eng.run(max_steps=200)
+    assert eng._prefill_fns[(6, 0, True)] is fn6
+
+
+# --- kv pool ----------------------------------------------------------------
+
+
+def test_kv_pool_insert_places_request_rows():
+    m = Model.build(
+        zoo.transformer_lm(V, d_model=16, num_heads=2, num_layers=2,
+                           mlp_ratio=2, use_rope=True), (S,), seed=1)
+    _resolve_head_dims(m.module, m.params)
+    pool = KVPool(m.module, num_slots=3, max_len=10)
+    req = pool.make_request_cache()
+    req = jax.tree_util.tree_map(
+        lambda x: jnp.full_like(x, 7.0), req)
+    pool.insert(req, 1)
+    for layer in pool.cache:
+        if layer is None:
+            continue
+        arr = np.asarray(layer["k"])
+        assert (arr[1] == 7.0).all()
+        assert (arr[0] == 0.0).all() and (arr[2] == 0.0).all()
+    with pytest.raises(ValueError, match="slot"):
+        pool.insert(req, 3)
+
+
+def test_kv_pool_rejects_capacity_beyond_position_table():
+    m = Model.build(
+        zoo.transformer_lm(V, d_model=16, num_heads=2, num_layers=1,
+                           mlp_ratio=2, use_rope=False, max_len=16),
+        (S,), seed=1)
+    _resolve_head_dims(m.module, m.params)
+    with pytest.raises(ValueError, match="too small"):
+        KVPool(m.module, num_slots=2, max_len=17)
+
+
+# --- scheduler --------------------------------------------------------------
+
+
+def _req(rid, p_len=4, budget=5, **kw):
+    return Request(rid=rid, prompt=PATTERN[:p_len].copy(),
+                   max_new_tokens=budget, **kw)
+
+
+def test_scheduler_fifo_admission_and_slot_reuse():
+    sched = FIFOScheduler(2)
+    reqs = [_req(i) for i in range(4)]
+    for r in reqs:
+        sched.submit(r)
+    admitted = sched.admit()
+    assert [r.rid for r in admitted] == [0, 1]
+    assert [r.slot for r in admitted] == [0, 1]        # deterministic
+    assert sched.queue_depth == 2 and sched.occupied == 2
+    assert not sched.admit()                           # no free slots
+    # finish 0 from PREFILLING; its slot goes to request 2
+    sched.release(reqs[0])
+    assert reqs[0].state is RequestState.FINISHED
+    assert sched.admit()[0] is reqs[2] and reqs[2].slot == 0
+    # request 1 finishes from DECODING
+    sched.to_decoding(reqs[1])
+    assert sched.running == {1: reqs[1]}
+    sched.release(reqs[1])
+    assert sched.admit()[0] is reqs[3] and reqs[3].slot == 1
+    assert sched.queue_depth == 0
+
+
+def test_scheduler_single_prefill_stream_is_fcfs():
+    sched = FIFOScheduler(3)
+    reqs = [_req(i) for i in range(3)]
+    for r in reqs:
+        sched.submit(r)
+    sched.admit()
+    assert sched.next_prefill() is reqs[0]
+    sched.to_decoding(reqs[0])
+    assert sched.next_prefill() is reqs[1]
+    with pytest.raises(AssertionError):
+        sched.to_decoding(reqs[2])                     # FCFS enforced
+
+
+def test_request_done_semantics():
+    r = _req(0, budget=2, stop_token=9)
+    assert not r.done
+    r.generated.append(3)
+    assert not r.done and not r.stopped
+    r.generated.append(9)
+    assert r.stopped and r.done
+    r2 = _req(1, budget=1)
+    r2.generated.append(9)                             # no stop_token set
+    assert r2.done and not r2.stopped
+    np.testing.assert_array_equal(r2.tokens,
+                                  np.concatenate([PATTERN[:4], [9]]))
+
+
+# --- engine validation ------------------------------------------------------
+
+
+def test_submit_validation(memorized_lm):
+    eng = ServingEngine(memorized_lm, num_slots=1, max_len=16)
+    with pytest.raises(ValueError, match="max_len"):
+        eng.submit(PATTERN[:10], 7)
+    with pytest.raises(ValueError, match="max_new_tokens"):
+        eng.submit(PATTERN[:4], 0)
+    with pytest.raises(ValueError, match="top_p"):
+        eng.submit(PATTERN[:4], 2, top_p=1.5)
+    with pytest.raises(ValueError, match="at least one"):
+        eng.submit(np.zeros((0,), np.int32), 2)
+
+
+def test_engine_rejects_non_sequential():
+    class Fake:
+        module = object()
+    with pytest.raises(TypeError, match="Sequential"):
+        ServingEngine(Fake())
+
+
+# --- metrics ----------------------------------------------------------------
+
+
+def test_metrics_lifecycle_and_summary():
+    clock = iter(np.arange(0.0, 100.0, 0.5))
+    mtr = ServingMetrics(clock=lambda: float(next(clock)))
+    mtr.record_submit(0)                   # t=0.0
+    mtr.record_first_token(0)              # t=0.5 -> ttft 0.5
+    mtr.record_iteration(queue_depth=2, occupied=1, num_slots=2)
+    mtr.record_decode(n_decoding=2, dt=0.25)
+    mtr.record_decode(n_decoding=1, dt=0.25)
+    mtr.record_finish(0, n_generated=5)    # t=1.0 -> latency 1.0
+    s = mtr.summary()
+    assert s["requests_finished"] == 1
+    assert s["tokens_generated"] == 5
+    assert s["ttft_s"]["p50"] == pytest.approx(0.5)
+    assert s["latency_s"]["p50"] == pytest.approx(1.0)
+    assert s["queue_depth"]["max"] == 2
+    assert s["slot_occupancy"]["mean"] == pytest.approx(0.5)
+    # all-iterations marginal decode rate: 3 tokens / 0.5 s
+    assert s["decode_tokens_per_sec"] == pytest.approx(6.0)
+    # full-occupancy steady state: 2 tokens / 0.25 s
+    assert mtr.decode_tokens_per_sec(min_occupancy=2) \
+        == pytest.approx(8.0)
+
+
+def test_engine_records_serving_metrics(memorized_lm):
+    eng = ServingEngine(memorized_lm, num_slots=2, max_len=32,
+                        prefill_chunk=4)
+    rids = [eng.submit(PATTERN[:6], 5), eng.submit(PATTERN[:4], 6),
+            eng.submit(PATTERN[:5], 4)]
+    eng.run(max_steps=500)
+    s = eng.metrics.summary()
+    assert s["requests_finished"] == 3
+    assert s["tokens_generated"] == 5 + 6 + 4
+    assert s["ttft_s"] is not None and s["ttft_s"]["p99"] >= \
+        s["ttft_s"]["p50"] >= 0
+    assert s["latency_s"]["p50"] > 0
+    assert s["prefill_chunks"] >= 2 + 1 + 2    # ceil(6/4)+ceil(4/4)+...
+    assert s["slot_occupancy"]["max"] == 1.0   # both slots ran together
+    assert s["queue_depth"]["max"] >= 1        # third request queued
+    assert s["phases"]["prefill"]["count"] == s["prefill_chunks"]
+    assert s["decode_tokens_per_sec"] > 0

@@ -1,0 +1,112 @@
+#!/usr/bin/env python
+"""Static check: backend/platform sniffs belong in ``compat.py`` only.
+
+The repo-wide convention (PR 1, documented on ``compat.backend_is_tpu``
+and ``models.decoding.generate``): every Pallas-vs-XLA fork keys off
+``compat.backend_is_tpu()`` — ONE trace-time contract instead of ad-hoc
+``jax.default_backend()`` / ``device.platform`` sniffs scattered per
+call site, which fork compiled programs on attributes jit erases and
+drift out of agreement with each other.
+
+This linter walks the AST (so docstrings and comments never
+false-positive) and flags, outside ``compat.py``:
+
+  * any call to ``*.default_backend(...)``
+  * any read of a ``.platform`` attribute (``jax.devices()[0].platform``
+    and friends)
+
+Scope: the ``distkeras_tpu`` package, ``bench.py``, ``examples/`` and
+``tools/``. A justified exception carries the marker comment
+``lint: allow-backend-sniff`` on the offending line.
+
+Exit status 1 when findings exist (wired into tier-1 as
+``tests/test_lint_backend_forks.py``).
+"""
+
+from __future__ import annotations
+
+import ast
+import sys
+from pathlib import Path
+from typing import List, Tuple
+
+ALLOW_MARK = "lint: allow-backend-sniff"
+
+#: paths scanned, relative to the repo root
+SCAN = ("distkeras_tpu", "bench.py", "examples", "tools")
+
+#: the one module allowed to sniff
+EXEMPT = ("compat.py",)
+
+Finding = Tuple[str, int, str]
+
+
+def _allowed(line: str) -> bool:
+    return ALLOW_MARK in line
+
+
+def check_source(src: str, rel: str) -> List[Finding]:
+    """Findings for one file's source text."""
+    try:
+        tree = ast.parse(src, filename=rel)
+    except SyntaxError as e:  # a broken file is its own finding
+        return [(rel, e.lineno or 0, f"syntax error: {e.msg}")]
+    lines = src.splitlines()
+    out: List[Finding] = []
+
+    def line_of(node: ast.AST) -> str:
+        ln = getattr(node, "lineno", 0)
+        return lines[ln - 1] if 0 < ln <= len(lines) else ""
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "default_backend":
+            if not _allowed(line_of(node)):
+                out.append((rel, node.lineno,
+                            "direct jax.default_backend() call — use "
+                            "compat.backend_is_tpu()"))
+        elif isinstance(node, ast.Attribute) \
+                and node.attr == "platform" \
+                and isinstance(node.ctx, ast.Load):
+            # stdlib look-alikes are not device sniffs: ``sys.platform``
+            # and the ``platform`` module's own attributes
+            if isinstance(node.value, ast.Name) \
+                    and node.value.id in ("sys", "platform"):
+                continue
+            if not _allowed(line_of(node)):
+                out.append((rel, node.lineno,
+                            ".platform device sniff — use "
+                            "compat.backend_is_tpu()"))
+    return out
+
+
+def check_tree(root: Path) -> List[Finding]:
+    findings: List[Finding] = []
+    for entry in SCAN:
+        p = root / entry
+        files = sorted(p.rglob("*.py")) if p.is_dir() \
+            else ([p] if p.exists() else [])
+        for f in files:
+            if f.name in EXEMPT:
+                continue
+            rel = str(f.relative_to(root))
+            findings.extend(check_source(f.read_text(), rel))
+    return findings
+
+
+def main(argv=None) -> int:
+    root = Path(argv[0]) if argv else Path(__file__).resolve().parent.parent
+    findings = check_tree(root)
+    for rel, lineno, msg in findings:
+        print(f"{rel}:{lineno}: {msg}")
+    if findings:
+        print(f"{len(findings)} backend-sniff finding(s); route through "
+              f"compat.backend_is_tpu() or mark the line with "
+              f"'# {ALLOW_MARK}'", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))
