@@ -47,7 +47,12 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from distkeras_tpu import obs
 from distkeras_tpu.compat import cost_analysis as _cost_analysis
+# the chip peak table lives with the telemetry tape now (obs.tape needs
+# it for MFU); re-exported here so bench callers keep their import path
+from distkeras_tpu.obs.tape import (  # noqa: F401
+    BF16_PEAK_FLOPS, detect_peak_flops)
 
 # persistent compilation cache: these are large graphs; caching makes
 # repeat bench runs (and driver re-runs) start in seconds
@@ -75,35 +80,54 @@ def _is_oom(e: BaseException) -> bool:
     return "resource_exhausted" in msg or "resource exhausted" in msg \
         or "out of memory" in msg or "oom" in msg or "memory" in msg
 
-#: bf16 peak matmul throughput per chip, by device_kind substring.
-#: Sources: published TPU spec sheets (v4: 275, v5e: 197, v5p: 459,
-#: v6e/Trillium: 918 TFLOP/s bf16).
-BF16_PEAK_FLOPS = (
-    ("v6e", 918e12), ("v6", 918e12),
-    ("v5p", 459e12),
-    ("v5 lite", 197e12), ("v5e", 197e12), ("v5litepod", 197e12),
-    ("v5", 459e12),
-    ("v4", 275e12),
-)
+#: per-family telemetry window (``_begin_family``/``_family_telemetry``)
+_FAMILY = {"compile0": None}
 
 
-def detect_peak_flops():
-    kind = jax.devices()[0].device_kind.lower()
-    for sub, peak in BF16_PEAK_FLOPS:
-        if sub in kind:
-            return peak, jax.devices()[0].device_kind
-    return None, jax.devices()[0].device_kind
+def _begin_family():
+    """Open a telemetry window for one bench family: reset the span
+    tree and snapshot the compile totals, so the record's rider shows
+    THIS family's compiles/spans, not the cumulative run."""
+    if obs.enabled():
+        obs.reset_spans()
+        _FAMILY["compile0"] = obs.compile_totals()
+
+
+def _family_telemetry():
+    """Compact telemetry rider for the family record: compile count and
+    seconds inside the window, host span totals (serving engine phases,
+    timed passes), and the device-memory watermark. None when telemetry
+    is disabled — and nothing here touches the timed loops, so the
+    headline is identical either way."""
+    if not obs.enabled():
+        return None
+    comp0 = _FAMILY.get("compile0") or {"count": 0, "seconds": 0.0}
+    comp = obs.compile_totals()
+    out = {
+        "compile_count": comp["count"] - comp0["count"],
+        "compile_seconds": round(comp["seconds"] - comp0["seconds"], 3),
+        "spans": {"/".join(p): {"total_s": round(t, 4), "count": c}
+                  for p, t, c in sorted(obs.span_records())},
+    }
+    mem = obs.memory_watermark()
+    if mem:
+        vals = [s["bytes_in_use"] for s in mem
+                if s.get("bytes_in_use") is not None]
+        if vals:
+            out["device_bytes_in_use_max"] = max(vals)
+    return out
 
 
 def _timed_passes(run_pass, n_passes: int, profile_dir=None):
     """run_pass() -> (examples, seconds). Returns per-pass ex/sec list."""
     rates = []
     for i in range(n_passes):
-        if profile_dir and i == n_passes - 1:
-            with jax.profiler.trace(profile_dir):
+        with obs.span("bench.pass"):
+            if profile_dir and i == n_passes - 1:
+                with jax.profiler.trace(profile_dir):
+                    ex, dt = run_pass()
+            else:
                 ex, dt = run_pass()
-        else:
-            ex, dt = run_pass()
         rates.append(ex / dt)
         print(f"pass {i}: {ex / dt:.1f} ex/sec", file=sys.stderr, flush=True)
     return rates
@@ -915,6 +939,7 @@ def main():
 
 
 def _run_mode(mode, args, on_accel, peak, device_kind):
+    _begin_family()
     if mode == "resnet50":
         steps = 50 if on_accel else 2
         n_passes = 3 if on_accel else 1
@@ -938,6 +963,7 @@ def _run_mode(mode, args, on_accel, peak, device_kind):
             "bf16_peak_tflops": round(peak / 1e12) if peak else None,
             "mfu": round(mfu, 4) if mfu else None,
         }
+        rec["telemetry"] = _family_telemetry()
         print(json.dumps(rec), flush=True)
         return rec
 
@@ -987,6 +1013,7 @@ def _run_mode(mode, args, on_accel, peak, device_kind):
                           "dispatch (gather-into-GEMM, no HBM buffer)",
             "device_kind": device_kind,
         }
+        rec["telemetry"] = _family_telemetry()
         print(json.dumps(rec), flush=True)
         return rec
 
@@ -1070,6 +1097,7 @@ def _run_mode(mode, args, on_accel, peak, device_kind):
                     "spread = [min, median, max] across passes",
             "device_kind": device_kind,
         }
+        rec["telemetry"] = _family_telemetry()
         print(json.dumps(rec), flush=True)
         return rec
 
@@ -1097,6 +1125,7 @@ def _run_mode(mode, args, on_accel, peak, device_kind):
             "new_tokens": new_tokens,
             "device_kind": device_kind,
         }
+        rec["telemetry"] = _family_telemetry()
         print(json.dumps(rec), flush=True)
         return rec
 
@@ -1150,6 +1179,7 @@ def _run_mode(mode, args, on_accel, peak, device_kind):
                     "loop (same compiled step, no scheduler)",
             "device_kind": device_kind,
         }
+        rec["telemetry"] = _family_telemetry()
         print(json.dumps(rec), flush=True)
         return rec
 
@@ -1226,6 +1256,7 @@ def _run_mode(mode, args, on_accel, peak, device_kind):
             "bf16_peak_tflops": round(peak / 1e12) if peak else None,
             "mfu": round(mfu, 4) if mfu else None,
         }
+        rec["telemetry"] = _family_telemetry()
         print(json.dumps(rec), flush=True)
         return rec
 
@@ -1278,6 +1309,7 @@ def _run_mode(mode, args, on_accel, peak, device_kind):
         "bf16_peak_tflops": round(peak / 1e12) if peak else None,
         "mfu": round(mfu, 4) if mfu else None,
     }
+    rec["telemetry"] = _family_telemetry()
     print(json.dumps(rec), flush=True)
     return rec
 

@@ -26,6 +26,8 @@ Package layout:
     inference/  predictors + evaluators (reference predictors.py/evaluators.py)
     serving/    continuous-batching LM serving engine (slot scheduler +
                 pooled KV cache over the models/decoding machinery)
+    obs/        unified telemetry: metrics registry, tracing spans,
+                recompile/goodput accounting, JSONL/Prometheus exporters
     utils/      serialization, checkpointing, history, profiling
 """
 

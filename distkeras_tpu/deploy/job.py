@@ -27,9 +27,10 @@ from __future__ import annotations
 import os
 import subprocess
 import sys
-import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence
+
+from distkeras_tpu.utils.profiling import now
 
 ENV_COORD = "DKT_COORDINATOR"
 ENV_NUM_PROCS = "DKT_NUM_PROCESSES"
@@ -219,7 +220,7 @@ class Job:
 
     def _run_once(self, attempt: int = 0) -> JobResult:
         spec = self.spec
-        t0 = time.perf_counter()
+        t0 = now()
         procs = self._spawn(attempt)
         # drain every pipe CONCURRENTLY: a worker that fills its 64KB stdout
         # pipe would otherwise block mid-collective and hang the whole
@@ -236,10 +237,10 @@ class Job:
                    for i, p in enumerate(procs)]
         for t in threads:
             t.start()
-        deadline = (time.perf_counter() + spec.timeout
+        deadline = (now() + spec.timeout
                     if spec.timeout else None)
         for t in threads:
-            t.join(max(0.1, deadline - time.perf_counter())
+            t.join(max(0.1, deadline - now())
                    if deadline else None)
         killed = [p.poll() is None for p in procs]
         for p, k in zip(procs, killed):
@@ -251,7 +252,7 @@ class Job:
                 for log, k in zip(logs, killed)]
         rcs = [p.returncode for p in procs]
         return JobResult(spec.name, rcs, logs,
-                         time.perf_counter() - t0)
+                         now() - t0)
 
 
 def ssh_commands(spec: JobSpec, hosts: Sequence[str],

@@ -149,8 +149,10 @@ class PunchcardClient:
     def wait(self, job_id: int, timeout: float = 300.0,
              poll: float = 0.2) -> Dict[str, Any]:
         import time
-        deadline = time.monotonic() + timeout
-        while time.monotonic() < deadline:
+        # deadline bookkeeping, not telemetry: monotonic is the right
+        # clock for a client-side timeout and stays raw by design
+        deadline = time.monotonic() + timeout  # lint: allow-raw-clock
+        while time.monotonic() < deadline:     # lint: allow-raw-clock
             st = self.status(job_id)
             if st["state"] in ("done", "failed", "error"):
                 return st

@@ -1,0 +1,188 @@
+"""JAX-specific telemetry collectors.
+
+Three signals XLA-land owns that generic counters can't see:
+
+* **Backend compiles** — every ``/jax/core/compile/backend_compile_
+  duration`` event from ``jax.monitoring`` feeds process-global totals
+  (count + seconds). Compile seconds are the "unproductive" term in the
+  goodput accounting (``obs.tape``).
+* **Per-function recompiles** — ``RecompileDetector.watch(name, fn)``
+  tracks a jitted function's executable-cache size
+  (``fn._cache_size()``). After ``mark_warm()`` any growth means the
+  hot step recompiled — the classic shape-leak bug (a Python int
+  promoted to a fresh traced shape, a ragged batch, a dtype drift) —
+  and ``check()`` raises a ``RecompileWarning`` naming the function.
+  Growth BEFORE warm-up is normal (first-call compiles, one program per
+  legitimate shape bucket).
+* **Device-memory watermarks** — ``memory_watermark()`` folds
+  ``utils.profiling.device_memory_stats`` into per-device gauges whose
+  ``max`` field is the high-water mark across calls.
+"""
+
+from __future__ import annotations
+
+import threading
+import warnings
+import weakref
+from typing import Dict, Optional
+
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+_lock = threading.Lock()
+_totals = {"count": 0, "seconds": 0.0}
+_listener_installed = [False]
+
+
+class RecompileWarning(UserWarning):
+    """A watched jitted function recompiled after warm-up."""
+
+
+def _on_event_duration(name: str, duration: float, **kw) -> None:
+    if name != _COMPILE_EVENT:
+        return
+    with _lock:
+        _totals["count"] += 1
+        _totals["seconds"] += float(duration)
+
+
+def install_compile_listener() -> None:
+    """Idempotent: register the ``jax.monitoring`` duration listener
+    feeding the process-global compile totals."""
+    if _listener_installed[0]:
+        return
+    import jax.monitoring
+    jax.monitoring.register_event_duration_secs_listener(
+        _on_event_duration)
+    _listener_installed[0] = True
+
+
+def compile_totals() -> Dict[str, float]:
+    """Process-global ``{"count", "seconds"}`` of backend compiles
+    since the listener was installed."""
+    install_compile_listener()
+    with _lock:
+        return dict(_totals)
+
+
+class RecompileDetector:
+    """Tracks executable-cache growth of named jitted functions.
+
+    Lifecycle: ``watch`` each hot function right after building it,
+    ``mark_warm()`` once the warm-up call(s) ran, then ``check()``
+    periodically (each epoch / every N serving iterations). ``check``
+    warns ONCE per observed growth step, so a leak that recompiles
+    every step does not also flood stderr every step.
+
+    Holds jitted functions via weakref where the callable supports it
+    (falling back to a strong reference otherwise) so watching never
+    extends an executable's lifetime.
+    """
+
+    def __init__(self, registry=None):
+        install_compile_listener()
+        from distkeras_tpu.obs import get_registry
+        self.registry = registry if registry is not None else get_registry()
+        self._watched: Dict[str, Dict] = {}
+        self._lock = threading.Lock()
+
+    @staticmethod
+    def _cache_size(fn) -> Optional[int]:
+        try:
+            return int(fn._cache_size())
+        except Exception:
+            return None
+
+    def watch(self, name: str, fn) -> None:
+        """Track ``fn`` (a ``jax.jit`` result) under ``name``. Raises
+        if it exposes no ``_cache_size`` (nothing to track)."""
+        if not hasattr(fn, "_cache_size"):
+            raise TypeError(
+                f"{name}: object has no _cache_size(); pass the "
+                "jax.jit-wrapped callable itself")
+        try:
+            ref = weakref.ref(fn)
+        except TypeError:
+            ref = lambda fn=fn: fn          # not weakref-able: strong
+        with self._lock:
+            self._watched[name] = {
+                "ref": ref,
+                "warm": None,                # cache size at mark_warm
+                "warned_at": None,           # size already warned about
+                "last": None,                # last observed size (kept
+            }                                # after the fn is GC'd)
+
+    def mark_warm(self, name: Optional[str] = None) -> None:
+        """Freeze the current cache size(s) as the expected steady
+        state; growth past it is a recompile."""
+        with self._lock:
+            entries = ([self._watched[name]] if name is not None
+                       else list(self._watched.values()))
+            for e in entries:
+                fn = e["ref"]()
+                if fn is not None:
+                    e["warm"] = self._cache_size(fn)
+
+    def counts(self) -> Dict[str, int]:
+        """Compile count per watched function — live cache size, or the
+        last observed size once the function has been GC'd (a finished
+        trainer's epoch program stays visible in the final snapshot)."""
+        out = {}
+        with self._lock:
+            items = list(self._watched.items())
+        for name, e in items:
+            fn = e["ref"]()
+            size = self._cache_size(fn) if fn is not None else None
+            if size is not None:
+                e["last"] = size
+            if size is not None or e["last"] is not None:
+                out[name] = size if size is not None else e["last"]
+        return out
+
+    def check(self, warn: bool = True) -> Dict[str, int]:
+        """Poll watched functions; returns ``{name:
+        recompiles_after_warm}`` for those that grew past their warm
+        size (empty when all quiet). Updates the registry counters
+        either way."""
+        grew: Dict[str, int] = {}
+        with self._lock:
+            items = list(self._watched.items())
+        gauge = self.registry.gauge("jit.compile_count")
+        for name, e in items:
+            fn = e["ref"]()
+            if fn is None:
+                continue
+            size = self._cache_size(fn)
+            if size is None:
+                continue
+            e["last"] = size
+            gauge.set(size, fn=name)
+            warm = e["warm"]
+            if warm is None or size <= warm:
+                continue
+            grew[name] = size - warm
+            if warn and e["warned_at"] != size:
+                e["warned_at"] = size
+                warnings.warn(
+                    f"jitted function {name!r} recompiled after "
+                    f"warm-up ({size - warm} new executable(s), cache "
+                    f"size {warm} -> {size}) — a hot step retracing "
+                    "usually means unstable shapes/dtypes (shape leak)",
+                    RecompileWarning, stacklevel=2)
+        return grew
+
+
+def memory_watermark(registry=None):
+    """Record per-device ``bytes_in_use`` gauges (watermark = ``max``
+    across calls). Returns the stats list, or None where the backend
+    exposes none (virtual CPU devices)."""
+    from distkeras_tpu.obs import get_registry
+    from distkeras_tpu.utils.profiling import device_memory_stats
+    registry = registry if registry is not None else get_registry()
+    stats = device_memory_stats()
+    if not stats:
+        return None
+    gauge = registry.gauge("device.bytes_in_use")
+    for s in stats:
+        if s.get("bytes_in_use") is not None:
+            gauge.set(s["bytes_in_use"], device=s["device"])
+    return stats

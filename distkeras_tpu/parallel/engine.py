@@ -415,6 +415,8 @@ class DistributedEngine:
         self._uniform_K = int(Ks[0]) if uniform else None
         self._epoch_fn = None  # built lazily (jitted shard_map)
         self._reset_fn = None  # built lazily (parallelism_factor > 1)
+        self._recompile = None  # obs detector, bound in _build()
+        self._warm_marked = False
 
     # -- state ------------------------------------------------------------
     def init_state(self, params: Pytree, model_state: Pytree,
@@ -487,6 +489,12 @@ class DistributedEngine:
             out_specs=(state_specs, P(None, axis)),
             check_vma=False)
         self._epoch_fn = jax.jit(mapped, donate_argnums=(0,))
+        # detector bound HERE, with the function it watches — callers
+        # (and tests) invoke _build() directly, so run_epoch cannot
+        # assume it created the epoch fn itself
+        from distkeras_tpu import obs
+        self._recompile = obs.RecompileDetector()
+        self._recompile.watch("engine.epoch", self._epoch_fn)
 
     def _make_inner_amortized(self):
         """Two-level epoch program: a param-sized collective once per
@@ -662,9 +670,20 @@ class DistributedEngine:
 
     def run_epoch(self, state: Dict, Xs, Ys):
         """Run S micro-steps. ``Xs``/``Ys``: ``[S, W, batch, ...]``."""
+        from distkeras_tpu import obs
         if self._epoch_fn is None:
             self._build()
-        return self._epoch_fn(state, Xs, Ys)
+        with obs.span("engine.epoch"):
+            out = self._epoch_fn(state, Xs, Ys)
+        # the epoch program compiles ONCE per engine by design (static
+        # shapes): after the first call's legitimate compile, any cache
+        # growth is a shape leak
+        if self._warm_marked:
+            self._recompile.check()
+        else:
+            self._recompile.mark_warm("engine.epoch")
+            self._warm_marked = True
+        return out
 
     # -- final model ------------------------------------------------------
     def extract_model(self, state: Dict) -> Tuple[Pytree, Pytree]:

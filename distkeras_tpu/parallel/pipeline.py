@@ -434,7 +434,8 @@ class PipelineTrainer:
                  callbacks: Optional[Sequence] = None,
                  checkpoint_dir: Optional[str] = None,
                  checkpoint_every: int = 1, resume: bool = False,
-                 checkpoint_async: bool = False):
+                 checkpoint_async: bool = False,
+                 telemetry=None):
         from distkeras_tpu.ops.losses import get_loss, with_class_weight
         from distkeras_tpu.ops.optimizers import (clip_by_global_norm,
                                                   get_optimizer)
@@ -469,6 +470,11 @@ class PipelineTrainer:
                 f"checkpoint_every must be >= 1, got {checkpoint_every}")
         self.resume = bool(resume)
         self.checkpoint_async = bool(checkpoint_async)
+        # same telemetry contract as Trainer: None = auto-tape, False =
+        # off, or a configured obs.TrainingTape (tokens are this
+        # trainer's example unit: one example row = one [S] sequence)
+        self.telemetry = telemetry
+        self.tape = None
         self.stop_training = False
         self.history = History()
         self.params_ = None
@@ -705,6 +711,11 @@ class PipelineTrainer:
 
         from distkeras_tpu.parallel.worker import stack_batches
 
+        from distkeras_tpu.obs import resolve_tape
+        tape = self.tape = resolve_tape(self.telemetry, "PipelineTrainer",
+                                        unit="tokens")
+        tape.watch("PipelineTrainer.epoch", run_epoch)
+
         validator = self._make_validator()
         carry = (params, opt_state)
         carry_box = [carry]
@@ -714,23 +725,29 @@ class PipelineTrainer:
         cbs = CallbackList(self.callbacks, self)
         cbs.train_begin()
         self.history.record_training_start()
+        tape.train_begin()
         try:
             for epoch in range(start_epoch, self.num_epoch):
-                # same shuffle-seed convention as Trainer._epoch_perm
-                perm = (np.random.RandomState(self.seed + 1000 * epoch)
-                        .permutation(len(X)) if self.shuffle_each_epoch
-                        else None)
-                Xs, Ys, nsteps = stack_batches(X, Y, self.batch_size, perm)
-                xb = jax.device_put(jnp.asarray(Xs), data_sh)
-                yb = jax.device_put(jnp.asarray(Ys), data_sh)
-                carry, (losses, mets) = run_epoch(carry, xb, yb)
-                carry_box[0] = carry
-                losses = jax.device_get(losses)
-                mets = jax.device_get(mets)
+                with tape.phase("data_wait"):
+                    # same shuffle-seed convention as Trainer._epoch_perm
+                    perm = (np.random.RandomState(self.seed + 1000 * epoch)
+                            .permutation(len(X))
+                            if self.shuffle_each_epoch else None)
+                    Xs, Ys, nsteps = stack_batches(X, Y, self.batch_size,
+                                                   perm)
+                with tape.phase("device"):
+                    xb = jax.device_put(jnp.asarray(Xs), data_sh)
+                    yb = jax.device_put(jnp.asarray(Ys), data_sh)
+                    carry, (losses, mets) = run_epoch(carry, xb, yb)
+                    carry_box[0] = carry
+                    losses = jax.device_get(losses)
+                    mets = jax.device_get(mets)
                 extra = {}
                 if validator is not None:
-                    extra = {k: np.asarray([float(v)]) for k, v in
-                             jax.device_get(validator(carry[0])).items()}
+                    with tape.phase("validation"):
+                        extra = {k: np.asarray([float(v)]) for k, v in
+                                 jax.device_get(
+                                     validator(carry[0])).items()}
                 self.history.append_epoch(loss=np.asarray(losses),
                                           **{k: np.asarray(v)
                                              for k, v in mets.items()},
@@ -739,16 +756,21 @@ class PipelineTrainer:
                 if manager is not None and (
                         (epoch + 1) % self.checkpoint_every == 0
                         or epoch == self.num_epoch - 1):
-                    manager.save(
-                        epoch,
-                        {"params": carry[0], "opt": carry[1]},
-                        metadata={"epoch": epoch})
+                    with tape.phase("checkpoint"):
+                        manager.save(
+                            epoch,
+                            {"params": carry[0], "opt": carry[1]},
+                            metadata={"epoch": epoch})
                     saved = True
                 logs = {"loss": float(np.mean(losses))}
                 logs.update({k: float(np.mean(np.asarray(v)))
                              for k, v in mets.items()})
                 logs.update({k: float(np.asarray(v).ravel()[0])
                              for k, v in extra.items()})
+                logs.update(tape.epoch_end(
+                    nsteps * self.batch_size * X.shape[1]))
+                if epoch == start_epoch:
+                    tape.mark_warm()
                 cbs.epoch_end(epoch, logs)
                 if self.stop_training:
                     # early stop between checkpoint_every boundaries: save
@@ -761,6 +783,7 @@ class PipelineTrainer:
                     break
         finally:
             self.history.record_training_stop()
+            tape.train_end()
             cbs.train_end()
         if manager is not None:
             manager.wait()

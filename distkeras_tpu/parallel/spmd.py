@@ -326,6 +326,9 @@ class SPMDTrainer(Trainer):
         def run_epoch(carry, Xs, Ys):
             return jax.lax.scan(step, carry, (Xs, Ys))
 
+        tape = self._make_tape()
+        tape.watch("SPMDTrainer.epoch", run_epoch)
+
         from distkeras_tpu.utils.prefetch import Prefetcher
         validator = self._make_validator(model.module)
         cbs = self._cb_list(
@@ -345,16 +348,22 @@ class SPMDTrainer(Trainer):
                 range(start_epoch, self.num_epoch)))
 
         self.record_training_start()
+        tape.train_begin()
         try:
             with self._profile_ctx():
+                from distkeras_tpu.obs import timed_stream
                 l_acc, m_acc = [], []
-                for (epoch, _, last), (Xs, Ys, S) in stream:
-                    Xs = jax.device_put(Xs, data_sh)
-                    Ys = jax.device_put(Ys, data_sh)
-                    carry, outs = run_epoch(carry, Xs, Ys)
-                    losses, mets = self._split_outs(outs)
-                    l_acc.append(host_fetch(losses))
-                    m_acc.append(host_fetch(mets))
+                examples = 0
+                for (epoch, _, last), (Xs, Ys, S) in timed_stream(stream,
+                                                                  tape):
+                    with tape.phase("device"):
+                        Xs = jax.device_put(Xs, data_sh)
+                        Ys = jax.device_put(Ys, data_sh)
+                        carry, outs = run_epoch(carry, Xs, Ys)
+                        losses, mets = self._split_outs(outs)
+                        l_acc.append(host_fetch(losses))
+                        m_acc.append(host_fetch(mets))
+                    examples += int(S) * self.batch_size
                     if not last:
                         continue
                     losses = np.concatenate(l_acc)
@@ -363,38 +372,47 @@ class SPMDTrainer(Trainer):
                     l_acc, m_acc = [], []
                     extra = {}
                     if validator is not None:
-                        extra = {k: np.asarray([float(v)]) for k, v in
-                                 host_fetch(validator(carry.params,
-                                                      carry.state)).items()}
+                        with tape.phase("validation"):
+                            extra = {k: np.asarray([float(v)]) for k, v in
+                                     host_fetch(validator(
+                                         carry.params,
+                                         carry.state)).items()}
                     self.history.append_epoch(loss=losses, **mets, **extra)
                     if manager is not None and self._should_checkpoint(epoch):
                         carry_tree = {"params": carry.params,
                                       "state": carry.state,
                                       "opt": carry.opt_state,
                                       "rng": carry.rng}
-                        if self.sharded_checkpoints:
-                            # every process writes ITS shards (barriers
-                            # inside); no host gather of the full tree
-                            manager.save(epoch, carry_tree,
-                                         metadata={"epoch": epoch})
-                        else:
-                            # host_fetch is a COLLECTIVE under multi-process
-                            # (allgather of non-addressable shards) — every
-                            # process must enter it; only the write is gated
-                            # on process 0
-                            snapshot = host_fetch(carry_tree)
-                            if jax.process_index() == 0:
-                                manager.save(epoch, snapshot,
+                        with tape.phase("checkpoint"):
+                            if self.sharded_checkpoints:
+                                # every process writes ITS shards (barriers
+                                # inside); no host gather of the full tree
+                                manager.save(epoch, carry_tree,
                                              metadata={"epoch": epoch})
+                            else:
+                                # host_fetch is a COLLECTIVE under
+                                # multi-process (allgather of
+                                # non-addressable shards) — every process
+                                # must enter it; only the write is gated
+                                # on process 0
+                                snapshot = host_fetch(carry_tree)
+                                if jax.process_index() == 0:
+                                    manager.save(epoch, snapshot,
+                                                 metadata={"epoch": epoch})
                     # logs derive from replicated values, so every process
                     # sees identical callback decisions (incl. stop_training
                     # and any collective get_weights fetch inside a callback)
-                    cbs.epoch_end(epoch,
-                                  self._epoch_logs(losses, mets, extra))
+                    logs = self._epoch_logs(losses, mets, extra)
+                    logs.update(tape.epoch_end(examples))
+                    examples = 0
+                    if epoch == start_epoch:
+                        tape.mark_warm()
+                    cbs.epoch_end(epoch, logs)
                     if self.stop_training:
                         break
         finally:
             self.record_training_stop()
+            tape.train_end()
             cbs.train_end()  # closes callback resources on exceptions too
         if manager is not None:
             manager.wait()  # async snapshots durable before return

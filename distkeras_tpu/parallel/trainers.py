@@ -55,7 +55,8 @@ class Trainer:
                  callbacks: Optional[Sequence] = None,
                  clip_grad_norm: Optional[float] = None,
                  class_weight: Optional[dict] = None,
-                 fused_vocab_head: bool = False):
+                 fused_vocab_head: bool = False,
+                 telemetry=None):
         self.master_model = keras_model
         opt_kwargs = dict(optimizer_kwargs or {})
         if learning_rate is not None and not isinstance(worker_optimizer,
@@ -125,6 +126,14 @@ class Trainer:
                 "the fused loss never materializes the per-sample logits "
                 "the class-weight wrapper scales. Drop one of the two.")
         self.fused_vocab_head = fused_vocab_head
+        # telemetry (obs subsystem): None = auto-tape when obs is
+        # enabled; False = off for this trainer; or pass a configured
+        # obs.TrainingTape (e.g. with flops_per_example for MFU). The
+        # live tape is exposed as ``self.tape`` during/after train();
+        # its per-epoch logs (examples_per_sec, data_wait_s, device_s,
+        # host_s, goodput, mfu, ...) merge into the callback logs.
+        self.telemetry = telemetry
+        self.tape = None
         self.stop_training = False
         self._weights_fn = None       # bound by trainers during train()
         self._pending_weights = None  # set via set_weights()
@@ -204,6 +213,14 @@ class Trainer:
             return contextlib.nullcontext()
         from distkeras_tpu.utils.profiling import trace
         return trace(self.profile_dir)
+
+    def _make_tape(self, unit: str = "examples"):
+        """Bind this run's telemetry tape (obs.NULL_TAPE when disabled:
+        every hook is a no-op, so the epoch loops stay branch-free)."""
+        from distkeras_tpu.obs import resolve_tape
+        self.tape = resolve_tape(self.telemetry, type(self).__name__,
+                                 unit)
+        return self.tape
 
     # -- reference-parity bookkeeping -------------------------------------
     def record_training_start(self):
@@ -410,6 +427,11 @@ class SingleTrainer(Trainer):
                                state_mask=self._state_mask(model),
                                fused_vocab_head=self.fused_vocab_head)
         runner = make_epoch_runner(step)
+        tape = self._make_tape()
+        # after the first epoch's legitimate compiles, any cache growth
+        # on the epoch program is a shape leak (warned via check() in
+        # tape.epoch_end)
+        tape.watch("SingleTrainer.epoch", runner)
 
         # SingleTrainer checkpoints the FULL carry (params + model state +
         # optimizer state + rng), so a resumed run is bitwise-identical to
@@ -441,14 +463,20 @@ class SingleTrainer(Trainer):
         cbs = self._cb_list(
             lambda: jax.device_get((carry.params, carry.state)))
         self.record_training_start()
+        tape.train_begin()
         try:
             with self._profile_ctx():
+                from distkeras_tpu.obs import timed_stream
                 l_acc, m_acc = [], []
-                for (epoch, _, last), (Xs, Ys, S) in stream:
-                    carry, outs = runner(carry, Xs, Ys)
-                    losses, mets = self._split_outs(outs)
-                    l_acc.append(jax.device_get(losses))
-                    m_acc.append(jax.device_get(mets))
+                examples = 0
+                for (epoch, _, last), (Xs, Ys, S) in timed_stream(stream,
+                                                                  tape):
+                    with tape.phase("device"):
+                        carry, outs = runner(carry, Xs, Ys)
+                        losses, mets = self._split_outs(outs)
+                        l_acc.append(jax.device_get(losses))
+                        m_acc.append(jax.device_get(mets))
+                    examples += int(S) * self.batch_size
                     if not last:
                         continue
                     losses = np.concatenate(l_acc)
@@ -457,22 +485,32 @@ class SingleTrainer(Trainer):
                     l_acc, m_acc = [], []
                     extra = {}
                     if validator is not None:
-                        extra = {k: np.asarray([float(v)]) for k, v in
-                                 jax.device_get(validator(
-                                     carry.params, carry.state)).items()}
+                        with tape.phase("validation"):
+                            extra = {k: np.asarray([float(v)]) for k, v in
+                                     jax.device_get(validator(
+                                         carry.params,
+                                         carry.state)).items()}
                     self.history.append_epoch(loss=losses, **mets, **extra)
                     if manager is not None and self._should_checkpoint(epoch):
-                        manager.save(
-                            epoch,
-                            {"params": carry.params, "state": carry.state,
-                             "opt": carry.opt_state, "rng": carry.rng},
-                            metadata={"epoch": epoch})
-                    cbs.epoch_end(epoch,
-                                  self._epoch_logs(losses, mets, extra))
+                        with tape.phase("checkpoint"):
+                            manager.save(
+                                epoch,
+                                {"params": carry.params,
+                                 "state": carry.state,
+                                 "opt": carry.opt_state, "rng": carry.rng},
+                                metadata={"epoch": epoch})
+                    logs = self._epoch_logs(losses, mets, extra)
+                    logs.update(tape.epoch_end(examples))
+                    examples = 0
+                    if epoch == start_epoch:
+                        # first full epoch saw every legitimate shape
+                        tape.mark_warm()
+                    cbs.epoch_end(epoch, logs)
                     if self.stop_training:
                         break
         finally:
             self.record_training_stop()
+            tape.train_end()
             cbs.train_end()  # closes callback resources on exceptions too
         if manager is not None:
             manager.wait()  # async snapshots durable before return

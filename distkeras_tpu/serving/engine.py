@@ -45,6 +45,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from distkeras_tpu import obs
 from distkeras_tpu.models.core import Model, Sequential
 from distkeras_tpu.models.decoding import (_attn_compute_dtype,
                                            _resolve_head_dims,
@@ -131,6 +132,34 @@ class ServingEngine:
         self._prefill_fns = {}
         self._first_fn = None
 
+        # telemetry: the CURRENT metrics window joins the unified
+        # obs.telemetry_snapshot() under "serving" (weakref-bound, so a
+        # dropped engine detaches itself); the decode steps — compiled
+        # once per sampler variant BY DESIGN — are recompile-watched,
+        # catching shape/dtype leaks that would silently recompile the
+        # hot loop (checked every _RECOMPILE_CHECK_EVERY iterations)
+        self._recompile = obs.RecompileDetector()
+        self._warmed = set()                 # decode variants marked warm
+        self._iters = 0
+        # first live engine owns the plain "serving" name; further
+        # engines get a unique suffix instead of silently displacing it
+        # (a displaced-then-GC'd registration would otherwise leave the
+        # still-alive first engine invisible in the snapshot). The bound
+        # method is WeakMethod-held by attach, so the registry never
+        # keeps this engine (and its KV pool) alive.
+        name = "serving"
+        if name in obs.components():
+            name = f"serving[{id(self):x}]"
+        obs.attach(name, self._telemetry_summary, owner=self)
+
+    #: engine iterations between recompile-detector polls
+    _RECOMPILE_CHECK_EVERY = 64
+
+    def _telemetry_summary(self):
+        """obs.attach provider: the CURRENT metrics window's summary
+        (``self.metrics`` is swapped per reporting interval)."""
+        return self.metrics.summary()
+
     # --- request intake ---------------------------------------------------
 
     def submit(self, prompt, max_new_tokens: int, *,
@@ -211,6 +240,9 @@ class ServingEngine:
                     return nxt, cache, split[:, 0]
 
             self._step_fns[greedy_only] = fn
+            self._recompile.watch(
+                "serving.decode_greedy" if greedy_only
+                else "serving.decode_sampled", fn)
         return fn
 
     #: prefill-program cache cap: every DISTINCT (q_len, t0, final)
@@ -275,17 +307,22 @@ class ServingEngine:
 
         req = self.scheduler.next_prefill()
         if req is not None:
-            with self.metrics.timer.phase("prefill"):
+            with self.metrics.timer.phase("prefill"), \
+                    obs.span("serving.prefill"):
                 self._advance_prefill(req, finished)
 
         running = self.scheduler.running
         if running:
-            with self.metrics.timer.phase("decode"):
+            with self.metrics.timer.phase("decode"), \
+                    obs.span("serving.decode"):
                 self._advance_decode(finished)
 
         self.metrics.record_iteration(self.scheduler.queue_depth,
                                       self.scheduler.occupied,
                                       self.num_slots)
+        self._iters += 1
+        if self._iters % self._RECOMPILE_CHECK_EVERY == 0:
+            self._recompile.check()
         return finished
 
     def run(self, max_steps: Optional[int] = None) -> Dict[int, np.ndarray]:
@@ -359,6 +396,13 @@ class ServingEngine:
                 self._tok, self._t, self._temp, self._topk, self._topp,
                 self._keys)
             self._keys = np.array(keys)
+        # warm baseline AFTER a variant's first call (its one legitimate
+        # compile); any cache growth past it is a shape leak
+        if greedy_only not in self._warmed:
+            self._warmed.add(greedy_only)
+            self._recompile.mark_warm(
+                "serving.decode_greedy" if greedy_only
+                else "serving.decode_sampled")
         # the per-iteration host sync: the scheduler must see token ids
         # to detect stops and free slots (docs/serving.md, follow-ups)
         nxt = np.asarray(nxt)

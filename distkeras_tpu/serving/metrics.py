@@ -6,128 +6,190 @@ ingestion) and end-to-end latency. Per engine iteration: queue depth,
 slot occupancy, decoding-slot count and decode wall time (the
 steady-state tokens/s series ``bench.py --model serving`` reduces).
 Phase wall-clock (prefill vs decode) rides on
-``utils.profiling.StepTimer``; percentile summaries use
-``utils.profiling.percentiles`` — one latency-summary convention across
-the repo.
+``utils.profiling.StepTimer``.
 
-Per-request state is STREAMING: submit timestamps live only while a
-request is in flight (popped into the ttft/latency sample lists as it
-progresses), so a long-lived engine holds O(in-flight) dict state, not
-O(requests ever served). The sample lists themselves grow one float per
-request / iteration — a server that runs forever should treat a
-ServingMetrics as a measurement window and swap in a fresh one per
-reporting interval (``engine.metrics = ServingMetrics()``, the
-``bench.py`` per-pass pattern).
+Since the telemetry PR this class is a thin shape over the
+``obs.MetricsRegistry``: TTFT/latency/queue-depth/occupancy live in
+registry **reservoir histograms**, so memory is BOUNDED —
+O(reservoir + in-flight requests + distinct batch sizes) no matter how
+long the engine runs (previously the ttft/latency/occupancy lists grew
+one float per request/iteration forever). Exact count/sum/min/max are
+streaming; percentiles come from the reservoir (exact until it fills,
+a uniform sample after). Per-request state is still streaming: submit
+timestamps live only while a request is in flight and are evicted at
+finish. A fresh ``ServingMetrics`` per reporting interval
+(``engine.metrics = ServingMetrics()``, the ``bench.py`` per-pass
+pattern) remains the way to get windowed percentiles.
+
+``summary()`` keys are unchanged from the pre-registry class — the
+backward-compat contract existing callers (bench, tests, dashboards)
+rely on; ``docs/observability.md`` is the glossary.
 """
 
 from __future__ import annotations
 
-import time
+from collections import deque
 from typing import Dict, List, Optional
 
-from distkeras_tpu.utils.profiling import StepTimer, percentiles
+from distkeras_tpu.obs import MetricsRegistry
+from distkeras_tpu.utils.profiling import StepTimer, now
+
+#: per-histogram reservoir: the percentile window of a metrics instance
+DEFAULT_RESERVOIR = 2048
 
 
 class ServingMetrics:
-    """Host-side counters; negligible overhead (dict writes and two
-    ``perf_counter`` calls per phase). ``clock`` is injectable so tests
-    can drive deterministic time."""
+    """Host-side counters; negligible overhead (a few registry updates
+    and two clock reads per phase). ``clock`` is injectable so tests
+    can drive deterministic time. ``registry`` defaults to a PRIVATE
+    registry per instance — a metrics object is a measurement window,
+    and windows must not share reservoirs; the engine attaches the
+    window to the unified ``obs.telemetry_snapshot()`` by reference."""
 
-    def __init__(self, clock=time.perf_counter):
+    def __init__(self, clock=now, registry: Optional[MetricsRegistry] = None,
+                 reservoir: int = DEFAULT_RESERVOIR):
         self.clock = clock
+        self.registry = registry if registry is not None \
+            else MetricsRegistry(reservoir_size=reservoir)
         self.timer = StepTimer()                 # "prefill" / "decode"
         self.submit_ts: Dict[int, float] = {}    # in-flight only
-        self._ttfts: List[float] = []
-        self._latencies: List[float] = []
-        self.requests_finished = 0
-        self.tokens_generated = 0
+        self._ttft = self.registry.histogram("serving.ttft_s")
+        self._latency = self.registry.histogram("serving.latency_s")
+        self._qdepth = self.registry.histogram("serving.queue_depth")
+        self._occ = self.registry.histogram("serving.slot_occupancy")
+        self._finished = self.registry.counter("serving.requests_finished")
+        self._tokens = self.registry.counter("serving.tokens_generated")
+        self._chunks = self.registry.counter("serving.prefill_chunks")
+        self._decode_toks = self.registry.counter("serving.decode_tokens")
+        self._decode_secs = self.registry.counter("serving.decode_seconds")
+        #: exact (tokens, seconds) aggregation per decoding-slot count —
+        #: bounded by the slot count, and authoritative for
+        #: ``decode_tokens_per_sec`` (the labeled counters mirror it for
+        #: exporters)
+        self._decode_agg: Dict[int, List[float]] = {}
+        #: recent (n_decoding, dt) samples — a BOUNDED window view
+        #: (bench.py reads the warm-up iterations from it)
+        self._decode_recent = deque(maxlen=reservoir)
         self._t_first_submit: Optional[float] = None
         self._t_last_finish: Optional[float] = None
-        self.queue_depth: List[int] = []         # per engine iteration
-        self.occupancy: List[float] = []         # occupied slots / S
-        self.decode_samples: List = []           # (decoding slots, dt)
-        self.prefill_chunks = 0
 
     # --- per-request ------------------------------------------------------
 
     def record_submit(self, rid: int) -> None:
-        now = self.clock()
-        self.submit_ts[rid] = now
+        now_ = self.clock()
+        self.submit_ts[rid] = now_
         if self._t_first_submit is None:
-            self._t_first_submit = now
+            self._t_first_submit = now_
 
     def record_first_token(self, rid: int) -> None:
         t0 = self.submit_ts.get(rid)
         if t0 is not None:
-            self._ttfts.append(self.clock() - t0)
+            self._ttft.observe(self.clock() - t0)
 
     def record_finish(self, rid: int, n_generated: int) -> None:
-        now = self.clock()
+        now_ = self.clock()
+        # evict the in-flight entry: finished-request state must not
+        # accumulate in a long-lived engine
         t0 = self.submit_ts.pop(rid, None)
         if t0 is not None:
-            self._latencies.append(now - t0)
-        self.requests_finished += 1
-        self.tokens_generated += int(n_generated)
-        self._t_last_finish = now
+            self._latency.observe(now_ - t0)
+        self._finished.inc()
+        self._tokens.inc(int(n_generated))
+        self._t_last_finish = now_
 
     # --- per-iteration ----------------------------------------------------
 
     def record_prefill_chunk(self) -> None:
-        self.prefill_chunks += 1
+        self._chunks.inc()
 
     def record_iteration(self, queue_depth: int, occupied: int,
                          num_slots: int) -> None:
-        self.queue_depth.append(int(queue_depth))
-        self.occupancy.append(occupied / num_slots)
+        self._qdepth.observe(int(queue_depth))
+        self._occ.observe(occupied / num_slots)
 
     def record_decode(self, n_decoding: int, dt: float) -> None:
-        self.decode_samples.append((int(n_decoding), float(dt)))
+        n, dt = int(n_decoding), float(dt)
+        agg = self._decode_agg.setdefault(n, [0.0, 0.0])
+        agg[0] += n
+        agg[1] += dt
+        self._decode_toks.inc(n, slots=n)
+        self._decode_secs.inc(dt, slots=n)
+        self._decode_recent.append((n, dt))
+
+    # --- properties kept for existing callers -----------------------------
+
+    @property
+    def requests_finished(self) -> int:
+        return int(self._finished.value())
+
+    @property
+    def tokens_generated(self) -> int:
+        return int(self._tokens.value())
+
+    @property
+    def prefill_chunks(self) -> int:
+        return int(self._chunks.value())
+
+    @property
+    def decode_samples(self) -> List:
+        """Recent ``(n_decoding, dt)`` pairs (bounded window)."""
+        return list(self._decode_recent)
 
     # --- reductions -------------------------------------------------------
 
     def ttfts(self) -> List[float]:
-        return list(self._ttfts)
+        """TTFT samples (the histogram reservoir — exact until
+        ``reservoir`` requests, a uniform sample after)."""
+        return self._ttft.samples()
 
     def latencies(self) -> List[float]:
-        return list(self._latencies)
+        return self._latency.samples()
 
     def decode_tokens_per_sec(self,
                               min_occupancy: int = 0) -> Optional[float]:
         """Marginal decode throughput over iterations with at least
         ``min_occupancy`` decoding slots — ``min_occupancy = S`` is the
         steady-state full-batch rate the acceptance criterion compares
-        against a raw batched decode loop."""
-        toks = sum(n for n, _ in self.decode_samples
+        against a raw batched decode loop. Exact over ALL iterations
+        (streaming per-slot-count aggregation, not the sample window).
+        """
+        toks = sum(a[0] for n, a in self._decode_agg.items()
                    if n >= min_occupancy)
-        secs = sum(dt for n, dt in self.decode_samples
+        secs = sum(a[1] for n, a in self._decode_agg.items()
                    if n >= min_occupancy)
         return toks / secs if secs > 0 else None
 
+    @staticmethod
+    def _pcts(hist) -> Optional[Dict[str, float]]:
+        stats = hist.stats()
+        if stats is None:
+            return None
+        return {"p50": stats["p50"], "p99": stats["p99"]}
+
     def summary(self) -> Dict:
-        """The metrics glossary of docs/serving.md, as one dict."""
+        """The metrics glossary of docs/observability.md, as one dict —
+        keys unchanged across the registry migration."""
         elapsed = (self._t_last_finish - self._t_first_submit
                    if self._t_first_submit is not None
                    and self._t_last_finish is not None else 0.0)
+        qd = self._qdepth.stats()
+        occ = self._occ.stats()
+        tokens = self.tokens_generated
         return {
             "requests_finished": self.requests_finished,
-            "tokens_generated": self.tokens_generated,
+            "tokens_generated": tokens,
             # request-level throughput: all generated tokens over the
             # first-submit -> last-finish span (includes queueing +
             # prefill)
-            "tokens_per_sec": (self.tokens_generated / elapsed
-                               if elapsed > 0 else None),
+            "tokens_per_sec": (tokens / elapsed if elapsed > 0 else None),
             # marginal decode rate, all iterations / full batch only
             "decode_tokens_per_sec": self.decode_tokens_per_sec(),
-            "ttft_s": percentiles(self._ttfts),
-            "latency_s": percentiles(self._latencies),
-            "queue_depth": ({"mean": sum(self.queue_depth)
-                             / len(self.queue_depth),
-                             "max": max(self.queue_depth)}
-                            if self.queue_depth else None),
-            "slot_occupancy": ({"mean": sum(self.occupancy)
-                                / len(self.occupancy),
-                                "max": max(self.occupancy)}
-                               if self.occupancy else None),
+            "ttft_s": self._pcts(self._ttft),
+            "latency_s": self._pcts(self._latency),
+            "queue_depth": ({"mean": qd["mean"], "max": qd["max"]}
+                            if qd else None),
+            "slot_occupancy": ({"mean": occ["mean"], "max": occ["max"]}
+                               if occ else None),
             "prefill_chunks": self.prefill_chunks,
             "phases": self.timer.summary(),
         }

@@ -9,10 +9,11 @@ host transfer per epoch, not one per batch.
 
 from __future__ import annotations
 
-import time
 from typing import Dict, List, Optional
 
 import numpy as np
+
+from distkeras_tpu.utils.profiling import wall
 
 
 class History:
@@ -26,15 +27,15 @@ class History:
 
     # -- wall clock (reference: Trainer.record_training_start/stop) -------
     def record_training_start(self) -> None:
-        self._start = time.time()
+        self._start = wall()
 
     def record_training_stop(self) -> None:
-        self._stop = time.time()
+        self._stop = wall()
 
     def get_training_time(self) -> float:
         if self._start is None:
             return 0.0
-        end = self._stop if self._stop is not None else time.time()
+        end = self._stop if self._stop is not None else wall()
         return end - self._start
 
     # -- metrics ----------------------------------------------------------

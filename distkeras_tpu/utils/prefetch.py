@@ -14,6 +14,8 @@ import queue
 import threading
 from typing import Callable, Iterable, Iterator, Tuple, TypeVar
 
+from distkeras_tpu.utils.profiling import now
+
 T = TypeVar("T")
 U = TypeVar("U")
 
@@ -33,13 +35,27 @@ class Prefetcher:
     """
 
     def __init__(self, fn: Callable[[T], U], items: Iterable[T],
-                 depth: int = 1):
+                 depth: int = 1, name: str = "prefetch"):
         if depth < 1:
             raise ValueError(f"depth must be >= 1, got {depth}")
         self._fn = fn
         self._items = list(items)
         self._q: "queue.Queue" = queue.Queue(maxsize=depth)
         self._stopped = threading.Event()
+        # telemetry (obs registry): queue depth at each consume (a full
+        # queue = loader ahead, an empty one = the consumer about to
+        # stall) and per-item consumer stall seconds. A couple of
+        # dict/float ops per ITEM — items are epoch chunks or shards,
+        # so this is nowhere near any hot path. Instruments bind at
+        # construction but recording checks obs.enabled() per consume,
+        # so disable()/enable() toggles mid-run behave like every other
+        # instrumentation point.
+        self._name = name
+        from distkeras_tpu import obs
+        self._obs = obs
+        reg = obs.get_registry()
+        self._g_depth = reg.gauge("prefetch.queue_depth")
+        self._h_stall = reg.histogram("prefetch.stall_s")
         self._thread = threading.Thread(target=self._produce, daemon=True)
         self._thread.start()
 
@@ -66,8 +82,17 @@ class Prefetcher:
                 return
         self._put(_SENTINEL)
 
+    def _note_consume(self, waited_s: float) -> None:
+        if self._obs.enabled():
+            self._g_depth.set(self._q.qsize(), stream=self._name)
+            self._h_stall.observe(waited_s, stream=self._name)
+
     def __iter__(self) -> Iterator[Tuple[T, U]]:
         try:
+            # consumer stall clock: starts when we begin waiting for an
+            # item and resets only on a successful get, so it spans the
+            # whole polling wait, not one 50 ms poll slice
+            t_wait = now()
             while True:
                 try:
                     # POLLING get (this PR): a blocking get() deadlocked
@@ -90,10 +115,12 @@ class Prefetcher:
                     continue
                 if got is _SENTINEL:
                     return
+                self._note_consume(now() - t_wait)
                 item, value, err = got
                 if err is not None:
                     raise err  # original type — callers match on it
                 yield item, value
+                t_wait = now()
         finally:
             # covers consumer break/exception (GeneratorExit) and normal end
             self.close()

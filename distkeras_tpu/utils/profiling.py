@@ -10,10 +10,20 @@ feed ``History.steps_per_second``.
 from __future__ import annotations
 
 import contextlib
+import threading
 import time
 from typing import Dict, List, Optional
 
 import jax
+
+#: the repo's clock access points. Library code must not call
+#: ``time.time()``/``time.perf_counter()`` directly (enforced by
+#: ``tools/lint_timing.py``): routing every read through here keeps ONE
+#: place that owns clock semantics — ``now`` is the monotonic
+#: high-resolution timer every duration in the telemetry layer uses,
+#: ``wall`` the epoch-seconds wall clock for timestamps.
+now = time.perf_counter
+wall = time.time
 
 
 @contextlib.contextmanager
@@ -33,29 +43,44 @@ def trace(logdir: str):
 
 class StepTimer:
     """Accumulates wall-clock per named phase; negligible overhead (two
-    ``perf_counter`` calls per phase)."""
+    ``perf_counter`` calls plus one lock acquire per phase).
+
+    THREAD-SAFE: the serving engine and ``StreamingPredictor`` touch
+    phase timers from worker threads, so the accumulate and every read
+    hold a lock (concurrent phases of the same name interleave
+    correctly; totals never tear). ``reset()`` clears accumulated
+    phases so long-running engines can treat the timer as a reporting
+    window instead of accumulating stale phases forever."""
 
     def __init__(self):
+        self._lock = threading.Lock()
         self.totals: Dict[str, float] = {}
         self.counts: Dict[str, int] = {}
 
     @contextlib.contextmanager
     def phase(self, name: str):
-        t0 = time.perf_counter()
+        t0 = now()
         try:
             yield
         finally:
-            dt = time.perf_counter() - t0
-            self.totals[name] = self.totals.get(name, 0.0) + dt
-            self.counts[name] = self.counts.get(name, 0) + 1
+            dt = now() - t0
+            with self._lock:
+                self.totals[name] = self.totals.get(name, 0.0) + dt
+                self.counts[name] = self.counts.get(name, 0) + 1
+
+    def reset(self) -> None:
+        with self._lock:
+            self.totals.clear()
+            self.counts.clear()
 
     def summary(self) -> Dict[str, Dict[str, float]]:
-        return {
-            name: {"total_s": self.totals[name],
-                   "count": self.counts[name],
-                   "mean_s": self.totals[name] / self.counts[name]}
-            for name in self.totals
-        }
+        with self._lock:
+            return {
+                name: {"total_s": self.totals[name],
+                       "count": self.counts[name],
+                       "mean_s": self.totals[name] / self.counts[name]}
+                for name in self.totals
+            }
 
 
 def percentiles(values, ps=(50.0, 99.0)) -> Optional[Dict[str, float]]:

@@ -93,6 +93,19 @@ def test_packed_moe_serving_example(capsys):
     assert "cross-document logit leak" in out and "OK" in out
 
 
+def test_telemetry_tour_example(capsys):
+    acc = run_example("examples.telemetry_tour")
+    out = capsys.readouterr().out
+    assert "unified telemetry snapshot" in out
+    # the one-snapshot acceptance surface: rates, goodput, MFU,
+    # per-function compile counts, prefetch stalls, serving percentiles
+    for key in ("imgs_per_sec", "goodput", "mfu", "recompiles",
+                "stall_s_total", "ttft_s_p50"):
+        assert key in out, key
+    assert "JSONL round-trip OK" in out
+    assert acc > 0.7, acc
+
+
 def test_long_context_serving_example(capsys):
     run_example("examples.long_context_serving")
     out = capsys.readouterr().out

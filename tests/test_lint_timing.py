@@ -1,0 +1,57 @@
+"""tools/lint_timing.py wired into tier-1: library code must stay free
+of raw ``time.time()``/``time.perf_counter()``/``time.monotonic()``
+calls outside the clock owner (``utils/profiling.py``) and the ``obs``
+telemetry subsystem, and the checker itself must actually detect the
+patterns it claims to."""
+
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO / "tools"))
+
+from lint_timing import ALLOW_MARK, check_source, check_tree  # noqa: E402
+
+
+def test_repo_library_code_is_free_of_raw_clocks():
+    findings = check_tree(REPO)
+    assert not findings, "\n".join(
+        f"{f}:{ln}: {msg}" for f, ln, msg in findings)
+
+
+def test_checker_flags_raw_clock_calls():
+    src = ("import time\n"
+           "a = time.time()\n"
+           "b = time.perf_counter()\n"
+           "c = time.monotonic()\n"
+           "d = time.sleep(1)\n")          # sleep is not a clock read
+    findings = check_source(src, "x.py")
+    assert [ln for _, ln, _ in findings] == [2, 3, 4]
+
+
+def test_checker_flags_alias_imports():
+    src = "from time import perf_counter\nt = perf_counter()\n"
+    findings = check_source(src, "x.py")
+    assert len(findings) == 1 and findings[0][1] == 1
+    assert "alias" in findings[0][2]
+
+
+def test_checker_skips_docstrings_comments_and_marked_lines():
+    src = (
+        '"""time.perf_counter() in a docstring is prose."""\n'
+        "# time.time() in a comment\n"
+        "import time\n"
+        f"deadline = time.monotonic() + 5  # {ALLOW_MARK}: deadline\n"
+    )
+    assert check_source(src, "x.py") == []
+
+
+def test_checker_skips_non_time_receivers():
+    # .time/.perf_counter attributes of OTHER objects are not clocks
+    src = "t = clock.time()\np = obj.perf_counter()\n"
+    assert check_source(src, "x.py") == []
+
+
+def test_checker_reports_syntax_errors_as_findings():
+    findings = check_source("def broken(:\n", "x.py")
+    assert len(findings) == 1 and "syntax" in findings[0][2]

@@ -1,0 +1,120 @@
+#!/usr/bin/env python
+"""Static check: raw clock reads belong to the telemetry layer only.
+
+The repo-wide convention (telemetry PR, documented on
+``utils.profiling.now``/``wall``): library code does not call
+``time.time()`` / ``time.perf_counter()`` / ``time.monotonic()``
+directly — every duration or timestamp routes through
+``utils/profiling.py`` (the clock owner) or the ``obs`` subsystem built
+on it. Ad-hoc clock reads are how the pre-telemetry fragments
+(``ServingMetrics`` lists, bench prints) drifted apart: each invented
+its own timing with no shared registry, units, or export path.
+
+This linter walks the AST (docstrings and comments never
+false-positive) and flags, inside the ``distkeras_tpu`` package but
+outside ``obs/`` and ``utils/profiling.py``:
+
+  * calls ``time.time(...)`` / ``time.perf_counter(...)`` /
+    ``time.monotonic(...)``
+  * ``from time import time/perf_counter/monotonic`` (the alias evasion)
+
+Scope is LIBRARY code only: ``bench.py``, ``examples/``, ``tools/`` and
+tests are measurement/driver code where raw clocks are the tool of the
+trade. A justified library exception (e.g. a client-side deadline, not
+telemetry) carries the marker comment ``lint: allow-raw-clock`` on the
+offending line — same pattern as ``lint_backend_forks.py``.
+
+Exit status 1 when findings exist (wired into tier-1 as
+``tests/test_lint_timing.py``).
+"""
+
+from __future__ import annotations
+
+import ast
+import sys
+from pathlib import Path
+from typing import List, Tuple
+
+ALLOW_MARK = "lint: allow-raw-clock"
+
+#: paths scanned, relative to the repo root (library code only)
+SCAN = ("distkeras_tpu",)
+
+#: modules allowed to read clocks raw: the clock owner and the
+#: telemetry subsystem built on it
+EXEMPT_FILES = ("profiling.py",)
+EXEMPT_DIRS = ("obs",)
+
+CLOCK_ATTRS = ("time", "perf_counter", "monotonic")
+
+Finding = Tuple[str, int, str]
+
+
+def _allowed(line: str) -> bool:
+    return ALLOW_MARK in line
+
+
+def check_source(src: str, rel: str) -> List[Finding]:
+    """Findings for one file's source text."""
+    try:
+        tree = ast.parse(src, filename=rel)
+    except SyntaxError as e:  # a broken file is its own finding
+        return [(rel, e.lineno or 0, f"syntax error: {e.msg}")]
+    lines = src.splitlines()
+    out: List[Finding] = []
+
+    def line_of(node: ast.AST) -> str:
+        ln = getattr(node, "lineno", 0)
+        return lines[ln - 1] if 0 < ln <= len(lines) else ""
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr in CLOCK_ATTRS \
+                and isinstance(node.func.value, ast.Name) \
+                and node.func.value.id == "time":
+            if not _allowed(line_of(node)):
+                out.append((rel, node.lineno,
+                            f"raw time.{node.func.attr}() call — use "
+                            "utils.profiling.now()/wall() (or the obs "
+                            "layer)"))
+        elif isinstance(node, ast.ImportFrom) and node.module == "time":
+            bad = [a.name for a in node.names if a.name in CLOCK_ATTRS]
+            if bad and not _allowed(line_of(node)):
+                out.append((rel, node.lineno,
+                            f"from time import {', '.join(bad)} — "
+                            "aliasing the raw clock; use "
+                            "utils.profiling.now()/wall()"))
+    return out
+
+
+def check_tree(root: Path) -> List[Finding]:
+    findings: List[Finding] = []
+    for entry in SCAN:
+        p = root / entry
+        files = sorted(p.rglob("*.py")) if p.is_dir() \
+            else ([p] if p.exists() else [])
+        for f in files:
+            if f.name in EXEMPT_FILES \
+                    or any(d in f.parts for d in EXEMPT_DIRS):
+                continue
+            rel = str(f.relative_to(root))
+            findings.extend(check_source(f.read_text(), rel))
+    return findings
+
+
+def main(argv=None) -> int:
+    root = Path(argv[0]) if argv else Path(__file__).resolve().parent.parent
+    findings = check_tree(root)
+    for rel, lineno, msg in findings:
+        print(f"{rel}:{lineno}: {msg}")
+    if findings:
+        print(f"{len(findings)} raw-clock finding(s); route through "
+              f"utils.profiling.now()/wall() or mark the line with "
+              f"'# {ALLOW_MARK}'", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

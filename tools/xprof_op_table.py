@@ -1,21 +1,31 @@
-"""Render a per-op time table from an XProf trace directory.
+"""Render a per-op time table from an XProf trace directory, or a span
+table from a telemetry JSONL export.
 
 Usage:
     python bench.py --profile /tmp/xprof            # capture
     python tools/xprof_op_table.py /tmp/xprof       # render markdown
+    python tools/xprof_op_table.py --spans t.jsonl  # host-span table
 
-Parses the ``*.xplane.pb`` the JAX profiler writes, aggregates the TPU
-device plane's "XLA Ops" line by op, and prints a markdown table of the
-top ops plus a category rollup (convolution/matmul vs batch-norm-statistics
-reductions vs other fusions vs data movement). Runs with the pure-python
-protobuf implementation so it works even where the tensorboard profile
-plugin's C++ bridge is version-mismatched (set
+Device mode parses the ``*.xplane.pb`` the JAX profiler writes,
+aggregates the TPU device plane's "XLA Ops" line by op, and prints a
+markdown table of the top ops plus a category rollup (convolution/matmul
+vs batch-norm-statistics reductions vs other fusions vs data movement).
+Runs with the pure-python protobuf implementation so it works even where
+the tensorboard profile plugin's C++ bridge is version-mismatched (set
 ``PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION=python`` if import fails).
+
+Span mode (``--spans``) reads the JSONL the telemetry layer exports
+(``obs.exporters.JsonlExporter`` — ``{"type": "span", "path": [...],
+"total_s", "count"}`` lines) and renders the HOST-side span tree with
+self-time accounting. Spans are bridged to
+``jax.profiler.TraceAnnotation``, so the names in this table are the
+same names on the xprof host timeline — the two views cross-reference.
 """
 
 from __future__ import annotations
 
 import glob
+import json
 import os
 import re
 import sys
@@ -89,7 +99,58 @@ def load_op_times(trace_dir: str):
     return dur, cnt
 
 
+def load_span_records(path: str):
+    """``[(path_tuple, total_s, count)]`` from a telemetry JSONL export
+    (latest ``seq`` in the file wins — the append-log convention of
+    ``obs.exporters``). Standalone parser: the tool must work in an
+    environment without the package importable."""
+    records = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                records.append(json.loads(line))
+    seq = max((r.get("seq", 0) for r in records), default=0)
+    return [(tuple(r["path"]), float(r["total_s"]), int(r["count"]))
+            for r in records
+            if r.get("type") == "span" and r.get("seq", 0) == seq]
+
+
+def render_span_table(records, top_n: int = 20) -> str:
+    """Markdown: span path, count, total, self (total minus direct
+    children — large self on a parent = untraced work inside it), and
+    share of the root total."""
+    if not records:
+        return "no span records\n"
+    totals = {path: (total, count) for path, total, count in records}
+    self_s = {}
+    for path, (total, _count) in totals.items():
+        child_sum = sum(t for p, (t, _c) in totals.items()
+                        if len(p) == len(path) + 1 and p[:-1] == path)
+        self_s[path] = max(total - child_sum, 0.0)
+    root_total = sum(t for p, (t, _c) in totals.items() if len(p) == 1)
+    out = [f"Host span total (root spans): {root_total:.4f}s "
+           f"({len(totals)} distinct paths)\n",
+           "| span | count | total | self | share |",
+           "|---|---|---|---|---|"]
+    ranked = sorted(totals.items(), key=lambda kv: (-kv[1][0], kv[0]))
+    for path, (total, count) in ranked[:top_n]:
+        name = " / ".join(path)
+        share = 100 * total / root_total if root_total else 0.0
+        out.append(f"| `{name}` | {count} | {total * 1e3:.1f} ms | "
+                   f"{self_s[path] * 1e3:.1f} ms | {share:.1f}% |")
+    return "\n".join(out) + "\n"
+
+
 def main():
+    if len(sys.argv) > 1 and sys.argv[1] == "--spans":
+        if len(sys.argv) < 3:
+            raise SystemExit("usage: xprof_op_table.py --spans FILE.jsonl"
+                             " [top_n]")
+        top_n = int(sys.argv[3]) if len(sys.argv) > 3 else 20
+        print(render_span_table(load_span_records(sys.argv[2]), top_n),
+              end="")
+        return
     trace_dir = sys.argv[1] if len(sys.argv) > 1 else "/tmp/xprof"
     top_n = int(sys.argv[2]) if len(sys.argv) > 2 else 10
     dur, cnt = load_op_times(trace_dir)
