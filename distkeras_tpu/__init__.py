@@ -28,6 +28,9 @@ Package layout:
                 pooled KV cache over the models/decoding machinery)
     obs/        unified telemetry: metrics registry, tracing spans,
                 recompile/goodput accounting, JSONL/Prometheus exporters
+    resilience/ fault tolerance: fault injection, retry policies,
+                supervised auto-resume training (preemption, anomaly
+                rollback); serving degradation lives in serving/
     utils/      serialization, checkpointing, history, profiling
 """
 

@@ -197,7 +197,8 @@ class StreamingPredictor(Predictor):
                     dev = jax.device_put(jnp.asarray(xb), self._in_sharding)
                     if not put((dev, pad)):
                         return  # consumer gone; release source and exit
-            except BaseException as e:  # surface in the consumer thread
+            except BaseException as e:  # lint: allow-swallow — surfaced
+                #                         in the consumer thread
                 err.append(e)
             finally:
                 put(SENTINEL)

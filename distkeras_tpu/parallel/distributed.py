@@ -35,6 +35,7 @@ from distkeras_tpu.parallel.engine import (
     DynSGDAlgo, ElasticAlgo, EngineConfig, host_fetch, shard_epoch_data)
 from distkeras_tpu.parallel.mesh import make_mesh
 from distkeras_tpu.parallel.trainers import Trainer
+from distkeras_tpu.resilience import faults
 
 
 class DistributedTrainer(Trainer):
@@ -126,6 +127,10 @@ class DistributedTrainer(Trainer):
             with self._profile_ctx():
                 for epoch, (Xs, Ys, S) in Prefetcher(
                         assemble, range(start_epoch, self.num_epoch)):
+                    # chaos hook: mid-training crash; note the engine
+                    # family resumes from the CENTER only (the documented
+                    # PS-retry semantic), not bitwise like Single/SPMD
+                    faults.point("train.epoch")
                     pf = self.parallelism_factor
                     if pf > 1:
                         # reference partition loop: each worker consumes
@@ -182,17 +187,28 @@ class DistributedTrainer(Trainer):
                     # device->host transfer is expensive and must only
                     # happen on save epochs
                     extracted = None
-                    if manager is not None and self._should_checkpoint(epoch):
+
+                    def save_center(epoch):
+                        nonlocal extracted
                         extracted = engine.extract_model(state)
                         if jax.process_index() == 0:  # one writer per ckpt
                             manager.save(epoch, {"params": extracted[0],
                                                  "state": extracted[1]},
                                          metadata={"epoch": epoch})
+
+                    saved = False
+                    if manager is not None and self._should_checkpoint(epoch):
+                        save_center(epoch)
+                        saved = True
                     cbs.epoch_end(epoch,
                                   self._epoch_logs(losses, mets, extra))
-                    if self.stop_training:
-                        # stops ALL workers: the center is shared — there
-                        # is no per-worker early stop in the engine protocol
+                    # stop_training stops ALL workers: the center is shared
+                    # — there is no per-worker early stop in the engine
+                    # protocol; a preemption request checkpoints the center
+                    # first (same save-on-exit rule as the other trainers)
+                    if self._epoch_exit(
+                            epoch, saved,
+                            save_center if manager is not None else None):
                         break
         finally:
             self.record_training_stop()

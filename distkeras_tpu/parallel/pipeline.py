@@ -28,6 +28,7 @@ in one program (see ``PipelinedLM.make_train_step`` and
 
 from __future__ import annotations
 
+import threading
 from functools import partial
 from typing import Any, Callable, Optional, Sequence, Tuple
 
@@ -481,6 +482,18 @@ class PipelineTrainer:
         self._fwd = None  # cached jitted forward for predict()
         self._weights_fn = None
         self._pending_weights = None
+        # preemption contract shared with the Trainer family (the
+        # supervisor drives it duck-typed; trainers.epoch_exit is the
+        # ONE copy of the stop/consume/save-on-exit rule): a standing
+        # request_preempt() asks the loop to checkpoint the current
+        # epoch and return cleanly
+        self._preempt = threading.Event()
+        self.preempted = False
+
+    def request_preempt(self) -> None:
+        """See ``Trainer.request_preempt`` — same contract (the notice
+        stands until an epoch loop consumes it)."""
+        self._preempt.set()
 
     def get_history(self):
         return self.history
@@ -720,6 +733,9 @@ class PipelineTrainer:
         carry = (params, opt_state)
         carry_box = [carry]
         self.stop_training = False
+        # standing preemption notices survive train() entry (see
+        # trainers.epoch_exit: consumed when acted on)
+        self.preempted = False
         self._pending_weights = None
         self._weights_fn = lambda: (jax.device_get(carry_box[0][0]), {})
         cbs = CallbackList(self.callbacks, self)
@@ -727,7 +743,11 @@ class PipelineTrainer:
         self.history.record_training_start()
         tape.train_begin()
         try:
+            from distkeras_tpu.parallel.trainers import epoch_exit
+            from distkeras_tpu.resilience import faults
             for epoch in range(start_epoch, self.num_epoch):
+                # chaos hook: a mid-training crash at an arbitrary epoch
+                faults.point("train.epoch")
                 with tape.phase("data_wait"):
                     # same shuffle-seed convention as Trainer._epoch_perm
                     perm = (np.random.RandomState(self.seed + 1000 * epoch)
@@ -740,7 +760,10 @@ class PipelineTrainer:
                     yb = jax.device_put(jnp.asarray(Ys), data_sh)
                     carry, (losses, mets) = run_epoch(carry, xb, yb)
                     carry_box[0] = carry
-                    losses = jax.device_get(losses)
+                    # chaos hook: NaN-poison the epoch losses the
+                    # anomaly guard watches
+                    losses = faults.corrupt("train.loss",
+                                            jax.device_get(losses))
                     mets = jax.device_get(mets)
                 extra = {}
                 if validator is not None:
@@ -772,14 +795,16 @@ class PipelineTrainer:
                 if epoch == start_epoch:
                     tape.mark_warm()
                 cbs.epoch_end(epoch, logs)
-                if self.stop_training:
-                    # early stop between checkpoint_every boundaries: save
-                    # the final state, or resume would lose these epochs
-                    if manager is not None and not saved:
-                        manager.save(
-                            epoch,
-                            {"params": carry[0], "opt": carry[1]},
-                            metadata={"epoch": epoch})
+                # early stop / preemption between checkpoint_every
+                # boundaries saves the final state, or resume would
+                # lose these epochs (trainers.epoch_exit: the shared
+                # exit rule, one copy for the whole family)
+                if epoch_exit(self, epoch, saved,
+                              (lambda ep: manager.save(
+                                  ep, {"params": carry[0],
+                                       "opt": carry[1]},
+                                  metadata={"epoch": ep}))
+                              if manager is not None else None):
                     break
         finally:
             self.history.record_training_stop()

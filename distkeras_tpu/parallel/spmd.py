@@ -30,6 +30,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from distkeras_tpu.data.dataset import Dataset
 from distkeras_tpu.models.core import Model
 from distkeras_tpu.parallel.engine import host_fetch
+from distkeras_tpu.resilience import faults
 from distkeras_tpu.parallel.sharding import named_shardings, param_specs
 from distkeras_tpu.parallel.trainers import Trainer
 from distkeras_tpu.parallel.worker import (TrainCarry, make_train_step,
@@ -354,8 +355,34 @@ class SPMDTrainer(Trainer):
                 from distkeras_tpu.obs import timed_stream
                 l_acc, m_acc = [], []
                 examples = 0
+
+                def save_now(epoch):
+                    carry_tree = {"params": carry.params,
+                                  "state": carry.state,
+                                  "opt": carry.opt_state,
+                                  "rng": carry.rng}
+                    with tape.phase("checkpoint"):
+                        if self.sharded_checkpoints:
+                            # every process writes ITS shards (barriers
+                            # inside); no host gather of the full tree
+                            manager.save(epoch, carry_tree,
+                                         metadata={"epoch": epoch})
+                        else:
+                            # host_fetch is a COLLECTIVE under
+                            # multi-process (allgather of
+                            # non-addressable shards) — every process
+                            # must enter it; only the write is gated
+                            # on process 0
+                            snapshot = host_fetch(carry_tree)
+                            if jax.process_index() == 0:
+                                manager.save(epoch, snapshot,
+                                             metadata={"epoch": epoch})
+
                 for (epoch, _, last), (Xs, Ys, S) in timed_stream(stream,
                                                                   tape):
+                    # chaos hook: a mid-training crash at an arbitrary
+                    # loop iteration (tests/test_resilience.py)
+                    faults.point("train.epoch")
                     with tape.phase("device"):
                         Xs = jax.device_put(Xs, data_sh)
                         Ys = jax.device_put(Ys, data_sh)
@@ -366,7 +393,10 @@ class SPMDTrainer(Trainer):
                     examples += int(S) * self.batch_size
                     if not last:
                         continue
-                    losses = np.concatenate(l_acc)
+                    # chaos hook: NaN-poison the epoch losses the
+                    # anomaly guard watches
+                    losses = faults.corrupt(
+                        "train.loss", np.concatenate(l_acc))
                     mets = {k: np.concatenate([m[k] for m in m_acc])
                             for k in (m_acc[0] if m_acc else {})}
                     l_acc, m_acc = [], []
@@ -378,27 +408,10 @@ class SPMDTrainer(Trainer):
                                          carry.params,
                                          carry.state)).items()}
                     self.history.append_epoch(loss=losses, **mets, **extra)
+                    saved = False
                     if manager is not None and self._should_checkpoint(epoch):
-                        carry_tree = {"params": carry.params,
-                                      "state": carry.state,
-                                      "opt": carry.opt_state,
-                                      "rng": carry.rng}
-                        with tape.phase("checkpoint"):
-                            if self.sharded_checkpoints:
-                                # every process writes ITS shards (barriers
-                                # inside); no host gather of the full tree
-                                manager.save(epoch, carry_tree,
-                                             metadata={"epoch": epoch})
-                            else:
-                                # host_fetch is a COLLECTIVE under
-                                # multi-process (allgather of
-                                # non-addressable shards) — every process
-                                # must enter it; only the write is gated
-                                # on process 0
-                                snapshot = host_fetch(carry_tree)
-                                if jax.process_index() == 0:
-                                    manager.save(epoch, snapshot,
-                                                 metadata={"epoch": epoch})
+                        save_now(epoch)
+                        saved = True
                     # logs derive from replicated values, so every process
                     # sees identical callback decisions (incl. stop_training
                     # and any collective get_weights fetch inside a callback)
@@ -408,7 +421,13 @@ class SPMDTrainer(Trainer):
                     if epoch == start_epoch:
                         tape.mark_warm()
                     cbs.epoch_end(epoch, logs)
-                    if self.stop_training:
+                    # preemption is delivered per-process (SIGTERM to the
+                    # job hits every worker); the stop decision below
+                    # must stay consistent across processes, which holds
+                    # when the preemption notice reaches all of them
+                    if self._epoch_exit(
+                            epoch, saved,
+                            save_now if manager is not None else None):
                         break
         finally:
             self.record_training_stop()

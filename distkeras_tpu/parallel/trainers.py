@@ -14,6 +14,7 @@ label_col, ...)`` and ``trainer.train(dataset) -> Model``.
 
 from __future__ import annotations
 
+import threading
 from typing import Callable, List, Optional, Sequence, Union
 
 import jax
@@ -27,7 +28,30 @@ from distkeras_tpu.ops.losses import get_loss
 from distkeras_tpu.ops.optimizers import Optimizer, get_optimizer
 from distkeras_tpu.parallel.worker import (
     TrainCarry, make_epoch_runner, make_train_step, stack_batches)
+from distkeras_tpu.resilience import faults
 from distkeras_tpu.utils.history import History
+
+
+def epoch_exit(trainer, epoch: int, saved: bool, save_fn) -> bool:
+    """Shared end-of-epoch stop logic for every epoch-loop trainer
+    (``Trainer`` subclasses AND the duck-typed ``PipelineTrainer`` —
+    ONE copy so the exit rule cannot drift between loops): on callback
+    stop OR a preemption request, make sure THIS epoch is checkpointed
+    (or resume would silently lose it) and tell the loop to break.
+
+    The preempt Event is consumed HERE, when it is acted on — not
+    cleared at train() entry — so a SIGTERM landing between a
+    supervisor's restart attempts (after the crash, before the resumed
+    run installs its loop) still stops the resumed run at its first
+    epoch instead of being silently dropped."""
+    trainer.preempted = trainer._preempt.is_set()
+    if not (trainer.stop_training or trainer.preempted):
+        return False
+    if trainer.preempted:
+        trainer._preempt.clear()   # consumed: acted on exactly once
+    if save_fn is not None and not saved:
+        save_fn(epoch)
+    return True
 
 
 class Trainer:
@@ -137,6 +161,25 @@ class Trainer:
         self.stop_training = False
         self._weights_fn = None       # bound by trainers during train()
         self._pending_weights = None  # set via set_weights()
+        # preemption (resilience PR): request_preempt() — signal-handler
+        # safe (an Event set is async-signal tolerable) — asks the epoch
+        # loop to checkpoint the CURRENT epoch and return cleanly;
+        # ``preempted`` reports whether the last train() ended that way
+        self._preempt = threading.Event()
+        self.preempted = False
+
+    def request_preempt(self) -> None:
+        """Ask the running epoch loop to checkpoint and stop at the end
+        of the current epoch (SIGTERM/preemption-notice path — see
+        ``resilience.TrainingSupervisor``). Safe to call from a signal
+        handler or another thread. The notice STANDS until an epoch
+        loop acts on it (``epoch_exit`` consumes it), so a preemption
+        delivered between a crash and the supervisor's resumed run is
+        honored by that run's first epoch, never dropped."""
+        self._preempt.set()
+
+    def _epoch_exit(self, epoch: int, saved: bool, save_fn) -> bool:
+        return epoch_exit(self, epoch, saved, save_fn)
 
     def _reject_step_options(self):
         """Trainers whose step semantics don't compose with the
@@ -266,6 +309,10 @@ class Trainer:
         trainer supplies its own view — carry, engine center, ...)."""
         from distkeras_tpu.utils.callbacks import CallbackList
         self.stop_training = False
+        # NOT clearing self._preempt here: a standing preemption notice
+        # (e.g. SIGTERM delivered while the supervisor was mid-restart)
+        # must stop the next run; epoch_exit consumes it when acted on
+        self.preempted = False
         self._pending_weights = None
         self._weights_fn = weights_fn
         cbs = CallbackList(self.callbacks, self)
@@ -369,9 +416,21 @@ class Trainer:
             items += [(e, si, i == len(order) - 1)
                       for i, si in enumerate(order)]
 
+        from distkeras_tpu.resilience.retry import io_retry
+        fetch_retry = io_retry()
+
         def assemble(item):
             epoch, si, _ = item
-            Xc, yc = self._training_arrays(sds.load_shard(si))
+
+            def fetch():
+                # chaos hook + transient-IO retry: a flaky shard read
+                # (NFS blip, injected "data.fetch" fault) costs a
+                # jittered backoff on the loader thread, not the run
+                faults.point("data.fetch")
+                return sds.load_shard(si)
+
+            Xc, yc = self._training_arrays(
+                fetch_retry.call(fetch, op="data.fetch"))
             perm = None
             if self.shuffle_each_epoch:
                 perm = np.random.RandomState(
@@ -469,8 +528,21 @@ class SingleTrainer(Trainer):
                 from distkeras_tpu.obs import timed_stream
                 l_acc, m_acc = [], []
                 examples = 0
+
+                def save_now(epoch):
+                    with tape.phase("checkpoint"):
+                        manager.save(
+                            epoch,
+                            {"params": carry.params,
+                             "state": carry.state,
+                             "opt": carry.opt_state, "rng": carry.rng},
+                            metadata={"epoch": epoch})
+
                 for (epoch, _, last), (Xs, Ys, S) in timed_stream(stream,
                                                                   tape):
+                    # chaos hook: a mid-training crash at an arbitrary
+                    # loop iteration (tests/test_resilience.py)
+                    faults.point("train.epoch")
                     with tape.phase("device"):
                         carry, outs = runner(carry, Xs, Ys)
                         losses, mets = self._split_outs(outs)
@@ -479,7 +551,10 @@ class SingleTrainer(Trainer):
                     examples += int(S) * self.batch_size
                     if not last:
                         continue
-                    losses = np.concatenate(l_acc)
+                    # chaos hook: NaN-poison the epoch losses the
+                    # anomaly guard watches (history/logs downstream)
+                    losses = faults.corrupt(
+                        "train.loss", np.concatenate(l_acc))
                     mets = {k: np.concatenate([m[k] for m in m_acc])
                             for k in (m_acc[0] if m_acc else {})}
                     l_acc, m_acc = [], []
@@ -491,14 +566,10 @@ class SingleTrainer(Trainer):
                                          carry.params,
                                          carry.state)).items()}
                     self.history.append_epoch(loss=losses, **mets, **extra)
+                    saved = False
                     if manager is not None and self._should_checkpoint(epoch):
-                        with tape.phase("checkpoint"):
-                            manager.save(
-                                epoch,
-                                {"params": carry.params,
-                                 "state": carry.state,
-                                 "opt": carry.opt_state, "rng": carry.rng},
-                                metadata={"epoch": epoch})
+                        save_now(epoch)
+                        saved = True
                     logs = self._epoch_logs(losses, mets, extra)
                     logs.update(tape.epoch_end(examples))
                     examples = 0
@@ -506,7 +577,9 @@ class SingleTrainer(Trainer):
                         # first full epoch saw every legitimate shape
                         tape.mark_warm()
                     cbs.epoch_end(epoch, logs)
-                    if self.stop_training:
+                    if self._epoch_exit(
+                            epoch, saved,
+                            save_now if manager is not None else None):
                         break
         finally:
             self.record_training_stop()

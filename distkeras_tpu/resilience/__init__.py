@@ -1,0 +1,29 @@
+"""``distkeras_tpu.resilience`` — the fault-tolerance subsystem.
+
+The immune system over the fast paths (kernels, continuous batching)
+and the eyes (obs telemetry): every failure mode the repo claims to
+handle is injectable (``faults``), bounded-retryable (``retry``), and
+supervised (``supervisor``); the serving layer degrades gracefully
+(deadlines, load shedding, poisoned-request isolation — see
+``serving/``). ``docs/resilience.md`` is the subsystem guide;
+``tests/test_resilience.py`` is the chaos suite that proves the
+invariants (crash-anywhere resume bitwise-identity, clean preemption,
+bounded rollback, bounded serving queues).
+
+Quick tour::
+
+    from distkeras_tpu import resilience
+    from distkeras_tpu.resilience import faults
+
+    faults.inject("ckpt.write", nth=2)        # or DKT_FAULTS=...
+    sup = resilience.TrainingSupervisor(trainer, max_restarts=3)
+    result = sup.run(dataset)                 # survives the fault
+    assert result.restarts <= 3
+"""
+
+from distkeras_tpu.resilience import faults  # noqa: F401
+from distkeras_tpu.resilience.faults import InjectedFault  # noqa: F401
+from distkeras_tpu.resilience.retry import (  # noqa: F401
+    RetryPolicy, classify_retryable, io_retry, no_retry)
+from distkeras_tpu.resilience.supervisor import (  # noqa: F401
+    AnomalyDetected, AnomalyGuard, SupervisedRun, TrainingSupervisor)

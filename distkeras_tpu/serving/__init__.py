@@ -24,8 +24,10 @@ per-step decode kernels and an actual serving workload:
 See ``docs/serving.md`` for the architecture and scheduling policy.
 """
 
-from distkeras_tpu.serving.engine import ServingEngine  # noqa: F401
+from distkeras_tpu.serving.engine import (DegradedRequest,  # noqa: F401
+                                          ServingEngine)
 from distkeras_tpu.serving.kv_pool import KVPool  # noqa: F401
 from distkeras_tpu.serving.metrics import ServingMetrics  # noqa: F401
-from distkeras_tpu.serving.scheduler import (FIFOScheduler,  # noqa: F401
-                                             Request, RequestState)
+from distkeras_tpu.serving.scheduler import (AdmissionRejected,  # noqa: F401
+                                             FIFOScheduler, Request,
+                                             RequestState, TERMINAL_STATES)

@@ -52,9 +52,30 @@ from distkeras_tpu.models.decoding import (_attn_compute_dtype,
                                            _sample_vec, _serving_params,
                                            decode_step_slots, prefill,
                                            prefill_chunk_step)
+from distkeras_tpu.resilience import faults
 from distkeras_tpu.serving.kv_pool import KVPool
 from distkeras_tpu.serving.metrics import ServingMetrics
-from distkeras_tpu.serving.scheduler import FIFOScheduler, Request
+from distkeras_tpu.serving.scheduler import (AdmissionRejected,
+                                             FIFOScheduler, Request,
+                                             RequestState,
+                                             TERMINAL_STATES)
+
+
+class DegradedRequest(RuntimeError):
+    """``run()`` drained a request that did NOT finish normally
+    (TIMED_OUT / CANCELLED). Raised by default so a degraded result can
+    never masquerade as a complete one in ``run()``'s plain
+    ``{rid: tokens}`` return; the terminal ``Request`` (state, partial
+    tokens, ``error`` cause) rides on ``.request``."""
+
+    def __init__(self, request: Request):
+        cause = (f": {request.error!r}" if request.error is not None
+                 else "")
+        super().__init__(
+            f"request {request.rid} ended {request.state.value}{cause} "
+            "— drive with step() to observe terminal states, or "
+            "run(on_degraded='return') to accept partial tokens")
+        self.request = request
 
 
 class ServingEngine:
@@ -71,7 +92,8 @@ class ServingEngine:
                  max_len: int = 256,
                  prefill_chunk: Optional[int] = None,
                  cache_dtype=None, weights_dtype="auto",
-                 metrics: Optional[ServingMetrics] = None):
+                 metrics: Optional[ServingMetrics] = None,
+                 max_queue: Optional[int] = None):
         module = model.module
         if not isinstance(module, Sequential):
             raise TypeError("ServingEngine expects a Sequential LM "
@@ -111,7 +133,11 @@ class ServingEngine:
         # is safe — insert() copies the whole row, and the occupant's
         # decode writes position t before the mask ever admits it
         self._staging = self.pool.make_request_cache()
-        self.scheduler = FIFOScheduler(self.num_slots)
+        # bounded admission (load shedding): submits past max_queue
+        # raise AdmissionRejected instead of growing the queue without
+        # bound under overload; None keeps the open-queue behavior
+        self.scheduler = FIFOScheduler(self.num_slots,
+                                       max_queue=max_queue)
         self.metrics = metrics if metrics is not None else ServingMetrics()
         self._requests: Dict[int, Request] = {}
         self._rid = itertools.count()
@@ -165,9 +191,16 @@ class ServingEngine:
     def submit(self, prompt, max_new_tokens: int, *,
                temperature: float = 0.0, top_k: Optional[int] = None,
                top_p: Optional[float] = None,
-               stop_token: Optional[int] = None, seed: int = 0) -> int:
+               stop_token: Optional[int] = None, seed: int = 0,
+               deadline_s: Optional[float] = None) -> int:
         """Enqueue one request; returns its id. Sampling defaults match
-        ``generate()`` (greedy); ``None`` knobs mean disabled."""
+        ``generate()`` (greedy); ``None`` knobs mean disabled.
+
+        ``deadline_s`` is a submit→finish budget on the engine clock: a
+        request still unfinished when it expires is terminated
+        ``TIMED_OUT`` at the next ``step()`` (partial tokens kept on the
+        returned request). Raises ``AdmissionRejected`` when the engine
+        was built with ``max_queue`` and the wait queue is full."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size < 1:
             raise ValueError("prompt must hold at least one token")
@@ -182,6 +215,9 @@ class ServingEngine:
                 f"max_len={self.max_len}")
         if top_p is not None and not 0.0 < top_p <= 1.0:
             raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+        if deadline_s is not None and float(deadline_s) <= 0:
+            raise ValueError(
+                f"deadline_s must be > 0, got {deadline_s}")
         req = Request(
             rid=next(self._rid), prompt=prompt,
             max_new_tokens=max_new_tokens,
@@ -189,10 +225,16 @@ class ServingEngine:
             top_k=0 if top_k is None else int(top_k),
             top_p=1.0 if top_p is None else float(top_p),
             stop_token=-1 if stop_token is None else int(stop_token),
-            seed=int(seed))
+            seed=int(seed),
+            deadline_s=None if deadline_s is None else float(deadline_s))
         req.rng = jax.random.PRNGKey(req.seed)
+        req.submit_t = self.metrics.clock()
+        try:
+            self.scheduler.submit(req)    # may shed (AdmissionRejected)
+        except AdmissionRejected:
+            self.metrics.record_rejected()
+            raise
         self._requests[req.rid] = req
-        self.scheduler.submit(req)
         self.metrics.record_submit(req.rid)
         return req.rid
 
@@ -299,17 +341,31 @@ class ServingEngine:
     # --- the scheduler iteration ------------------------------------------
 
     def step(self) -> List[Request]:
-        """One iteration: admit, advance ONE prefill chunk, run one
-        decode step over all slots. Returns requests finished during
-        this iteration."""
+        """One iteration: expire deadlines, admit, advance ONE prefill
+        chunk, run one decode step over all slots. Returns requests that
+        reached a terminal state during this iteration (FINISHED,
+        TIMED_OUT or CANCELLED — check ``req.state``).
+
+        Error isolation: an exception while advancing ONE request's
+        prefill (a poisoned prompt, an injected ``serving.prefill``
+        fault) cancels that request and recycles its slot; in-flight
+        decode streams are untouched and keep emitting token-identical
+        output. A decode-step error is batch-wide and not attributable
+        to one request, so it propagates — but it is raised before any
+        engine state mutates, so ``step()`` can simply be called again
+        (the failed iteration retries wholesale)."""
         finished: List[Request] = []
+        self._expire_deadlines(finished)
         self.scheduler.admit()
 
         req = self.scheduler.next_prefill()
         if req is not None:
             with self.metrics.timer.phase("prefill"), \
                     obs.span("serving.prefill"):
-                self._advance_prefill(req, finished)
+                try:
+                    self._advance_prefill(req, finished)
+                except Exception as e:
+                    self._poison(req, e, finished)
 
         running = self.scheduler.running
         if running:
@@ -325,14 +381,29 @@ class ServingEngine:
             self._recompile.check()
         return finished
 
-    def run(self, max_steps: Optional[int] = None) -> Dict[int, np.ndarray]:
-        """Drive ``step()`` until every submitted request finishes;
-        returns ``{rid: tokens}`` for requests finished during this
-        call."""
+    def run(self, max_steps: Optional[int] = None,
+            on_degraded: str = "raise") -> Dict[int, np.ndarray]:
+        """Drive ``step()`` until every submitted request reaches a
+        terminal state; returns ``{rid: tokens}`` for requests drained
+        during this call.
+
+        A request that ends TIMED_OUT or CANCELLED raises
+        ``DegradedRequest`` (default) — its empty/partial token array
+        must not be indistinguishable from a finished one in the plain
+        tokens dict. Pass ``on_degraded="return"`` to include partial
+        tokens instead, or drive ``step()`` directly to observe
+        per-request terminal states."""
+        if on_degraded not in ("raise", "return"):
+            raise ValueError(
+                f"on_degraded must be 'raise' or 'return', "
+                f"got {on_degraded!r}")
         out: Dict[int, np.ndarray] = {}
         steps = 0
         while self.scheduler.pending:
             for r in self.step():
+                if r.state is not RequestState.FINISHED \
+                        and on_degraded == "raise":
+                    raise DegradedRequest(r)
                 out[r.rid] = r.tokens
             steps += 1
             if max_steps is not None and steps >= max_steps \
@@ -343,9 +414,88 @@ class ServingEngine:
                     f"occupied={self.scheduler.occupied})")
         return out
 
+    # --- degradation paths ------------------------------------------------
+
+    def _expire_deadlines(self, finished: List[Request]) -> None:
+        """Terminate every in-flight request whose ``deadline_s`` has
+        expired (engine clock), freeing its slot for queued work. A
+        timed-out request keeps the tokens it generated so far."""
+        now_ = self.metrics.clock()
+        expired = [r for r in self._requests.values()
+                   if r.deadline_s is not None
+                   and now_ - r.submit_t >= r.deadline_s]
+        for r in expired:
+            self._terminate(r, RequestState.TIMED_OUT, finished)
+            self.metrics.record_timeout(r.rid)
+
+    def _poison(self, req: Request, err: Exception,
+                finished: List[Request]) -> None:
+        """Per-request work failed: quarantine THIS request (CANCELLED,
+        ``req.error`` holds the cause), recycle its slot, leave every
+        other stream untouched."""
+        if req.state in TERMINAL_STATES:
+            raise err    # already terminal — nothing to isolate
+        self._terminate(req, RequestState.CANCELLED, finished, error=err)
+        self.metrics.record_cancelled(req.rid)
+
+    def cancel(self, rid: int) -> Request:
+        """Cancel an in-flight request by id (client disconnect etc.);
+        returns the terminal Request (evicted from the engine)."""
+        req = self._requests[rid]
+        out: List[Request] = []
+        self._terminate(req, RequestState.CANCELLED, out)
+        self.metrics.record_cancelled(rid)
+        return out[0]
+
+    def _terminate(self, req: Request, state, finished: List[Request],
+                   error: Optional[BaseException] = None) -> None:
+        """Shared terminal transition for the degradation paths: move
+        the request out of the scheduler (freeing its slot when it holds
+        one), park the slot's decode vector on the inert sentinel, and
+        evict the request from the engine — the caller owns it from
+        here, exactly like ``_finish``."""
+        had_slot = req.state in (RequestState.PREFILLING,
+                                 RequestState.DECODING)
+        self.scheduler.cancel(req, state)
+        if had_slot:
+            self._t[req.slot] = self.max_len   # sentinel: slot inert
+        req.error = error
+        del self._requests[req.rid]
+        finished.append(req)
+
+    def health(self) -> Dict:
+        """Readiness snapshot for load balancers / probes, built on the
+        unified ``obs.telemetry_snapshot()``: is the engine accepting
+        work, how deep is the queue, and the degradation tally of the
+        CURRENT metrics window. ``status`` is ``"ok"`` while admission
+        is open, ``"saturated"`` once the bounded queue is full (a
+        probe should stop routing new traffic here until it drains)."""
+        sch = self.scheduler
+        accepting = (sch.max_queue is None
+                     or sch.queue_depth < sch.max_queue)
+        m = self.metrics
+        return {
+            "status": "ok" if accepting else "saturated",
+            "accepting": accepting,
+            "queue_depth": sch.queue_depth,
+            "max_queue": sch.max_queue,
+            "slots": {"total": self.num_slots, "occupied": sch.occupied,
+                      "free": self.num_slots - sch.occupied},
+            "requests": {"in_flight": len(self._requests),
+                         "finished": m.requests_finished,
+                         "rejected": m.requests_rejected,
+                         "timed_out": m.requests_timed_out,
+                         "cancelled": m.requests_cancelled},
+            "telemetry": obs.telemetry_snapshot(),
+        }
+
     # --- internals --------------------------------------------------------
 
     def _advance_prefill(self, req: Request, finished: List[Request]):
+        # chaos hook: an injected raise here exercises the
+        # poisoned-request isolation in step(); an injected stall is the
+        # slow-prefill scenario (queue grows, deadlines/shedding engage)
+        faults.point("serving.prefill")
         p_len = len(req.prompt)
         chunk = self.prefill_chunk
         if chunk is None or p_len <= chunk:
@@ -382,6 +532,10 @@ class ServingEngine:
         self._keys[s] = np.array(req.rng)
 
     def _advance_decode(self, finished: List[Request]):
+        # chaos hook: fires BEFORE any state mutates, so an injected
+        # decode-step error leaves the iteration wholesale-retryable
+        # (see step() docstring)
+        faults.point("serving.decode")
         t0 = self.metrics.clock()
         n_active = len(self.scheduler.running)
         greedy_only = all(r.temperature <= 0.0

@@ -60,6 +60,13 @@ class ServingMetrics:
         self._finished = self.registry.counter("serving.requests_finished")
         self._tokens = self.registry.counter("serving.tokens_generated")
         self._chunks = self.registry.counter("serving.prefill_chunks")
+        # degradation counters (resilience PR): shed at admission,
+        # expired deadlines, poisoned-request isolations
+        self._rejected = self.registry.counter("serving.requests_rejected")
+        self._timed_out = self.registry.counter(
+            "serving.requests_timed_out")
+        self._cancelled = self.registry.counter(
+            "serving.requests_cancelled")
         self._decode_toks = self.registry.counter("serving.decode_tokens")
         self._decode_secs = self.registry.counter("serving.decode_seconds")
         #: exact (tokens, seconds) aggregation per decoding-slot count —
@@ -97,6 +104,21 @@ class ServingMetrics:
         self._tokens.inc(int(n_generated))
         self._t_last_finish = now_
 
+    def record_rejected(self) -> None:
+        """A submit shed by the bounded admission queue (the request
+        never entered the engine — no submit timestamp to evict)."""
+        self._rejected.inc()
+
+    def record_timeout(self, rid: int) -> None:
+        """A request's deadline expired before it finished."""
+        self.submit_ts.pop(rid, None)
+        self._timed_out.inc()
+
+    def record_cancelled(self, rid: int) -> None:
+        """A request isolated after a step error (or cancelled by API)."""
+        self.submit_ts.pop(rid, None)
+        self._cancelled.inc()
+
     # --- per-iteration ----------------------------------------------------
 
     def record_prefill_chunk(self) -> None:
@@ -129,6 +151,18 @@ class ServingMetrics:
     @property
     def prefill_chunks(self) -> int:
         return int(self._chunks.value())
+
+    @property
+    def requests_rejected(self) -> int:
+        return int(self._rejected.value())
+
+    @property
+    def requests_timed_out(self) -> int:
+        return int(self._timed_out.value())
+
+    @property
+    def requests_cancelled(self) -> int:
+        return int(self._cancelled.value())
 
     @property
     def decode_samples(self) -> List:
@@ -177,6 +211,11 @@ class ServingMetrics:
         tokens = self.tokens_generated
         return {
             "requests_finished": self.requests_finished,
+            # degradation tally (keys ADDED by the resilience PR; all
+            # pre-existing keys unchanged)
+            "requests_rejected": self.requests_rejected,
+            "requests_timed_out": self.requests_timed_out,
+            "requests_cancelled": self.requests_cancelled,
             "tokens_generated": tokens,
             # request-level throughput: all generated tokens over the
             # first-submit -> last-finish span (includes queueing +

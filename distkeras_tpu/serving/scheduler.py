@@ -2,15 +2,23 @@
 admission, per-request state machine, slot allocation/release.
 
 The scheduler is pure host-side bookkeeping — it never touches device
-arrays. Policy (deliberately simple, documented in docs/serving.md):
+arrays. Policy (deliberately simple, documented in docs/serving.md;
+degradation semantics in docs/resilience.md):
 
   * FCFS admission: queued requests take free slots in arrival order.
+  * BOUNDED queue: with ``max_queue`` set, a submit past the bound
+    raises ``AdmissionRejected`` (explicit load shedding — the queue
+    never grows without bound under overload).
   * ONE prefill stream: the oldest admitted-but-not-yet-decoding
     request advances one prompt chunk per engine iteration, interleaved
     between decode steps (long prompts therefore do not stall in-flight
     decode streams; they just take several iterations to come online).
   * Slots release on finish (stop token or length limit) and are
-    immediately reusable by the next queued request.
+    immediately reusable by the next queued request. A request can also
+    leave via ``cancel()`` — deadline timeout (``TIMED_OUT``) or
+    poisoned-request isolation (``CANCELLED``) — from ANY live state.
+  * Double-release is a loud error, never a silent double-free: two
+    requests sharing one KV slot would corrupt both streams.
 """
 
 from __future__ import annotations
@@ -23,11 +31,32 @@ from typing import Dict, List, Optional
 import numpy as np
 
 
+class AdmissionRejected(RuntimeError):
+    """Submit refused: the bounded admission queue is full (load
+    shedding). Callers retry later or route elsewhere — the engine
+    sheds explicitly instead of queueing unboundedly."""
+
+    def __init__(self, queue_depth: int, max_queue: int):
+        super().__init__(
+            f"admission queue full ({queue_depth}/{max_queue} waiting); "
+            "request shed")
+        self.queue_depth = queue_depth
+        self.max_queue = max_queue
+
+
 class RequestState(enum.Enum):
     QUEUED = "queued"            # submitted, waiting for a slot
     PREFILLING = "prefilling"    # slot assigned, prompt chunks running
     DECODING = "decoding"        # in the slot-batched decode loop
     FINISHED = "finished"        # stop token or length limit reached
+    TIMED_OUT = "timed_out"      # per-request deadline_s expired
+    CANCELLED = "cancelled"      # isolated after a step error / by API
+
+
+#: states a request never leaves
+TERMINAL_STATES = frozenset(
+    {RequestState.FINISHED, RequestState.TIMED_OUT,
+     RequestState.CANCELLED})
 
 
 @dataclass
@@ -51,6 +80,10 @@ class Request:
     prefill_pos: int = 0                 # prompt positions ingested
     generated: List[int] = field(default_factory=list)
     rng: object = None                   # per-request PRNG key (engine)
+    deadline_s: Optional[float] = None   # submit->finish budget (engine
+    #                                      clock); None = no deadline
+    submit_t: float = 0.0                # engine-clock submit timestamp
+    error: Optional[BaseException] = None  # why CANCELLED (isolation)
 
     @property
     def stopped(self) -> bool:
@@ -73,10 +106,13 @@ class Request:
 class FIFOScheduler:
     """FIFO queue + slot allocator + state machine transitions."""
 
-    def __init__(self, num_slots: int):
+    def __init__(self, num_slots: int, max_queue: Optional[int] = None):
         if num_slots < 1:
             raise ValueError(f"num_slots must be >= 1, got {num_slots}")
+        if max_queue is not None and int(max_queue) < 1:
+            raise ValueError(f"max_queue must be >= 1, got {max_queue}")
         self.num_slots = int(num_slots)
+        self.max_queue = None if max_queue is None else int(max_queue)
         self.waiting: deque = deque()          # QUEUED, FIFO
         self.prefilling: deque = deque()       # PREFILLING, FIFO
         self.running: Dict[int, Request] = {}  # slot -> DECODING request
@@ -87,6 +123,9 @@ class FIFOScheduler:
     # --- queue ------------------------------------------------------------
 
     def submit(self, req: Request) -> None:
+        if self.max_queue is not None \
+                and len(self.waiting) >= self.max_queue:
+            raise AdmissionRejected(len(self.waiting), self.max_queue)
         req.state = RequestState.QUEUED
         self.waiting.append(req)
 
@@ -116,15 +155,46 @@ class FIFOScheduler:
         req.state = RequestState.DECODING
         self.running[req.slot] = req
 
-    def release(self, req: Request) -> None:
-        """Finish a request from either in-flight state and free its
-        slot."""
+    def _evict(self, req: Request) -> None:
+        """Remove an in-flight request from its live structure and free
+        its slot. Raises on a request that holds no slot — a terminal
+        (double-release) or still-QUEUED request — because silently
+        appending its slot to the free list would hand the same KV slot
+        to two requests."""
         if req.state is RequestState.DECODING:
             del self.running[req.slot]
         elif req.state is RequestState.PREFILLING:
             self.prefilling.remove(req)
-        req.state = RequestState.FINISHED
+        else:
+            raise RuntimeError(
+                f"cannot release request {req.rid} in state "
+                f"{req.state.value!r}: it holds no slot "
+                "(double release, or the request was never admitted)")
         self._free.append(req.slot)
+
+    def release(self, req: Request) -> None:
+        """Finish a request from either in-flight state and free its
+        slot. Releasing twice (or releasing a QUEUED request) raises —
+        it would put one slot on the free list twice."""
+        self._evict(req)
+        req.state = RequestState.FINISHED
+
+    def cancel(self, req: Request,
+               state: RequestState = RequestState.CANCELLED) -> None:
+        """Terminate a request from ANY live state (degradation paths:
+        deadline ``TIMED_OUT``, poisoned-request ``CANCELLED``). A
+        queued request just leaves the queue; an admitted one also
+        frees its slot. Terminal requests raise (same double-free
+        guard as ``release``)."""
+        if state not in (RequestState.CANCELLED, RequestState.TIMED_OUT):
+            raise ValueError(
+                f"cancel() target state must be CANCELLED or TIMED_OUT, "
+                f"got {state}")
+        if req.state is RequestState.QUEUED:
+            self.waiting.remove(req)
+        else:
+            self._evict(req)
+        req.state = state
 
     # --- introspection ----------------------------------------------------
 

@@ -16,12 +16,15 @@ from __future__ import annotations
 import json
 import os
 import shutil
+import zlib
 from typing import Any, Dict, List, Optional
 
 import jax
 import numpy as np
 
 from distkeras_tpu.models.serialization import _flatten_with_paths
+from distkeras_tpu.resilience import faults
+from distkeras_tpu.resilience.retry import RetryPolicy, io_retry
 
 
 def _unflatten_like(template, flat):
@@ -71,16 +74,31 @@ class CheckpointManager:
     """
 
     def __init__(self, directory: str, max_to_keep: int = 3,
-                 async_writes: bool = False):
+                 async_writes: bool = False,
+                 retry: Optional[RetryPolicy] = None):
         self.directory = directory
         self.max_to_keep = int(max_to_keep)
         if self.max_to_keep < 1:
             raise ValueError(
                 f"max_to_keep must be >= 1, got {max_to_keep}")
         os.makedirs(directory, exist_ok=True)
+        # transient-IO retry (resilience.retry): a flaky write/read costs
+        # a jittered backoff, not the snapshot; non-IO errors surface raw
+        self.retry = io_retry() if retry is None else retry
+        self._sweep_stale_tmp()
         self.async_writes = bool(async_writes)
         self._thread = None
         self._write_error: Optional[BaseException] = None
+
+    def _sweep_stale_tmp(self) -> None:
+        """Remove ``step_*.tmp`` dirs left by a crash mid-write: they
+        were never published (publish is the atomic rename), so they are
+        garbage that would otherwise accumulate forever — and a later
+        save of the SAME step must not inherit a half-written temp."""
+        for name in os.listdir(self.directory):
+            if name.startswith("step_") and name.endswith(".tmp"):
+                shutil.rmtree(os.path.join(self.directory, name),
+                              ignore_errors=True)
 
     # -- write ------------------------------------------------------------
     def save(self, step: int, tree: Any,
@@ -91,7 +109,8 @@ class CheckpointManager:
         flat = _flatten_with_paths(tree)
         final = os.path.join(self.directory, f"step_{step}")
         if not self.async_writes:
-            self._write(step, flat, metadata, final)
+            self.retry.call(self._write, step, flat, metadata, final,
+                            op="ckpt.write")
             return final
 
         import threading
@@ -113,20 +132,31 @@ class CheckpointManager:
 
     def _write_guarded(self, step, flat, metadata, final):
         try:
-            self._write(step, flat, metadata, final)
-        except BaseException as e:  # surfaced on the next wait()/save()
-            self._write_error = e
+            self.retry.call(self._write, step, flat, metadata, final,
+                            op="ckpt.write")
+        except BaseException as e:  # lint: allow-swallow — surfaced on
+            self._write_error = e   # the next wait()/save()
+
+    @staticmethod
+    def _crc(arr: np.ndarray) -> int:
+        return zlib.crc32(np.ascontiguousarray(arr).tobytes()) & 0xFFFFFFFF
 
     def _write(self, step, flat, metadata, final):
         tmp = final + ".tmp"
         if os.path.exists(tmp):
             shutil.rmtree(tmp)
         os.makedirs(tmp)
+        faults.point("ckpt.write")
         np.savez(os.path.join(tmp, ARRAYS), **flat)
         with open(os.path.join(tmp, MANIFEST), "w") as f:
             json.dump({"step": int(step),
                        "keys": sorted(flat),
+                       # per-leaf payload checksums, verified on restore:
+                       # a truncated/corrupted arrays.npz fails loudly
+                       # with the leaf name instead of deep inside numpy
+                       "crc32": {k: self._crc(v) for k, v in flat.items()},
                        "metadata": metadata or {}}, f, indent=2)
+        faults.point("ckpt.rename")
         if os.path.exists(final):
             shutil.rmtree(final)
         os.rename(tmp, final)  # atomic publish
@@ -155,7 +185,8 @@ class CheckpointManager:
         return steps[-1] if steps else None
 
     def restore(self, template: Any, step: Optional[int] = None) -> Any:
-        """Restore into the structure of ``template`` (shapes validated)."""
+        """Restore into the structure of ``template`` (shapes validated,
+        per-leaf crc32 verified against the manifest)."""
         self.wait()
         if step is None:
             step = self.latest_step()
@@ -163,9 +194,55 @@ class CheckpointManager:
             raise FileNotFoundError(
                 f"no checkpoints in {self.directory!r}")
         path = os.path.join(self.directory, f"step_{step}")
-        arrays = np.load(os.path.join(path, ARRAYS))
-        flat = {k: arrays[k] for k in arrays.files}
+        flat = self.retry.call(self._read_verified, path,
+                               op="ckpt.restore")
         return _unflatten_like(template, flat)
+
+    def _read_verified(self, path: str) -> Dict[str, np.ndarray]:
+        """Load ``arrays.npz`` with integrity checking: a truncated or
+        corrupted snapshot fails loudly with the checkpoint path and the
+        offending LEAF name — never an opaque zlib/zipfile traceback
+        from deep inside numpy. Checkpoints written before the checksum
+        format (no ``crc32`` in the manifest) load unverified."""
+        faults.point("ckpt.restore")
+        with open(os.path.join(path, MANIFEST)) as f:
+            manifest = json.load(f)
+        crcs = manifest.get("crc32", {})
+        try:
+            arrays = np.load(os.path.join(path, ARRAYS))
+        except Exception as e:
+            raise ValueError(
+                f"checkpoint {path!r}: {ARRAYS} unreadable (truncated "
+                f"or corrupt): {e}") from e
+        flat = {}
+        for k in arrays.files:
+            try:
+                arr = arrays[k]
+            except Exception as e:
+                raise ValueError(
+                    f"checkpoint {path!r}: leaf {k!r} unreadable "
+                    f"(truncated or corrupt {ARRAYS}): {e}") from e
+            want = crcs.get(k)
+            if want is not None and self._crc(arr) != int(want):
+                raise ValueError(
+                    f"checkpoint {path!r}: leaf {k!r} failed its crc32 "
+                    f"check (manifest {want}, payload {self._crc(arr)}) "
+                    "— the snapshot is corrupt; restore an older step")
+            flat[k] = arr
+        missing = [k for k in manifest.get("keys", []) if k not in flat]
+        if missing:
+            raise ValueError(
+                f"checkpoint {path!r}: leaves in the manifest but "
+                f"missing from {ARRAYS}: {missing[:5]}"
+                f"{'...' if len(missing) > 5 else ''}")
+        return flat
+
+    def delete(self, step: int) -> None:
+        """Remove one step's snapshot (the supervisor's rollback path: a
+        poisoned epoch's checkpoint must stop being resumable)."""
+        self.wait()
+        shutil.rmtree(os.path.join(self.directory, f"step_{step}"),
+                      ignore_errors=True)
 
     def keys(self, step: Optional[int] = None) -> Optional[List[str]]:
         """Flat array keys stored in a checkpoint (format introspection —
@@ -276,6 +353,11 @@ class ShardedCheckpointManager(CheckpointManager):
         if jax.process_count() > 1:
             from jax.experimental import multihost_utils
             multihost_utils.sync_global_devices(f"dkt_ckpt_mkdir_{step}")
+        # injection points only — NO retry here: the sharded save runs
+        # multi-process barriers, and a single process retrying would
+        # desynchronize them (the documented reason checkpoint_async is
+        # rejected too)
+        faults.point("ckpt.write")
         np.savez(os.path.join(tmp, f"arrays_p{pid}.npz"), **flat)
         if jax.process_count() > 1:
             from jax.experimental import multihost_utils
@@ -287,6 +369,7 @@ class ShardedCheckpointManager(CheckpointManager):
                            "leaves": leaves,
                            "num_processes": jax.process_count(),
                            "metadata": metadata or {}}, f, indent=2)
+            faults.point("ckpt.rename")
             if os.path.exists(final):
                 shutil.rmtree(final)
             os.rename(tmp, final)  # atomic publish
@@ -390,6 +473,7 @@ class ShardedCheckpointManager(CheckpointManager):
             step = self.latest_step()
         if step is None:
             raise FileNotFoundError(f"no checkpoints in {self.directory!r}")
+        faults.point("ckpt.restore")
         pieces, leaves = self._load_shards(step)
 
         flat_sh, treedef = jax.tree_util.tree_flatten_with_path(shardings)
@@ -438,6 +522,7 @@ class ShardedCheckpointManager(CheckpointManager):
             step = self.latest_step()
         if step is None:
             raise FileNotFoundError(f"no checkpoints in {self.directory!r}")
+        faults.point("ckpt.restore")
         pieces, leaves = self._load_shards(step)
         flat = {}
         for key, stored in pieces.items():

@@ -70,10 +70,17 @@ class Prefetcher:
         return False
 
     def _produce(self):
+        from distkeras_tpu.resilience import faults
         for item in self._items:
             if self._stopped.is_set():
                 return
             try:
+                # chaos hook (resilience.faults): an injected Exception
+                # takes the same consumer-side re-raise path as a real
+                # fn error; a stall models a wedged loader; an injected
+                # BaseException kills the thread WITHOUT the sentinel —
+                # the dead-producer case __iter__ must detect
+                faults.point("prefetch.produce")
                 out = (item, self._fn(item), None)
             except Exception as e:  # re-raised consumer-side
                 self._put((item, None, e))
@@ -108,10 +115,22 @@ class Prefetcher:
                     # get() timeout and its own stop-flag check — once
                     # the thread is dead AND the queue is empty, nothing
                     # can arrive anymore
-                    if self._stopped.is_set() \
-                            and not self._thread.is_alive() \
-                            and self._q.empty():
-                        return       # closed mid-stream and fully drained
+                    if not self._thread.is_alive() and self._q.empty():
+                        if self._stopped.is_set():
+                            return   # closed mid-stream and fully drained
+                        # dead producer, no sentinel, nothing queued and
+                        # close() never ran: the thread died from a
+                        # non-Exception BaseException (or was killed)
+                        # before putting the sentinel. Without this check
+                        # the consumer would poll this empty queue
+                        # forever.
+                        raise RuntimeError(
+                            f"prefetch producer thread ({self._name!r}) "
+                            "died without delivering a result or the "
+                            "end-of-stream sentinel (non-Exception "
+                            "BaseException in the producer, or the "
+                            "thread was killed); the data stream is "
+                            "broken")
                     continue
                 if got is _SENTINEL:
                     return

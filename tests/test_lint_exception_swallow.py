@@ -1,0 +1,78 @@
+"""tools/lint_exception_swallow.py wired into tier-1: library code must
+not swallow ``BaseException`` (or use bare ``except:``) without
+re-raising — a silent swallow eats KeyboardInterrupt/SystemExit and
+hides injected chaos faults — and the checker itself must detect the
+patterns it claims to."""
+
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO / "tools"))
+
+from lint_exception_swallow import (  # noqa: E402
+    ALLOW_MARK, check_source, check_tree)
+
+
+def test_repo_is_free_of_exception_swallows():
+    findings = check_tree(REPO)
+    assert not findings, "\n".join(
+        f"{f}:{ln}: {msg}" for f, ln, msg in findings)
+
+
+def test_checker_flags_bare_except():
+    src = "try:\n    x = 1\nexcept:\n    pass\n"
+    findings = check_source(src, "x.py")
+    assert [(f, ln) for f, ln, _ in findings] == [("x.py", 3)]
+    assert "bare" in findings[0][2]
+
+
+def test_checker_flags_base_exception_without_reraise():
+    src = ("try:\n    x = 1\n"
+           "except BaseException as e:\n    log(e)\n")
+    findings = check_source(src, "x.py")
+    assert len(findings) == 1 and findings[0][1] == 3
+
+
+def test_checker_flags_base_exception_in_tuple():
+    src = ("try:\n    x = 1\n"
+           "except (ValueError, BaseException):\n    pass\n")
+    assert len(check_source(src, "x.py")) == 1
+
+
+def test_checker_accepts_reraise_and_exception():
+    src = (
+        "try:\n    x = 1\n"
+        "except BaseException:\n    cleanup()\n    raise\n"
+        "try:\n    y = 2\n"
+        "except Exception as e:\n    log(e)\n"      # legal boundary
+        "try:\n    z = 3\n"
+        "except BaseException as e:\n    raise RuntimeError('ctx') from e\n"
+    )
+    assert check_source(src, "x.py") == []
+
+
+def test_checker_ignores_raise_in_nested_function():
+    """A ``raise`` inside a nested def runs later, not on this
+    exception — it must not count as re-raising."""
+    src = (
+        "try:\n    x = 1\n"
+        "except BaseException as e:\n"
+        "    def later():\n        raise e\n"
+        "    stash(later)\n"
+    )
+    assert len(check_source(src, "x.py")) == 1
+
+
+def test_checker_skips_marked_lines():
+    src = (
+        "try:\n    x = 1\n"
+        f"except BaseException as e:  # {ALLOW_MARK} — consumer-side\n"
+        "    box.append(e)\n"
+    )
+    assert check_source(src, "x.py") == []
+
+
+def test_checker_reports_syntax_errors_as_findings():
+    findings = check_source("def broken(:\n", "x.py")
+    assert len(findings) == 1 and "syntax" in findings[0][2]

@@ -490,7 +490,10 @@ def test_serving_summary_keys_are_backward_compatible():
     assert set(s) == {
         "requests_finished", "tokens_generated", "tokens_per_sec",
         "decode_tokens_per_sec", "ttft_s", "latency_s", "queue_depth",
-        "slot_occupancy", "prefill_chunks", "phases"}
+        "slot_occupancy", "prefill_chunks", "phases",
+        # degradation tally ADDED by the resilience PR (pre-existing
+        # keys above are the frozen compat contract)
+        "requests_rejected", "requests_timed_out", "requests_cancelled"}
 
 
 # --- integration: prefetch gauges -------------------------------------------

@@ -1,0 +1,799 @@
+"""Chaos suite for the resilience subsystem (this PR).
+
+The invariants, each driven through REAL code paths by armed injection
+points (``resilience.faults``):
+
+  * crash at ANY registered checkpoint/data/training injection point →
+    supervised training completes with final params BITWISE-identical
+    to the uninterrupted run;
+  * transient faults heal in place via ``resilience.retry`` (no restart
+    spent);
+  * SIGTERM mid-run checkpoints the current epoch and exits cleanly
+    (in-process handler test + a real subprocess exit-0 test);
+  * NaN injection triggers exactly one rollback, and the re-run is
+    bitwise-identical to the uninterrupted run;
+  * serving: deadlines expire to TIMED_OUT, overload sheds with a
+    bounded queue, and a poisoned request is CANCELLED without
+    perturbing other in-flight streams (token-identical outputs).
+"""
+
+import os
+import signal
+import subprocess
+import sys
+from pathlib import Path
+
+import jax
+import numpy as np
+import pytest
+
+from distkeras_tpu.data import Dataset
+from distkeras_tpu.models import Dense, Model, Sequential, zoo
+from distkeras_tpu.parallel import SingleTrainer
+from distkeras_tpu.resilience import (AnomalyDetected, AnomalyGuard,
+                                      InjectedFault, RetryPolicy,
+                                      TrainingSupervisor, faults, io_retry)
+from distkeras_tpu.serving import (AdmissionRejected, FIFOScheduler,
+                                   Request, RequestState, ServingEngine,
+                                   ServingMetrics)
+from distkeras_tpu.utils.callbacks import Callback
+from distkeras_tpu.utils.checkpoint import CheckpointManager
+from distkeras_tpu.utils.prefetch import Prefetcher
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+@pytest.fixture(autouse=True)
+def _fault_isolation():
+    """Every test starts and ends with a disarmed fault registry."""
+    faults.reset()
+    yield
+    faults.reset()
+
+
+# --- faults: triggers and actions -------------------------------------------
+
+
+def test_fault_nth_fires_exactly_once():
+    faults.inject("t.point", nth=2)
+    faults.point("t.point")                      # call 1: no fire
+    with pytest.raises(InjectedFault, match="t.point"):
+        faults.point("t.point")                  # call 2: fires
+    for _ in range(5):
+        faults.point("t.point")                  # never again
+    assert faults.fired("t.point") == 1
+
+
+def test_fault_every_k():
+    faults.inject("t.every", every=3)
+    fires = 0
+    for _ in range(9):
+        try:
+            faults.point("t.every")
+        except InjectedFault:
+            fires += 1
+    assert fires == 3 and faults.fired("t.every") == 3
+
+
+def test_fault_prob_is_seeded_and_reproducible():
+    def pattern():
+        faults.inject("t.prob", prob=0.5, seed=42)
+        out = []
+        for _ in range(20):
+            try:
+                faults.point("t.prob")
+                out.append(0)
+            except InjectedFault:
+                out.append(1)
+        return out
+
+    a, b = pattern(), pattern()
+    assert a == b and 0 < sum(a) < 20
+
+
+def test_fault_stall_and_custom_error():
+    faults.inject("t.stall", every=1, stall_s=0.001)
+    faults.point("t.stall")                      # stalls, returns
+    assert faults.fired("t.stall") == 1
+    faults.inject("t.err", nth=1, error=OSError("disk on fire"))
+    with pytest.raises(OSError, match="disk on fire"):
+        faults.point("t.err")
+
+
+def test_fault_corrupt_nan_only_at_corrupt_sites():
+    faults.inject("t.nan", nth=1, action="nan")
+    out = faults.corrupt("t.nan", np.ones(3, np.float32))
+    assert np.isnan(out).all()
+    # a nan spec firing at a CONTROL point is a loud usage error, not a
+    # silent no-op that consumes the trigger while injecting nothing
+    faults.inject("t.nan2", nth=1, action="nan")
+    with pytest.raises(ValueError, match="corrupt\\(\\) sites"):
+        faults.point("t.nan2")
+    clean = faults.corrupt("t.clean", np.ones(2))
+    np.testing.assert_array_equal(clean, np.ones(2))
+
+
+def test_fault_env_spec_parsing_and_catalog():
+    faults.load_env("a.b=nth:2,transient:true;c.d=prob:0.25,seed:7")
+    act = faults.active()
+    assert act["a.b"]["trigger"] == "nth:2" and act["a.b"]["transient"]
+    assert "prob:0.25" in act["c.d"]["trigger"]
+    assert {"a.b", "c.d"} <= set(faults.points())
+    with pytest.raises(ValueError, match="unknown option"):
+        faults.load_env("x=never:1")
+    with pytest.raises(ValueError, match="exactly one trigger"):
+        faults.inject("x", nth=1, every=2)
+
+
+# --- retry: backoff, classification, deadline -------------------------------
+
+
+def test_retry_heals_transient_and_respects_caps():
+    calls, sleeps = [], []
+    policy = RetryPolicy(max_attempts=4, base_delay_s=0.1, max_delay_s=0.15,
+                         seed=0, sleep=sleeps.append)
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise OSError("blip")
+        return "ok"
+
+    assert policy.call(flaky) == "ok"
+    assert len(calls) == 3 and len(sleeps) == 2
+    # full jitter: uniform over (0, min(max_delay, base * 2^k)]
+    assert 0 <= sleeps[0] <= 0.1 and 0 <= sleeps[1] <= 0.15
+
+
+def test_retry_non_retryable_raises_immediately():
+    calls = []
+    policy = RetryPolicy(max_attempts=5, sleep=lambda _: None)
+
+    def bug():
+        calls.append(1)
+        raise ValueError("a bug, not weather")
+
+    with pytest.raises(ValueError):
+        policy.call(bug)
+    assert len(calls) == 1
+    # InjectedFault honors its transient flag
+    with pytest.raises(InjectedFault):
+        policy.call(lambda: (_ for _ in ()).throw(
+            InjectedFault("x", transient=False)))
+
+
+def test_retry_exhaustion_and_deadline():
+    policy = RetryPolicy(max_attempts=3, sleep=lambda _: None, seed=1)
+    calls = []
+
+    def always():
+        calls.append(1)
+        raise OSError("down")
+
+    with pytest.raises(OSError):
+        policy.call(always)
+    assert len(calls) == 3
+    # a zero deadline forbids any backoff sleep: one attempt only
+    tight = RetryPolicy(max_attempts=5, deadline_s=0.0,
+                        sleep=lambda _: None)
+    calls.clear()
+    with pytest.raises(OSError):
+        tight.call(always)
+    assert len(calls) == 1
+
+
+# --- checkpoint hardening (satellites) --------------------------------------
+
+
+def test_stale_tmp_swept_on_init(tmp_path):
+    mgr = CheckpointManager(str(tmp_path))
+    mgr.save(0, {"a": np.ones(3)})
+    os.makedirs(tmp_path / "step_7.tmp")       # crash-mid-write debris
+    mgr2 = CheckpointManager(str(tmp_path))
+    assert not (tmp_path / "step_7.tmp").exists()
+    assert mgr2.all_steps() == [0]             # published steps untouched
+
+
+def test_truncated_arrays_fail_loudly(tmp_path):
+    mgr = CheckpointManager(str(tmp_path))
+    mgr.save(0, {"w": np.arange(1000.0)})
+    p = tmp_path / "step_0" / "arrays.npz"
+    p.write_bytes(p.read_bytes()[: p.stat().st_size // 2])
+    with pytest.raises(ValueError, match="truncated or corrupt"):
+        mgr.restore({"w": np.zeros(1000)})
+
+
+def test_crc_mismatch_names_the_leaf(tmp_path):
+    """A payload that no longer matches the manifest (bitrot, a swapped
+    file) fails naming the LEAF, not deep inside numpy."""
+    a = CheckpointManager(str(tmp_path / "a"))
+    b = CheckpointManager(str(tmp_path / "b"))
+    a.save(0, {"w": np.ones(8), "v": np.zeros(4)})
+    b.save(0, {"w": np.full(8, 7.0), "v": np.zeros(4)})
+    # swap b's arrays under a's manifest: zip-consistent but wrong bytes
+    (tmp_path / "a" / "step_0" / "arrays.npz").write_bytes(
+        (tmp_path / "b" / "step_0" / "arrays.npz").read_bytes())
+    with pytest.raises(ValueError, match="'w' failed its crc32"):
+        a.restore({"w": np.zeros(8), "v": np.zeros(4)})
+
+
+def test_pre_checksum_checkpoints_restore_unverified(tmp_path):
+    import json
+    mgr = CheckpointManager(str(tmp_path))
+    mgr.save(0, {"a": np.arange(4.0)})
+    mpath = tmp_path / "step_0" / "manifest.json"
+    man = json.loads(mpath.read_text())
+    del man["crc32"]                           # old-format manifest
+    mpath.write_text(json.dumps(man))
+    restored = mgr.restore({"a": np.zeros(4)})
+    np.testing.assert_array_equal(restored["a"], np.arange(4.0))
+
+
+def test_manager_delete_removes_step(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), max_to_keep=5)
+    for s in range(3):
+        mgr.save(s, {"a": np.full(2, s)})
+    mgr.delete(2)
+    assert mgr.all_steps() == [0, 1] and mgr.latest_step() == 1
+
+
+def test_checkpoint_write_fault_heals_via_retry(tmp_path):
+    faults.inject("ckpt.write", nth=1, transient=True)
+    mgr = CheckpointManager(str(tmp_path))
+    mgr.save(0, {"a": np.ones(2)})             # retried, durable
+    assert mgr.all_steps() == [0] and faults.fired("ckpt.write") == 1
+
+
+def test_checkpoint_restore_fault_heals_via_retry(tmp_path):
+    mgr = CheckpointManager(str(tmp_path))
+    mgr.save(0, {"a": np.ones(2)})
+    faults.inject("ckpt.restore", nth=1, transient=True)
+    out = mgr.restore({"a": np.zeros(2)})
+    np.testing.assert_array_equal(out["a"], np.ones(2))
+    assert faults.fired("ckpt.restore") == 1
+
+
+# --- prefetcher dead-producer hang (satellite) ------------------------------
+
+
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+def test_prefetcher_dead_producer_raises_not_hangs():
+    """A producer killed by a non-Exception BaseException never puts
+    the sentinel; the consumer must get a loud RuntimeError, not poll
+    an empty queue forever."""
+    faults.inject("prefetch.produce", nth=1, error=SystemExit("killed"))
+    with pytest.raises(RuntimeError, match="died without delivering"):
+        list(Prefetcher(lambda x: x, [1, 2, 3]))
+
+
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+def test_prefetcher_dead_producer_after_partial_stream():
+    faults.inject("prefetch.produce", nth=3, error=SystemExit("killed"))
+    got = []
+    with pytest.raises(RuntimeError, match="died without"):
+        for item, value in Prefetcher(lambda x: x * 10, [1, 2, 3, 4]):
+            got.append(value)
+    assert got == [10, 20]                      # pre-crash results kept
+
+
+def test_prefetcher_plain_exception_still_original_type():
+    faults.inject("prefetch.produce", nth=2,
+                  error=KeyError("shard gone"))
+    it = iter(Prefetcher(lambda x: x, "ab"))
+    assert next(it) == ("a", "a")
+    with pytest.raises(KeyError, match="shard gone"):
+        next(it)
+
+
+# --- scheduler hardening (satellite) ----------------------------------------
+
+
+def _sched_req(rid, **kw):
+    return Request(rid=rid, prompt=np.arange(4, dtype=np.int32),
+                   max_new_tokens=4, **kw)
+
+
+def test_scheduler_double_release_raises():
+    sched = FIFOScheduler(2)
+    r = _sched_req(0)
+    sched.submit(r)
+    sched.admit()
+    sched.release(r)
+    assert sched.occupied == 0
+    with pytest.raises(RuntimeError, match="double release"):
+        sched.release(r)
+    assert len(sched._free) == 2               # slot freed exactly once
+
+
+def test_scheduler_release_queued_raises():
+    sched = FIFOScheduler(1)
+    a, b = _sched_req(0), _sched_req(1)
+    sched.submit(a)
+    sched.submit(b)
+    sched.admit()                              # a admitted, b queued
+    with pytest.raises(RuntimeError, match="holds no slot"):
+        sched.release(b)
+
+
+def test_scheduler_cancel_from_every_live_state():
+    sched = FIFOScheduler(2)
+    reqs = [_sched_req(i) for i in range(3)]
+    for r in reqs:
+        sched.submit(r)
+    sched.admit()                              # 0,1 prefilling; 2 queued
+    sched.to_decoding(reqs[0])
+    sched.cancel(reqs[2])                      # queued
+    sched.cancel(reqs[1], RequestState.TIMED_OUT)   # prefilling
+    sched.cancel(reqs[0])                      # decoding
+    assert reqs[2].state is RequestState.CANCELLED
+    assert reqs[1].state is RequestState.TIMED_OUT
+    assert sched.occupied == 0 and not sched.pending
+    with pytest.raises(RuntimeError):
+        sched.cancel(reqs[0])                  # terminal: double-free guard
+    with pytest.raises(ValueError, match="target state"):
+        sched.cancel(_sched_req(9), RequestState.FINISHED)
+
+
+def test_scheduler_bounded_queue_sheds():
+    sched = FIFOScheduler(1, max_queue=2)
+    sched.submit(_sched_req(0))
+    sched.submit(_sched_req(1))
+    with pytest.raises(AdmissionRejected, match="full"):
+        sched.submit(_sched_req(2))
+    assert sched.queue_depth == 2
+
+
+# --- supervised training: the chaos invariant -------------------------------
+
+
+def _ds(n=512):
+    rs = np.random.RandomState(0)
+    X = rs.randn(n, 8).astype(np.float32)
+    y = (X.sum(axis=1) > 0).astype(np.int64)
+    return Dataset({"features": X, "label": y})
+
+
+def _mlp():
+    return Model.build(Sequential([Dense(16, activation="relu"), Dense(2)]),
+                       (8,), seed=0)
+
+
+def _trainer(ckpt=None, resume=False, num_epoch=4, **kw):
+    return SingleTrainer(
+        _mlp(), batch_size=32, num_epoch=num_epoch,
+        worker_optimizer="adam", learning_rate=0.01,
+        loss="sparse_categorical_crossentropy_from_logits",
+        checkpoint_dir=ckpt, resume=resume, **kw)
+
+
+def _assert_trees_equal(a, b):
+    la = jax.tree_util.tree_leaves(a)
+    lb = jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+@pytest.fixture(scope="module")
+def oracle_params():
+    """Final params of the UNINTERRUPTED 4-epoch run — the bitwise
+    oracle every chaos run must reproduce."""
+    return _trainer().train(_ds()).params
+
+
+@pytest.mark.parametrize("fault_point",
+                         ["ckpt.write", "ckpt.rename", "train.epoch",
+                          "prefetch.produce"])
+def test_crash_at_any_point_resumes_bitwise(tmp_path, oracle_params,
+                                            fault_point):
+    """THE chaos invariant: a hard (non-transient) fault at any
+    registered training-path injection point kills train(); the
+    supervisor restarts with resume=True and the final params are
+    bitwise-identical to the uninterrupted run."""
+    faults.inject(fault_point, nth=2)          # after epoch 0 durably saved
+    tr = _trainer(ckpt=str(tmp_path / "ck"))
+    sup = TrainingSupervisor(tr, max_restarts=2,
+                             handle_signals=())
+    result = sup.run(_ds())
+    assert result.restarts == 1 and not result.preempted
+    assert faults.fired(fault_point) == 1
+    _assert_trees_equal(result.model.params, oracle_params)
+    # no crash debris: stale tmp dirs were swept on the resume path
+    assert not [p for p in (tmp_path / "ck").iterdir()
+                if p.name.endswith(".tmp")]
+
+
+def test_transient_fault_heals_without_restart(tmp_path, oracle_params):
+    """A retryable blip costs a backoff, not a restart: the supervisor
+    never intervenes and the run still matches the oracle."""
+    faults.inject("ckpt.write", nth=2, transient=True)
+    tr = _trainer(ckpt=str(tmp_path / "ck"))
+    sup = TrainingSupervisor(tr, handle_signals=())
+    result = sup.run(_ds())
+    assert result.restarts == 0 and result.rollbacks == 0
+    assert faults.fired("ckpt.write") == 1
+    _assert_trees_equal(result.model.params, oracle_params)
+
+
+def test_restart_budget_exhausts_loudly(tmp_path):
+    faults.inject("train.epoch", every=1)      # every attempt dies
+    tr = _trainer(ckpt=str(tmp_path / "ck"))
+    sup = TrainingSupervisor(tr, max_restarts=2, handle_signals=())
+    with pytest.raises(InjectedFault):
+        sup.run(_ds())
+    assert sup.restarts == 2                   # budget spent, then surfaced
+
+
+def test_supervisor_requires_checkpoint_dir():
+    with pytest.raises(ValueError, match="checkpoint_dir"):
+        TrainingSupervisor(_trainer())
+
+
+def test_supervisor_rejects_async_checkpoints_with_guard(tmp_path):
+    tr = _trainer(ckpt=str(tmp_path), checkpoint_async=True)
+    with pytest.raises(ValueError, match="checkpoint_async"):
+        TrainingSupervisor(tr, anomaly_guard=AnomalyGuard())
+
+
+# --- preemption (SIGTERM) ---------------------------------------------------
+
+
+class _SigtermAt(Callback):
+    """Deliver a real SIGTERM to this process at the end of an epoch."""
+
+    def __init__(self, epoch):
+        self.epoch = epoch
+
+    def on_epoch_end(self, epoch, logs=None):
+        if epoch == self.epoch:
+            os.kill(os.getpid(), signal.SIGTERM)
+
+
+def test_sigterm_checkpoints_current_epoch_and_stops(tmp_path,
+                                                     oracle_params):
+    """In-process preemption: the supervisor's SIGTERM handler requests
+    a preempt, the epoch loop checkpoints the CURRENT epoch (between
+    checkpoint_every boundaries) and returns cleanly; a resumed run
+    completes bitwise-identical to the uninterrupted one."""
+    ckpt = str(tmp_path / "ck")
+    tr = _trainer(ckpt=ckpt, num_epoch=4, checkpoint_every=10,
+                  callbacks=[_SigtermAt(1)])
+    result = TrainingSupervisor(tr).run(_ds())
+    assert result.preempted and tr.preempted
+    # epoch 1 was checkpointed despite checkpoint_every=10
+    assert CheckpointManager(ckpt).latest_step() == 1
+    resumed = _trainer(ckpt=ckpt, num_epoch=4, resume=True).train(_ds())
+    _assert_trees_equal(resumed.params, oracle_params)
+
+
+def test_standing_preempt_notice_survives_train_entry(tmp_path,
+                                                      oracle_params):
+    """A preemption notice delivered while no epoch loop is running
+    (e.g. SIGTERM between a crash and the supervisor's resumed run)
+    must stop the NEXT run at its first epoch — consumed when acted
+    on, never silently dropped at train() entry."""
+    ckpt = str(tmp_path / "ck")
+    tr = _trainer(ckpt=ckpt, num_epoch=4, checkpoint_every=10)
+    tr.request_preempt()                       # standing notice
+    tr.train(_ds())
+    assert tr.preempted
+    assert CheckpointManager(ckpt).latest_step() == 0
+    # the notice was CONSUMED when acted on: the SAME trainer resumes
+    # and completes normally instead of immediately re-preempting
+    tr.resume = True
+    resumed = tr.train(_ds())
+    assert not tr.preempted
+    _assert_trees_equal(resumed.params, oracle_params)
+
+
+_PREEMPT_SCRIPT = """
+import os, signal, sys
+import numpy as np
+from distkeras_tpu.data import Dataset
+from distkeras_tpu.models import Dense, Model, Sequential
+from distkeras_tpu.parallel import SingleTrainer
+from distkeras_tpu.resilience import TrainingSupervisor
+from distkeras_tpu.utils.callbacks import Callback
+
+class Kill(Callback):
+    def on_epoch_end(self, epoch, logs=None):
+        if epoch == 1:
+            os.kill(os.getpid(), signal.SIGTERM)
+
+rs = np.random.RandomState(0)
+X = rs.randn(256, 8).astype("float32")
+y = (X.sum(axis=1) > 0).astype("int64")
+m = Model.build(Sequential([Dense(8, activation="relu"), Dense(2)]),
+                (8,), seed=0)
+tr = SingleTrainer(m, batch_size=32, num_epoch=50, worker_optimizer="sgd",
+                   learning_rate=0.1,
+                   loss="sparse_categorical_crossentropy_from_logits",
+                   checkpoint_dir=sys.argv[1], callbacks=[Kill()])
+TrainingSupervisor(tr, on_preempt="exit").run(
+    Dataset({"features": X, "label": y}))
+raise SystemExit("unreachable: preemption should have exited 0")
+"""
+
+
+def test_sigterm_subprocess_exits_zero(tmp_path):
+    """The batch-job contract end to end in a REAL process: SIGTERM
+    mid-run → checkpoint → exit code 0 (never the 50-epoch run)."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "-c", _PREEMPT_SCRIPT, str(tmp_path / "ck")],
+        cwd=str(REPO), env=env, capture_output=True, text=True,
+        timeout=180)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert CheckpointManager(str(tmp_path / "ck")).latest_step() == 1
+
+
+# --- anomaly guard: NaN rollback --------------------------------------------
+
+
+def test_nan_injection_triggers_rollback_exactly_once(tmp_path,
+                                                      oracle_params):
+    faults.inject("train.loss", nth=3, action="nan")   # poison epoch 2
+    tr = _trainer(ckpt=str(tmp_path / "ck"))
+    sup = TrainingSupervisor(tr, anomaly_guard=AnomalyGuard(),
+                             rollback_budget=1, max_restarts=0,
+                             handle_signals=())
+    result = sup.run(_ds())
+    assert result.rollbacks == 1 and result.restarts == 0
+    assert faults.fired("train.loss") == 1
+    # the poisoned epoch re-ran clean from the last good snapshot:
+    # bitwise-identical to the uninterrupted run (the NaN only ever
+    # touched the host-side loss, and its checkpoint was rolled back)
+    _assert_trees_equal(result.model.params, oracle_params)
+
+
+def test_rollback_budget_exhausts_loudly(tmp_path):
+    faults.inject("train.loss", every=1, action="nan")  # every epoch bad
+    tr = _trainer(ckpt=str(tmp_path / "ck"))
+    sup = TrainingSupervisor(tr, anomaly_guard=AnomalyGuard(),
+                             rollback_budget=1, max_restarts=0,
+                             handle_signals=())
+    with pytest.raises(AnomalyDetected):
+        sup.run(_ds())
+    assert sup.rollbacks == 1
+
+
+def test_anomaly_guard_raises_standalone(tmp_path):
+    """Without a supervisor the guard is still a loud NaN tripwire."""
+    faults.inject("train.loss", nth=1, action="nan")
+    tr = _trainer(ckpt=str(tmp_path / "ck"),
+                  callbacks=[AnomalyGuard()])
+    with pytest.raises(AnomalyDetected, match="non-finite"):
+        tr.train(_ds())
+
+
+def test_anomaly_guard_spike_detection():
+    guard = AnomalyGuard(spike_factor=5.0, window=4)
+    for epoch, loss in enumerate([1.0, 0.9, 0.8]):
+        guard.on_epoch_end(epoch, {"loss": loss})
+    guard.on_epoch_end(3, {"loss": 2.0})       # above median, below 5x
+    with pytest.raises(AnomalyDetected, match="spike"):
+        guard.on_epoch_end(4, {"loss": 50.0})
+    with pytest.raises(ValueError, match="spike_factor"):
+        AnomalyGuard(spike_factor=0.5)
+
+
+# --- serving degradation ----------------------------------------------------
+
+V, S = 29, 12
+PATTERN = np.array([3, 1, 4, 1, 5, 9, 2, 6, 5, 3, 5, 8])
+
+
+@pytest.fixture(scope="module")
+def lm():
+    """Untrained LM: token-IDENTITY comparisons only ever compare two
+    runs of the same per-slot programs, so no fitting is needed."""
+    return Model.build(
+        zoo.transformer_lm(V, d_model=32, num_heads=4, num_layers=2,
+                           mlp_ratio=2, use_rope=True), (S,), seed=2)
+
+
+def _drain(eng, max_steps=400):
+    done = {}
+    for _ in range(max_steps):
+        for r in eng.step():
+            done[r.rid] = r
+        if not eng.scheduler.pending:
+            return done
+    raise AssertionError("engine failed to drain")
+
+
+def test_deadline_expires_queued_request_to_timed_out(lm):
+    box = [0.0]
+    eng = ServingEngine(lm, num_slots=1, max_len=32,
+                        metrics=ServingMetrics(clock=lambda: box[0]))
+    r1 = eng.submit(PATTERN[:4], 6)                       # no deadline
+    r2 = eng.submit(PATTERN[:4], 6, deadline_s=5.0)       # will starve
+    box[0] = 10.0                                         # r2 expired
+    done = _drain(eng)
+    assert done[r2].state is RequestState.TIMED_OUT
+    assert done[r2].generated == []                       # never admitted
+    assert done[r1].state is RequestState.FINISHED
+    assert eng.metrics.requests_timed_out == 1
+    assert eng.metrics.summary()["requests_timed_out"] == 1
+    with pytest.raises(ValueError, match="deadline_s"):
+        eng.submit(PATTERN[:3], 2, deadline_s=0.0)
+
+
+def test_deadline_mid_decode_keeps_partial_tokens_frees_slot(lm):
+    box = [0.0]
+    eng = ServingEngine(lm, num_slots=1, max_len=32,
+                        metrics=ServingMetrics(clock=lambda: box[0]))
+    r1 = eng.submit(PATTERN[:4], 20, deadline_s=5.0)
+    done = {}
+    for _ in range(5):                         # prefill + a few decodes
+        for r in eng.step():
+            done[r.rid] = r
+    assert eng[r1].state is RequestState.DECODING
+    box[0] = 10.0                              # expire mid-decode
+    r2 = eng.submit(PATTERN[:3], 3)            # next occupant
+    done.update(_drain(eng))
+    assert done[r1].state is RequestState.TIMED_OUT
+    assert 0 < len(done[r1].generated) < 20    # partial output kept
+    assert done[r2].state is RequestState.FINISHED
+
+
+def test_overload_sheds_with_bounded_queue(lm):
+    """4x-capacity overload: the queue never exceeds max_queue, the
+    excess is shed explicitly, and every accepted request completes."""
+    eng = ServingEngine(lm, num_slots=2, max_len=32, max_queue=4)
+    accepted, rejected = [], 0
+    for _ in range(4 * (2 + 4)):               # 4x (slots + queue)
+        try:
+            accepted.append(eng.submit(PATTERN[:3], 3))
+        except AdmissionRejected:
+            rejected += 1
+    assert len(accepted) == 4 and rejected == 20
+    assert eng.scheduler.queue_depth == 4      # bounded, not growing
+    h = eng.health()
+    assert h["status"] == "saturated" and not h["accepting"]
+    assert h["requests"]["rejected"] == 20
+    done = _drain(eng)
+    assert sorted(done) == sorted(accepted)
+    assert all(done[r].state is RequestState.FINISHED for r in accepted)
+    assert eng.metrics.summary()["queue_depth"]["max"] <= 4
+    h = eng.health()
+    assert h["status"] == "ok" and h["queue_depth"] == 0
+    assert "telemetry" in h and "metrics" in h["telemetry"]
+
+
+def _run_isolation(lm, poison):
+    eng = ServingEngine(lm, num_slots=2, max_len=32)
+    r1 = eng.submit(PATTERN[:4], 8)
+    while not eng.scheduler.running:           # r1 decoding first
+        eng.step()
+    if poison:
+        faults.inject("serving.prefill", nth=1,
+                      error=ValueError("poisoned prompt"))
+    r2 = eng.submit(PATTERN[:5], 6)
+    done = _drain(eng)
+    return done[r1], done[r2]
+
+
+def test_poisoned_request_is_isolated_token_identically(lm):
+    """A request whose prefill dies is CANCELLED and its slot recycled;
+    the in-flight stream's output is TOKEN-IDENTICAL to the run where
+    the neighbour was healthy."""
+    clean_r1, clean_r2 = _run_isolation(lm, poison=False)
+    faults.reset()
+    r1, r2 = _run_isolation(lm, poison=True)
+    assert r2.state is RequestState.CANCELLED
+    assert isinstance(r2.error, ValueError)
+    assert faults.fired("serving.prefill") == 1
+    assert clean_r2.state is RequestState.FINISHED
+    np.testing.assert_array_equal(r1.tokens, clean_r1.tokens)
+    assert r1.state is RequestState.FINISHED
+
+
+def test_poisoned_request_slot_is_reused(lm):
+    eng = ServingEngine(lm, num_slots=1, max_len=32)
+    faults.inject("serving.prefill", nth=1, error=ValueError("bad"))
+    bad = eng.submit(PATTERN[:4], 4)
+    ok = eng.submit(PATTERN[:4], 4)
+    done = _drain(eng)
+    assert done[bad].state is RequestState.CANCELLED
+    assert done[ok].state is RequestState.FINISHED
+    assert eng.metrics.requests_cancelled == 1
+    assert eng.scheduler.occupied == 0
+
+
+def test_injected_decode_error_is_wholesale_retryable(lm):
+    """A decode-step error is batch-wide: step() raises BEFORE mutating
+    engine state, so simply stepping again completes every request with
+    the same tokens as a fault-free engine."""
+    ref_eng = ServingEngine(lm, num_slots=2, max_len=32)
+    ra = ref_eng.submit(PATTERN[:4], 6)
+    rb = ref_eng.submit(PATTERN[:5], 5)
+    ref = _drain(ref_eng)
+
+    eng = ServingEngine(lm, num_slots=2, max_len=32)
+    a = eng.submit(PATTERN[:4], 6)
+    b = eng.submit(PATTERN[:5], 5)
+    faults.inject("serving.decode", nth=3)
+    errors, done = 0, {}
+    for _ in range(400):
+        try:
+            for r in eng.step():
+                done[r.rid] = r
+        except InjectedFault:
+            errors += 1
+        if not eng.scheduler.pending:
+            break
+    assert errors == 1
+    np.testing.assert_array_equal(done[a].tokens, ref[ra].tokens)
+    np.testing.assert_array_equal(done[b].tokens, ref[rb].tokens)
+
+
+def test_run_raises_on_degraded_request(lm):
+    """run()'s plain {rid: tokens} return must never pass a degraded
+    (timed-out/cancelled) request off as a finished one."""
+    from distkeras_tpu.serving import DegradedRequest
+    box = [0.0]
+    eng = ServingEngine(lm, num_slots=1, max_len=32,
+                        metrics=ServingMetrics(clock=lambda: box[0]))
+    eng.submit(PATTERN[:4], 6, deadline_s=2.0)
+    box[0] = 5.0
+    with pytest.raises(DegradedRequest, match="timed_out"):
+        eng.run(max_steps=50)
+    # opt-in acceptance of partial tokens
+    box2 = [0.0]
+    eng2 = ServingEngine(lm, num_slots=1, max_len=32,
+                         metrics=ServingMetrics(clock=lambda: box2[0]))
+    rid2 = eng2.submit(PATTERN[:4], 6, deadline_s=2.0)
+    box2[0] = 5.0
+    out = eng2.run(max_steps=50, on_degraded="return")
+    np.testing.assert_array_equal(out[rid2], PATTERN[:4])  # prompt only
+    with pytest.raises(ValueError, match="on_degraded"):
+        eng2.run(on_degraded="bogus")
+
+
+def test_engine_cancel_api(lm):
+    eng = ServingEngine(lm, num_slots=2, max_len=32)
+    keep = eng.submit(PATTERN[:4], 5)
+    drop = eng.submit(PATTERN[:5], 5)
+    while not eng.scheduler.running:
+        eng.step()
+    req = eng.cancel(drop)
+    assert req.state is RequestState.CANCELLED
+    with pytest.raises(KeyError):
+        eng[drop]                              # evicted from the engine
+    done = _drain(eng)
+    assert done[keep].state is RequestState.FINISHED
+
+
+def test_slow_prefill_stall_does_not_break_engine(lm):
+    """The injected slow-prefill scenario: iterations get slower but
+    every request still completes (the load-shedding/deadline levers
+    are what a deployment would arm on top)."""
+    faults.inject("serving.prefill", every=2, stall_s=0.001)
+    eng = ServingEngine(lm, num_slots=2, max_len=32, prefill_chunk=2)
+    rids = [eng.submit(PATTERN[:6], 3), eng.submit(PATTERN[:5], 3)]
+    done = _drain(eng)
+    assert all(done[r].state is RequestState.FINISHED for r in rids)
+    assert faults.fired("serving.prefill") >= 1
+
+
+# --- data-fetch retry (sharded stream) --------------------------------------
+
+
+def test_sharded_fetch_transient_fault_heals():
+    from distkeras_tpu.data.sharded import ShardedDataset
+    rs = np.random.RandomState(0)
+    X = rs.randn(256, 8).astype(np.float32)
+    y = (X.sum(axis=1) > 0).astype(np.int64)
+    shards = ShardedDataset.from_datasets([
+        Dataset({"features": X[:128], "label": y[:128]}),
+        Dataset({"features": X[128:], "label": y[128:]}),
+    ])
+    faults.inject("data.fetch", nth=1, transient=True)
+    tr = _trainer(num_epoch=2)
+    model = tr.train(shards)
+    assert faults.fired("data.fetch") == 1
+    assert np.isfinite(tr.get_history().losses()).all()
+    assert model is not None

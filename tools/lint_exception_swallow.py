@@ -1,0 +1,132 @@
+#!/usr/bin/env python
+"""Static check: library code must not swallow the un-catchable.
+
+The resilience subsystem's contract is that failures are CLASSIFIED —
+retryable IO errors heal, crashes restart from checkpoints, anomalies
+roll back. A ``except:`` / ``except BaseException:`` handler that does
+not re-raise breaks the whole chain silently: it eats
+``KeyboardInterrupt``/``SystemExit`` (hangs instead of dying), hides
+injected chaos faults (tests pass while the code path is broken), and
+turns a crash the supervisor would recover from into undefined state.
+
+This linter walks the AST (docstrings and comments never
+false-positive) and flags, inside the ``distkeras_tpu`` package:
+
+  * bare ``except:`` handlers
+  * ``except BaseException`` handlers (alone or in a tuple)
+
+UNLESS the handler body re-raises (a ``raise`` statement in the
+handler itself — nested ``def``/``lambda`` bodies don't count: they
+run later, not on this exception). Catching ``Exception`` stays legal —
+that is the classification boundary the resilience layer is built on.
+
+A justified swallow (e.g. a worker thread stashing the error for the
+consumer thread to re-raise) carries the marker comment
+``lint: allow-swallow`` on the ``except`` line — same pattern as
+``lint_timing.py`` / ``lint_backend_forks.py``.
+
+Scope is LIBRARY code only: ``bench.py``, ``examples/``, ``tools/`` and
+tests are driver code. Exit status 1 when findings exist (wired into
+tier-1 as ``tests/test_lint_exception_swallow.py``).
+"""
+
+from __future__ import annotations
+
+import ast
+import sys
+from pathlib import Path
+from typing import List, Tuple
+
+ALLOW_MARK = "lint: allow-swallow"
+
+#: paths scanned, relative to the repo root (library code only)
+SCAN = ("distkeras_tpu",)
+
+Finding = Tuple[str, int, str]
+
+
+def _mentions_base_exception(type_node) -> bool:
+    """Does the handler's type expression name BaseException (directly
+    or as a tuple element)?"""
+    if type_node is None:
+        return False
+    nodes = (type_node.elts if isinstance(type_node, ast.Tuple)
+             else [type_node])
+    return any(isinstance(n, ast.Name) and n.id == "BaseException"
+               for n in nodes)
+
+
+def _reraises(handler: ast.ExceptHandler) -> bool:
+    """A ``raise`` anywhere in the handler body counts as re-raising —
+    EXCEPT inside nested function/class bodies, which execute later,
+    not while this exception is in flight."""
+    stack = list(handler.body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, ast.Raise):
+            return True
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.ClassDef)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+    return False
+
+
+def check_source(src: str, rel: str) -> List[Finding]:
+    """Findings for one file's source text."""
+    try:
+        tree = ast.parse(src, filename=rel)
+    except SyntaxError as e:  # a broken file is its own finding
+        return [(rel, e.lineno or 0, f"syntax error: {e.msg}")]
+    lines = src.splitlines()
+    out: List[Finding] = []
+
+    def allowed(node: ast.AST) -> bool:
+        ln = getattr(node, "lineno", 0)
+        return 0 < ln <= len(lines) and ALLOW_MARK in lines[ln - 1]
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        bare = node.type is None
+        base = _mentions_base_exception(node.type)
+        if not (bare or base):
+            continue
+        if _reraises(node) or allowed(node):
+            continue
+        what = "bare 'except:'" if bare else "'except BaseException'"
+        out.append((rel, node.lineno,
+                    f"{what} without re-raise swallows "
+                    "KeyboardInterrupt/SystemExit and injected faults — "
+                    "catch Exception (the classification boundary), "
+                    "re-raise, or mark the line with "
+                    f"'# {ALLOW_MARK}'"))
+    return out
+
+
+def check_tree(root: Path) -> List[Finding]:
+    findings: List[Finding] = []
+    for entry in SCAN:
+        p = root / entry
+        files = sorted(p.rglob("*.py")) if p.is_dir() \
+            else ([p] if p.exists() else [])
+        for f in files:
+            rel = str(f.relative_to(root))
+            findings.extend(check_source(f.read_text(), rel))
+    return findings
+
+
+def main(argv=None) -> int:
+    root = Path(argv[0]) if argv else Path(__file__).resolve().parent.parent
+    findings = check_tree(root)
+    for rel, lineno, msg in findings:
+        print(f"{rel}:{lineno}: {msg}")
+    if findings:
+        print(f"{len(findings)} exception-swallow finding(s); see "
+              f"tools/lint_exception_swallow.py", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))
