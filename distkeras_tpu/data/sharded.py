@@ -118,6 +118,23 @@ class ShardedDataset:
         rs = np.random.RandomState(seed + 7919 * (epoch + 1))
         return list(rs.permutation(self.num_shards))
 
+    def epoch_items(self, start_epoch: int, num_epoch: int, seed: int,
+                    shuffle: bool) -> List[tuple]:
+        """The flattened ``(epoch, shard_idx, is_epoch_last)`` visit
+        sequence for epochs ``[start_epoch, num_epoch)`` — the work list
+        a single flat ``Prefetcher`` stream iterates (overlap PR:
+        one stream spanning epoch boundaries keeps the loader AND the
+        device-staging ``place`` hook busy across epochs; a per-epoch
+        stream would stall one shard load + one H2D copy at every
+        boundary). The order derives only from ``shard_order``, so every
+        consumer shares the same shuffle-determinism formula."""
+        items = []
+        for e in range(start_epoch, num_epoch):
+            order = self.shard_order(e, seed, shuffle)
+            items += [(e, si, i == len(order) - 1)
+                      for i, si in enumerate(order)]
+        return items
+
     # NOTE deliberately no __len__: shards load lazily, so there is no
     # cheap global length (len() raising the standard TypeError also keeps
     # bool(sds) truthy — a __len__ that raises would break `if sds:`)

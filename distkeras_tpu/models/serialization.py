@@ -26,12 +26,21 @@ from distkeras_tpu.models.core import LAYER_REGISTRY, Model
 FORMAT_VERSION = "distkeras_tpu.model.v1"
 
 
+def leaf_key(path) -> str:
+    """THE flat-key formula for a pytree key path (``a/b/0/c``): the
+    one definition shared by model serialization AND every checkpoint
+    read/write path (``utils/checkpoint.py``). Save and restore derive
+    keys independently from their trees, so a drift between copies of
+    this formula would fail every leaf lookup on restore — which is why
+    there is exactly one copy."""
+    return "/".join(str(getattr(p, "key", getattr(p, "idx", p)))
+                    for p in path)
+
+
 def _flatten_with_paths(tree) -> Dict[str, np.ndarray]:
     flat = {}
     for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
-        key = "/".join(str(getattr(p, "key", getattr(p, "idx", p)))
-                       for p in path)
-        flat[key] = np.asarray(leaf)
+        flat[leaf_key(path)] = np.asarray(leaf)
     return flat
 
 
@@ -39,8 +48,7 @@ def _unflatten_like(template, flat: Dict[str, np.ndarray]):
     paths_leaves = jax.tree_util.tree_flatten_with_path(template)
     leaves = []
     for path, leaf in paths_leaves[0]:
-        key = "/".join(str(getattr(p, "key", getattr(p, "idx", p)))
-                       for p in path)
+        key = leaf_key(path)
         arr = flat[key]
         if tuple(arr.shape) != tuple(leaf.shape):
             raise ValueError(

@@ -34,7 +34,7 @@ from distkeras_tpu.parallel.engine import (
     AdagAlgo, AveragingAlgo, DistAlgorithm, DistributedEngine, DownpourAlgo,
     DynSGDAlgo, ElasticAlgo, EngineConfig, host_fetch, shard_epoch_data)
 from distkeras_tpu.parallel.mesh import make_mesh
-from distkeras_tpu.parallel.trainers import Trainer
+from distkeras_tpu.parallel.trainers import Trainer, val_logs
 from distkeras_tpu.resilience import faults
 
 
@@ -176,11 +176,9 @@ class DistributedTrainer(Trainer):
                     extra = {}
                     if validator is not None:
                         # evaluate the CENTER (the model a user would ship)
-                        extra = {k: np.asarray([float(v)]) for k, v in
-                                 host_fetch(validator(
-                                     state["center"]["params"],
-                                     _val_state(state["worker"]["state"]))
-                                 ).items()}
+                        extra = val_logs(host_fetch(validator(
+                            state["center"]["params"],
+                            _val_state(state["worker"]["state"]))))
                     losses, mets = host_fetch(losses), host_fetch(mets)
                     self.history.append_epoch(loss=losses, **mets, **extra)
                     # cadence check BEFORE extract_model: the full-state

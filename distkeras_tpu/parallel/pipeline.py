@@ -539,7 +539,12 @@ class PipelineTrainer:
         else:
             Xv = np.asarray(vd[self.features_col])
             yv = np.asarray(vd[self.label_col])
-        Xv, yv = jnp.asarray(Xv), jnp.asarray(yv)
+        # device-cached across epochs AND train() calls (supervisor
+        # restarts), keyed on dataset identity — trainers.py holds the
+        # one copy of the invalidation rule
+        from distkeras_tpu.parallel.trainers import cache_validation_on_device
+        Xv, yv = cache_validation_on_device(self, np.asarray(Xv),
+                                            np.asarray(yv))
         loss_fn = self.eval_loss
         metric_fns = self._metric_fns() or {}
         lm = self.lm
@@ -737,40 +742,54 @@ class PipelineTrainer:
         # trainers.epoch_exit: consumed when acted on)
         self.preempted = False
         self._pending_weights = None
-        self._weights_fn = lambda: (jax.device_get(carry_box[0][0]), {})
+        self._weights_fn = lambda: (  # callback API: explicit user fetch
+            jax.device_get(carry_box[0][0]), {})  # lint: allow-host-sync
         cbs = CallbackList(self.callbacks, self)
         cbs.train_begin()
         self.history.record_training_start()
         tape.train_begin()
         try:
-            from distkeras_tpu.parallel.trainers import epoch_exit
+            from distkeras_tpu.obs import timed_stream
+            from distkeras_tpu.parallel.trainers import epoch_exit, val_logs
             from distkeras_tpu.resilience import faults
-            for epoch in range(start_epoch, self.num_epoch):
+            from distkeras_tpu.utils.prefetch import Prefetcher, \
+                device_stager
+
+            def assemble(epoch):
+                # same shuffle-seed convention as Trainer._epoch_perm
+                perm = (np.random.RandomState(self.seed + 1000 * epoch)
+                        .permutation(len(X))
+                        if self.shuffle_each_epoch else None)
+                return stack_batches(X, Y, self.batch_size, perm)
+
+            # epoch e+1's shuffle gather + stacking + sharded H2D staging
+            # run on the loader thread while the device trains epoch e
+            # (docs/overlap.md; depth=1 — a chunk is the whole stacked
+            # epoch, one-ahead is full overlap). device_put of the
+            # numpy stack DIRECTLY with the target sharding — the old
+            # jax.device_put(jnp.asarray(Xs)) first materialized a
+            # default-device copy, then moved it (double host copy)
+            stream = Prefetcher(assemble,
+                                range(start_epoch, self.num_epoch),
+                                depth=1, place=device_stager(data_sh),
+                                name="pipeline-feed")
+            for epoch, (xb, yb, nsteps) in timed_stream(stream, tape):
                 # chaos hook: a mid-training crash at an arbitrary epoch
                 faults.point("train.epoch")
-                with tape.phase("data_wait"):
-                    # same shuffle-seed convention as Trainer._epoch_perm
-                    perm = (np.random.RandomState(self.seed + 1000 * epoch)
-                            .permutation(len(X))
-                            if self.shuffle_each_epoch else None)
-                    Xs, Ys, nsteps = stack_batches(X, Y, self.batch_size,
-                                                   perm)
                 with tape.phase("device"):
-                    xb = jax.device_put(jnp.asarray(Xs), data_sh)
-                    yb = jax.device_put(jnp.asarray(Ys), data_sh)
                     carry, (losses, mets) = run_epoch(carry, xb, yb)
                     carry_box[0] = carry
-                    # chaos hook: NaN-poison the epoch losses the
-                    # anomaly guard watches
-                    losses = faults.corrupt("train.loss",
-                                            jax.device_get(losses))
-                    mets = jax.device_get(mets)
+                    # the epoch-boundary fetch (one per epoch; device_get
+                    # enqueues the per-leaf async copies itself)
+                    losses, mets = jax.device_get(  # lint: allow-host-sync
+                        (losses, mets))
+                # chaos hook: NaN-poison the epoch losses the
+                # anomaly guard watches
+                losses = faults.corrupt("train.loss", losses)
                 extra = {}
                 if validator is not None:
                     with tape.phase("validation"):
-                        extra = {k: np.asarray([float(v)]) for k, v in
-                                 jax.device_get(
-                                     validator(carry[0])).items()}
+                        extra = val_logs(validator(carry[0]))
                 self.history.append_epoch(loss=np.asarray(losses),
                                           **{k: np.asarray(v)
                                              for k, v in mets.items()},
@@ -813,7 +832,8 @@ class PipelineTrainer:
         if manager is not None:
             manager.wait()
 
-        self.params_ = jax.device_get(carry[0])
+        # end-of-train result fetch
+        self.params_ = jax.device_get(carry[0])  # lint: allow-host-sync
         if self._pending_weights is not None:
             self.params_ = self._pending_weights[0]
         return self.params_

@@ -330,23 +330,31 @@ class SPMDTrainer(Trainer):
         tape = self._make_tape()
         tape.watch("SPMDTrainer.epoch", run_epoch)
 
-        from distkeras_tpu.utils.prefetch import Prefetcher
+        from distkeras_tpu.utils.prefetch import Prefetcher, device_stager
         validator = self._make_validator(model.module)
         cbs = self._cb_list(
             lambda: host_fetch((carry.params, carry.state)))
 
+        # loader-thread staging with the TRAINER'S data sharding: the
+        # epoch loop consumes batches already resident (or streaming)
+        # across the data axes — no inline device_put on the training
+        # thread (docs/overlap.md)
+        stage = device_stager(data_sh)
         if sharded:
             # out-of-core (data.sharded.ShardedDataset): compiled scan per
             # shard; ONE flat prefetch stream spans epoch boundaries so the
             # loader thread never idles (Trainer._sharded_stream)
-            stream = self._sharded_stream(dataset, start_epoch)
+            stream = self._sharded_stream(dataset, start_epoch, place=stage)
         else:
             # in-memory: ONE chunk per epoch; the Prefetcher overlaps the
-            # next epoch's shuffle+stack with this epoch's device scan
+            # next epoch's shuffle+stack+H2D with this epoch's device
+            # scan. depth=1: a chunk is the whole stacked epoch, and
+            # one-ahead is full overlap — deeper only multiplies the
+            # dataset's device-memory footprint
             stream = (((e, 0, True), chunk) for e, chunk in Prefetcher(
                 lambda e: stack_batches(X, y, self.batch_size,
                                         self._epoch_perm(e, len(X))),
-                range(start_epoch, self.num_epoch)))
+                range(start_epoch, self.num_epoch), depth=1, place=stage))
 
         self.record_training_start()
         tape.train_begin()
@@ -362,9 +370,15 @@ class SPMDTrainer(Trainer):
                                   "opt": carry.opt_state,
                                   "rng": carry.rng}
                     with tape.phase("checkpoint"):
-                        if self.sharded_checkpoints:
-                            # every process writes ITS shards (barriers
-                            # inside); no host gather of the full tree
+                        if self.sharded_checkpoints \
+                                or jax.process_count() == 1:
+                            # sharded: every process writes ITS shards
+                            # (barriers inside), no host gather. Dense
+                            # single-process: the manager's async-D2H
+                            # snapshot fences the device tree itself
+                            # (overlap PR) — transfers run concurrently,
+                            # and with checkpoint_async the
+                            # serialize+rename overlaps the next scan
                             manager.save(epoch, carry_tree,
                                          metadata={"epoch": epoch})
                         else:
@@ -378,21 +392,31 @@ class SPMDTrainer(Trainer):
                                 manager.save(epoch, snapshot,
                                              metadata={"epoch": epoch})
 
+                from distkeras_tpu.parallel.engine import host_async
+                from distkeras_tpu.parallel.trainers import val_logs
                 for (epoch, _, last), (Xs, Ys, S) in timed_stream(stream,
                                                                   tape):
                     # chaos hook: a mid-training crash at an arbitrary
                     # loop iteration (tests/test_resilience.py)
                     faults.point("train.epoch")
                     with tape.phase("device"):
-                        Xs = jax.device_put(Xs, data_sh)
-                        Ys = jax.device_put(Ys, data_sh)
+                        # batches arrive device-resident from the
+                        # loader thread (device_stager above); per-step
+                        # losses/metrics stay on device until the
+                        # epoch-boundary fetch (overlap PR)
                         carry, outs = run_epoch(carry, Xs, Ys)
                         losses, mets = self._split_outs(outs)
-                        l_acc.append(host_fetch(losses))
-                        m_acc.append(host_fetch(mets))
+                        host_async((losses, mets))
+                        l_acc.append(losses)
+                        m_acc.append(mets)
                     examples += int(S) * self.batch_size
                     if not last:
                         continue
+                    with tape.phase("device"):
+                        # ONE boundary fetch (collective allgather under
+                        # multi-process — same count/order on every
+                        # process as the per-shard fetches it replaces)
+                        l_acc, m_acc = host_fetch((l_acc, m_acc))
                     # chaos hook: NaN-poison the epoch losses the
                     # anomaly guard watches
                     losses = faults.corrupt(
@@ -403,10 +427,8 @@ class SPMDTrainer(Trainer):
                     extra = {}
                     if validator is not None:
                         with tape.phase("validation"):
-                            extra = {k: np.asarray([float(v)]) for k, v in
-                                     host_fetch(validator(
-                                         carry.params,
-                                         carry.state)).items()}
+                            extra = val_logs(host_fetch(validator(
+                                carry.params, carry.state)))
                     self.history.append_epoch(loss=losses, **mets, **extra)
                     saved = False
                     if manager is not None and self._should_checkpoint(epoch):

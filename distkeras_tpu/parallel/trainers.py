@@ -32,6 +32,39 @@ from distkeras_tpu.resilience import faults
 from distkeras_tpu.utils.history import History
 
 
+def val_logs(fetched_or_device) -> dict:
+    """Validator outputs -> the ``extra`` logs dict (``{key: [scalar]}``
+    float arrays) every epoch loop records. The device->host read of the
+    validation scalars happens HERE — the ONE sanctioned validation
+    fetch point shared by the whole trainer family (it runs once per
+    epoch, at the boundary, after the epoch program was dispatched)."""
+    fetched = jax.device_get(fetched_or_device)  # lint: allow-host-sync
+    return {k: np.asarray([float(v)])            # lint: allow-host-sync
+            for k, v in fetched.items()}
+
+
+def cache_validation_on_device(trainer, Xv, yv):
+    """Device-resident validation arrays, cached on ``trainer`` ACROSS
+    ``train()`` calls keyed on the ``validation_data`` object's identity
+    (plus shape/dtype): a supervised run restarting after a crash — or
+    any repeated ``train()`` on one trainer — stops re-paying the full
+    validation-set H2D copy every attempt. Shared by the ``Trainer``
+    family AND the duck-typed ``PipelineTrainer`` (one copy of the
+    invalidation rule). The cache holds the key object itself, so
+    identity can't be recycled; swapping ``validation_data`` (or a
+    shape/dtype change) invalidates. In-place mutation of a kept
+    ``validation_data`` is not detected — replace the object to change
+    the data."""
+    key = (Xv.shape, str(Xv.dtype), yv.shape, str(yv.dtype))
+    cached = getattr(trainer, "_val_device_cache", None)
+    if cached is not None and cached[0] is trainer.validation_data \
+            and cached[1] == key:
+        return cached[2]
+    arrs = (jnp.asarray(Xv), jnp.asarray(yv))
+    trainer._val_device_cache = (trainer.validation_data, key, arrs)
+    return arrs
+
+
 def epoch_exit(trainer, epoch: int, saved: bool, save_fn) -> bool:
     """Shared end-of-epoch stop logic for every epoch-loop trainer
     (``Trainer`` subclasses AND the duck-typed ``PipelineTrainer`` —
@@ -234,7 +267,8 @@ class Trainer:
             tree = multihost_utils.broadcast_one_to_all(tree)
             start = int(multihost_utils.broadcast_one_to_all(
                 np.int32(start)))
-            return jax.device_get(tree), start
+            # resume path, runs once before the loop starts
+            return jax.device_get(tree), start  # lint: allow-host-sync
         return self._restore_local(manager, template)
 
     @staticmethod
@@ -372,6 +406,9 @@ class Trainer:
         from distkeras_tpu.data.dataset import coerce_column
         return coerce_column(X), coerce_column(y)
 
+    def _device_validation_arrays(self, Xv, yv):
+        return cache_validation_on_device(self, Xv, yv)
+
     def _make_validator(self, module):
         """Jitted full-set eval: ``validator(params, state) ->
         {"val_loss": ..., "val_<metric>": ...}`` (scalars). Built once; the
@@ -386,10 +423,9 @@ class Trainer:
 
         # the arrays are jit ARGUMENTS (not closure captures) so the whole
         # validation set is not constant-folded into the executable; the
-        # asarray places them on device ONCE so epochs don't re-pay the
-        # host->device copy
-        Xv = jnp.asarray(Xv)
-        yv = jnp.asarray(yv)
+        # device cache places them ONCE per dataset — across epochs AND
+        # across train() calls (supervisor restarts)
+        Xv, yv = self._device_validation_arrays(Xv, yv)
 
         @jax.jit
         def evalf(params, state, Xv, yv):
@@ -402,19 +438,20 @@ class Trainer:
         return lambda params, state: evalf(params, state, Xv, yv)
 
     # -- out-of-core plumbing ----------------------------------------------
-    def _sharded_stream(self, sds, start_epoch: int):
+    def _sharded_stream(self, sds, start_epoch: int, place=None):
         """ONE Prefetcher over the flattened (epoch, shard) sequence of a
-        ``ShardedDataset``: yields ``((epoch, shard_idx, is_epoch_last),
-        (Xs, Ys, n_steps))``. A single flat stream keeps the background
-        loader busy ACROSS epoch boundaries (a per-epoch prefetcher would
-        stall one shard-load at every boundary), and one definition keeps
-        the shuffle determinism formula shared by every sharded trainer."""
+        ``ShardedDataset`` (``ShardedDataset.epoch_items``): yields
+        ``((epoch, shard_idx, is_epoch_last), (Xs, Ys, n_steps))``. A
+        single flat stream keeps the background loader busy ACROSS epoch
+        boundaries (a per-epoch prefetcher would stall one shard-load at
+        every boundary), and one definition keeps the shuffle determinism
+        formula shared by every sharded trainer. ``place`` stages each
+        stacked chunk onto device ON THE LOADER THREAD
+        (``prefetch.device_stager``) with a 2-deep device buffer —
+        consumers receive device-resident batches (docs/overlap.md)."""
         from distkeras_tpu.utils.prefetch import Prefetcher
-        items = []
-        for e in range(start_epoch, self.num_epoch):
-            order = sds.shard_order(e, self.seed, self.shuffle_each_epoch)
-            items += [(e, si, i == len(order) - 1)
-                      for i, si in enumerate(order)]
+        items = sds.epoch_items(start_epoch, self.num_epoch, self.seed,
+                                self.shuffle_each_epoch)
 
         from distkeras_tpu.resilience.retry import io_retry
         fetch_retry = io_retry()
@@ -437,7 +474,8 @@ class Trainer:
                     self.seed + 1000 * epoch + 31 * si).permutation(len(Xc))
             return stack_batches(Xc, yc, self.batch_size, perm)
 
-        return Prefetcher(assemble, items)
+        return Prefetcher(assemble, items, depth=2 if place else 1,
+                          place=place)
 
     # -- data plumbing -----------------------------------------------------
     def _training_arrays(self, dataset: Dataset):
@@ -501,26 +539,42 @@ class SingleTrainer(Trainer):
                  "opt": self.worker_optimizer.init(model.params),
                  "rng": jax.random.PRNGKey(self.seed)}
         tree, start_epoch = self._maybe_resume(manager, fresh)
+        # place the (numpy, when resumed) carry on device ONCE: the first
+        # epoch's runner signature then matches every later epoch's — a
+        # numpy carry on the first call plus a device carry on the next
+        # adds a second jit-cache entry and false-positives the recompile
+        # detector. The runner does not donate, so zero-copy placement is
+        # safe (unlike the SPMD/pipeline restore paths, which must copy).
+        tree = jax.tree_util.tree_map(jnp.asarray, tree)
         carry = TrainCarry(params=tree["params"], state=tree["state"],
                            opt_state=tree["opt"], rng=tree["rng"])
 
+        from distkeras_tpu.utils.prefetch import device_stager
         if sharded:
             # out-of-core: compiled scan per shard; ONE flat prefetch
             # stream spans epoch boundaries so the loader never idles
             # (Trainer._sharded_stream; reference analogue: Spark workers
-            # iterate HDFS partition rows — workers.py :: Worker.train)
-            stream = self._sharded_stream(dataset, start_epoch)
+            # iterate HDFS partition rows — workers.py :: Worker.train);
+            # the loader thread also stages each chunk onto device
+            stream = self._sharded_stream(dataset, start_epoch,
+                                          place=device_stager())
         else:
-            # in-memory: ONE chunk per epoch; epoch e+1's shuffle gather +
-            # stacking runs while the device trains epoch e
+            # in-memory: ONE chunk per epoch; epoch e+1's shuffle gather,
+            # stacking AND device staging run while the device trains
+            # epoch e. depth=1 here — a chunk is the WHOLE stacked
+            # epoch, and one-ahead already gives full overlap; deeper
+            # buffering would only multiply dataset copies in device
+            # memory (docs/overlap.md)
             stream = (((e, 0, True), chunk) for e, chunk in Prefetcher(
                 lambda e: stack_batches(X, y, self.batch_size,
                                         self._epoch_perm(e, len(X))),
-                range(start_epoch, self.num_epoch)))
+                range(start_epoch, self.num_epoch), depth=1,
+                place=device_stager()))
 
         validator = self._make_validator(model.module)
-        cbs = self._cb_list(
-            lambda: jax.device_get((carry.params, carry.state)))
+        cbs = self._cb_list(  # callback API: an explicit user-facing fetch
+            lambda: jax.device_get(  # lint: allow-host-sync
+                (carry.params, carry.state)))
         self.record_training_start()
         tape.train_begin()
         try:
@@ -538,6 +592,7 @@ class SingleTrainer(Trainer):
                              "opt": carry.opt_state, "rng": carry.rng},
                             metadata={"epoch": epoch})
 
+                from distkeras_tpu.parallel.engine import host_async
                 for (epoch, _, last), (Xs, Ys, S) in timed_stream(stream,
                                                                   tape):
                     # chaos hook: a mid-training crash at an arbitrary
@@ -545,12 +600,25 @@ class SingleTrainer(Trainer):
                     faults.point("train.epoch")
                     with tape.phase("device"):
                         carry, outs = runner(carry, Xs, Ys)
+                        # per-step loss/metric arrays STAY ON DEVICE for
+                        # the whole epoch — only the D2H transfer is
+                        # started here (non-blocking), so a multi-shard
+                        # epoch no longer pays one blocking round trip
+                        # per shard (overlap PR)
                         losses, mets = self._split_outs(outs)
-                        l_acc.append(jax.device_get(losses))
-                        m_acc.append(jax.device_get(mets))
+                        host_async((losses, mets))
+                        l_acc.append(losses)
+                        m_acc.append(mets)
                     examples += int(S) * self.batch_size
                     if not last:
                         continue
+                    with tape.phase("device"):
+                        # ONE epoch-boundary fetch of everything the
+                        # epoch accumulated (transfers already in
+                        # flight); blocking here also bounds the device
+                        # phase through the last dispatched program
+                        l_acc, m_acc = jax.device_get(  # lint: allow-host-sync
+                            (l_acc, m_acc))
                     # chaos hook: NaN-poison the epoch losses the
                     # anomaly guard watches (history/logs downstream)
                     losses = faults.corrupt(
@@ -561,10 +629,8 @@ class SingleTrainer(Trainer):
                     extra = {}
                     if validator is not None:
                         with tape.phase("validation"):
-                            extra = {k: np.asarray([float(v)]) for k, v in
-                                     jax.device_get(validator(
-                                         carry.params,
-                                         carry.state)).items()}
+                            extra = val_logs(validator(carry.params,
+                                                       carry.state))
                     self.history.append_epoch(loss=losses, **mets, **extra)
                     saved = False
                     if manager is not None and self._should_checkpoint(epoch):
@@ -588,8 +654,9 @@ class SingleTrainer(Trainer):
         if manager is not None:
             manager.wait()  # async snapshots durable before return
 
-        trained = model.replace(params=jax.device_get(carry.params),
-                                state=jax.device_get(carry.state))
+        trained = model.replace(  # end-of-train fetch of the result
+            params=jax.device_get(carry.params),  # lint: allow-host-sync
+            state=jax.device_get(carry.state))    # lint: allow-host-sync
         trained = self._apply_pending_weights(trained)
         self.master_model = trained
         return trained
@@ -655,14 +722,16 @@ class EnsembleTrainer(Trainer):
             Yk = np.stack([s[1] for s in stacked])
             carry, outs = run_epoch(carry, Xk, Yk)
             losses, mets = self._split_outs(outs)
-            # [k, steps] -> record as [steps, k]
+            # [k, steps] -> record as [steps, k]; epoch-boundary fetch
             self.history.append_epoch(
-                loss=jax.device_get(losses).T,
-                **{n: jax.device_get(v).T for n, v in mets.items()})
+                loss=jax.device_get(losses).T,  # lint: allow-host-sync
+                **{n: jax.device_get(v).T       # lint: allow-host-sync
+                   for n, v in mets.items()})
         self.record_training_stop()
 
-        params_h = jax.device_get(carry.params)
-        state_h = jax.device_get(carry.state)
+        # end-of-train result fetch
+        params_h = jax.device_get(carry.params)  # lint: allow-host-sync
+        state_h = jax.device_get(carry.state)    # lint: allow-host-sync
         self.models_ = [
             base.replace(
                 params=jax.tree_util.tree_map(lambda p: p[i], params_h),

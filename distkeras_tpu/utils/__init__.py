@@ -6,4 +6,6 @@ from distkeras_tpu.utils.callbacks import (  # noqa: F401
 from distkeras_tpu.utils.checkpoint import (  # noqa: F401
     CheckpointManager, ShardedCheckpointManager)
 from distkeras_tpu.utils.history import History  # noqa: F401
+from distkeras_tpu.utils.prefetch import (  # noqa: F401
+    Prefetcher, device_stager)
 from distkeras_tpu.utils import profiling  # noqa: F401

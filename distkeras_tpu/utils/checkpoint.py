@@ -9,22 +9,82 @@ Format: one directory per step — ``step_<N>/manifest.json`` +
 ``step_<N>/arrays.npz`` (flattened pytree paths -> numpy arrays), written to
 a temp dir and atomically renamed, so a crash mid-write never corrupts the
 latest snapshot. ``CheckpointManager`` keeps the newest ``max_to_keep``.
+
+Zero-stall saves (overlap PR, docs/overlap.md): the device->host
+snapshot runs as per-leaf ASYNC transfers fenced into snapshot-owned
+host memory before ``save()`` returns (``_snapshot_flat`` — safe
+against the epoch loop donating the checkpointed buffers right after),
+and with ``async_writes=True`` the serialize+rename overlaps the next
+epoch's compute through an ordered, bounded background write queue.
+Chaos hooks: ``ckpt.d2h`` (mid-transfer), ``ckpt.write``,
+``ckpt.rename``, ``ckpt.restore`` (resilience.faults).
 """
 
 from __future__ import annotations
 
 import json
 import os
+import queue
 import shutil
+import threading
 import zlib
 from typing import Any, Dict, List, Optional
 
 import jax
 import numpy as np
 
-from distkeras_tpu.models.serialization import _flatten_with_paths
+from distkeras_tpu.models.serialization import leaf_key
 from distkeras_tpu.resilience import faults
 from distkeras_tpu.resilience.retry import RetryPolicy, io_retry
+
+
+def _enqueue_d2h(paths_leaves) -> None:
+    """Enqueue ``copy_to_host_async`` for every device leaf (ONE copy of
+    the enqueue contract, shared by the dense and sharded save paths)
+    and hit the ``ckpt.d2h`` chaos point — the crash-mid-transfer site,
+    after the copies are in flight, before any is fenced."""
+    for _, leaf in paths_leaves:
+        if isinstance(leaf, jax.Array):
+            try:
+                leaf.copy_to_host_async()
+            except Exception:  # lint: allow-swallow — an array type
+                pass           # without async D2H just fetches synchronously
+    faults.point("ckpt.d2h")
+
+
+def _snapshot_flat(tree: Any) -> Dict[str, np.ndarray]:
+    """Flattened ``{path: host array}`` snapshot of a pytree via
+    per-leaf ASYNC device->host transfer (overlap PR, docs/overlap.md):
+
+    1. ``copy_to_host_async`` is enqueued for EVERY device leaf first,
+       so all D2H transfers run concurrently instead of each leaf
+       paying its own serial round trip (what a leaf-by-leaf
+       ``jax.device_get`` costs);
+    2. then each leaf is fenced to host memory — ``np.asarray`` on a
+       CPU-backend jax array (and on numpy views) is zero-copy, so any
+       result that does not own its buffer is copied. This is the
+       snapshot-before-donate contract: once this function returns, no
+       DEVICE buffer is read again — every ``jax.Array`` leaf lands in
+       snapshot-owned memory, so the epoch loop may immediately
+       donate/overwrite the checkpointed carry while the
+       serialize+fsync proceeds in the background. (A plain
+       owning-numpy leaf stays aliased, not copied — host trees are
+       caller-owned, and callers must not mutate them before
+       ``wait()``; same contract as the old ``device_get`` path.)
+
+    ``ckpt.d2h`` is the chaos hook for a crash mid-transfer (after the
+    copies are enqueued, before the ready-fence).
+    """
+    paths_leaves = jax.tree_util.tree_flatten_with_path(tree)[0]
+    _enqueue_d2h(paths_leaves)
+    flat = {}
+    for path, leaf in paths_leaves:
+        key = leaf_key(path)
+        arr = np.asarray(leaf)
+        if not arr.flags["OWNDATA"]:
+            arr = arr.copy()   # fence into snapshot-owned host memory
+        flat[key] = arr
+    return flat
 
 
 def _unflatten_like(template, flat):
@@ -40,8 +100,7 @@ def _unflatten_like(template, flat):
     paths_leaves = jax.tree_util.tree_flatten_with_path(template)
     leaves = []
     for path, leaf in paths_leaves[0]:
-        key = "/".join(str(getattr(p, "key", getattr(p, "idx", p)))
-                       for p in path)
+        key = leaf_key(path)
         arr = flat[key]
         if tuple(arr.shape) != tuple(np.shape(leaf)):
             raise ValueError(
@@ -64,31 +123,46 @@ ARRAYS = "arrays.npz"
 class CheckpointManager:
     """Step-indexed atomic checkpoints of arbitrary pytrees.
 
-    ``async_writes=True`` moves the disk write (npz serialize + atomic
-    rename) to a background thread so a large snapshot does not stall the
-    training loop — the device->host fetch still happens synchronously at
-    ``save()`` time (the arrays must be a consistent cut of training
-    state). Writes are serialized through one worker thread; ``wait()``
-    blocks until all queued snapshots are durable (called automatically on
-    the next ``save``/``restore``/``latest_step`` to keep ordering simple).
+    Zero-stall save path (overlap PR): ``save()`` always snapshots via
+    per-leaf async D2H (``_snapshot_flat`` — transfers overlap, and the
+    returned host copies are snapshot-owned, so the caller's donated
+    device buffers are never read after ``save()`` returns). With
+    ``async_writes=True`` the disk write (npz serialize + fsync-ish
+    atomic rename) then runs on a background worker thread OVERLAPPED
+    with the caller's next epoch: ``save()`` no longer blocks on the
+    PREVIOUS write either — writes queue in order through one worker,
+    bounded by ``max_pending`` in-flight snapshots (backpressure: a
+    disk slower than the epoch cadence stalls the loop at the bound
+    instead of growing host memory without limit). A queued write's
+    error surfaces on the next ``save()``/``wait()``. ``wait()`` blocks
+    until every queued snapshot is durable (reads — ``restore``/
+    ``latest_step`` — call it implicitly so they observe queued writes).
     """
 
     def __init__(self, directory: str, max_to_keep: int = 3,
                  async_writes: bool = False,
-                 retry: Optional[RetryPolicy] = None):
+                 retry: Optional[RetryPolicy] = None,
+                 max_pending: int = 2):
         self.directory = directory
         self.max_to_keep = int(max_to_keep)
         if self.max_to_keep < 1:
             raise ValueError(
                 f"max_to_keep must be >= 1, got {max_to_keep}")
+        if int(max_pending) < 1:
+            raise ValueError(
+                f"max_pending must be >= 1, got {max_pending}")
         os.makedirs(directory, exist_ok=True)
         # transient-IO retry (resilience.retry): a flaky write/read costs
         # a jittered backoff, not the snapshot; non-IO errors surface raw
         self.retry = io_retry() if retry is None else retry
         self._sweep_stale_tmp()
         self.async_writes = bool(async_writes)
-        self._thread = None
-        self._write_error: Optional[BaseException] = None
+        self.max_pending = int(max_pending)
+        self._q: Optional[queue.Queue] = None
+        self._worker: Optional[threading.Thread] = None
+        self._slots = threading.Semaphore(self.max_pending)
+        self._err_lock = threading.Lock()
+        self._write_errors: List[BaseException] = []
 
     def _sweep_stale_tmp(self) -> None:
         """Remove ``step_*.tmp`` dirs left by a crash mid-write: they
@@ -103,39 +177,64 @@ class CheckpointManager:
     # -- write ------------------------------------------------------------
     def save(self, step: int, tree: Any,
              metadata: Optional[Dict] = None) -> str:
-        """Snapshot ``tree`` (any pytree of arrays) at ``step``."""
-        self.wait()  # one in-flight write at a time; surfaces prior errors
-        tree = jax.device_get(tree)
-        flat = _flatten_with_paths(tree)
+        """Snapshot ``tree`` (any pytree of arrays) at ``step``.
+
+        The device->host snapshot is fenced BEFORE return (see
+        ``_snapshot_flat`` — the caller may donate the tree's device
+        buffers immediately after); the disk write is synchronous or
+        queued per ``async_writes``. Prior queued-write errors re-raise
+        here (without blocking on writes still in flight)."""
+        self._raise_write_errors()
+        flat = _snapshot_flat(tree)
         final = os.path.join(self.directory, f"step_{step}")
         if not self.async_writes:
             self.retry.call(self._write, step, flat, metadata, final,
                             op="ckpt.write")
             return final
-
-        import threading
-        self._thread = threading.Thread(
-            target=self._write_guarded, args=(step, flat, metadata, final),
-            daemon=True)
-        self._thread.start()
+        # bounded in-flight snapshots: acquire a slot (backpressure),
+        # released by the worker once this write is durable
+        self._slots.acquire()
+        self._ensure_worker()
+        self._q.put((step, flat, metadata, final))
         return final
 
     def wait(self) -> None:
-        """Block until the in-flight async write (if any) is durable; re-
-        raise its error in the caller."""
-        if self._thread is not None:
-            self._thread.join()
-            self._thread = None
-        if self._write_error is not None:
-            err, self._write_error = self._write_error, None
-            raise err
+        """Block until every queued async write is durable; re-raise the
+        first queued error in the caller."""
+        if self._q is not None:
+            self._q.join()
+        self._raise_write_errors()
 
-    def _write_guarded(self, step, flat, metadata, final):
-        try:
-            self.retry.call(self._write, step, flat, metadata, final,
-                            op="ckpt.write")
-        except BaseException as e:  # lint: allow-swallow — surfaced on
-            self._write_error = e   # the next wait()/save()
+    def _raise_write_errors(self) -> None:
+        with self._err_lock:
+            if not self._write_errors:
+                return
+            err = self._write_errors[0]
+            self._write_errors = []
+        raise err
+
+    def _ensure_worker(self) -> None:
+        if self._worker is not None and self._worker.is_alive():
+            return
+        self._q = self._q or queue.Queue()
+        self._worker = threading.Thread(target=self._drain_writes,
+                                        daemon=True)
+        self._worker.start()
+
+    def _drain_writes(self) -> None:
+        """The single writer thread: writes publish in submission order
+        (atomic-rename ordering and ``_gc`` stay race-free)."""
+        while True:
+            step, flat, metadata, final = self._q.get()
+            try:
+                self.retry.call(self._write, step, flat, metadata, final,
+                                op="ckpt.write")
+            except BaseException as e:  # lint: allow-swallow — surfaced
+                with self._err_lock:    # on the next wait()/save()
+                    self._write_errors.append(e)
+            finally:
+                self._slots.release()
+                self._q.task_done()
 
     @staticmethod
     def _crc(arr: np.ndarray) -> int:
@@ -247,6 +346,7 @@ class CheckpointManager:
     def keys(self, step: Optional[int] = None) -> Optional[List[str]]:
         """Flat array keys stored in a checkpoint (format introspection —
         e.g. distinguishing params-only snapshots from full-carry ones)."""
+        self.wait()  # an explicit step may still be in the write queue
         if step is None:
             step = self.latest_step()
         if step is None:
@@ -256,6 +356,7 @@ class CheckpointManager:
         return list(arrays.files)
 
     def metadata(self, step: Optional[int] = None) -> Dict:
+        self.wait()  # an explicit step may still be in the write queue
         if step is None:
             step = self.latest_step()
         path = os.path.join(self.directory, f"step_{step}", MANIFEST)
@@ -317,11 +418,16 @@ class ShardedCheckpointManager(CheckpointManager):
     def save(self, step: int, tree: Any,
              metadata: Optional[Dict] = None) -> str:
         self.wait()
+        # per-shard async D2H first (same overlap as _snapshot_flat: all
+        # shard transfers in flight before any is fenced by np.asarray
+        # below); the write itself stays synchronous — multi-process
+        # barriers must stay on the training thread
+        paths_leaves = jax.tree_util.tree_flatten_with_path(tree)[0]
+        _enqueue_d2h(paths_leaves)
         flat = {}
         leaves = {}
-        for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
-            key = "/".join(str(getattr(p, "key", getattr(p, "idx", p)))
-                           for p in path)
+        for path, leaf in paths_leaves:
+            key = leaf_key(path)
             shape = tuple(np.shape(leaf))
             dtype = (leaf.dtype if isinstance(leaf, jax.Array)
                      else np.asarray(leaf).dtype)
@@ -479,8 +585,7 @@ class ShardedCheckpointManager(CheckpointManager):
         flat_sh, treedef = jax.tree_util.tree_flatten_with_path(shardings)
         out = []
         for path, sharding in flat_sh:
-            key = "/".join(str(getattr(p, "key", getattr(p, "idx", p)))
-                           for p in path)
+            key = leaf_key(path)
             if key not in leaves:
                 raise KeyError(f"leaf {key!r} not in checkpoint {step}")
             shape = tuple(leaves[key]["shape"])

@@ -6,13 +6,25 @@ next partition while others train). Here the per-epoch host work — the
 permutation gather (``data/native.py``) and the ``[S, W, B, ...]`` stacking
 — runs on a worker thread one epoch ahead, so the accelerator never waits
 on the host between epochs.
+
+Device staging (overlap PR, docs/overlap.md): with ``place=`` the
+producer thread ALSO moves each assembled result onto device (e.g. a
+sharded ``jax.device_put`` with the trainer's data sharding) before
+queueing it, so the consumer's ``next()`` hands back a device-resident
+batch — the H2D copy for chunk k+1 runs while the device computes
+chunk k. The bounded queue is the device-side double buffer AND the
+backpressure: ``place`` runs only once a queue slot is FREE (the
+producer blocked on a full queue holds an assembled HOST chunk, never
+a third device-resident one), so device memory for in-flight input
+data is capped at ``depth`` queued chunks + the one the consumer
+holds, no matter how far the host gets ahead.
 """
 
 from __future__ import annotations
 
 import queue
 import threading
-from typing import Callable, Iterable, Iterator, Tuple, TypeVar
+from typing import Callable, Iterable, Iterator, Optional, Tuple, TypeVar
 
 from distkeras_tpu.utils.profiling import now
 
@@ -27,6 +39,13 @@ class Prefetcher:
     ahead on a background thread. Exceptions in ``fn`` re-raise (original
     type) at the consuming ``next()`` call.
 
+    ``place`` (optional) post-processes each ``fn`` result ON THE
+    PRODUCER THREAD before it is queued — the device-staging hook (see
+    module doc and ``device_stager``): the consumer then receives
+    device-resident values and never pays the H2D copy on its own
+    thread. ``place`` errors take the same consumer-side re-raise path
+    as ``fn`` errors.
+
     The producer thread is cleaned up on EVERY exit path: normal
     exhaustion, consumer ``break``/exception (via ``GeneratorExit`` in the
     iterator), explicit ``close()``, or context-manager exit. The producer
@@ -35,10 +54,12 @@ class Prefetcher:
     """
 
     def __init__(self, fn: Callable[[T], U], items: Iterable[T],
-                 depth: int = 1, name: str = "prefetch"):
+                 depth: int = 1, name: str = "prefetch",
+                 place: Optional[Callable[[U], U]] = None):
         if depth < 1:
             raise ValueError(f"depth must be >= 1, got {depth}")
         self._fn = fn
+        self._place = place
         self._items = list(items)
         self._q: "queue.Queue" = queue.Queue(maxsize=depth)
         self._stopped = threading.Event()
@@ -69,6 +90,16 @@ class Prefetcher:
                 continue
         return False
 
+    def _await_queue_space(self) -> bool:
+        """Poll until the queue has a free slot (or shutdown). Safe as a
+        reservation: this thread is the only producer, so a slot seen
+        free stays free until our own put."""
+        while not self._stopped.is_set():
+            if not self._q.full():
+                return True
+            self._stopped.wait(0.05)
+        return False
+
     def _produce(self):
         from distkeras_tpu.resilience import faults
         for item in self._items:
@@ -81,7 +112,17 @@ class Prefetcher:
                 # BaseException kills the thread WITHOUT the sentinel —
                 # the dead-producer case __iter__ must detect
                 faults.point("prefetch.produce")
-                out = (item, self._fn(item), None)
+                value = self._fn(item)
+                if self._place is not None:
+                    # device staging happens HERE, on the loader thread
+                    # — but only once the queue has room: a producer
+                    # blocked on a full queue must hold an assembled
+                    # HOST chunk, not an extra device-resident one (the
+                    # depth-bounded device-memory cap, module doc)
+                    if not self._await_queue_space():
+                        return
+                    value = self._place(value)
+                out = (item, value, None)
             except Exception as e:  # re-raised consumer-side
                 self._put((item, None, e))
                 return
@@ -159,3 +200,24 @@ class Prefetcher:
         iteration once they are gone)."""
         self._stopped.set()
         self._thread.join(timeout=5.0)
+
+
+def device_stager(sharding=None) -> Callable:
+    """A ``place=`` callable for the trainers' ``(Xs, Ys, n_steps)``
+    epoch chunks: dispatches ``jax.device_put`` of both stacked arrays
+    (with ``sharding`` when given — the trainer's data sharding — or
+    onto the default device otherwise) on the loader thread.
+    ``device_put`` only ENQUEUES the transfer, so the loader is not
+    serialized on the copy either; by the time the epoch loop consumes
+    the chunk the data is on (or streaming to) device, and the jitted
+    epoch program never blocks on a host->device copy of its inputs."""
+    import jax
+
+    def place(chunk):
+        Xs, Ys, n_steps = chunk
+        if sharding is None:
+            return jax.device_put(Xs), jax.device_put(Ys), n_steps
+        return (jax.device_put(Xs, sharding),
+                jax.device_put(Ys, sharding), n_steps)
+
+    return place

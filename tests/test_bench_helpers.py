@@ -86,3 +86,100 @@ def test_summary_line_carries_every_headline_and_stays_compact():
     assert len(line) < 1500, len(line)
     # first record doubles as the line's own metric fields
     assert parsed["value"] == 2571.0 and parsed["unit"] == "imgs/sec"
+
+
+# -- regression tripwire (overlap PR) ----------------------------------------
+
+def test_prev_headlines_reads_newest_round():
+    import glob
+    import re
+    root = os.path.dirname(bench.__file__)
+    rounds = [int(re.search(r"BENCH_r(\d+)\.json$", p).group(1))
+              for p in glob.glob(os.path.join(root, "BENCH_r*.json"))]
+    heads, src, kind = bench._prev_headlines(root)
+    # whatever rounds the repo carries, the newest must win (r05 as of
+    # this test's writing; hardcoding it would break on every new round)
+    assert src == f"BENCH_r{max(rounds):02d}.json"
+    assert isinstance(heads, dict) and heads
+    assert isinstance(kind, str) and kind  # gate for cross-hw comparisons
+
+
+def test_regression_check_flags_value_drop():
+    prev = {"m": {"value": 1000.0, "vs_baseline": 2.0}}
+    rec = {"metric": "m", "value": 850.0, "vs_baseline": 2.0}
+    out = bench._regression_check(rec, prev, "BENCH_r05.json")
+    assert out["value_vs_prev"] == 0.85
+    assert any("value dropped" in f for f in out["flags"])
+
+
+def test_regression_check_passes_within_tolerance():
+    prev = {"m": {"value": 1000.0, "vs_baseline": 2.0}}
+    rec = {"metric": "m", "value": 950.0, "vs_baseline": 1.95}
+    out = bench._regression_check(rec, prev, "BENCH_r05.json")
+    assert out is not None and "flags" not in out
+    assert out["value_vs_prev"] == 0.95
+
+
+def test_regression_check_flags_the_known_moe_below_anchor():
+    """The standing moe_lm_train 0.735x regression (BENCH_r05's
+    numbers, pinned here as a synthetic prev record so the test
+    outlives the repo's BENCH files): even when the value matches the
+    previous round exactly, the below-anchor flag keeps it visible
+    instead of letting two matching rounds silently normalize it."""
+    heads = {"moe_lm_train_tokens_per_sec_per_chip":
+             {"value": 47156.5, "vs_baseline": 0.735}}
+    rec = {"metric": "moe_lm_train_tokens_per_sec_per_chip",
+           "value": 47156.5, "vs_baseline": 0.735}
+    out = bench._regression_check(rec, heads, "BENCH_r05.json")
+    assert any("below_anchor" in f for f in out["flags"])
+    assert "value dropped" not in " ".join(out["flags"])  # value held
+
+
+def test_regression_check_none_without_history_or_flags():
+    rec = {"metric": "m", "value": 100.0, "vs_baseline": 1.2}
+    assert bench._regression_check(rec, None, None) is None
+
+
+def test_summary_line_surfaces_regression_flags():
+    records = [
+        {"metric": "a", "value": 1.0, "vs_baseline": 1.0,
+         "regression": {"flags": ["value dropped to 0.850x of r05"]}},
+        {"metric": "b", "value": 2.0, "vs_baseline": 1.5,
+         "regression": None},
+    ]
+    parsed = json.loads(bench._summary_line(records, "cpu"))
+    assert parsed["regressions"] == {
+        "a": ["value dropped to 0.850x of r05"]}
+
+
+def test_regression_check_skips_cross_hardware_comparison():
+    """A CPU smoke run vs a TPU-captured record must not flag a bogus
+    100x 'drop' — the vs-prev comparison is gated on device_kind (the
+    in-run below-anchor check still applies)."""
+    prev = {"m": {"value": 64000.0, "vs_baseline": 2.0}}
+    rec = {"metric": "m", "value": 600.0, "vs_baseline": 2.0,
+           "device_kind": "cpu"}
+    out = bench._regression_check(rec, prev, "BENCH_r05.json",
+                                  prev_kind="TPU v5 lite")
+    assert "flags" not in out and "value_vs_prev" not in out
+    assert "device_kind" in out["prev_skipped"]
+    # same hardware: the comparison runs and flags
+    out = bench._regression_check(dict(rec, device_kind="TPU v5 lite"),
+                                  prev, "BENCH_r05.json",
+                                  prev_kind="TPU v5 lite")
+    assert any("dropped" in f for f in out["flags"])
+
+
+def test_regression_check_inverts_for_lower_is_better_metric():
+    """overlap_train_ckpt_overhead_x is lower-is-better: an improvement
+    (value drop) must NOT flag, a >11% rise must."""
+    metric = "overlap_train_ckpt_overhead_x"
+    assert metric in bench.LOWER_IS_BETTER
+    prev = {metric: {"value": 1.2, "vs_baseline": 0.833}}
+    improved = {"metric": metric, "value": 1.0, "vs_baseline": 1.0}
+    out = bench._regression_check(improved, prev, "BENCH_r05.json")
+    assert "flags" not in out, out
+    worse = {"metric": metric, "value": 1.4, "vs_baseline": 0.714}
+    out = bench._regression_check(worse, prev, "BENCH_r05.json")
+    assert any("rose" in f for f in out["flags"])
+    assert any("below_anchor" in f for f in out["flags"])

@@ -1,0 +1,80 @@
+"""tools/lint_host_sync.py wired into tier-1: the library epoch-loop
+modules must stay free of ad-hoc blocking host syncs
+(``jax.device_get`` / ``.block_until_ready()`` / ``float(<traced>)``)
+outside the allow-marked sanctioned fetch points — the overlap PR's
+non-blocking-loop discipline (docs/overlap.md) — and the checker itself
+must actually detect the patterns it claims to."""
+
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO / "tools"))
+
+from lint_host_sync import (  # noqa: E402
+    ALLOW_MARK, EPOCH_LOOP_MODULES, check_source, check_tree)
+
+
+def test_repo_epoch_loops_are_free_of_host_syncs():
+    findings = check_tree(REPO)
+    assert not findings, "\n".join(
+        f"{f}:{ln}: {msg}" for f, ln, msg in findings)
+
+
+def test_scope_covers_the_three_trainer_loops():
+    # the modules this PR made non-blocking must stay in scope
+    for mod in ("trainers.py", "spmd.py", "pipeline.py"):
+        assert any(m.endswith(mod) for m in EPOCH_LOOP_MODULES)
+
+
+def test_checker_flags_device_get_and_alias_import():
+    src = ("import jax\n"
+           "x = jax.device_get(tree)\n"
+           "from jax import device_get\n")
+    findings = check_source(src, "x.py")
+    assert [ln for _, ln, _ in findings] == [2, 3]
+    assert "device_get" in findings[0][2]
+
+
+def test_checker_flags_block_until_ready():
+    src = "y = loss.block_until_ready()\n"
+    findings = check_source(src, "x.py")
+    assert len(findings) == 1 and "block_until_ready" in findings[0][2]
+
+
+def test_checker_float_heuristic():
+    src = ("a = float(loss)\n"                        # device scalar: flag
+           "b = float(np.mean(losses))\n"             # numpy: host-side
+           "c = float(np.asarray(v).ravel()[0])\n"    # numpy-rooted
+           "d = float(1.0)\n")                        # constant
+    findings = check_source(src, "x.py")
+    assert [ln for _, ln, _ in findings] == [1]
+    assert "float" in findings[0][2]
+
+
+def test_checker_exempts_init_scalar_coercions():
+    src = ("class T:\n"
+           "    def __init__(self, lr):\n"
+           "        self.lr = float(lr)\n"
+           "    def train(self, v):\n"
+           "        return float(v)\n")
+    findings = check_source(src, "x.py")
+    assert [ln for _, ln, _ in findings] == [5]
+
+
+def test_checker_skips_marked_lines_and_comments():
+    src = ("import jax\n"
+           "# jax.device_get(tree) in a comment\n"
+           f"x = jax.device_get(t)  # {ALLOW_MARK}: boundary fetch\n")
+    assert check_source(src, "x.py") == []
+
+
+def test_checker_skips_non_jax_receivers():
+    # other objects' .device_get attributes are not the banned call
+    src = "x = mgr.device_get(t)\n"
+    assert check_source(src, "x.py") == []
+
+
+def test_checker_reports_syntax_errors_as_findings():
+    findings = check_source("def broken(:\n", "x.py")
+    assert len(findings) == 1 and "syntax" in findings[0][2]

@@ -1,0 +1,323 @@
+"""Overlap engine (docs/overlap.md): device-resident double-buffered
+input staging, the zero-stall checkpoint snapshot/write path, and the
+validation device cache.
+
+The contracts under test:
+
+  * ``Prefetcher(place=...)`` stages results on the PRODUCER thread and
+    the bounded queue is real backpressure (the loader can never run
+    more than ``depth`` staged chunks ahead of the consumer);
+  * ``CheckpointManager.save`` fences a snapshot the caller may DONATE
+    immediately after (snapshot-before-donate) — the written bytes
+    match the pre-donation values even though XLA reused the buffers;
+  * async writes overlap the caller (save returns while the write is in
+    flight) and stay ordered/durable;
+  * validation arrays upload once per dataset identity, across repeated
+    ``train()`` calls, and invalidate when the dataset is swapped.
+"""
+
+import threading
+import time  # measurement-side clocks in a test file
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distkeras_tpu.data import Dataset
+from distkeras_tpu.data.sharded import ShardedDataset
+from distkeras_tpu.models import Dense, Model, Sequential
+from distkeras_tpu.parallel import SingleTrainer
+from distkeras_tpu.resilience import InjectedFault, faults
+from distkeras_tpu.utils.checkpoint import CheckpointManager, _snapshot_flat
+from distkeras_tpu.utils.prefetch import Prefetcher, device_stager
+
+
+@pytest.fixture(autouse=True)
+def _fault_isolation():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+# --- device staging ----------------------------------------------------------
+
+
+def test_place_runs_on_producer_thread_and_yields_device_arrays():
+    main = threading.get_ident()
+    seen = []
+
+    def place(chunk):
+        seen.append(threading.get_ident())
+        Xs, Ys, S = chunk
+        return jax.device_put(Xs), jax.device_put(Ys), S
+
+    items = list(range(4))
+    fn = lambda i: (np.full((2, 3), i, np.float32),
+                    np.full((2,), i, np.float32), 2)
+    got = list(Prefetcher(fn, items, depth=2, place=place))
+    assert [i for i, _ in got] == items
+    assert seen and all(t != main for t in seen)
+    for i, (Xs, Ys, S) in got:
+        assert isinstance(Xs, jax.Array) and isinstance(Ys, jax.Array)
+        np.testing.assert_array_equal(np.asarray(Xs)[0], i)
+
+
+def test_device_stager_applies_requested_sharding():
+    sh = jax.sharding.SingleDeviceSharding(jax.devices()[0])
+    Xs, Ys, S = device_stager(sh)((np.zeros((4, 2), np.float32),
+                                   np.zeros((4,), np.float32), 4))
+    assert isinstance(Xs, jax.Array) and Xs.sharding == sh
+    assert isinstance(Ys, jax.Array) and Ys.sharding == sh
+    assert S == 4
+    # float64 numpy stages to the canonical f32 — identical to what the
+    # old inline jnp.asarray + device_put double copy produced
+    Xs, _, _ = device_stager()((np.zeros((2, 2), np.float64),
+                                np.zeros((2,), np.float64), 2))
+    assert Xs.dtype == jnp.float32
+
+
+def test_backpressure_bounds_producer_lead():
+    """The producer may stage at most depth (queued) + 1 (in hand)
+    chunks ahead of the consumer — the device-memory bound."""
+    produced = []
+    consumed = []
+    depth = 2
+
+    def fn(i):
+        produced.append(i)
+        return i
+
+    p = Prefetcher(fn, range(10), depth=depth)
+    it = iter(p)
+    try:
+        for expect in range(4):
+            item, value = next(it)
+            consumed.append(item)
+            time.sleep(0.05)  # let the producer run as far as it can
+            lead = len(produced) - len(consumed)
+            assert lead <= depth + 1, (produced, consumed)
+    finally:
+        p.close()
+
+
+def test_staged_chunks_never_exceed_queue_plus_consumer():
+    """Device-memory cap: place() runs only when a queue slot is free,
+    so live staged chunks are bounded by depth (queued) + 1 (consumed)
+    — a producer blocked on a full queue holds a HOST chunk only."""
+    depth = 1
+    staged, consumed = [], []
+
+    def place(v):
+        staged.append(v)
+        return v
+
+    p = Prefetcher(lambda i: i, range(8), depth=depth, place=place)
+    it = iter(p)
+    try:
+        for _ in range(5):
+            item, _ = next(it)
+            consumed.append(item)
+            time.sleep(0.05)  # give the producer every chance to run ahead
+            live = len(staged) - len(consumed)
+            assert live <= depth, (staged, consumed)
+    finally:
+        p.close()
+
+
+def test_place_error_reraises_consumer_side_with_original_type():
+    class Boom(RuntimeError):
+        pass
+
+    def place(v):
+        if v == 1:
+            raise Boom("staging failed")
+        return v
+
+    it = iter(Prefetcher(lambda i: i, range(3), place=place))
+    assert next(it)[1] == 0
+    with pytest.raises(Boom):
+        list(it)
+
+
+def test_epoch_items_flattens_and_shuffles_deterministically():
+    ds = Dataset({"features": np.zeros((8, 2), np.float32),
+                  "label": np.zeros((8,), np.int32)})
+    sds = ShardedDataset.from_datasets([ds, ds, ds])
+    items = sds.epoch_items(1, 3, seed=7, shuffle=True)
+    assert len(items) == 6                       # 2 epochs x 3 shards
+    assert items == sds.epoch_items(1, 3, seed=7, shuffle=True)
+    for e in (1, 2):
+        epoch = [(ep, si, last) for ep, si, last in items if ep == e]
+        assert sorted(si for _, si, _ in epoch) == [0, 1, 2]
+        assert [last for _, _, last in epoch] == [False, False, True]
+        assert epoch[-1][1] == sds.shard_order(e, 7, True)[-1]
+    flat = sds.epoch_items(0, 2, seed=7, shuffle=False)
+    assert [si for _, si, _ in flat] == [0, 1, 2, 0, 1, 2]
+
+
+# --- zero-stall checkpointing ------------------------------------------------
+
+
+def test_snapshot_owns_its_memory():
+    dev = jnp.arange(16.0)
+    host_view = np.arange(4.0)[::2]              # non-owning numpy view
+    flat = _snapshot_flat({"a": dev, "b": host_view})
+    assert flat["a"].flags["OWNDATA"]
+    assert flat["b"].flags["OWNDATA"]
+    np.testing.assert_array_equal(flat["a"], np.arange(16.0))
+
+
+def test_snapshot_before_donate_survives_buffer_reuse(tmp_path):
+    """THE donation-safety contract: the epoch loop may donate the
+    checkpointed buffers the moment save() returns; the snapshot on
+    disk still holds the pre-donation values."""
+    m = CheckpointManager(str(tmp_path), async_writes=True)
+
+    @jax.jit
+    def bump(x):
+        return x + 1.0
+
+    donate = jax.jit(lambda x: x * 0.0, donate_argnums=(0,))
+
+    x = bump(jnp.arange(1024.0))                 # XLA-owned buffer
+    want = np.asarray(x).copy()
+    m.save(0, {"x": x})
+    _ = donate(x)                                # buffer reused by XLA
+    m.wait()
+    got = m.restore({"x": np.zeros(1024, np.float32)})
+    np.testing.assert_array_equal(got["x"], want)
+
+
+def test_async_save_overlaps_the_caller(tmp_path):
+    """With a deliberately slow disk (stalled write), save() returns
+    long before the write completes — the serialize+rename runs behind
+    the caller's next epoch; wait() observes durability."""
+    faults.inject("ckpt.write", every=1, stall_s=0.25)
+    m = CheckpointManager(str(tmp_path), async_writes=True)
+    t0 = time.perf_counter()
+    m.save(0, {"w": np.arange(64, dtype=np.float32)})
+    assert time.perf_counter() - t0 < 0.2        # did not ride the stall
+    m.wait()
+    assert m.all_steps() == [0]
+
+
+def test_async_saves_queue_without_blocking_on_previous(tmp_path):
+    """save() no longer waits out the PREVIOUS write: two stalled
+    writes queue back-to-back; the bounded queue (max_pending) then
+    applies backpressure on the third."""
+    faults.inject("ckpt.write", every=1, stall_s=0.2)
+    m = CheckpointManager(str(tmp_path), async_writes=True, max_pending=2)
+    tree = {"w": np.arange(64, dtype=np.float32)}
+    t0 = time.perf_counter()
+    m.save(0, tree)
+    m.save(1, tree)                              # queued, not blocked
+    assert time.perf_counter() - t0 < 0.2
+    t1 = time.perf_counter()
+    m.save(2, tree)                              # over the bound: waits
+    assert time.perf_counter() - t1 > 0.05
+    m.wait()
+    assert m.all_steps()[-1] == 2
+
+
+def test_d2h_fault_point_fires_in_save(tmp_path):
+    faults.inject("ckpt.d2h", nth=1)
+    m = CheckpointManager(str(tmp_path))
+    with pytest.raises(InjectedFault):
+        m.save(0, {"w": jnp.zeros(4)})
+    assert faults.fired("ckpt.d2h") == 1
+    assert m.all_steps() == []                   # nothing half-published
+    m.save(1, {"w": jnp.zeros(4)})               # manager still healthy
+    assert m.all_steps() == [1]
+
+
+def test_sync_manager_rejects_bad_max_pending(tmp_path):
+    with pytest.raises(ValueError, match="max_pending"):
+        CheckpointManager(str(tmp_path), max_pending=0)
+
+
+# --- validation device cache -------------------------------------------------
+
+
+def _trainer(val, **kw):
+    return SingleTrainer(
+        Model.build(Sequential([Dense(2)]), (4,), seed=0),
+        batch_size=16, num_epoch=1, worker_optimizer="sgd",
+        loss="sparse_categorical_crossentropy_from_logits",
+        validation_data=val, **kw)
+
+
+def _val_pair(n=32, seed=0):
+    rs = np.random.RandomState(seed)
+    return (rs.randn(n, 4).astype(np.float32),
+            rs.randint(0, 2, n).astype(np.int32))
+
+
+def _train_ds(n=64):
+    rs = np.random.RandomState(1)
+    return Dataset({"features": rs.randn(n, 4).astype(np.float32),
+                    "label": rs.randint(0, 2, n)})
+
+
+def test_validation_arrays_cached_across_train_calls():
+    tr = _trainer(_val_pair())
+    ds = _train_ds()
+    tr.train(ds)
+    _, _, first = tr._val_device_cache
+    assert all(isinstance(a, jax.Array) for a in first)
+    tr.train(ds)                                 # e.g. supervisor restart
+    _, _, second = tr._val_device_cache
+    assert second[0] is first[0] and second[1] is first[1]
+    assert "val_loss" in tr.get_history().metric_names()
+
+
+def test_validation_cache_invalidates_on_new_dataset():
+    tr = _trainer(_val_pair(seed=0))
+    ds = _train_ds()
+    tr.train(ds)
+    _, _, first = tr._val_device_cache
+    tr.validation_data = _val_pair(seed=3)       # swapped: must re-upload
+    tr.train(ds)
+    _, _, second = tr._val_device_cache
+    assert second[0] is not first[0]
+    np.testing.assert_array_equal(np.asarray(second[0]),
+                                  tr.validation_data[0])
+
+
+# --- the end-to-end overlap story -------------------------------------------
+
+
+def test_sharded_training_consumes_device_resident_batches(tmp_path):
+    """Out-of-core training through the device-staged stream (2-deep
+    buffer) with per-epoch async checkpoints: same results contract as
+    always — and the stream handed the epoch loop jax Arrays."""
+    rs = np.random.RandomState(0)
+    X = rs.randn(96, 4).astype(np.float32)
+    y = rs.randint(0, 2, 96)
+    full = Dataset({"features": X, "label": y})
+    sds = ShardedDataset.write(full, str(tmp_path / "shards"), 3)
+
+    staged_types = []
+    orig = Prefetcher.__iter__
+
+    def spying_iter(self):
+        for item, value in orig(self):
+            if isinstance(value, tuple) and len(value) == 3:
+                staged_types.append(type(value[0]))
+            yield item, value
+
+    Prefetcher.__iter__ = spying_iter
+    try:
+        tr = SingleTrainer(
+            Model.build(Sequential([Dense(2)]), (4,), seed=0),
+            batch_size=16, num_epoch=2, worker_optimizer="sgd",
+            loss="sparse_categorical_crossentropy_from_logits",
+            checkpoint_dir=str(tmp_path / "ck"), checkpoint_async=True,
+            checkpoint_every=1)
+        tr.train(sds)
+    finally:
+        Prefetcher.__iter__ = orig
+    assert staged_types and all(issubclass(t, jax.Array)
+                                for t in staged_types)
+    assert CheckpointManager(str(tmp_path / "ck")).latest_step() == 1
+    assert tr.get_history().losses().size == 2 * (96 // 3 // 16) * 3

@@ -384,8 +384,8 @@ def oracle_params():
 
 
 @pytest.mark.parametrize("fault_point",
-                         ["ckpt.write", "ckpt.rename", "train.epoch",
-                          "prefetch.produce"])
+                         ["ckpt.write", "ckpt.rename", "ckpt.d2h",
+                          "train.epoch", "prefetch.produce"])
 def test_crash_at_any_point_resumes_bitwise(tmp_path, oracle_params,
                                             fault_point):
     """THE chaos invariant: a hard (non-transient) fault at any
@@ -415,6 +415,38 @@ def test_transient_fault_heals_without_restart(tmp_path, oracle_params):
     assert result.restarts == 0 and result.rollbacks == 0
     assert faults.fired("ckpt.write") == 1
     _assert_trees_equal(result.model.params, oracle_params)
+
+
+@pytest.mark.parametrize("fault_point", ["ckpt.d2h", "ckpt.write"])
+def test_async_checkpointing_resumes_bitwise(tmp_path, oracle_params,
+                                             fault_point):
+    """The overlap-PR invariant: ZERO-STALL checkpointing (async D2H
+    snapshot + background serialize) under supervision is still
+    bitwise-identical to the uninterrupted run — including a hard fault
+    mid-transfer at the new ``ckpt.d2h`` point (the snapshot fence) and
+    one on the background write path (``ckpt.write``, surfaced at the
+    next save's error check instead of the write site)."""
+    faults.inject(fault_point, nth=2)          # after epoch 0 durably saved
+    tr = _trainer(ckpt=str(tmp_path / "ck"), checkpoint_async=True)
+    sup = TrainingSupervisor(tr, max_restarts=2, handle_signals=())
+    result = sup.run(_ds())
+    assert result.restarts == 1 and not result.preempted
+    assert faults.fired(fault_point) == 1
+    _assert_trees_equal(result.model.params, oracle_params)
+
+
+def test_async_checkpoints_are_durable_after_train(tmp_path,
+                                                   oracle_params):
+    """train() waits out the background write queue before returning:
+    every epoch's async snapshot is on disk, and a resume from the last
+    one reproduces the uninterrupted run exactly."""
+    ckpt = str(tmp_path / "ck")
+    _trainer(ckpt=ckpt, num_epoch=2, checkpoint_async=True).train(_ds())
+    mgr = CheckpointManager(ckpt)
+    assert mgr.latest_step() == 1
+    resumed = _trainer(ckpt=ckpt, resume=True,
+                       checkpoint_async=True).train(_ds())
+    _assert_trees_equal(resumed.params, oracle_params)
 
 
 def test_restart_budget_exhausts_loudly(tmp_path):

@@ -1,0 +1,159 @@
+#!/usr/bin/env python
+"""Static check: no ad-hoc host syncs inside the epoch-loop modules.
+
+The overlap PR (docs/overlap.md) made the trainer epoch loops
+non-blocking: batches are staged onto device by the loader thread,
+per-step loss/metric arrays stay on device until ONE epoch-boundary
+fetch, and checkpoint snapshots fence through the manager's async-D2H
+path. A stray ``jax.device_get`` / ``.block_until_ready()`` /
+``float(<traced scalar>)`` dropped into one of these loops silently
+reintroduces a per-step device round trip — the regression class this
+linter pins down, the way ``lint_timing.py`` pins raw clock reads.
+
+Scope is the LIBRARY EPOCH-LOOP MODULES only (``EPOCH_LOOP_MODULES``
+below): the trainer loops this discipline governs. Everything else —
+inference, serving, bench/driver code — fetches freely. Flags:
+
+  * ``jax.device_get(...)`` calls (and ``from jax import device_get``
+    alias imports);
+  * ``.block_until_ready()`` method calls on anything;
+  * ``float(x)`` where ``x`` is not a constant and contains no
+    ``np``/``numpy`` reference — ``float(device_scalar)`` is an
+    implicit blocking transfer, while ``float(np.mean(host))`` is
+    host-side arithmetic (the heuristic). ``__init__`` bodies are
+    exempt (constructor scalar coercions are not syncs).
+
+Sanctioned fetch points — ``parallel.engine.host_fetch``/``host_async``
+internals, the shared ``trainers.val_logs`` validation fetch, the
+epoch-boundary fetches, callback-API ``get_weights`` providers and
+end-of-train result fetches — carry the marker comment
+``# lint: allow-host-sync`` on the offending line.
+
+Exit status 1 when findings exist (wired into tier-1 as
+``tests/test_lint_host_sync.py``).
+"""
+
+from __future__ import annotations
+
+import ast
+import sys
+from pathlib import Path
+from typing import List, Tuple
+
+ALLOW_MARK = "lint: allow-host-sync"
+
+#: the modules holding library epoch loops — the blocking-sync-free zone
+EPOCH_LOOP_MODULES = (
+    "distkeras_tpu/parallel/trainers.py",
+    "distkeras_tpu/parallel/spmd.py",
+    "distkeras_tpu/parallel/pipeline.py",
+    "distkeras_tpu/parallel/distributed.py",
+    "distkeras_tpu/parallel/engine.py",
+)
+
+Finding = Tuple[str, int, str]
+
+
+def _allowed(line: str) -> bool:
+    return ALLOW_MARK in line
+
+
+def _mentions_numpy(node: ast.AST) -> bool:
+    """Does the expression reference ``np``/``numpy`` anywhere? Host-side
+    arithmetic routes through numpy; a bare traced value does not."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name) and sub.id in ("np", "numpy"):
+            return True
+    return False
+
+
+def _init_ranges(tree: ast.AST) -> List[Tuple[int, int]]:
+    return [(n.lineno, n.end_lineno or n.lineno)
+            for n in ast.walk(tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and n.name == "__init__"]
+
+
+def check_source(src: str, rel: str) -> List[Finding]:
+    """Findings for one file's source text."""
+    try:
+        tree = ast.parse(src, filename=rel)
+    except SyntaxError as e:  # a broken file is its own finding
+        return [(rel, e.lineno or 0, f"syntax error: {e.msg}")]
+    lines = src.splitlines()
+    inits = _init_ranges(tree)
+    out: List[Finding] = []
+
+    def line_of(node: ast.AST) -> str:
+        ln = getattr(node, "lineno", 0)
+        return lines[ln - 1] if 0 < ln <= len(lines) else ""
+
+    def in_init(node: ast.AST) -> bool:
+        ln = getattr(node, "lineno", 0)
+        return any(lo <= ln <= hi for lo, hi in inits)
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            f = node.func
+            if isinstance(f, ast.Attribute) and f.attr == "device_get" \
+                    and isinstance(f.value, ast.Name) \
+                    and f.value.id == "jax":
+                if not _allowed(line_of(node)):
+                    out.append((rel, node.lineno,
+                                "jax.device_get() in an epoch-loop module "
+                                "— route through host_fetch/the "
+                                "epoch-boundary fetch, or mark the "
+                                "sanctioned site"))
+            elif isinstance(f, ast.Attribute) \
+                    and f.attr == "block_until_ready":
+                if not _allowed(line_of(node)):
+                    out.append((rel, node.lineno,
+                                ".block_until_ready() in an epoch-loop "
+                                "module — a blocking device sync; let the "
+                                "boundary fetch bound the epoch"))
+            elif isinstance(f, ast.Name) and f.id == "float" \
+                    and node.args and not isinstance(node.args[0],
+                                                     ast.Constant) \
+                    and not _mentions_numpy(node.args[0]) \
+                    and not in_init(node):
+                if not _allowed(line_of(node)):
+                    out.append((rel, node.lineno,
+                                "float(<non-numpy value>) in an "
+                                "epoch-loop module — on a traced/device "
+                                "scalar this is an implicit blocking "
+                                "transfer; fetch at the boundary (or go "
+                                "through numpy) instead"))
+        elif isinstance(node, ast.ImportFrom) and node.module == "jax":
+            bad = [a.name for a in node.names if a.name == "device_get"]
+            if bad and not _allowed(line_of(node)):
+                out.append((rel, node.lineno,
+                            "from jax import device_get — aliasing the "
+                            "banned fetch; use host_fetch or a marked "
+                            "site"))
+    return sorted(out, key=lambda f: f[1])
+
+
+def check_tree(root: Path) -> List[Finding]:
+    findings: List[Finding] = []
+    for entry in EPOCH_LOOP_MODULES:
+        p = root / entry
+        if p.exists():
+            findings.extend(check_source(p.read_text(), entry))
+    return findings
+
+
+def main(argv=None) -> int:
+    root = Path(argv[0]) if argv else Path(__file__).resolve().parent.parent
+    findings = check_tree(root)
+    for rel, lineno, msg in findings:
+        print(f"{rel}:{lineno}: {msg}")
+    if findings:
+        print(f"{len(findings)} host-sync finding(s); route through the "
+              f"sanctioned fetch points or mark the line with "
+              f"'# {ALLOW_MARK}'", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))
