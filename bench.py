@@ -537,7 +537,8 @@ def bench_generate(batch: int, new_tokens: int, n_passes: int,
 
 
 def bench_serving(num_slots: int, prompt_len: int, new_tokens: int,
-                  n_requests: int, n_passes: int, prefill_chunk=None):
+                  n_requests: int, n_passes: int, prefill_chunk=None,
+                  trace_out=None):
     """Continuous-batching engine (``distkeras_tpu.serving``) on the
     ``--model lm`` config, driven by a SYNTHETIC OPEN-LOOP arrival
     trace: the first ``num_slots`` requests arrive at t=0 (the pool
@@ -549,9 +550,21 @@ def bench_serving(num_slots: int, prompt_len: int, new_tokens: int,
     batch size — same compiled per-slot step, same per-iteration host
     sync, no scheduler), TTFT p50/p99 and request latency p50/p99.
 
-    Returns (full_occupancy_rates, raw_rates, summaries) across
-    passes."""
+    Also records the SLO view (obs.slo): ttft_p99 / tpot_p99 /
+    availability objectives evaluated per pass against thresholds
+    scaled from the measured warm step time (so the burn rate is a
+    meaningful utilization-of-budget number on any backend), and dumps
+    the request-level Chrome trace (obs.tracing) of the LAST pass to
+    ``trace_out`` (default: a temp-dir artifact) — loadable in
+    Perfetto next to the BENCH record.
+
+    Returns (full_occupancy_rates, raw_rates, summaries, slo_statuses,
+    trace_path) across passes."""
+    import tempfile
+
     from distkeras_tpu.models import Model, zoo
+    from distkeras_tpu.obs.slo import (SLOEngine, availability,
+                                       tpot_p99, ttft_p99)
     from distkeras_tpu.serving import ServingEngine, ServingMetrics
 
     cfg = LM_CFG
@@ -575,6 +588,18 @@ def bench_serving(num_slots: int, prompt_len: int, new_tokens: int,
     # offered load ~2x capacity: capacity is num_slots tokens per
     # iteration, so saturation + a real queue
     mean_ia = step_dt * new_tokens / (2.0 * num_slots)
+
+    # SLO objectives scaled from the measured step time: a request at
+    # 2x offered load queues behind ~one pool drain, so its TTFT
+    # budget is a few full-decode spans; TPOT budget is a few step
+    # times (per-token cadence). Deliberately tight enough that a real
+    # scheduling regression burns budget, loose enough that healthy
+    # runs don't breach on noise.
+    eng.slo = SLOEngine(
+        [ttft_p99(max(0.25, 4.0 * step_dt * new_tokens)),
+         tpot_p99(max(0.01, 4.0 * step_dt)),
+         availability()],
+        clock=eng.metrics.clock)
 
     def raw_loop_rate(steps):
         """The same compiled per-slot decode step at full batch, driven
@@ -618,7 +643,7 @@ def bench_serving(num_slots: int, prompt_len: int, new_tokens: int,
             t = t + 1
         return num_slots * steps / (time.perf_counter() - t0)
 
-    full_rates, raw_rates, summaries = [], [], []
+    full_rates, raw_rates, summaries, slo_statuses = [], [], [], []
     for i in range(n_passes):
         eng.metrics = ServingMetrics()
         arrivals = np.concatenate([
@@ -644,15 +669,29 @@ def bench_serving(num_slots: int, prompt_len: int, new_tokens: int,
         full_rates.append(rate)
         raw_rates.append(raw)
         summaries.append(m.summary())
+        # the per-pass SLO evaluation: this pass's metrics window
+        # against the step-time-scaled objectives
+        slo_statuses.append(eng.slo.evaluate(m))
         s = summaries[-1]
+        burn = max(st["burn_rate"] for st in slo_statuses[-1].values())
         print(f"pass {i}: {rate:.1f} tok/s steady-state "
               f"({rate / raw:.2f}x of raw loop {raw:.1f}); "
               f"ttft p50/p99 = {s['ttft_s']['p50'] * 1e3:.0f}/"
               f"{s['ttft_s']['p99'] * 1e3:.0f} ms; "
               f"latency p50/p99 = {s['latency_s']['p50'] * 1e3:.0f}/"
-              f"{s['latency_s']['p99'] * 1e3:.0f} ms",
+              f"{s['latency_s']['p99'] * 1e3:.0f} ms; "
+              f"slo max burn {burn:.2f}",
               file=sys.stderr, flush=True)
-    return full_rates, raw_rates, summaries
+    # request-level Chrome trace of the run (the last passes' ring —
+    # the tracer is bounded, so this is the most recent max_requests
+    # timelines), loadable in Perfetto next to the BENCH record
+    trace_path = None
+    if eng.tracer.enabled:
+        trace_path = trace_out or os.path.join(
+            tempfile.gettempdir(),
+            f"bench_serving_trace_{os.getpid()}.json")
+        eng.tracer.dump_chrome_trace(trace_path)
+    return full_rates, raw_rates, summaries, slo_statuses, trace_path
 
 
 #: configs the default (driver-facing) MoE bench runs. dense_dispatch is
@@ -1362,12 +1401,13 @@ def _run_mode(mode, args, on_accel, peak, device_kind):
         else:
             num_slots, prompt_len, new_tokens = 2, 8, 8
             n_requests, n_passes, chunk = 4, 1, None
-        rates, raws, summaries = bench_serving(
+        rates, raws, summaries, slo_statuses, trace_path = bench_serving(
             num_slots, prompt_len, new_tokens, n_requests, n_passes,
             prefill_chunk=chunk)
         value = statistics.median(rates)
         raw = statistics.median(raws)
         mid = summaries[len(summaries) // 2]
+        slo_mid = slo_statuses[len(slo_statuses) // 2]
         rec = {
             "metric": "serving_steady_decode_tokens_per_sec_per_chip",
             "value": round(value, 1),
@@ -1384,6 +1424,22 @@ def _run_mode(mode, args, on_accel, peak, device_kind):
             "spread": _spread(rates),
             "ttft_s": mid["ttft_s"],
             "latency_s": mid["latency_s"],
+            # SLO view (obs.slo; thresholds scaled from the warm step
+            # time — see bench_serving): the objective values, burn
+            # rates and any breaches of the MEDIAN pass, plus the
+            # request-level Chrome trace artifact (Perfetto-loadable)
+            "slo": {
+                "ttft_p99_s": slo_mid["ttft_p99"]["value"],
+                "ttft_threshold_s": slo_mid["ttft_p99"]["threshold_s"],
+                "tpot_p99_s": slo_mid["tpot_p99"]["value"],
+                "tpot_threshold_s": slo_mid["tpot_p99"]["threshold_s"],
+                "availability": slo_mid["availability"]["value"],
+                "burn_rate": {name: round(st["burn_rate"], 4)
+                              for name, st in slo_mid.items()},
+                "breach": sorted(name for name, st in slo_mid.items()
+                                 if st["breach"]),
+            },
+            "trace_artifact": trace_path,
             "request_tokens_per_sec": (
                 None if mid["tokens_per_sec"] is None
                 else round(mid["tokens_per_sec"], 1)),

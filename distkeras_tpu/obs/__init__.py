@@ -44,9 +44,15 @@ from distkeras_tpu.obs import collectors, exporters  # noqa: F401
 from distkeras_tpu.obs.collectors import (  # noqa: F401
     RecompileDetector, RecompileWarning, compile_totals,
     memory_watermark)
+from distkeras_tpu.obs.exporters import SCHEMA_VERSION  # noqa: F401
 from distkeras_tpu.obs.tape import (  # noqa: F401
     NULL_TAPE, TrainingTape, detect_peak_flops, resolve_tape,
     timed_stream)
+from distkeras_tpu.obs.tracing import (  # noqa: F401
+    NULL_TRACER, RequestTracer, resolve_tracer)
+from distkeras_tpu.obs.recorder import (  # noqa: F401
+    NULL_RECORDER, FlightRecorder, get_recorder, resolve_recorder)
+from distkeras_tpu.obs.slo import Objective, SLOEngine  # noqa: F401
 
 _enabled = [os.environ.get("DKT_TELEMETRY", "1") not in ("0", "false")]
 _registry_lock = threading.Lock()
@@ -152,6 +158,7 @@ def telemetry_snapshot(registry: Optional[MetricsRegistry] = None) -> Dict:
     # view must include the reading taken in this same call
     mem = memory_watermark(registry)
     return {
+        "schema_version": SCHEMA_VERSION,
         "metrics": registry.snapshot(),
         "spans": span_summary(),
         "compile": compile_totals(),

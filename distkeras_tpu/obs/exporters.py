@@ -4,14 +4,21 @@ Three formats, deliberately boring:
 
 * **JSONL event log** — one self-describing line per series
   (``{"type": "counter"|"gauge"|"histogram"|"span", ...}``) plus a
-  ``meta`` header. Append-oriented (a long-running job re-exports
-  snapshots under increasing ``seq``), and lossless for the snapshot
-  shape: ``read_jsonl(path)`` reconstructs exactly what
-  ``registry.snapshot()`` produced (the round-trip test).
+  ``meta`` header carrying ``schema_version``. Append-oriented (a
+  long-running job re-exports snapshots under increasing ``seq``), and
+  lossless for the snapshot shape: ``read_jsonl(path)`` reconstructs
+  exactly what ``registry.snapshot()`` produced (the round-trip test).
+  FORWARD-compatible by contract: readers skip record types they don't
+  know and ignore unknown top-level keys, so the format can grow
+  (new ``type`` lines, new fields) without breaking old consumers —
+  bump ``SCHEMA_VERSION`` on any change an old reader must not
+  silently misread.
 * **Prometheus text** — the ``# TYPE``-annotated exposition format, for
   scraping or file-based node-exporter pickup. Histograms render as
   summaries (quantile series + ``_sum``/``_count``); metric names are
-  sanitized (dots -> underscores).
+  sanitized (dots -> underscores). Every line carries a
+  ``process_index`` label (``registry.process_label()``) so multi-host
+  fleets aggregate without per-call-site label plumbing.
 * **In-process snapshot** — ``obs.telemetry_snapshot()`` (the
   ``obs/__init__`` API) returns the unified dict; these functions only
   serialize it.
@@ -24,6 +31,14 @@ from typing import Dict, List, Optional, Tuple
 
 from distkeras_tpu.obs import spans as _spans
 
+#: telemetry format version, stamped into ``telemetry_snapshot()``,
+#: every JSONL ``meta`` header and flight-recorder dump. Version 2 =
+#: this scheme's introduction (version 1 is the implicit, unstamped
+#: telemetry-PR format). Bump on changes an old reader must not
+#: silently misread; additive keys/record types do NOT need a bump
+#: (readers tolerate them by contract).
+SCHEMA_VERSION = 2
+
 _QUANTILE_KEYS = ("p50", "p99")
 
 
@@ -31,7 +46,8 @@ def snapshot_lines(snapshot: Dict, spans: Optional[List] = None,
                    seq: int = 0) -> List[str]:
     """Decompose a registry snapshot (+ optional
     ``spans.span_records()`` list) into JSONL lines."""
-    lines = [json.dumps({"type": "meta", "seq": seq})]
+    lines = [json.dumps({"type": "meta", "seq": seq,
+                         "schema_version": SCHEMA_VERSION})]
     for name, series in snapshot.get("counters", {}).items():
         for labels, value in series.items():
             lines.append(json.dumps(
@@ -59,7 +75,10 @@ def read_jsonl(path: str, seq: Optional[int] = None
                ) -> Tuple[Dict, List]:
     """Parse a JSONL export back into ``(snapshot, span_records)``.
     With ``seq=None`` the LATEST sequence in the file wins (the
-    append-log read convention)."""
+    append-log read convention). Forward-compatible: record types this
+    reader doesn't know are skipped and unknown top-level keys are
+    ignored, so a newer writer's log (higher ``schema_version``, extra
+    line types) still yields the series this version understands."""
     records = []
     with open(path) as f:
         for line in f:
@@ -73,7 +92,7 @@ def read_jsonl(path: str, seq: Optional[int] = None
     for r in records:
         if r.get("seq", 0) != seq:
             continue
-        t = r["type"]
+        t = r.get("type")
         if t == "counter":
             snapshot["counters"].setdefault(r["name"], {})[
                 r["labels"]] = r["value"]
@@ -127,12 +146,20 @@ def _prom_value(v: str) -> str:
 
 
 def _prom_labels(labels: str, extra: str = "") -> str:
-    from distkeras_tpu.obs.registry import parse_label_string
-    parts = [f'{_prom_name(k)}="{_prom_value(v)}"'
-             for k, v in parse_label_string(labels)]
+    from distkeras_tpu.obs.registry import (parse_label_string,
+                                            process_label)
+    pk, pv = process_label()
+    pairs = parse_label_string(labels)
+    # process_index first on EVERY line (multi-host groundwork; the
+    # single registry.process_label() helper is the only source) —
+    # unless the series carries its own, which wins (a duplicate label
+    # name is invalid exposition format and fails the whole scrape)
+    parts = ([] if any(_prom_name(k) == pk for k, _ in pairs)
+             else [f'{pk}="{_prom_value(pv)}"'])
+    parts += [f'{_prom_name(k)}="{_prom_value(v)}"' for k, v in pairs]
     if extra:
         parts.append(extra)            # quantile goes last, per convention
-    return "{" + ",".join(parts) + "}" if parts else ""
+    return "{" + ",".join(parts) + "}"
 
 
 def prometheus_text(snapshot: Optional[Dict] = None,

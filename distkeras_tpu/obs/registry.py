@@ -62,6 +62,28 @@ def label_string(key: Tuple[Tuple[str, str], ...]) -> str:
     return ",".join(f"{_esc(k)}={_esc(v)}" for k, v in key)
 
 
+_process_label: list = [None]
+
+
+def process_label() -> Tuple[str, str]:
+    """``("process_index", "<jax.process_index()>")`` — THE one helper
+    every exporter stamps onto its output lines (groundwork for the
+    multi-host runtime: a fleet's scraped series aggregate by process
+    without any per-call-site label plumbing). The first SUCCESSFUL
+    read is cached; a failure (jax unavailable / backend not yet
+    initialized) falls back to ``"0"`` WITHOUT caching, so an export
+    that runs before ``jax.distributed.initialize()`` does not pin
+    every later export on this host to process 0."""
+    if _process_label[0] is None:
+        try:
+            import jax
+            idx = str(int(jax.process_index()))
+        except Exception:
+            return ("process_index", "0")    # transient: retry next call
+        _process_label[0] = ("process_index", idx)
+    return _process_label[0]
+
+
 def parse_label_string(s: str):
     """Inverse of ``label_string``: ``[(key, value), ...]``."""
     if not s:

@@ -72,12 +72,22 @@ def epoch_exit(trainer, epoch: int, saved: bool, save_fn) -> bool:
     stop OR a preemption request, make sure THIS epoch is checkpointed
     (or resume would silently lose it) and tell the loop to break.
 
+    Also the step-ring hook: every epoch lands one record in the
+    flight recorder (``obs.recorder``), so a crash dump shows the
+    recent training timeline next to the serving iterations — a no-op
+    NULL object when telemetry is disabled.
+
     The preempt Event is consumed HERE, when it is acted on — not
     cleared at train() entry — so a SIGTERM landing between a
     supervisor's restart attempts (after the crash, before the resumed
     run installs its loop) still stops the resumed run at its first
     epoch instead of being silently dropped."""
     trainer.preempted = trainer._preempt.is_set()
+    from distkeras_tpu.obs.recorder import resolve_recorder
+    resolve_recorder().record(
+        "train.epoch", trainer=type(trainer).__name__, epoch=int(epoch),
+        saved=bool(saved), stop=bool(trainer.stop_training),
+        preempted=bool(trainer.preempted))
     if not (trainer.stop_training or trainer.preempted):
         return False
     if trainer.preempted:

@@ -36,8 +36,10 @@ environment::
 ``nth``, ``every``, ``prob``, ``seed``, ``stall``, ``action``,
 ``transient``). Every trigger increments the ``faults.triggered``
 counter (labeled by point) on the obs registry, so chaos runs are
-visible in ``telemetry_snapshot()``. ``docs/resilience.md`` carries the
-injection-point catalog.
+visible in ``telemetry_snapshot()`` — and notifies any registered
+``add_trigger_listener`` callbacks (the flight recorder
+``obs.recorder`` uses this to snapshot its ring at the moment of
+failure). ``docs/resilience.md`` carries the injection-point catalog.
 """
 
 from __future__ import annotations
@@ -49,8 +51,9 @@ import time
 from typing import Dict, List, Optional
 
 __all__ = [
-    "InjectedFault", "active", "clear", "corrupt", "fired", "inject",
-    "load_env", "point", "points", "reset",
+    "InjectedFault", "active", "add_trigger_listener", "clear",
+    "corrupt", "fired", "inject", "load_env", "point", "points",
+    "remove_trigger_listener", "reset",
 ]
 
 
@@ -127,6 +130,36 @@ _specs: Dict[str, _Spec] = {}
 _calls: Dict[str, int] = {}      # per-point site-call counts
 _fires: Dict[str, int] = {}      # per-point trigger counts
 _seen: Dict[str, bool] = {}      # self-registering site catalog
+_listeners: List = []            # trigger observers (flight recorder)
+
+
+def add_trigger_listener(fn) -> None:
+    """Register ``fn(point_name)`` to run on EVERY fault trigger,
+    before the fault's action executes — how the flight recorder
+    (``obs.recorder``) snapshots its ring at the moment of failure.
+    Idempotent per callable; listener errors are reported as warnings,
+    never masking the fault itself."""
+    with _lock:
+        if fn not in _listeners:
+            _listeners.append(fn)
+
+
+def remove_trigger_listener(fn) -> None:
+    with _lock:
+        if fn in _listeners:
+            _listeners.remove(fn)
+
+
+def _notify_listeners(name: str) -> None:
+    with _lock:
+        listeners = list(_listeners)
+    for fn in listeners:
+        try:
+            fn(name)
+        except Exception as e:
+            import warnings
+            warnings.warn(f"fault trigger listener {fn!r} failed for "
+                          f"point {name!r}: {e!r}", stacklevel=3)
 
 
 def inject(name: str, *, nth: Optional[int] = None,
@@ -201,6 +234,7 @@ def _check(name: str):
             return None
         _record_trigger(name)
     _note_obs(name)
+    _notify_listeners(name)
     return spec
 
 

@@ -182,7 +182,13 @@ class TrainingSupervisor:
 
     def _counter(self, name: str):
         from distkeras_tpu import obs
-        return obs.get_registry().counter(name)
+        # every call site passes a "supervisor.*" literal; the variable
+        # here is just the lazy-import shim
+        return obs.get_registry().counter(name)  # lint: allow-dynamic-metric-name
+
+    def _recorder(self):
+        from distkeras_tpu.obs.recorder import resolve_recorder
+        return resolve_recorder()
 
     def _install_signals(self):
         installed = {}
@@ -227,14 +233,27 @@ class TrainingSupervisor:
                         raise
                     self.rollbacks += 1
                     self._counter("supervisor.rollbacks").inc()
+                    # flight-recorder forensics: ring state at rollback
+                    rec = self._recorder()
+                    rec.record("supervisor.rollback",
+                               epoch=err.epoch, key=err.key,
+                               reason=err.reason, attempt=self.rollbacks)
+                    rec.auto_dump("supervisor.rollback")
                     self._rollback(err)
                     trainer.resume = True
                     continue
-                except self.restart_on:
+                except self.restart_on as err:
                     if self.restarts >= self.max_restarts:
                         raise
                     self.restarts += 1
                     self._counter("supervisor.restarts").inc()
+                    # dump the ring BEFORE the restart overwrites it —
+                    # the crash context (recent epochs/iterations) is
+                    # exactly what post-mortems need
+                    rec = self._recorder()
+                    rec.record("supervisor.restart", error=repr(err),
+                               attempt=self.restarts)
+                    rec.auto_dump("supervisor.restart")
                     trainer.resume = True
                     continue
                 preempted = bool(getattr(trainer, "preempted", False))

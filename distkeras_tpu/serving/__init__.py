@@ -17,9 +17,12 @@ per-step decode kernels and an actual serving workload:
                    (static shapes, jit compiled once), chunked prefill
                    interleaved between decode iterations, per-slot
                    sampling state
-    metrics.py     TTFT, request latency, queue depth, slot occupancy,
-                   tokens/s — the numbers ``bench.py --model serving``
-                   records
+    metrics.py     TTFT, TPOT, request latency, queue depth, slot
+                   occupancy, tokens/s — the numbers ``bench.py
+                   --model serving`` records; request-level timelines,
+                   the flight-recorder ring and declarative SLOs live
+                   in ``distkeras_tpu.obs`` (tracing/recorder/slo) and
+                   are wired through the engine
 
 See ``docs/serving.md`` for the architecture and scheduling policy.
 """

@@ -19,8 +19,12 @@ prefill/decode machinery:
   * Per-slot sampling state (temperature / top_k / top_p / stop_token
     vectors through ``_sample_vec``, per-slot PRNG keys) lets greedy
     and sampled requests with different stop tokens share one batch.
-  * ``ServingMetrics`` records TTFT, request latency, queue depth,
-    slot occupancy and the per-iteration decode rate.
+  * ``ServingMetrics`` records TTFT, TPOT, request latency, queue
+    depth, slot occupancy and the per-iteration decode rate; the
+    request-level layer rides along — per-request timelines
+    (``obs.tracing``, Chrome-trace exportable), a flight-recorder ring
+    of recent iterations (``obs.recorder``, auto-dumped on failures)
+    and declarative SLOs (``obs.slo``) reported by ``health()``.
 
 Greedy outputs are token-identical per request to a standalone
 ``generate()`` call on the same prompt (the oracle contract:
@@ -46,6 +50,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from distkeras_tpu import obs
+from distkeras_tpu.obs.recorder import resolve_recorder
+from distkeras_tpu.obs.slo import SLOEngine
+from distkeras_tpu.obs.tracing import resolve_tracer
 from distkeras_tpu.models.core import Model, Sequential
 from distkeras_tpu.models.decoding import (_attn_compute_dtype,
                                            _resolve_head_dims,
@@ -93,7 +100,8 @@ class ServingEngine:
                  prefill_chunk: Optional[int] = None,
                  cache_dtype=None, weights_dtype="auto",
                  metrics: Optional[ServingMetrics] = None,
-                 max_queue: Optional[int] = None):
+                 max_queue: Optional[int] = None,
+                 tracer=None, slo=None):
         module = model.module
         if not isinstance(module, Sequential):
             raise TypeError("ServingEngine expects a Sequential LM "
@@ -139,6 +147,22 @@ class ServingEngine:
         self.scheduler = FIFOScheduler(self.num_slots,
                                        max_queue=max_queue)
         self.metrics = metrics if metrics is not None else ServingMetrics()
+        # request-level observability (obs.tracing / obs.recorder /
+        # obs.slo): the tracer shares the metrics clock so timeline
+        # durations and measured latencies are directly comparable;
+        # the scheduler records admissions where they happen; the
+        # flight recorder is the process-global ring (NULL when obs is
+        # disabled); ``slo`` takes an SLOEngine or a sequence of
+        # Objectives (evaluated every _SLO_EVAL_EVERY iterations and
+        # reported by health())
+        self.tracer = resolve_tracer(tracer, clock=self.metrics.clock)
+        self.scheduler.tracer = (self.tracer if self.tracer.enabled
+                                 else None)
+        self.recorder = resolve_recorder()
+        if slo is None or isinstance(slo, SLOEngine):
+            self.slo = slo
+        else:
+            self.slo = SLOEngine(list(slo), clock=self.metrics.clock)
         self._requests: Dict[int, Request] = {}
         self._rid = itertools.count()
 
@@ -180,11 +204,20 @@ class ServingEngine:
 
     #: engine iterations between recompile-detector polls
     _RECOMPILE_CHECK_EVERY = 64
+    #: engine iterations between SLO evaluations (when ``slo`` is set)
+    _SLO_EVAL_EVERY = 32
 
     def _telemetry_summary(self):
         """obs.attach provider: the CURRENT metrics window's summary
-        (``self.metrics`` is swapped per reporting interval)."""
-        return self.metrics.summary()
+        (``self.metrics`` is swapped per reporting interval), plus the
+        compact per-request timelines and the latest SLO status —
+        additive keys on the established component shape."""
+        snap = self.metrics.summary()
+        if self.tracer.enabled:
+            snap["requests"] = self.tracer.summaries()
+        if self.slo is not None:
+            snap["slo"] = self.slo.status()
+        return snap
 
     # --- request intake ---------------------------------------------------
 
@@ -233,9 +266,16 @@ class ServingEngine:
             self.scheduler.submit(req)    # may shed (AdmissionRejected)
         except AdmissionRejected:
             self.metrics.record_rejected()
+            self.tracer.on_reject()
+            # storm detection lives in the recorder: enough sheds since
+            # the last dump auto-snapshot the ring (overload forensics)
+            self.recorder.note_rejection(
+                rid=req.rid, queue_depth=self.scheduler.queue_depth,
+                max_queue=self.scheduler.max_queue)
             raise
         self._requests[req.rid] = req
         self.metrics.record_submit(req.rid)
+        self.tracer.on_submit(req.rid, self.scheduler.queue_depth)
         return req.rid
 
     def __getitem__(self, rid: int) -> Request:
@@ -356,7 +396,21 @@ class ServingEngine:
         (the failed iteration retries wholesale)."""
         finished: List[Request] = []
         self._expire_deadlines(finished)
-        self.scheduler.admit()
+        admitted = self.scheduler.admit()
+
+        # flight-recorder ring: this iteration's composition, written
+        # BEFORE prefill/decode run so a mid-iteration fault dump
+        # contains the failing iteration itself (field assembly gated
+        # on a live recorder — the disabled path costs one check)
+        if self.recorder.enabled:
+            self.recorder.record(
+                "serving.iteration", iter=self._iters,
+                queue_depth=self.scheduler.queue_depth,
+                occupied=self.scheduler.occupied,
+                decoding=[r.rid for r in
+                          self.scheduler.running.values()],
+                prefilling=[r.rid for r in self.scheduler.prefilling],
+                admitted=[r.rid for r in admitted])
 
         req = self.scheduler.next_prefill()
         if req is not None:
@@ -379,6 +433,9 @@ class ServingEngine:
         self._iters += 1
         if self._iters % self._RECOMPILE_CHECK_EVERY == 0:
             self._recompile.check()
+        if self.slo is not None \
+                and self._iters % self._SLO_EVAL_EVERY == 0:
+            self.slo.evaluate(self.metrics)
         return finished
 
     def run(self, max_steps: Optional[int] = None,
@@ -403,6 +460,10 @@ class ServingEngine:
             for r in self.step():
                 if r.state is not RequestState.FINISHED \
                         and on_degraded == "raise":
+                    # crash forensics: snapshot the ring before the
+                    # degraded drain surfaces to the caller
+                    self.recorder.auto_dump(
+                        f"degraded_request:{r.state.value}")
                     raise DegradedRequest(r)
                 out[r.rid] = r.tokens
             steps += 1
@@ -460,6 +521,8 @@ class ServingEngine:
         if had_slot:
             self._t[req.slot] = self.max_len   # sentinel: slot inert
         req.error = error
+        self.tracer.on_terminal(req.rid, state.value,
+                                len(req.generated))
         del self._requests[req.rid]
         finished.append(req)
 
@@ -469,14 +532,29 @@ class ServingEngine:
         work, how deep is the queue, and the degradation tally of the
         CURRENT metrics window. ``status`` is ``"ok"`` while admission
         is open, ``"saturated"`` once the bounded queue is full (a
-        probe should stop routing new traffic here until it drains)."""
+        probe should stop routing new traffic here until it drains),
+        and ``"degraded"`` while accepting but in breach of a declared
+        SLO (``slo=`` objectives; the principled load-shed/reroute
+        trigger — a probe keeps the instance but weights traffic
+        away). The ``slo`` key carries the freshly evaluated
+        per-objective status (None without objectives)."""
         sch = self.scheduler
         accepting = (sch.max_queue is None
                      or sch.queue_depth < sch.max_queue)
         m = self.metrics
+        # record=False: a probe is a READ — it must not append to the
+        # SLO history, restamp gauges or count breach transitions, or
+        # the numbers would depend on how often a balancer polls
+        slo_status = (None if self.slo is None
+                      else self.slo.evaluate(m, record=False))
+        breaching = bool(slo_status) and any(
+            st["breach"] for st in slo_status.values())
+        status = ("saturated" if not accepting
+                  else "degraded" if breaching else "ok")
         return {
-            "status": "ok" if accepting else "saturated",
+            "status": status,
             "accepting": accepting,
+            "slo": slo_status,
             "queue_depth": sch.queue_depth,
             "max_queue": sch.max_queue,
             "slots": {"total": self.num_slots, "occupied": sch.occupied,
@@ -510,6 +588,7 @@ class ServingEngine:
                                    self._staging, chunk_toks)
         req.prefill_pos = t0 + q_len
         self.metrics.record_prefill_chunk()
+        self.tracer.on_prefill_chunk(req.rid, t0, q_len)
         if not final:
             return
         self.pool.insert(self._staging, req.slot)
@@ -519,6 +598,7 @@ class ServingEngine:
         token = int(first)
         req.generated.append(token)
         self.metrics.record_first_token(req.rid)
+        self.tracer.on_first_token(req.rid)
         if req.done:
             self._finish(req, finished)
             return
@@ -560,6 +640,11 @@ class ServingEngine:
         # the per-iteration host sync: the scheduler must see token ids
         # to detect stops and free slots (docs/serving.md, follow-ups)
         nxt = np.asarray(nxt)
+        if self.tracer.enabled:
+            # one aggregated decode tick per running request (the
+            # tracer folds decode_agg of these into one stored event)
+            self.tracer.on_decode(
+                [r.rid for r in self.scheduler.running.values()])
         for slot, req in list(self.scheduler.running.items()):
             token = int(nxt[slot])
             req.generated.append(token)
@@ -574,6 +659,8 @@ class ServingEngine:
         self.scheduler.release(req)
         self._t[slot] = self.max_len          # sentinel: slot inert
         self.metrics.record_finish(req.rid, len(req.generated))
+        self.tracer.on_terminal(req.rid, RequestState.FINISHED.value,
+                                len(req.generated))
         # evict: the caller owns the finished Request from here —
         # otherwise every prompt ever served stays resident
         del self._requests[req.rid]

@@ -2,7 +2,9 @@
 of which a single ``generate()`` call can even express.
 
 Per request: TTFT (submit -> first token — prefill queueing + prompt
-ingestion) and end-to-end latency. Per engine iteration: queue depth,
+ingestion), TPOT (mean seconds per generated token after the first —
+the streaming-cadence number the ``tpot_p99`` SLO reads) and
+end-to-end latency. Per engine iteration: queue depth,
 slot occupancy, decoding-slot count and decode wall time (the
 steady-state tokens/s series ``bench.py --model serving`` reduces).
 Phase wall-clock (prefill vs decode) rides on
@@ -53,7 +55,9 @@ class ServingMetrics:
             else MetricsRegistry(reservoir_size=reservoir)
         self.timer = StepTimer()                 # "prefill" / "decode"
         self.submit_ts: Dict[int, float] = {}    # in-flight only
+        self.first_ts: Dict[int, float] = {}     # in-flight only
         self._ttft = self.registry.histogram("serving.ttft_s")
+        self._tpot = self.registry.histogram("serving.tpot_s")
         self._latency = self.registry.histogram("serving.latency_s")
         self._qdepth = self.registry.histogram("serving.queue_depth")
         self._occ = self.registry.histogram("serving.slot_occupancy")
@@ -89,17 +93,24 @@ class ServingMetrics:
             self._t_first_submit = now_
 
     def record_first_token(self, rid: int) -> None:
+        now_ = self.clock()
         t0 = self.submit_ts.get(rid)
         if t0 is not None:
-            self._ttft.observe(self.clock() - t0)
+            self._ttft.observe(now_ - t0)
+            self.first_ts[rid] = now_
 
     def record_finish(self, rid: int, n_generated: int) -> None:
         now_ = self.clock()
-        # evict the in-flight entry: finished-request state must not
+        # evict the in-flight entries: finished-request state must not
         # accumulate in a long-lived engine
         t0 = self.submit_ts.pop(rid, None)
         if t0 is not None:
             self._latency.observe(now_ - t0)
+        t_first = self.first_ts.pop(rid, None)
+        if t_first is not None and n_generated > 1:
+            # TPOT: mean seconds per generated token AFTER the first
+            # (the streaming-cadence number; the first token is TTFT's)
+            self._tpot.observe((now_ - t_first) / (n_generated - 1))
         self._finished.inc()
         self._tokens.inc(int(n_generated))
         self._t_last_finish = now_
@@ -112,11 +123,13 @@ class ServingMetrics:
     def record_timeout(self, rid: int) -> None:
         """A request's deadline expired before it finished."""
         self.submit_ts.pop(rid, None)
+        self.first_ts.pop(rid, None)
         self._timed_out.inc()
 
     def record_cancelled(self, rid: int) -> None:
         """A request isolated after a step error (or cancelled by API)."""
         self.submit_ts.pop(rid, None)
+        self.first_ts.pop(rid, None)
         self._cancelled.inc()
 
     # --- per-iteration ----------------------------------------------------
@@ -224,6 +237,9 @@ class ServingMetrics:
             # marginal decode rate, all iterations / full batch only
             "decode_tokens_per_sec": self.decode_tokens_per_sec(),
             "ttft_s": self._pcts(self._ttft),
+            # key ADDED by the tracing/SLO PR (pre-existing keys
+            # unchanged): per-token decode cadence of finished requests
+            "tpot_s": self._pcts(self._tpot),
             "latency_s": self._pcts(self._latency),
             "queue_depth": ({"mean": qd["mean"], "max": qd["max"]}
                             if qd else None),

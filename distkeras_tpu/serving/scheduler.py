@@ -116,6 +116,11 @@ class FIFOScheduler:
         self.waiting: deque = deque()          # QUEUED, FIFO
         self.prefilling: deque = deque()       # PREFILLING, FIFO
         self.running: Dict[int, Request] = {}  # slot -> DECODING request
+        # request-level tracing hook (obs.tracing): the engine binds
+        # its tracer here so admission decisions are recorded WHERE
+        # they are made; None (standalone scheduler use) records
+        # nothing
+        self.tracer = None
         # pop() hands out slot 0 first — deterministic placement makes
         # oracle tests and trace reading reproducible
         self._free = list(range(self.num_slots))[::-1]
@@ -140,6 +145,11 @@ class FIFOScheduler:
             req.prefill_pos = 0
             self.prefilling.append(req)
             admitted.append(req)
+            if self.tracer is not None:
+                # queue depth AT admission: requests still waiting
+                # after this one took its slot
+                self.tracer.on_admit(req.rid, req.slot,
+                                     len(self.waiting))
         return admitted
 
     def next_prefill(self) -> Optional[Request]:

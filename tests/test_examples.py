@@ -106,6 +106,20 @@ def test_telemetry_tour_example(capsys):
     assert acc > 0.7, acc
 
 
+def test_request_tracing_example(capsys):
+    served = run_example("examples.request_tracing")
+    out = capsys.readouterr().out
+    # the request-level acceptance surface: per-request timelines, the
+    # Perfetto trace artifact, the SLO burn-rate report, and the
+    # flight-recorder ring
+    assert "request timelines" in out
+    assert "Chrome trace:" in out and "Perfetto" in out
+    assert "SLO report:" in out and "burn_rate" in out
+    assert "flight recorder ring" in out
+    assert "shed by bounded admission" in out
+    assert served >= 5
+
+
 def test_long_context_serving_example(capsys):
     run_example("examples.long_context_serving")
     out = capsys.readouterr().out

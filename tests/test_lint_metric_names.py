@@ -1,0 +1,67 @@
+"""tools/lint_metric_names.py wired into tier-1: registry metric names
+in library code must be literal ``component.snake_case`` strings — no
+f-strings or runtime-built names (the series-cardinality bomb no
+``max_series`` cap can fold) — and the checker itself must detect the
+patterns it claims to."""
+
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO / "tools"))
+
+from lint_metric_names import (ALLOW_MARK, NAME_RE,  # noqa: E402
+                               check_source, check_tree)
+
+
+def test_repo_library_code_uses_literal_metric_names():
+    findings = check_tree(REPO)
+    assert not findings, "\n".join(
+        f"{f}:{ln}: {msg}" for f, ln, msg in findings)
+
+
+def test_name_regex_accepts_the_repo_conventions():
+    for good in ("serving.ttft_s", "slo.burn_rate", "faults.triggered",
+                 "device.bytes_in_use", "a.b.c_d2"):
+        assert NAME_RE.match(good), good
+    for bad in ("serving", "Serving.ttft", "serving.TTFT", "serving.",
+                ".ttft", "serving..x", "serving.ttft-s", "1x.y"):
+        assert not NAME_RE.match(bad), bad
+
+
+def test_checker_flags_fstring_and_dynamic_names():
+    src = (
+        "r.counter(f'serving.{kind}')\n"
+        "r.gauge(name)\n"
+        "r.histogram('prefix.' + kind)\n"
+        "r.counter('serving.ok_name')\n"      # literal + well-formed
+    )
+    findings = check_source(src, "x.py")
+    assert [ln for _, ln, _ in findings] == [1, 2, 3]
+    assert "f-string" in findings[0][2]
+    assert "dynamic" in findings[1][2]
+
+
+def test_checker_flags_malformed_literals():
+    src = ("r.counter('NoDots')\n"
+           "r.gauge('Bad.Case')\n"
+           "r.histogram('fine.name')\n")
+    findings = check_source(src, "x.py")
+    assert [ln for _, ln, _ in findings] == [1, 2]
+    assert "component.snake_case" in findings[0][2]
+
+
+def test_checker_skips_marked_lines_and_non_metric_calls():
+    src = (
+        f"r.histogram(f'{{name}}.phase_s')  # {ALLOW_MARK}\n"
+        "collections.Counter(x)\n"            # not a metric method
+        "r.counter()\n"                       # no positional name
+        "r.describe(name)\n"                  # different method
+        '"""r.counter(f"doc.{x}") in a docstring is prose."""\n'
+    )
+    assert check_source(src, "x.py") == []
+
+
+def test_checker_reports_syntax_errors_as_findings():
+    findings = check_source("def broken(:\n", "x.py")
+    assert len(findings) == 1 and "syntax" in findings[0][2]

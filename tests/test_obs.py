@@ -159,7 +159,32 @@ def test_prometheus_escapes_device_style_labels():
     text = exporters.prometheus_text(r.snapshot())
     line = [ln for ln in text.splitlines() if ln.endswith(" 123.0")]
     assert line == ['distkeras_device_bytes_in_use'
-                    '{device="TPU_0(process=0,(0,0,0,0))"} 123.0'], text
+                    '{process_index="0",'
+                    'device="TPU_0(process=0,(0,0,0,0))"} 123.0'], text
+
+
+def test_prometheus_every_line_carries_process_index():
+    """Satellite (multi-host groundwork): every exported series line —
+    labeled or not — carries the process_index label from the single
+    registry.process_label() helper, with no per-call-site plumbing."""
+    from distkeras_tpu.obs.registry import process_label
+    assert process_label() == ("process_index", "0")
+    r = MetricsRegistry()
+    r.counter("a.b").inc()                     # unlabeled
+    r.gauge("c.d").set(1.0, k="v")             # labeled
+    r.histogram("e.f").observe(2.0)
+    text = exporters.prometheus_text(r.snapshot())
+    for ln in text.splitlines():
+        if ln.startswith("#") or not ln:
+            continue
+        assert 'process_index="0"' in ln, ln
+    # a series carrying its OWN process_index label wins — a duplicate
+    # label name is invalid exposition format (fails the whole scrape)
+    r2 = MetricsRegistry()
+    r2.counter("a.b").inc(process_index="3")
+    (line,) = [ln for ln in exporters.prometheus_text(
+        r2.snapshot()).splitlines() if not ln.startswith("#")]
+    assert line == 'distkeras_a_b_total{process_index="3"} 1.0'
 
 
 def test_registry_snapshot_shape():
@@ -257,6 +282,44 @@ def test_jsonl_roundtrip_reproduces_snapshot(tmp_path):
     assert {p for p, _t, _c in span_recs} == {("a",), ("a", "b")}
 
 
+def test_jsonl_header_carries_schema_version(tmp_path):
+    """Satellite: the meta header versions the format so trace/recorder
+    consumers can evolve it without breaking old logs."""
+    r = MetricsRegistry()
+    r.counter("a.b").inc()
+    path = str(tmp_path / "t.jsonl")
+    exporters.JsonlExporter(path).export(r.snapshot(), spans=[])
+    with open(path) as f:
+        meta = json.loads(f.readline())
+    assert meta["type"] == "meta"
+    assert meta["schema_version"] == exporters.SCHEMA_VERSION
+    assert obs.telemetry_snapshot()["schema_version"] \
+        == exporters.SCHEMA_VERSION
+
+
+def test_read_jsonl_tolerates_unknown_types_and_keys(tmp_path):
+    """Forward compatibility: a NEWER writer's log (unknown record
+    types, extra top-level keys, keyless lines) still yields the series
+    this reader understands — no KeyError, nothing dropped."""
+    path = str(tmp_path / "t.jsonl")
+    lines = [
+        {"type": "meta", "seq": 0, "schema_version": 99,
+         "written_by": "future-version"},
+        {"type": "counter", "seq": 0, "name": "a.b", "labels": "",
+         "value": 3.0, "future_field": {"x": 1}},
+        {"type": "request_trace", "seq": 0, "rid": 7},   # unknown type
+        {"note": "a line with no type key at all"},
+        {"type": "span", "seq": 0, "path": ["x"], "total_s": 1.0,
+         "count": 2, "self_s": 0.5},
+    ]
+    with open(path, "w") as f:
+        for rec in lines:
+            f.write(json.dumps(rec) + "\n")
+    snap, spans = exporters.read_jsonl(path)
+    assert snap["counters"]["a.b"][""] == 3.0
+    assert spans == [(("x",), 1.0, 2)]
+
+
 def test_jsonl_latest_seq_wins(tmp_path):
     r = MetricsRegistry()
     c = r.counter("n")
@@ -275,13 +338,16 @@ def test_jsonl_latest_seq_wins(tmp_path):
 def test_prometheus_text_format():
     text = exporters.prometheus_text(_populated_registry().snapshot())
     assert "# TYPE distkeras_req_total_total counter" in text
-    assert 'distkeras_req_total_total{route="gen"} 7.0' in text
+    assert ('distkeras_req_total_total{process_index="0",route="gen"} '
+            "7.0") in text
     assert "# TYPE distkeras_depth gauge" in text
     q50 = [ln for ln in text.splitlines()
-           if ln.startswith('distkeras_lat_s{route="gen",quantile="0.5"}')]
+           if ln.startswith('distkeras_lat_s{process_index="0",'
+                            'route="gen",quantile="0.5"}')]
     assert len(q50) == 1
     assert float(q50[0].rsplit(" ", 1)[1]) == pytest.approx(0.2)
-    assert 'distkeras_lat_s_count{route="gen"} 3' in text
+    assert ('distkeras_lat_s_count{process_index="0",route="gen"} 3'
+            in text)
 
 
 def test_xprof_tool_renders_span_table(tmp_path):
@@ -493,7 +559,10 @@ def test_serving_summary_keys_are_backward_compatible():
         "slot_occupancy", "prefill_chunks", "phases",
         # degradation tally ADDED by the resilience PR (pre-existing
         # keys above are the frozen compat contract)
-        "requests_rejected", "requests_timed_out", "requests_cancelled"}
+        "requests_rejected", "requests_timed_out", "requests_cancelled",
+        # per-token decode cadence ADDED by the tracing/SLO PR (feeds
+        # the tpot_p99 objective)
+        "tpot_s"}
 
 
 # --- integration: prefetch gauges -------------------------------------------

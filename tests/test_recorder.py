@@ -1,0 +1,242 @@
+"""Flight recorder (``obs.recorder``): the bounded ring, JSONL dumps,
+and every auto-dump trigger — chaos-injected serving faults, admission
+storms, degraded drains, supervisor restarts — plus the disabled
+NULL-object path."""
+
+import json
+
+import numpy as np
+import pytest
+
+from distkeras_tpu import obs
+from distkeras_tpu.data import Dataset
+from distkeras_tpu.models import Dense, Model, Sequential, zoo
+from distkeras_tpu.obs.recorder import (NULL_RECORDER, FlightRecorder,
+                                        get_recorder, read_flight_dump,
+                                        reset_recorder, resolve_recorder)
+from distkeras_tpu.parallel import SingleTrainer
+from distkeras_tpu.resilience import (InjectedFault, TrainingSupervisor,
+                                      faults)
+from distkeras_tpu.serving import (AdmissionRejected, DegradedRequest,
+                                   ServingEngine)
+
+
+@pytest.fixture(autouse=True)
+def _isolation(tmp_path):
+    """Fresh global recorder (dumping under tmp_path, unthrottled) and
+    a disarmed fault registry around every test."""
+    faults.reset()
+    reset_recorder()
+    rec = get_recorder()
+    rec.dump_dir = str(tmp_path / "flight")
+    rec.min_auto_interval_s = 0.0
+    yield rec
+    faults.reset()
+    reset_recorder()
+
+
+# --- ring + dump mechanics --------------------------------------------------
+
+
+def test_ring_is_bounded_and_ordered():
+    rec = FlightRecorder(capacity=5)
+    for i in range(12):
+        rec.record("t.kind", i=i)
+    records = rec.records()
+    assert len(records) == 5
+    assert [r["i"] for r in records] == list(range(7, 12))
+    assert all(r["kind"] == "t.kind" for r in records)
+
+
+def test_dump_writes_versioned_jsonl(tmp_path):
+    rec = FlightRecorder(capacity=8, dump_dir=str(tmp_path))
+    rec.record("a.b", x=1)
+    rec.record("c.d", y="z")
+    path = rec.dump(reason="unit test!")
+    header, records = read_flight_dump(path)
+    assert header["schema_version"] == obs.SCHEMA_VERSION
+    assert header["reason"] == "unit test!"
+    assert header["n_records"] == 2
+    assert [r["kind"] for r in records] == ["a.b", "c.d"]
+    assert records[0]["x"] == 1 and records[1]["y"] == "z"
+    # every line is valid standalone JSON
+    with open(path) as f:
+        for line in f:
+            json.loads(line)
+
+
+def test_auto_dump_is_throttled_but_explicit_dump_is_not(tmp_path):
+    rec = FlightRecorder(dump_dir=str(tmp_path),
+                         min_auto_interval_s=3600.0)
+    rec.record("x.y")
+    assert rec.auto_dump("first") is not None
+    assert rec.auto_dump("second") is None        # throttled
+    assert rec.dump("explicit") is not None       # never throttled
+    assert len(rec.dumps) == 2
+
+
+def test_resolve_recorder_null_object_when_disabled(_isolation):
+    assert resolve_recorder() is _isolation
+    obs.disable()
+    try:
+        rec = resolve_recorder()
+        assert rec is NULL_RECORDER and not rec.enabled
+        # the whole surface is a no-op
+        rec.record("a.b", x=1)
+        rec.note_rejection()
+        assert rec.auto_dump("r") is None and rec.dump() is None
+        assert rec.records() == []
+    finally:
+        obs.enable()
+
+
+# --- serving integration ----------------------------------------------------
+
+V, S = 29, 12
+PATTERN = np.array([3, 1, 4, 1, 5, 9, 2, 6, 5, 3, 5, 8])
+
+
+@pytest.fixture(scope="module")
+def tiny_lm():
+    return Model.build(
+        zoo.transformer_lm(V, d_model=32, num_heads=4, num_layers=2,
+                           mlp_ratio=2, use_rope=True), (S,), seed=0)
+
+
+def test_chaos_fault_dump_contains_failing_and_preceding_iterations(
+        tiny_lm, _isolation):
+    """THE acceptance shape: an armed ``serving.prefill`` fault fires
+    mid-run; the auto-dump holds the failing iteration (recorded at
+    step() entry, before the fault site runs) plus the preceding
+    iterations still in the ring."""
+    eng = ServingEngine(tiny_lm, num_slots=2, max_len=32)
+    assert eng.recorder is _isolation
+    # build up preceding history: several full iterations first
+    eng.submit(PATTERN[:4], 6)
+    eng.submit(PATTERN[:5], 5)
+    for _ in range(5):
+        eng.step()
+    fault_iter = eng._iters                 # the iteration that will fail
+    faults.inject("serving.prefill", nth=1)
+    eng.submit(PATTERN[:3], 4)              # its prefill will be poisoned
+    eng.step()                              # fault fires -> auto dump
+    assert faults.fired("serving.prefill") == 1
+    assert len(_isolation.dumps) == 1
+    header, records = read_flight_dump(_isolation.dumps[0])
+    assert header["reason"] == "fault:serving.prefill"
+    iters = [r["iter"] for r in records
+             if r["kind"] == "serving.iteration"]
+    assert fault_iter in iters              # the failing iteration
+    assert len([i for i in iters if i < fault_iter]) >= 4  # preceding
+    # the fault trigger itself is on the ring, after the iteration
+    kinds = [r["kind"] for r in records]
+    assert kinds.index("fault.triggered") \
+        > kinds.index("serving.iteration")
+    # batch composition rides on each iteration record
+    assert all({"queue_depth", "occupied", "decoding", "prefilling",
+                "admitted"} <= set(r)
+               for r in records if r["kind"] == "serving.iteration")
+
+
+def test_decode_fault_dump_fires_too(tiny_lm, _isolation):
+    eng = ServingEngine(tiny_lm, num_slots=1, max_len=24)
+    eng.submit(PATTERN[:4], 4)
+    eng.step()                               # prefill + first decode
+    faults.inject("serving.decode", nth=1)
+    with pytest.raises(InjectedFault):
+        eng.step()
+    assert any("fault_serving.decode" in p or "serving.decode" in p
+               for p in _isolation.dumps)
+
+
+def test_admission_storm_triggers_dump(tiny_lm, _isolation):
+    _isolation.reject_storm = 3
+    eng = ServingEngine(tiny_lm, num_slots=1, max_len=24, max_queue=1)
+    eng.submit(PATTERN[:4], 4)
+    eng.step()                               # admits into the one slot
+    eng.submit(PATTERN[:4], 4)               # fills the bounded queue
+    sheds = 0
+    for _ in range(5):
+        with pytest.raises(AdmissionRejected):
+            eng.submit(PATTERN[:4], 4)
+        sheds += 1
+    assert sheds == 5
+    assert len(_isolation.dumps) >= 1
+    header, records = read_flight_dump(_isolation.dumps[0])
+    assert header["reason"] == "admission_storm"
+    rejected = [r for r in records if r["kind"] == "serving.rejected"]
+    assert len(rejected) == 3                 # the storm threshold
+    assert all(r["max_queue"] == 1 for r in rejected)
+
+
+def test_degraded_request_drain_dumps(tiny_lm, _isolation):
+    eng = ServingEngine(tiny_lm, num_slots=1, max_len=24)
+    eng.submit(PATTERN[:4], 4, deadline_s=1e-6)
+    with pytest.raises(DegradedRequest):
+        eng.run(max_steps=100)
+    assert any("degraded_request_timed_out" in p
+               for p in _isolation.dumps)
+
+
+def test_disabled_engine_records_nothing(tiny_lm, _isolation):
+    obs.disable()
+    try:
+        eng = ServingEngine(tiny_lm, num_slots=1, max_len=24)
+        assert eng.recorder is NULL_RECORDER
+        eng.submit(PATTERN[:4], 3)
+        eng.run(max_steps=100)
+    finally:
+        obs.enable()
+    assert _isolation.records() == []         # global ring untouched
+    assert _isolation.dumps == []
+
+
+# --- trainer + supervisor integration ---------------------------------------
+
+
+def _ds(n=256):
+    rs = np.random.RandomState(0)
+    X = rs.randn(n, 8).astype(np.float32)
+    y = (X.sum(axis=1) > 0).astype(np.int64)
+    return Dataset({"features": X, "label": y})
+
+
+def _trainer(ckpt, num_epoch=3):
+    return SingleTrainer(
+        Model.build(Sequential([Dense(16, activation="relu"), Dense(2)]),
+                    (8,), seed=0),
+        batch_size=32, num_epoch=num_epoch, worker_optimizer="adam",
+        learning_rate=0.01,
+        loss="sparse_categorical_crossentropy_from_logits",
+        checkpoint_dir=ckpt)
+
+
+def test_epoch_ring_hook_records_every_epoch(tmp_path, _isolation):
+    _trainer(str(tmp_path / "ck")).train(_ds())
+    epochs = [r for r in _isolation.records()
+              if r["kind"] == "train.epoch"]
+    assert [r["epoch"] for r in epochs] == [0, 1, 2]
+    assert all(r["trainer"] == "SingleTrainer" for r in epochs)
+    assert all(r["saved"] for r in epochs)    # checkpoint_every=1
+
+
+def test_supervisor_restart_dumps_crash_context(tmp_path, _isolation):
+    faults.inject("train.epoch", nth=2)       # crash in epoch 1
+    sup = TrainingSupervisor(_trainer(str(tmp_path / "ck")),
+                             max_restarts=2, handle_signals=())
+    result = sup.run(_ds())
+    assert result.restarts == 1
+    restart_dumps = [p for p in _isolation.dumps
+                     if "supervisor.restart" in p]
+    assert len(restart_dumps) == 1
+    header, records = read_flight_dump(restart_dumps[0])
+    kinds = [r["kind"] for r in records]
+    # crash context: the epochs before the crash, the fault trigger,
+    # and the supervisor's intervention record
+    assert "train.epoch" in kinds
+    assert "fault.triggered" in kinds
+    assert kinds[-1] == "supervisor.restart"
+    (restart,) = [r for r in records
+                  if r["kind"] == "supervisor.restart"]
+    assert restart["attempt"] == 1
+    assert "InjectedFault" in restart["error"]

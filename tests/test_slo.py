@@ -1,0 +1,301 @@
+"""SLO engine (``obs.slo``): objective math (good fraction, burn rate,
+breach), the tpot histogram feeding it, engine/health integration, and
+the exporter round-trips of the new slo/trace series (hostile TPU
+device-string labels included)."""
+
+import numpy as np
+import pytest
+
+from distkeras_tpu import obs
+from distkeras_tpu.obs import exporters
+from distkeras_tpu.obs.registry import MetricsRegistry
+from distkeras_tpu.obs.slo import (Objective, SLOEngine, availability,
+                                   latency_objective, tpot_p99, ttft_p99)
+from distkeras_tpu.models import Model, zoo
+from distkeras_tpu.serving import ServingEngine, ServingMetrics
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 50.0
+
+    def advance(self, dt):
+        self.t += dt
+
+    def __call__(self):
+        return self.t
+
+
+def _metrics_with_ttfts(clk, ttfts):
+    """A ServingMetrics window holding exactly these TTFT samples."""
+    m = ServingMetrics(clock=clk)
+    for rid, ttft in enumerate(ttfts):
+        m.record_submit(rid)
+        clk.advance(ttft)
+        m.record_first_token(rid)
+        m.record_finish(rid, 1)
+    return m
+
+
+# --- objective validation ---------------------------------------------------
+
+
+def test_objective_validation():
+    with pytest.raises(ValueError, match="kind"):
+        Objective("x", "throughput")
+    with pytest.raises(ValueError, match="target"):
+        Objective("x", "latency", "m.h", 1.0, target=1.0)
+    with pytest.raises(ValueError, match="metric"):
+        Objective("x", "latency", "", 1.0)
+    with pytest.raises(ValueError, match="threshold"):
+        Objective("x", "latency", "m.h", 0.0)
+    with pytest.raises(ValueError, match="at least one"):
+        SLOEngine([])
+    with pytest.raises(ValueError, match="duplicate"):
+        SLOEngine([ttft_p99(1.0), ttft_p99(2.0)])
+
+
+# --- evaluation math --------------------------------------------------------
+
+
+def test_latency_objective_good_fraction_burn_and_breach():
+    clk = FakeClock()
+    # 8 of 10 requests within 1.0s: good_fraction 0.8
+    m = _metrics_with_ttfts(clk, [0.1] * 8 + [5.0, 5.0])
+    reg = MetricsRegistry()
+    slo = SLOEngine([latency_objective("ttft_p90", "serving.ttft_s",
+                                       1.0, target=0.9)],
+                    clock=clk, registry=reg)
+    st = slo.evaluate(m)["ttft_p90"]
+    assert st["n"] == 10
+    assert st["good_fraction"] == pytest.approx(0.8)
+    # burn rate: bad fraction 0.2 over budget 0.1 -> 2x
+    assert st["burn_rate"] == pytest.approx(2.0)
+    assert st["breach"] is True
+    assert st["value"] > 1.0                 # the p90 exceeds threshold
+    assert st["threshold_s"] == 1.0
+
+
+def test_latency_objective_clean_window():
+    clk = FakeClock()
+    m = _metrics_with_ttfts(clk, [0.1] * 10)
+    slo = SLOEngine([ttft_p99(1.0)], clock=clk,
+                    registry=MetricsRegistry())
+    st = slo.evaluate(m)["ttft_p99"]
+    assert st["good_fraction"] == 1.0
+    assert st["burn_rate"] == 0.0
+    assert st["breach"] is False
+
+
+def test_empty_window_is_vacuously_good():
+    clk = FakeClock()
+    slo = SLOEngine([ttft_p99(1.0), availability()], clock=clk,
+                    registry=MetricsRegistry())
+    st = slo.evaluate(ServingMetrics(clock=clk))
+    assert st["ttft_p99"]["good_fraction"] == 1.0
+    assert st["ttft_p99"]["value"] is None
+    assert st["availability"]["good_fraction"] == 1.0
+    assert not st["ttft_p99"]["breach"]
+
+
+def test_availability_counts_all_degradation_paths():
+    clk = FakeClock()
+    m = ServingMetrics(clock=clk)
+    for rid in range(7):
+        m.record_submit(rid)
+        m.record_finish(rid, 1)
+    m.record_rejected()
+    m.record_timeout(100)
+    m.record_cancelled(101)
+    slo = SLOEngine([availability(target=0.75)], clock=clk,
+                    registry=MetricsRegistry())
+    st = slo.evaluate(m)["availability"]
+    assert st["n"] == 10
+    assert st["good_fraction"] == pytest.approx(0.7)
+    # bad 0.3 over budget 0.25 -> 1.2x burn, in breach
+    assert st["burn_rate"] == pytest.approx(1.2)
+    assert st["breach"] is True
+
+
+def test_tpot_histogram_matches_hand_computation():
+    clk = FakeClock()
+    m = ServingMetrics(clock=clk)
+    m.record_submit(0)
+    clk.advance(0.5)
+    m.record_first_token(0)                  # TTFT = 0.5
+    clk.advance(0.9)
+    m.record_finish(0, 10)                   # 9 tokens after the first
+    (tpot,) = m.registry.histogram("serving.tpot_s").samples()
+    assert tpot == pytest.approx(0.9 / 9)
+    assert m.summary()["tpot_s"]["p50"] == pytest.approx(0.1)
+    # single-token requests contribute no tpot sample
+    m.record_submit(1)
+    m.record_first_token(1)
+    m.record_finish(1, 1)
+    assert len(m.registry.histogram("serving.tpot_s").samples()) == 1
+
+
+def test_breach_counter_increments_on_transitions_only():
+    clk = FakeClock()
+    reg = MetricsRegistry()
+    slo = SLOEngine([ttft_p99(1.0)], clock=clk, registry=reg)
+    bad = _metrics_with_ttfts(clk, [5.0] * 10)
+    good = _metrics_with_ttfts(FakeClock(), [0.1] * 10)
+    slo.evaluate(bad)                        # ok -> breach: +1
+    slo.evaluate(bad)                        # still breached: no inc
+    slo.evaluate(good)                       # heals
+    slo.evaluate(bad)                        # breaches again: +1
+    assert reg.counter("slo.breach").value(objective="ttft_p99") == 2
+    # gauges carry the latest evaluation
+    assert reg.gauge("slo.burn_rate").value(
+        objective="ttft_p99") == pytest.approx(100.0)
+    assert reg.gauge("slo.good_fraction").value(
+        objective="ttft_p99") == 0.0
+
+
+def test_unrecorded_evaluation_has_no_side_effects():
+    """``evaluate(record=False)`` — the health()-probe variant — must
+    not touch history, gauges or the breach counter: probe frequency
+    cannot shape the SLO record."""
+    clk = FakeClock()
+    reg = MetricsRegistry()
+    slo = SLOEngine([ttft_p99(1.0)], clock=clk, registry=reg)
+    bad = _metrics_with_ttfts(clk, [5.0] * 4)
+    st = slo.evaluate(bad, record=False)
+    assert st["ttft_p99"]["breach"] is True    # same statuses computed
+    assert slo.status() is None                # no history appended
+    assert slo.breached() == []                # no transition tracked
+    assert reg.counter("slo.breach").value(objective="ttft_p99") == 0
+    assert reg.gauge("slo.burn_rate").value(objective="ttft_p99") is None
+
+
+def test_status_reports_rolling_window_max_burn():
+    clk = FakeClock()
+    slo = SLOEngine([ttft_p99(1.0)], window_s=100.0, clock=clk,
+                    registry=MetricsRegistry())
+    assert slo.status() is None              # before any evaluation
+    slo.evaluate(_metrics_with_ttfts(clk, [5.0] * 4))   # burn 100x
+    clk.advance(10.0)
+    slo.evaluate(_metrics_with_ttfts(FakeClock(), [0.1] * 4))
+    st = slo.status()
+    assert st["objectives"]["ttft_p99"]["burn_rate"] == 0.0
+    assert st["objectives"]["ttft_p99"]["window_max_burn_rate"] \
+        == pytest.approx(100.0)
+    assert st["ok"] is True                  # latest evaluation is clean
+    assert slo.breached() == []
+    # evaluations older than window_s age out of the window max
+    clk.advance(200.0)
+    slo.evaluate(_metrics_with_ttfts(FakeClock(), [0.1] * 4))
+    assert slo.status()["objectives"]["ttft_p99"][
+        "window_max_burn_rate"] == 0.0
+
+
+# --- engine integration -----------------------------------------------------
+
+V, S = 29, 12
+PATTERN = np.array([3, 1, 4, 1, 5, 9, 2, 6, 5, 3, 5, 8])
+
+
+@pytest.fixture(scope="module")
+def tiny_lm():
+    return Model.build(
+        zoo.transformer_lm(V, d_model=32, num_heads=4, num_layers=2,
+                           mlp_ratio=2, use_rope=True), (S,), seed=0)
+
+
+def test_engine_health_reports_slo_status(tiny_lm):
+    eng = ServingEngine(tiny_lm, num_slots=2, max_len=32,
+                        slo=[ttft_p99(60.0), tpot_p99(30.0),
+                             availability()])
+    eng.submit(PATTERN[:4], 5)
+    eng.submit(PATTERN[:5], 4)
+    eng.run(max_steps=300)
+    h = eng.health()
+    assert h["status"] == "ok"
+    assert set(h["slo"]) == {"ttft_p99", "tpot_p99", "availability"}
+    assert all(not st["breach"] for st in h["slo"].values())
+    # the component view carries the same status (additive key)
+    assert "slo" in eng._telemetry_summary()
+
+
+def test_engine_health_degrades_on_breach(tiny_lm):
+    clk = FakeClock()
+    eng = ServingEngine(tiny_lm, num_slots=1, max_len=24,
+                        metrics=ServingMetrics(clock=clk),
+                        slo=[availability(target=0.9)])
+    assert eng.slo.clock is clk              # objectives on the engine clock
+    # drive availability under target: one finish, two timeouts
+    eng.submit(PATTERN[:4], 2)
+    eng.run(max_steps=100)
+    for _ in range(2):
+        rid = eng.submit(PATTERN[:4], 4, deadline_s=0.5)
+        clk.advance(1.0)
+        eng.step()
+        assert eng.tracer.summaries()[rid]["state"] == "timed_out"
+    h = eng.health()
+    assert h["accepting"] is True
+    assert h["status"] == "degraded"         # the principled trigger
+    assert h["slo"]["availability"]["breach"] is True
+
+
+def test_engine_without_slo_is_unchanged(tiny_lm):
+    eng = ServingEngine(tiny_lm, num_slots=1, max_len=24)
+    assert eng.slo is None
+    eng.submit(PATTERN[:4], 2)
+    eng.run(max_steps=100)
+    h = eng.health()
+    assert h["status"] == "ok" and h["slo"] is None
+
+
+def test_engine_evaluates_periodically_during_step(tiny_lm):
+    eng = ServingEngine(tiny_lm, num_slots=1, max_len=64,
+                        slo=[ttft_p99(60.0)])
+    eng.submit(PATTERN[:4], 40)              # enough decode iterations
+    eng.run(max_steps=200)
+    assert eng._iters > eng._SLO_EVAL_EVERY
+    assert eng.slo.status() is not None      # evaluated mid-run
+
+
+# --- exporter round-trips of the new series (satellite) ---------------------
+
+
+def test_slo_series_prometheus_roundtrip_with_hostile_labels():
+    """The PR-3 regression surface extended to the new metric families:
+    TPU device strings (``,``/``=`` inside values) through the slo
+    gauges and the flat label form, out to Prometheus text."""
+    from distkeras_tpu.obs.registry import (label_string,
+                                            parse_label_string)
+    reg = MetricsRegistry()
+    hostile = "TPU_0(process=0,(0,0,0,0))"
+    reg.gauge("slo.burn_rate").set(2.5, objective="ttft_p99",
+                                   device=hostile)
+    reg.counter("slo.breach").inc(objective="tpot=p99,odd", device=hostile)
+    reg.histogram("serving.tpot_s").observe(0.125, device=hostile)
+    # flat-form round trip
+    for metric in ("slo.burn_rate", "slo.breach"):
+        snap_section = ("gauges" if metric == "slo.burn_rate"
+                        else "counters")
+        series = reg.snapshot()[snap_section][metric]
+        for flat in series:
+            parsed = parse_label_string(flat)
+            assert label_string(tuple(parsed)) == flat
+            assert dict(parsed)["device"] == hostile
+    # prometheus text: values intact, device quoted verbatim
+    text = exporters.prometheus_text(reg.snapshot())
+    assert ('distkeras_slo_burn_rate{process_index="0",'
+            f'device="{hostile}",objective="ttft_p99"}} 2.5') in text
+    assert ('distkeras_slo_breach_total{process_index="0",'
+            f'device="{hostile}",objective="tpot=p99,odd"}} 1.0') in text
+    assert "distkeras_serving_tpot_s_count" in text
+
+
+def test_slo_and_tpot_series_jsonl_roundtrip(tmp_path):
+    reg = MetricsRegistry()
+    hostile = "TPU_0(process=0,(0,0,0,0))"
+    reg.gauge("slo.burn_rate").set(1.5, objective="ttft_p99",
+                                   device=hostile)
+    reg.histogram("serving.tpot_s").observe(0.25, device=hostile)
+    path = str(tmp_path / "slo.jsonl")
+    exporters.JsonlExporter(path).export(reg.snapshot(), spans=[])
+    snap, _ = exporters.read_jsonl(path)
+    assert snap == reg.snapshot()            # lossless, labels intact

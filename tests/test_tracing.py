@@ -1,0 +1,315 @@
+"""Request-level tracing (``obs.tracing``): the per-request timeline,
+its token-exact duration accounting, the Chrome/Perfetto trace export,
+and the serving-engine integration points."""
+
+import json
+
+import numpy as np
+import pytest
+
+from distkeras_tpu import obs
+from distkeras_tpu.models import Model, zoo
+from distkeras_tpu.obs.tracing import (NULL_TRACER, RequestTracer,
+                                       resolve_tracer)
+from distkeras_tpu.serving import ServingEngine, ServingMetrics
+
+
+class FakeClock:
+    """Deterministic injectable clock (monotonic; advance() moves it)."""
+
+    def __init__(self):
+        self.t = 100.0
+
+    def advance(self, dt):
+        self.t += dt
+
+    def __call__(self):
+        return self.t
+
+
+# --- tracer unit behavior ---------------------------------------------------
+
+
+def test_timeline_durations_sum_exactly_to_latency():
+    clk = FakeClock()
+    tr = RequestTracer(clock=clk)
+    tr.on_submit(0, queue_depth=3)
+    clk.advance(0.5)                       # queued
+    tr.on_admit(0, slot=1, queue_depth=2)
+    clk.advance(0.25)                      # prefill
+    tr.on_first_token(0)
+    clk.advance(1.25)                      # decode
+    tr.on_terminal(0, "finished", n_tokens=10)
+    s = tr.summaries()[0]
+    d = s["durations"]
+    assert d["queued_s"] == pytest.approx(0.5)
+    assert d["prefill_s"] == pytest.approx(0.25)
+    assert d["ttft_s"] == pytest.approx(0.75)
+    assert d["decode_s"] == pytest.approx(1.25)
+    assert d["total_s"] == pytest.approx(2.0)
+    # the token-exactness identity: phases partition the latency
+    assert d["queued_s"] + d["prefill_s"] + d["decode_s"] \
+        == pytest.approx(d["total_s"], abs=1e-12)
+    assert s["slot"] == 1
+    assert s["queue_depth_at_submit"] == 3
+    assert s["queue_depth_at_admit"] == 2
+    assert s["state"] == "finished" and s["n_tokens"] == 10
+
+
+def test_timeline_terminated_mid_prefill_still_partitions_latency():
+    """A request that dies after admission but before its first token
+    attributes the admit->end span to prefill, so the sum-exactly
+    invariant holds on every terminal path."""
+    clk = FakeClock()
+    tr = RequestTracer(clock=clk)
+    tr.on_submit(0, 1)
+    clk.advance(0.5)
+    tr.on_admit(0, slot=0, queue_depth=0)
+    clk.advance(0.75)                      # dies ingesting its prompt
+    tr.on_terminal(0, "cancelled", 0)
+    d = tr.summaries()[0]["durations"]
+    assert d == {"queued_s": pytest.approx(0.5),
+                 "prefill_s": pytest.approx(0.75),
+                 "total_s": pytest.approx(1.25)}
+    assert "ttft_s" not in d and "decode_s" not in d
+
+
+def test_timeline_terminated_while_queued_has_no_slot_phases():
+    clk = FakeClock()
+    tr = RequestTracer(clock=clk)
+    tr.on_submit(5, queue_depth=9)
+    clk.advance(2.0)
+    tr.on_terminal(5, "timed_out", n_tokens=0)
+    d = tr.summaries()[5]["durations"]
+    assert d == {"queued_s": pytest.approx(2.0),
+                 "total_s": pytest.approx(2.0)}
+
+
+def test_decode_events_aggregate_per_n_iterations():
+    clk = FakeClock()
+    tr = RequestTracer(clock=clk, decode_agg=4)
+    tr.on_submit(0, 0)
+    tr.on_admit(0, 0, 0)
+    tr.on_first_token(0)
+    for _ in range(10):
+        clk.advance(0.01)
+        tr.on_decode([0])
+    tr.on_terminal(0, "finished", 11)
+    (tl,) = tr.timelines()
+    decode_events = [e for e in tl.events if e["name"] == "decode"]
+    # 10 iterations at agg=4: two full windows + one terminal flush
+    assert [e["iters"] for e in decode_events] == [4, 4, 2]
+    assert tl.decode_iters == 10
+
+
+def test_tracer_bounds_completed_timelines_and_events():
+    tr = RequestTracer(max_requests=3, max_events=8)
+    for rid in range(5):
+        tr.on_submit(rid, 0)
+        tr.on_admit(rid, 0, 0)
+        for c in range(20):                 # far past max_events
+            tr.on_prefill_chunk(rid, c, 1)
+        tr.on_terminal(rid, "finished", 1)
+    tls = tr.timelines()
+    assert [t.rid for t in tls] == [2, 3, 4]   # ring: oldest evicted
+    for t in tls:
+        assert len(t.events) == 8
+        assert t.summary()["dropped_events"] > 0
+        assert t.prefill_chunks == 20           # counters stay exact
+
+
+def test_events_for_unknown_rid_are_ignored():
+    tr = RequestTracer()
+    tr.on_first_token(42)
+    tr.on_decode([42])
+    tr.on_terminal(42, "finished", 1)
+    assert tr.summaries() == {}
+
+
+def test_resolve_tracer_policy():
+    assert resolve_tracer(False) is NULL_TRACER
+    t = RequestTracer()
+    assert resolve_tracer(t) is t
+    assert resolve_tracer(None).enabled
+    obs.disable()
+    try:
+        assert resolve_tracer(None) is NULL_TRACER
+    finally:
+        obs.enable()
+
+
+# --- Chrome trace export ----------------------------------------------------
+
+
+def _flows(events, ph):
+    return [e for e in events if e.get("ph") == ph]
+
+
+def test_chrome_trace_one_complete_flow_per_request():
+    clk = FakeClock()
+    tr = RequestTracer(clock=clk)
+    for rid in (0, 1):
+        tr.on_submit(rid, rid)
+        clk.advance(0.1)
+        tr.on_admit(rid, rid, 0)
+        clk.advance(0.1)
+        tr.on_first_token(rid)
+        clk.advance(0.1)
+        tr.on_terminal(rid, "finished", 3)
+    # a third request sheds in the queue: still one complete flow
+    tr.on_submit(2, 5)
+    clk.advance(0.05)
+    tr.on_terminal(2, "cancelled", 0)
+    ct = tr.chrome_trace()
+    ct = json.loads(json.dumps(ct))        # validates as JSON
+    events = ct["traceEvents"]
+    starts, finishes = _flows(events, "s"), _flows(events, "f")
+    assert sorted(e["id"] for e in starts) == [0, 1, 2]
+    assert sorted(e["id"] for e in finishes) == [0, 1, 2]
+    for s in starts:                       # each start has its finish
+        (f,) = [f for f in finishes if f["id"] == s["id"]]
+        assert f["ts"] >= s["ts"]
+    # request tracks carry the three phase slices; slot tracks the
+    # occupancy interval; a queued-only request has just "queued"
+    names = {(e["pid"], e["tid"], e["name"]) for e in events
+             if e.get("ph") == "X"}
+    for rid in (0, 1):
+        assert (1, rid, "queued") in names
+        assert (1, rid, "prefill") in names
+        assert (1, rid, "decode") in names
+        assert (0, rid, f"req {rid}") in names
+    assert (1, 2, "queued") in names
+    assert not any(t == (1, 2, "prefill") for t in names)
+    # durations are microseconds on the shared clock
+    (q0,) = [e for e in events if e.get("ph") == "X"
+             and e["pid"] == 1 and e["tid"] == 0
+             and e["name"] == "queued"]
+    assert q0["dur"] == pytest.approx(0.1 * 1e6)
+
+
+def test_chrome_trace_dump_is_loadable_json(tmp_path):
+    tr = RequestTracer()
+    tr.on_submit(0, 0)
+    tr.on_admit(0, 0, 0)
+    tr.on_first_token(0)
+    tr.on_terminal(0, "finished", 2)
+    path = tr.dump_chrome_trace(str(tmp_path / "trace.json"))
+    with open(path) as f:
+        ct = json.load(f)
+    assert ct["displayTimeUnit"] == "ms"
+    assert any(e.get("ph") == "M" for e in ct["traceEvents"])
+
+
+# --- engine integration -----------------------------------------------------
+
+V, S = 29, 12
+PATTERN = np.array([3, 1, 4, 1, 5, 9, 2, 6, 5, 3, 5, 8])
+
+
+@pytest.fixture(scope="module")
+def tiny_lm():
+    """Untrained tiny LM: tracing asserts timelines, not token values."""
+    return Model.build(
+        zoo.transformer_lm(V, d_model=32, num_heads=4, num_layers=2,
+                           mlp_ratio=2, use_rope=True), (S,), seed=0)
+
+
+def test_engine_timelines_are_token_exact_under_staggered_arrivals(
+        tiny_lm):
+    """The acceptance shape: a staggered-arrival run's per-request
+    traces show admitted -> TTFT -> finish with durations summing
+    (exactly — same clock on both sides) to the measured latency, and
+    every request's decode-iteration count equals its generated tokens
+    minus the prefill-sampled first one."""
+    eng = ServingEngine(tiny_lm, num_slots=2, max_len=32)
+    rids = [eng.submit(PATTERN[:4], 6), eng.submit(PATTERN[:6], 5)]
+    eng.step()
+    eng.step()
+    rids += [eng.submit(PATTERN[:3], 7), eng.submit(PATTERN[:5], 4)]
+    out = eng.run(max_steps=500)
+    assert sorted(out) == sorted(rids)
+    summ = eng.tracer.summaries()
+    for i, rid in enumerate(rids):
+        s = summ[rid]
+        d = s["durations"]
+        assert s["state"] == "finished"
+        assert s["slot"] in (0, 1)
+        # phases partition the request's life exactly
+        assert d["queued_s"] + d["prefill_s"] + d["decode_s"] \
+            == pytest.approx(d["total_s"], abs=1e-9)
+        assert d["ttft_s"] == pytest.approx(
+            d["queued_s"] + d["prefill_s"], abs=1e-9)
+        # token-exact: one decode iteration per generated token after
+        # the prefill-sampled first
+        budget = [6, 5, 7, 4][i]
+        assert s["n_tokens"] == budget
+        assert s["decode_iters"] == budget - 1
+    # the engine-measured latency histogram and the timeline totals are
+    # the same numbers on the same clock; the edges are adjacent (not
+    # shared) clock reads, so agreement is within clock tolerance
+    lats = sorted(eng.metrics.latencies())
+    totals = sorted(s["durations"]["total_s"] for s in summ.values())
+    assert lats == pytest.approx(totals, abs=5e-3)
+    # Chrome trace: one complete flow per request
+    ct = json.loads(json.dumps(eng.tracer.chrome_trace()))
+    starts = _flows(ct["traceEvents"], "s")
+    finishes = _flows(ct["traceEvents"], "f")
+    assert sorted(e["id"] for e in starts) == sorted(rids)
+    assert sorted(e["id"] for e in finishes) == sorted(rids)
+
+
+def test_engine_merges_request_summaries_into_component(tiny_lm):
+    eng = ServingEngine(tiny_lm, num_slots=1, max_len=24)
+    rid = eng.submit(PATTERN[:4], 3)
+    eng.run(max_steps=200)
+    # earlier engines may still be alive and own the plain "serving"
+    # name; THIS engine's component is whichever serving* entry holds
+    # our rid
+    comps = obs.telemetry_snapshot()["components"]
+    mine = [c for n, c in comps.items() if n.startswith("serving")
+            and rid in c.get("requests", {})]
+    assert len(mine) == 1
+    assert mine[0]["requests"][rid]["state"] == "finished"
+
+
+def test_engine_tracer_records_queue_depth_and_slot(tiny_lm):
+    eng = ServingEngine(tiny_lm, num_slots=1, max_len=24)
+    r0 = eng.submit(PATTERN[:4], 3)
+    r1 = eng.submit(PATTERN[:4], 3)      # waits behind r0
+    eng.run(max_steps=300)
+    s0, s1 = eng.tracer.summaries()[r0], eng.tracer.summaries()[r1]
+    assert s0["queue_depth_at_submit"] == 1   # itself, pre-admission
+    assert s1["queue_depth_at_submit"] == 2
+    assert s0["slot"] == 0 and s1["slot"] == 0  # slot recycled
+    assert s1["durations"]["queued_s"] > 0
+
+
+def test_engine_with_disabled_obs_uses_null_tracer(tiny_lm):
+    obs.disable()
+    try:
+        eng = ServingEngine(tiny_lm, num_slots=1, max_len=24)
+        assert eng.tracer is NULL_TRACER
+        assert eng.scheduler.tracer is None
+        eng.submit(PATTERN[:4], 2)
+        eng.run(max_steps=200)
+        assert eng.tracer.summaries() == {}
+    finally:
+        obs.enable()
+
+
+def test_engine_cancel_and_timeout_land_in_timeline(tiny_lm):
+    clk = FakeClock()
+    metrics = ServingMetrics(clock=clk)
+    eng = ServingEngine(tiny_lm, num_slots=1, max_len=24,
+                        metrics=metrics)
+    # tracer auto-created on the SAME injectable clock
+    assert eng.tracer.clock is clk
+    r0 = eng.submit(PATTERN[:4], 5, deadline_s=1.0)
+    clk.advance(2.0)                       # expire before any work
+    eng.step()
+    s = eng.tracer.summaries()[r0]
+    assert s["state"] == "timed_out"
+    assert s["durations"]["total_s"] == pytest.approx(2.0)
+    r1 = eng.submit(PATTERN[:4], 5)
+    eng.cancel(r1)
+    assert eng.tracer.summaries()[r1]["state"] == "cancelled"

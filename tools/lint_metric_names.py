@@ -1,0 +1,129 @@
+#!/usr/bin/env python
+"""Static check: registry metric names are literal ``component.snake_case``.
+
+The metrics registry (``obs.registry``) keys series by NAME; labels
+carry the variable dimensions. A name built at runtime — an f-string,
+a concatenation, a variable — is the classic cardinality bomb: every
+novel value mints a new top-level series, which no ``max_series`` cap
+folds (the cap bounds LABEL sets per metric, not metric count), and
+dashboards/alerts can't be written against names that don't exist in
+the source. The telemetry layer's convention, stated in
+docs/observability.md, is therefore:
+
+  * every ``registry.counter(...)`` / ``.gauge(...)`` /
+    ``.histogram(...)`` call in library code passes a STRING LITERAL
+    first argument;
+  * the literal matches ``component.snake_case`` — a lowercase
+    dotted path like ``serving.ttft_s`` or ``slo.burn_rate`` (at
+    least one dot: the first segment names the owning component).
+
+This linter walks the AST (docstrings and comments never
+false-positive) of the ``distkeras_tpu`` package and flags violations
+of both rules. Justified exceptions — e.g. a tape whose metric prefix
+is the trainer class name (a bounded, code-defined set), or an SLO
+engine READING a configured series — carry the marker comment
+``lint: allow-dynamic-metric-name`` on the offending line, same
+pattern as the other four lints.
+
+Exit status 1 when findings exist (wired into tier-1 as
+``tests/test_lint_metric_names.py``).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+import sys
+from pathlib import Path
+from typing import List, Tuple
+
+ALLOW_MARK = "lint: allow-dynamic-metric-name"
+
+#: paths scanned, relative to the repo root (library code only —
+#: tests/bench/examples construct ad-hoc registries freely)
+SCAN = ("distkeras_tpu",)
+
+#: the registry instrument constructors
+METRIC_METHODS = ("counter", "gauge", "histogram")
+
+#: component.snake_case: lowercase dotted path, >= 2 segments
+NAME_RE = re.compile(r"^[a-z][a-z0-9_]*(\.[a-z0-9_]+)+$")
+
+Finding = Tuple[str, int, str]
+
+
+def _allowed(line: str) -> bool:
+    return ALLOW_MARK in line
+
+
+def check_source(src: str, rel: str) -> List[Finding]:
+    """Findings for one file's source text."""
+    try:
+        tree = ast.parse(src, filename=rel)
+    except SyntaxError as e:  # a broken file is its own finding
+        return [(rel, e.lineno or 0, f"syntax error: {e.msg}")]
+    lines = src.splitlines()
+    out: List[Finding] = []
+
+    def line_of(node: ast.AST) -> str:
+        ln = getattr(node, "lineno", 0)
+        return lines[ln - 1] if 0 < ln <= len(lines) else ""
+
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in METRIC_METHODS):
+            continue
+        if not node.args:
+            continue                    # no positional name: not ours
+        if _allowed(line_of(node)):
+            continue
+        arg = node.args[0]
+        method = node.func.attr
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            if not NAME_RE.match(arg.value):
+                out.append((rel, node.lineno,
+                            f".{method}({arg.value!r}): metric names "
+                            "must be component.snake_case (lowercase "
+                            "dotted path, e.g. 'serving.ttft_s')"))
+        elif isinstance(arg, ast.JoinedStr):
+            out.append((rel, node.lineno,
+                        f".{method}(f\"...\"): f-string metric name — "
+                        "a runtime-built name mints unbounded series; "
+                        "use a literal name + labels"))
+        else:
+            out.append((rel, node.lineno,
+                        f".{method}(<{type(arg).__name__}>): dynamic "
+                        "metric name — use a string literal (labels "
+                        "carry the variable dimensions)"))
+    return out
+
+
+def check_tree(root: Path) -> List[Finding]:
+    findings: List[Finding] = []
+    for entry in SCAN:
+        p = root / entry
+        files = sorted(p.rglob("*.py")) if p.is_dir() \
+            else ([p] if p.exists() else [])
+        for f in files:
+            rel = str(f.relative_to(root))
+            findings.extend(check_source(f.read_text(), rel))
+    return findings
+
+
+def main(argv=None) -> int:
+    root = Path(argv[0]) if argv else Path(__file__).resolve().parent.parent
+    findings = check_tree(root)
+    for rel, lineno, msg in findings:
+        print(f"{rel}:{lineno}: {msg}")
+    if findings:
+        print(f"{len(findings)} metric-name finding(s); use literal "
+              f"component.snake_case names (labels for variable "
+              f"dimensions) or mark the line with '# {ALLOW_MARK}'",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))
