@@ -628,17 +628,12 @@ def _cache_write_slots(kv, k, v, t):
             "v": jnp.where(hit4, vh.astype(kv["v"].dtype), kv["v"])}
 
 
-def _decode_attn_slots(attn: MultiHeadAttention, p, kv, x, t):
-    """One-token attention against the pooled cache at per-slot
-    positions. x: [S, 1, d]; t: [S]. The einsum/storage-dtype path of
-    ``_decode_attn`` with a [S, L] validity mask."""
-    dt = jnp.dtype(attn.dtype)
-    xc = x.astype(dt)
-    q, k, v = _project_qkv(attn, p, xc)
-    if attn.use_rope:
-        q = apply_rope(q, t[:, None], scale=attn.rope_scale)
-        k = apply_rope(k, t[:, None], scale=attn.rope_scale)
-    kv = _cache_write_slots(kv, k, v, t)
+def _slot_attn_readout(attn: MultiHeadAttention, p, q, kv, t, dt):
+    """Masked per-slot attention of the projected decode queries against
+    a logically contiguous ``[S, H, L, D]`` kv view — a slab pool or a
+    page gather in logical-position order — plus the output projection.
+    Shared by the slab and paged decode paths so the two are bitwise
+    identical wherever the view holds identical values."""
     scale = (attn.head_dim or q.shape[-1]) ** -0.5
     b = q.shape[0]
     hkv = attn.kv_heads
@@ -655,7 +650,21 @@ def _decode_attn_slots(attn: MultiHeadAttention, p, kv, x, t):
     w = jax.nn.softmax(s, axis=-1)
     out = _decode_mix(w, kv).astype(dt)
     out = out.reshape(b, 1, attn.num_heads, dh)
-    y = jnp.einsum("bshe,hed->bsd", out, p["wo"].astype(dt))
+    return jnp.einsum("bshe,hed->bsd", out, p["wo"].astype(dt))
+
+
+def _decode_attn_slots(attn: MultiHeadAttention, p, kv, x, t):
+    """One-token attention against the pooled cache at per-slot
+    positions. x: [S, 1, d]; t: [S]. The einsum/storage-dtype path of
+    ``_decode_attn`` with a [S, L] validity mask."""
+    dt = jnp.dtype(attn.dtype)
+    xc = x.astype(dt)
+    q, k, v = _project_qkv(attn, p, xc)
+    if attn.use_rope:
+        q = apply_rope(q, t[:, None], scale=attn.rope_scale)
+        k = apply_rope(k, t[:, None], scale=attn.rope_scale)
+    kv = _cache_write_slots(kv, k, v, t)
+    y = _slot_attn_readout(attn, p, q, kv, t, dt)
     return y.astype(x.dtype), kv
 
 
@@ -682,6 +691,123 @@ def decode_step_slots(module: Sequential, params, state, cache, tok, t):
         block = _decode_block_of(layer)
         if block is not None:
             x, new_cache[i] = _decode_block_slots(block, p, s, kv, x, t)
+        elif isinstance(layer, PositionalEmbedding):
+            x = x + p["embeddings"][t][:, None, :].astype(x.dtype)
+        elif isinstance(layer, Dropout):
+            pass                                         # eval: identity
+        else:
+            x, _ = layer.apply(p, s, x, training=False)
+    return x[:, 0], new_cache                            # [S, V]
+
+
+# --- paged decode (serving engine, paged KV cache PR) -----------------------
+#
+# The paged pool stores every layer's cache as [N, Hkv, page_len, Dh]
+# fixed-size pages; a per-slot page table [S, P] maps logical page p of
+# slot s to a physical page id (the engine's sentinel — an id >= N —
+# marks an unallocated logical page). The decode step is ONE compiled
+# program regardless of which pages a slot owns: the table is a traced
+# argument, writes scatter through it (out-of-range drops, so the
+# free-slot position sentinel writes nothing, exactly like the slab
+# one-hot write), and reads gather the slot's pages back into the same
+# logically contiguous [S, H, L, D] view the slab step consumes — the
+# shared ``_slot_attn_readout`` epilogue then makes the two paths
+# bitwise identical wherever the views hold identical values.
+
+
+def _cache_write_pages(kv, k, v, t, table, page_len: int):
+    """Write one [S, 1, H, D] k/v decode slab at per-slot positions
+    ``t`` ([S] int) into the paged pool [N, H, page_len, D] through the
+    slot page tables ``table`` ([S, P] int). Slot ``s`` writes physical
+    page ``table[s, t[s] // page_len]`` at offset ``t[s] % page_len``;
+    a ``t[s]`` past the logical capacity (the engine's free/prefilling
+    sentinel) or a sentinel table entry writes nothing (scatter drop)."""
+    kh = k[:, 0]                                         # [S, H, D]
+    vh = v[:, 0]
+    n_pages = kv["k"].shape[0]
+    n_logical = table.shape[1]
+    lp = t // page_len                                   # [S] logical page
+    off = t % page_len
+    pp = jnp.take_along_axis(
+        table, jnp.clip(lp, 0, n_logical - 1)[:, None], axis=1)[:, 0]
+    # sentinel: out-of-range t (or an unallocated logical page whose
+    # table entry is >= N already) routes the scatter out of bounds,
+    # where mode="drop" discards it
+    pp = jnp.where((lp >= 0) & (lp < n_logical), pp, n_pages)
+    if "k_scale" in kv:
+        qk, sk = _quantize_kv(kh)
+        qv, sv = _quantize_kv(vh)
+        return {
+            "k": kv["k"].at[pp, :, off].set(qk, mode="drop"),
+            "v": kv["v"].at[pp, :, off].set(qv, mode="drop"),
+            "k_scale": kv["k_scale"].at[pp, :, off].set(sk, mode="drop"),
+            "v_scale": kv["v_scale"].at[pp, :, off].set(sv, mode="drop")}
+    return {"k": kv["k"].at[pp, :, off].set(
+                kh.astype(kv["k"].dtype), mode="drop"),
+            "v": kv["v"].at[pp, :, off].set(
+                vh.astype(kv["v"].dtype), mode="drop")}
+
+
+def _gather_pages(kv, table):
+    """The slot page tables' view of the pool: gather each slot's pages
+    into a logically contiguous [S, H, P*page_len, D] cache (scale
+    planes [S, H, P*page_len]). Sentinel table entries clamp to the
+    last physical page — harmless garbage, masked by the ``<= t``
+    validity mask exactly like a slab row's stale tail."""
+    out = {}
+    for key in ("k", "v"):
+        pg = kv[key][table]                  # [S, P, H, page_len, D]
+        s, p, h, pl, d = pg.shape
+        out[key] = pg.transpose(0, 2, 1, 3, 4).reshape(s, h, p * pl, d)
+    if "k_scale" in kv:
+        for key in ("k_scale", "v_scale"):
+            pg = kv[key][table]              # [S, P, H, page_len]
+            s, p, h, pl = pg.shape
+            out[key] = pg.transpose(0, 2, 1, 3).reshape(s, h, p * pl)
+    return out
+
+
+def _decode_attn_slots_paged(attn: MultiHeadAttention, p, kv, x, t,
+                             table, page_len: int):
+    """One-token attention against the PAGED pool at per-slot
+    positions: scatter the new k/v through the page tables, then run
+    the slab readout over the gathered per-slot view."""
+    dt = jnp.dtype(attn.dtype)
+    xc = x.astype(dt)
+    q, k, v = _project_qkv(attn, p, xc)
+    if attn.use_rope:
+        q = apply_rope(q, t[:, None], scale=attn.rope_scale)
+        k = apply_rope(k, t[:, None], scale=attn.rope_scale)
+    kv = _cache_write_pages(kv, k, v, t, table, page_len)
+    y = _slot_attn_readout(attn, p, q, _gather_pages(kv, table), t, dt)
+    return y.astype(x.dtype), kv
+
+
+def _decode_block_slots_paged(block: TransformerBlock, p, s, kv, x, t,
+                              table, page_len: int):
+    h, _ = block.norm1.apply(p["norm1"], s["norm1"], x)
+    a, kv = _decode_attn_slots_paged(block.attn, p["attn"], kv, h, t,
+                                     table, page_len)
+    x = x + a
+    h, _ = block.norm2.apply(p["norm2"], s["norm2"], x)
+    m, _ = block.mlp.apply(p["mlp"], s["mlp"], h, training=False)
+    return x + m, kv
+
+
+def decode_step_slots_paged(module: Sequential, params, state, cache,
+                            tok, t, table, page_len: int):
+    """One token through the stack against a PAGED pooled cache: tok
+    [S] int, t [S] int, table [S, P] int page tables; returns
+    ([S, V] logits, cache). The paged mirror of ``decode_step_slots``
+    — same garbage-logits contract for sentinel slots."""
+    x = tok[:, None]                                     # [S, 1]
+    new_cache = list(cache)
+    for i, layer in enumerate(module.layers):
+        p, s, kv = params[i], state[i], cache[i]
+        block = _decode_block_of(layer)
+        if block is not None:
+            x, new_cache[i] = _decode_block_slots_paged(
+                block, p, s, kv, x, t, table, page_len)
         elif isinstance(layer, PositionalEmbedding):
             x = x + p["embeddings"][t][:, None, :].astype(x.dtype)
         elif isinstance(layer, Dropout):

@@ -20,10 +20,14 @@ Record vocabulary (each line carries ``seq``, ``t`` —
 ``utils.profiling.wall`` epoch seconds — and ``kind``):
 
 * ``serving.iteration`` — per engine ``step()``: queue depth,
-  occupancy, decoding/prefilling/admitted rids (written BEFORE the
-  iteration's prefill/decode run, so a mid-iteration fault dump
-  contains the failing iteration itself);
+  occupancy, decoding/prefilling/admitted rids, and — paged engines —
+  ``pages_free`` (written BEFORE the iteration's prefill/decode run,
+  so a mid-iteration fault dump contains the failing iteration
+  itself; an admission stall reads directly as queue growth against a
+  starved page budget);
 * ``serving.rejected`` — one shed submit;
+* ``serving.preempted`` — a decoding request's pages evicted back to
+  the queue (rid, slot, tokens generated so far, pages freed);
 * ``train.epoch`` — per epoch-loop iteration of any trainer
   (``parallel.trainers.epoch_exit``, the shared exit point);
 * ``supervisor.restart`` / ``supervisor.rollback`` — interventions;

@@ -14,6 +14,8 @@ timestamp on the engine clock):
 
 * ``submitted`` — entered the admission queue (queue depth attached);
 * ``admitted`` — took a KV slot (slot id + remaining queue depth);
+* ``prefix_hit`` — the paged engine served the first N context tokens
+  off shared prefix-cache pages (prefill skipped them);
 * ``prefill_chunk`` — one prompt chunk ingested (bounded by
   ``ceil(max_len / prefill_chunk)`` per request);
 * ``first_token`` — prefill complete, first sample emitted (the TTFT
@@ -21,6 +23,11 @@ timestamp on the engine clock):
 * ``decode`` — AGGREGATED: one event per ``decode_agg`` engine
   iterations (not per token — the hot loop stays cheap), plus a final
   flush at terminal;
+* ``preempted`` / ``resumed`` — the paged engine evicted the
+  request's pages back to the queue under budget pressure / brought
+  it back after the recompute prefill (tokens generated so far
+  attached; the request stays live — ``admitted`` fires again on
+  re-admission);
 * ``finished`` / ``timed_out`` / ``cancelled`` — terminal.
 
 Memory is bounded everywhere: completed timelines live in a
@@ -77,7 +84,7 @@ class RequestTimeline:
                  "state", "slot", "queue_depth_at_submit",
                  "queue_depth_at_admit", "prefill_chunks", "decode_iters",
                  "n_tokens", "events", "dropped_events", "_agg_count",
-                 "_agg_t0")
+                 "_agg_t0", "n_preempted", "prefix_hit_tokens")
 
     def __init__(self, rid: int):
         self.rid = rid
@@ -96,6 +103,8 @@ class RequestTimeline:
         self.dropped_events = 0
         self._agg_count = 0          # decode iters since last flush
         self._agg_t0: Optional[float] = None
+        self.n_preempted = 0         # page-budget evictions survived
+        self.prefix_hit_tokens = 0   # context tokens off shared pages
 
     def add_event(self, name: str, t: float, max_events: int,
                   **fields) -> None:
@@ -157,6 +166,10 @@ class RequestTimeline:
             "n_tokens": self.n_tokens,
             "durations": self.durations(),
         }
+        if self.n_preempted:
+            out["n_preempted"] = self.n_preempted
+        if self.prefix_hit_tokens:
+            out["prefix_hit_tokens"] = self.prefix_hit_tokens
         if self.dropped_events:
             out["dropped_events"] = self.dropped_events
         return out
@@ -176,10 +189,19 @@ class _NullTracer:
     def on_prefill_chunk(self, rid, t0, q_len):
         pass
 
+    def on_prefix_hit(self, rid, n_tokens):
+        pass
+
     def on_first_token(self, rid):
         pass
 
     def on_decode(self, rids):
+        pass
+
+    def on_preempt(self, rid, n_generated=0):
+        pass
+
+    def on_resume(self, rid):
         pass
 
     def on_terminal(self, rid, state, n_tokens=0):
@@ -262,6 +284,19 @@ class RequestTracer:
             tl.add_event("prefill_chunk", t, self.max_events,
                          pos=int(t0), len=int(q_len))
 
+    def on_prefix_hit(self, rid: int, n_tokens: int) -> None:
+        """The paged engine served ``n_tokens`` of this request's
+        context off shared prefix-cache pages (their prefill compute
+        was skipped)."""
+        t = self.clock()
+        with self._lock:
+            tl = self._live.get(rid)
+            if tl is None:
+                return
+            tl.prefix_hit_tokens += int(n_tokens)
+            tl.add_event("prefix_hit", t, self.max_events,
+                         tokens=int(n_tokens))
+
     def on_first_token(self, rid: int) -> None:
         t = self.clock()
         with self._lock:
@@ -270,6 +305,31 @@ class RequestTracer:
                 return
             tl.first_token_t = t
             tl.add_event("first_token", t, self.max_events)
+
+    def on_preempt(self, rid: int, n_generated: int = 0) -> None:
+        """Page-budget eviction: the request left its slot but stays
+        LIVE (its timeline keeps accumulating through re-admission —
+        ``admitted`` fires again; latency still measures to the real
+        terminal)."""
+        t = self.clock()
+        with self._lock:
+            tl = self._live.get(rid)
+            if tl is None:
+                return
+            tl.flush_decode(t, self.max_events)
+            tl.n_preempted += 1
+            tl.add_event("preempted", t, self.max_events,
+                         n_generated=int(n_generated))
+
+    def on_resume(self, rid: int) -> None:
+        """Recompute prefill finished after a preemption; the request
+        rejoined the decode batch."""
+        t = self.clock()
+        with self._lock:
+            tl = self._live.get(rid)
+            if tl is None:
+                return
+            tl.add_event("resumed", t, self.max_events)
 
     def on_decode(self, rids) -> None:
         """One engine decode iteration over ``rids`` (the decoding

@@ -1,5 +1,5 @@
-"""Continuous-batching serving engine (this PR): iteration-level
-scheduling over ``generate()``'s prefill/decode machinery.
+"""Continuous-batching serving engine: iteration-level scheduling over
+``generate()``'s prefill/decode machinery, on a paged KV cache.
 
 The single-call ``generate()`` path decodes one fixed batch to
 completion: a straggler request holds every batch row until
@@ -7,30 +7,40 @@ completion: a straggler request holds every batch row until
 This package is the Orca/vLLM-style fix — the missing layer between the
 per-step decode kernels and an actual serving workload:
 
-    kv_pool.py     pooled ``[S, max_len]`` KV cache, resident across
-                   requests; batch-1 prefill caches insert into a slot
-    scheduler.py   FIFO admission queue + per-request state machine
+    kv_pool.py     ``PagedKVPool`` — fixed pool of per-layer KV pages,
+                   per-slot page tables, refcounted on-demand
+                   allocation — plus ``PrefixCache`` (hash-consed
+                   shared prompt prefixes, copy-on-write partial
+                   pages) and the legacy slab ``KVPool``
+    scheduler.py   admission queue + per-request state machine
                    (queued -> prefilling -> decoding -> finished) with
-                   slot allocation/release
+                   slot allocation/release; ``PriorityScheduler`` adds
+                   priority classes and preemption back to the queue
     engine.py      the slot-based decode loop: ONE compiled
-                   ``decode_step_slots`` over all slots per iteration
-                   (static shapes, jit compiled once), chunked prefill
-                   interleaved between decode iterations, per-slot
-                   sampling state
+                   ``decode_step_slots_paged`` over all slots per
+                   iteration (static shapes, the page table is a
+                   traced argument, jit compiled once), chunked
+                   prefill interleaved between decode iterations with
+                   shared prefixes skipped, page-budget admission and
+                   preemption/resume, per-slot sampling state
     metrics.py     TTFT, TPOT, request latency, queue depth, slot
-                   occupancy, tokens/s — the numbers ``bench.py
+                   occupancy, tokens/s, page-budget gauges and
+                   prefix-cache hit rates — the numbers ``bench.py
                    --model serving`` records; request-level timelines,
                    the flight-recorder ring and declarative SLOs live
                    in ``distkeras_tpu.obs`` (tracing/recorder/slo) and
                    are wired through the engine
 
-See ``docs/serving.md`` for the architecture and scheduling policy.
+See ``docs/serving.md`` for the architecture, the paged-KV design and
+the scheduling policy.
 """
 
 from distkeras_tpu.serving.engine import (DegradedRequest,  # noqa: F401
                                           ServingEngine)
-from distkeras_tpu.serving.kv_pool import KVPool  # noqa: F401
+from distkeras_tpu.serving.kv_pool import (KVPool,  # noqa: F401
+                                           PagedKVPool, PrefixCache)
 from distkeras_tpu.serving.metrics import ServingMetrics  # noqa: F401
 from distkeras_tpu.serving.scheduler import (AdmissionRejected,  # noqa: F401
-                                             FIFOScheduler, Request,
+                                             FIFOScheduler,
+                                             PriorityScheduler, Request,
                                              RequestState, TERMINAL_STATES)

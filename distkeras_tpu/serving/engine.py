@@ -3,19 +3,33 @@
 The Orca/vLLM iteration-level serving pattern on this repo's
 prefill/decode machinery:
 
-  * A fixed pool of ``S`` KV-cache slots stays resident on device
-    (``kv_pool.KVPool``); every iteration runs ONE compiled
-    ``decode_step_slots`` over ALL slots — shapes are static, the jit
-    compiles once per engine per sampler variant (argmax-only for
-    all-greedy batches, the full per-slot sampler for mixed ones), and
-    requests at different sequence positions coexist because ``t`` is
-    a per-slot vector.
-  * Requests admit FIFO into free slots; a new request's prompt
-    prefills into a batch-1 staging cache — chunked
-    (``prefill_chunk``), one chunk per engine iteration, interleaved
-    between decode steps so a long prompt never stalls in-flight
-    streams — then the filled rows INSERT into the request's pool slot
-    and it joins the decode batch.
+  * A paged KV cache (``kv_pool.PagedKVPool``, the default
+    ``kv_layout="paged"``) stays resident on device: fixed-size pages
+    allocated on demand per request, per-slot page tables driving one
+    compiled ``decode_step_slots_paged`` over ALL slots — shapes are
+    static (the table is a traced argument), the jit compiles once per
+    engine per sampler variant (argmax-only for all-greedy batches,
+    the full per-slot sampler for mixed ones), and requests at
+    different sequence positions coexist because ``t`` is a per-slot
+    vector. Admission is COST-AWARE (``PriorityScheduler``): a request
+    admits when its prompt's pages fit the free-page budget (priority
+    classes first, FCFS within), and a decode step that outgrows the
+    pool preempts the youngest lowest-priority stream back to the
+    queue — its context re-prefills on re-admission via the resumable
+    ``prefill_chunk_step``, token-identically. Identical prompt
+    prefixes hash-cons onto shared read-only pages
+    (``kv_pool.PrefixCache``): prefill skips the shared positions, a
+    partially matched page is served copy-on-write. The legacy slab
+    pool (``kv_layout="slab"``: one ``[S, max_len]`` row per slot,
+    FIFO admission, no preemption) remains for comparison — the paged
+    data plane is benched against it at equal HBM in ``bench.py``.
+  * Requests admit into free slots; a new request's prompt prefills
+    into a batch-1 staging cache — chunked (``prefill_chunk``), one
+    chunk per engine iteration, interleaved between decode steps so a
+    long prompt never stalls in-flight streams — then the filled pages
+    INSERT into the request's pool pages (only the pages the prompt
+    actually fills, minus the shared-prefix pages) and it joins the
+    decode batch.
   * Per-slot sampling state (temperature / top_k / top_p / stop_token
     vectors through ``_sample_vec``, per-slot PRNG keys) lets greedy
     and sampled requests with different stop tokens share one batch.
@@ -57,13 +71,16 @@ from distkeras_tpu.models.core import Model, Sequential
 from distkeras_tpu.models.decoding import (_attn_compute_dtype,
                                            _resolve_head_dims,
                                            _sample_vec, _serving_params,
-                                           decode_step_slots, prefill,
-                                           prefill_chunk_step)
+                                           decode_step_slots,
+                                           decode_step_slots_paged,
+                                           prefill, prefill_chunk_step)
 from distkeras_tpu.resilience import faults
-from distkeras_tpu.serving.kv_pool import KVPool
+from distkeras_tpu.serving.kv_pool import (KVPool, PagedKVPool,
+                                           PrefixCache)
 from distkeras_tpu.serving.metrics import ServingMetrics
 from distkeras_tpu.serving.scheduler import (AdmissionRejected,
-                                             FIFOScheduler, Request,
+                                             FIFOScheduler,
+                                             PriorityScheduler, Request,
                                              RequestState,
                                              TERMINAL_STATES)
 
@@ -91,8 +108,31 @@ class ServingEngine:
     one scheduler iteration; ``run()`` drains to completion (the
     synchronous driver — an async transport wraps these two calls).
 
-    ``max_len`` is the per-slot cache capacity: every request needs
+    ``max_len`` is the per-request cache capacity: every request needs
     ``len(prompt) + max_new_tokens <= max_len``.
+
+    Paged-cache knobs (``kv_layout="paged"``, the default):
+
+    * ``page_len`` — positions per KV page. Smaller pages waste less
+      tail (fragmentation is < ``page_len`` positions per request) and
+      share prefixes at finer grain; larger pages mean fewer
+      table entries and scatter/gather indices. 16 is the vLLM-era
+      sweet spot for the einsum path (docs/serving.md §Paged KV).
+    * ``num_pages`` — the HBM budget, in pages. Default
+      ``num_slots * ceil(max_len / page_len)`` (worst-case capacity
+      parity with the slab pool); size it DOWN to actual traffic and
+      let cost-aware admission + preemption absorb the tail.
+    * ``prefix_cache`` — hash-cons identical prompt prefixes onto
+      shared pages (on by default; sharing is exact up to
+      chunked-prefill fp reassociation — see ``kv_pool.PrefixCache``).
+    * ``prefix_granularity`` — round PARTIAL-page (copy-on-write)
+      matches down to a multiple of this many tokens (full-page
+      matches are unaffected). The default 1 shares maximally, but
+      every distinct matched length makes the residual prefill chunk a
+      novel ragged shape — an inline XLA compile the first time it
+      appears (the same hazard as novel prompt lengths,
+      docs/serving.md follow-ups). Set to ``page_len`` to keep
+      sharing page-granular and the program set bounded.
     """
 
     def __init__(self, model: Model, *, num_slots: int = 4,
@@ -101,7 +141,11 @@ class ServingEngine:
                  cache_dtype=None, weights_dtype="auto",
                  metrics: Optional[ServingMetrics] = None,
                  max_queue: Optional[int] = None,
-                 tracer=None, slo=None):
+                 tracer=None, slo=None,
+                 kv_layout: str = "paged", page_len: int = 16,
+                 num_pages: Optional[int] = None,
+                 prefix_cache: bool = True,
+                 prefix_granularity: int = 1):
         module = model.module
         if not isinstance(module, Sequential):
             raise TypeError("ServingEngine expects a Sequential LM "
@@ -134,18 +178,43 @@ class ServingEngine:
                         else _serving_params(model.params, weights_dtype))
         self._state = model.state
 
-        self.pool = KVPool(module, self.num_slots, self.max_len,
-                           cache_dtype)
+        if kv_layout not in ("paged", "slab"):
+            raise ValueError(
+                f"kv_layout must be 'paged' or 'slab', got {kv_layout!r}")
+        self.kv_layout = kv_layout
+        if kv_layout == "paged":
+            self.pool = PagedKVPool(module, self.num_slots, self.max_len,
+                                    page_len=page_len,
+                                    num_pages=num_pages,
+                                    dtype=cache_dtype)
+            self.page_len = self.pool.page_len
+            self.prefix = PrefixCache(self.pool) if prefix_cache else None
+            if prefix_granularity < 1:
+                raise ValueError(
+                    f"prefix_granularity must be >= 1, "
+                    f"got {prefix_granularity}")
+            self._prefix_granularity = int(prefix_granularity)
+            # cost-aware scheduling: priority classes + preemption; the
+            # engine gates admission on the free-page budget below
+            scheduler = PriorityScheduler(self.num_slots,
+                                          max_queue=max_queue)
+        else:
+            self.pool = KVPool(module, self.num_slots, self.max_len,
+                               cache_dtype)
+            self.page_len = None
+            self.prefix = None
+            scheduler = FIFOScheduler(self.num_slots,
+                                      max_queue=max_queue)
         # ONE reusable batch-1 prefill staging cache: positions past the
         # current prompt hold a previous request's stale entries, which
-        # is safe — insert() copies the whole row, and the occupant's
-        # decode writes position t before the mask ever admits it
+        # is safe — insert copies only the pages/rows the prompt filled,
+        # and the occupant's decode writes position t before the mask
+        # ever admits it
         self._staging = self.pool.make_request_cache()
         # bounded admission (load shedding): submits past max_queue
         # raise AdmissionRejected instead of growing the queue without
         # bound under overload; None keeps the open-queue behavior
-        self.scheduler = FIFOScheduler(self.num_slots,
-                                       max_queue=max_queue)
+        self.scheduler = scheduler
         self.metrics = metrics if metrics is not None else ServingMetrics()
         # request-level observability (obs.tracing / obs.recorder /
         # obs.slo): the tracer shares the metrics clock so timeline
@@ -225,7 +294,8 @@ class ServingEngine:
                temperature: float = 0.0, top_k: Optional[int] = None,
                top_p: Optional[float] = None,
                stop_token: Optional[int] = None, seed: int = 0,
-               deadline_s: Optional[float] = None) -> int:
+               deadline_s: Optional[float] = None,
+               priority: int = 1) -> int:
         """Enqueue one request; returns its id. Sampling defaults match
         ``generate()`` (greedy); ``None`` knobs mean disabled.
 
@@ -233,7 +303,12 @@ class ServingEngine:
         request still unfinished when it expires is terminated
         ``TIMED_OUT`` at the next ``step()`` (partial tokens kept on the
         returned request). Raises ``AdmissionRejected`` when the engine
-        was built with ``max_queue`` and the wait queue is full."""
+        was built with ``max_queue`` and the wait queue is full.
+
+        ``priority`` (paged engine): lower admits first — 0
+        interactive, 1 standard (default), 2 batch. A queued priority-0
+        request may PREEMPT lower-priority decoding streams when the
+        page budget is short; ignored by the slab engine's FCFS."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size < 1:
             raise ValueError("prompt must hold at least one token")
@@ -251,6 +326,15 @@ class ServingEngine:
         if deadline_s is not None and float(deadline_s) <= 0:
             raise ValueError(
                 f"deadline_s must be > 0, got {deadline_s}")
+        if self.kv_layout == "paged":
+            # a request whose worst case exceeds the whole pool could
+            # never finish — even after preempting everything else
+            worst = self.pool.pages_for(prompt.size + max_new_tokens)
+            if worst > self.pool.num_pages:
+                raise ValueError(
+                    f"request needs up to {worst} pages but the pool "
+                    f"holds {self.pool.num_pages}; raise num_pages or "
+                    "lower max_new_tokens")
         req = Request(
             rid=next(self._rid), prompt=prompt,
             max_new_tokens=max_new_tokens,
@@ -258,7 +342,7 @@ class ServingEngine:
             top_k=0 if top_k is None else int(top_k),
             top_p=1.0 if top_p is None else float(top_p),
             stop_token=-1 if stop_token is None else int(stop_token),
-            seed=int(seed),
+            seed=int(seed), priority=int(priority),
             deadline_s=None if deadline_s is None else float(deadline_s))
         req.rng = jax.random.PRNGKey(req.seed)
         req.submit_t = self.metrics.clock()
@@ -300,19 +384,29 @@ class ServingEngine:
         fn = self._step_fns.get(greedy_only)
         if fn is None:
             module = self.module
+            paged = self.kv_layout == "paged"
+            page_len = self.page_len
+
+            def step(params, state, cache, tok, t, tables):
+                if paged:
+                    return decode_step_slots_paged(
+                        module, params, state, cache, tok, t, tables,
+                        page_len)
+                return decode_step_slots(
+                    module, params, state, cache, tok, t)
 
             if greedy_only:
                 @jax.jit
-                def fn(params, state, cache, tok, t):
-                    logits, cache = decode_step_slots(
-                        module, params, state, cache, tok, t)
+                def fn(params, state, cache, tok, t, tables=None):
+                    logits, cache = step(params, state, cache, tok, t,
+                                         tables)
                     return jnp.argmax(logits, axis=-1), cache
             else:
                 @jax.jit
                 def fn(params, state, cache, tok, t, temp, topk, topp,
-                       keys):
-                    logits, cache = decode_step_slots(
-                        module, params, state, cache, tok, t)
+                       keys, tables=None):
+                    logits, cache = step(params, state, cache, tok, t,
+                                         tables)
                     # per-slot key streams: a request's draws depend
                     # only on its own seed, not on which neighbours
                     # share the batch
@@ -378,6 +472,248 @@ class ServingEngine:
             self._first_fn = f
         return self._first_fn
 
+    # --- paged admission / page budget ------------------------------------
+
+    def _admit(self) -> List[Request]:
+        """Admission for this iteration. Slab: FCFS into free slots.
+        Paged: cost-aware — the highest-priority queued request admits
+        while a slot AND its context's page budget are available
+        (prefix-cache hits cost nothing: shared pages are reused, not
+        allocated); when the budget is short, a strictly-higher-
+        priority arrival preempts lower-priority decoding streams."""
+        if self.kv_layout != "paged":
+            return self.scheduler.admit()
+        admitted: List[Request] = []
+        sch = self.scheduler
+        while sch.free_slots:
+            req = sch.peek()
+            if req is None:
+                break
+            plan = self._page_plan(req)
+            if plan is not None:
+                sch.admit_one(req)
+                self._apply_page_plan(req, plan)
+                admitted.append(req)
+                continue
+            if not self._preempt_victim(beneficiary=req,
+                                        strict_priority=True):
+                break
+        return admitted
+
+    def _page_plan(self, req: Request) -> Optional[Dict]:
+        """Fund ``req``'s (re)admission from the page budget: prefix-
+        match its context, reclaim cache-only pages if the private
+        remainder does not fit, allocate. None when it cannot be
+        funded (the caller may preempt and retry next iteration).
+
+        Matched pages are incref'd HERE, before any reclaim — the
+        reclaim sweep frees cache-only (ref == 1) pages and must never
+        eat the chain this very plan is about to use."""
+        pool = self.pool
+        toks = req.context_tokens
+        # context + 1: the first decode write (position len(toks))
+        # must land on an allocated page
+        n_logical = pool.pages_for(len(toks) + 1)
+        if self.prefix is not None:
+            full, shared_len, donor = self._match_prefix(toks)
+        else:
+            full, shared_len, donor = [], 0, None
+        for pid in full:
+            pool.incref(pid)             # the slot's hold, owned early
+        if donor is not None:
+            pool.incref(donor)           # held until loaded to staging
+        n_private = n_logical - len(full)
+        if pool.free_pages < n_private and self.prefix is not None:
+            deficit = n_private - pool.free_pages
+            # reclaim ONLY when it can actually close the gap: an
+            # unfundable admission must not drain the reusable prefix
+            # cache for nothing (it would strip sharing from every
+            # later same-template request while the head stays queued)
+            if self.prefix.evictable_pages() >= deficit:
+                self.prefix.reclaim(deficit)
+        if pool.free_pages < n_private:
+            for pid in full:
+                pool.decref(pid)
+            if donor is not None:
+                pool.decref(donor)
+            return None
+        priv = [pool.alloc_page() for _ in range(n_private)]
+        return {"full": full, "priv": priv, "shared_len": shared_len,
+                "donor": donor}
+
+    def _match_prefix(self, toks):
+        """``PrefixCache.match`` with the engine's partial-match
+        granularity applied: the copy-on-write match length rounds
+        down to a multiple of ``prefix_granularity`` (0 drops the
+        donor), bounding how many distinct residual-chunk shapes —
+        each an inline compile on first sight — sharing can mint."""
+        full, shared_len, donor = self.prefix.match(toks)
+        g = self._prefix_granularity
+        if donor is not None and g > 1:
+            base = len(full) * self.pool.page_len
+            m = ((shared_len - base) // g) * g
+            shared_len = base + m
+            if m == 0:
+                donor = None
+        return full, shared_len, donor
+
+    def _rematch_at_prefill(self, req: Request) -> None:
+        """Prefix pages REGISTERED between this request's admission and
+        its prefill turn (requests ahead of it in the single prefill
+        stream — the common case in a burst of same-template arrivals)
+        are adopted late: re-match, swap the private pages the longer
+        chain now covers for the shared ones, return the privates to
+        the budget."""
+        pool = self.pool
+        toks = req.context_tokens
+        full, shared_len, donor = self._match_prefix(toks)
+        if shared_len <= getattr(req, "_shared_len", 0):
+            return
+        old_full = getattr(req, "_n_shared_full", 0)
+        slot = req.slot
+        for j in range(old_full, len(full)):
+            old = int(pool.tables[slot, j])
+            pool.incref(full[j])
+            pool.assign(slot, j, full[j])
+            pool.decref(old)                  # private page, freed
+        old_donor = getattr(req, "_donor_ref", None)
+        if old_donor is not None:
+            pool.decref(old_donor)
+            req._donor_ref = None
+        if donor is not None:
+            pool.incref(donor)
+            req._donor_ref = donor
+        req._shared_len = shared_len
+        req._n_shared_full = len(full)
+        req._load_pages = list(full) + (
+            [donor] if donor is not None else [])
+
+    def _apply_page_plan(self, req: Request, plan: Dict) -> None:
+        slot = req.slot
+        pool = self.pool
+        for j, pid in enumerate(plan["full"]):
+            pool.assign(slot, j, pid)    # ref taken in _page_plan
+        for i, pid in enumerate(plan["priv"]):
+            pool.assign(slot, len(plan["full"]) + i, pid)
+        req._shared_len = plan["shared_len"]
+        req._n_shared_full = len(plan["full"])
+        # pages to materialize into the staging cache before prefill:
+        # the full shared chain plus the copy-on-write donor (whose
+        # temporary ref drops once the load has happened)
+        req._donor_ref = plan["donor"]
+        req._load_pages = list(plan["full"]) + (
+            [plan["donor"]] if plan["donor"] is not None else [])
+
+    def _preempt_victim(self, beneficiary: Request,
+                        strict_priority: bool) -> bool:
+        """Preempt ONE admitted request (decoding or mid-prefill —
+        both hold budget pages) to free pages for ``beneficiary``:
+        the lowest-priority, youngest victim. ``strict_priority``
+        (admission path) only sacrifices strictly lower-priority
+        streams. The decode-growth path also preempts within the
+        class (youngest first) and INCLUDES the beneficiary itself:
+        when the beneficiary is the worst-ranked stream alive, it is
+        the one evicted — growing it at a higher-priority neighbour's
+        expense would invert the priority the scheduler promises.
+        Either way the best-ranked stream is never a victim, so it
+        runs to completion — the progress guarantee that makes
+        preemption deadlock-free."""
+        victim = None
+        candidates = list(self.scheduler.running.values()) \
+            + list(self.scheduler.prefilling)
+        for r in candidates:
+            if strict_priority and (
+                    r is beneficiary
+                    or r.priority <= beneficiary.priority):
+                continue
+            if victim is None \
+                    or (r.priority, r.rid) > (victim.priority, victim.rid):
+                victim = r
+        if victim is None:
+            return False
+        self._preempt(victim)
+        return True
+
+    def _preempt(self, victim: Request) -> None:
+        """Evict an admitted request's pages back to the queue. Its
+        generated tokens stay (the re-prefill context); a decoding
+        victim's per-slot sampling key is snapshotted so a sampled
+        stream resumes EXACTLY where it left off (schedule-independent
+        draws); a prefilling victim keeps its submit-time key (its
+        first token has not been sampled yet)."""
+        slot = victim.slot
+        if victim.state is RequestState.DECODING:
+            victim.rng = np.array(self._keys[slot])
+        self.scheduler.preempt(victim)
+        freed = self.pool.release_slot(slot)
+        self._t[slot] = self.max_len          # sentinel: slot inert
+        if getattr(victim, "_donor_ref", None) is not None:
+            # admitted with a copy-on-write donor hold that prefill
+            # never consumed
+            self.pool.decref(victim._donor_ref)
+            victim._donor_ref = None
+        victim._shared_len = 0
+        victim._n_shared_full = 0
+        victim._load_pages = []
+        self.metrics.record_preemption(victim.rid)
+        self.tracer.on_preempt(victim.rid, len(victim.generated))
+        if self.recorder.enabled:
+            self.recorder.record(
+                "serving.preempted", rid=victim.rid, slot=slot,
+                n_generated=len(victim.generated), pages_freed=freed,
+                pages_free=self.pool.free_pages)
+
+    def _ensure_decode_pages(self) -> None:
+        """Before a decode step: every running slot whose next write
+        position crosses into an unallocated logical page gets one —
+        from the free list, then by reclaiming cache-only prefix
+        pages, then by preempting the youngest lowest-priority OTHER
+        stream. Serviced oldest-highest-priority first, so pressure
+        lands on the back of the line."""
+        pool = self.pool
+        by_rank = sorted(self.scheduler.running.values(),
+                         key=lambda r: (r.priority, r.rid))
+        for req in by_rank:
+            if req.state is not RequestState.DECODING:
+                continue                      # preempted this pass
+            slot = req.slot
+            lp = int(self._t[slot]) // pool.page_len
+            if pool.tables[slot, lp] < pool.num_pages:
+                continue                      # page already allocated
+            while True:
+                pid = pool.alloc_page()
+                if pid is not None:
+                    pool.assign(slot, lp, pid)
+                    break
+                if self.prefix is not None and self.prefix.evict_one():
+                    continue
+                if not self._preempt_victim(beneficiary=req,
+                                            strict_priority=False):
+                    raise RuntimeError(
+                        "page pool exhausted: no free page, nothing "
+                        "evictable, no preemptable stream (submit "
+                        "validation should have prevented this)")
+                if req.state is not RequestState.DECODING:
+                    break    # the beneficiary was the worst-ranked
+                    #          stream and preempted ITSELF; its pages
+                    #          are back in the budget
+
+    def _fragmentation(self) -> float:
+        """Wasted tail positions across live slots: 1 - used/allocated
+        (an allocated page holds ``page_len`` positions; the slot uses
+        ``t`` of them so far). 0 = perfectly packed."""
+        pool = self.pool
+        used = alloc = 0
+        for slot, req in self.scheduler.running.items():
+            alloc += len(pool.slot_pages(slot))
+            used += int(self._t[slot])
+        for req in self.scheduler.prefilling:
+            alloc += len(pool.slot_pages(req.slot))
+            used += req.prefill_pos
+        if alloc == 0:
+            return 0.0
+        return max(0.0, 1.0 - used / (alloc * pool.page_len))
+
     # --- the scheduler iteration ------------------------------------------
 
     def step(self) -> List[Request]:
@@ -396,13 +732,18 @@ class ServingEngine:
         (the failed iteration retries wholesale)."""
         finished: List[Request] = []
         self._expire_deadlines(finished)
-        admitted = self.scheduler.admit()
+        admitted = self._admit()
 
         # flight-recorder ring: this iteration's composition, written
         # BEFORE prefill/decode run so a mid-iteration fault dump
         # contains the failing iteration itself (field assembly gated
-        # on a live recorder — the disabled path costs one check)
+        # on a live recorder — the disabled path costs one check).
+        # Paged engines add the free-page count: an admission stall in
+        # a post-mortem dump reads directly as "queue grew while pages
+        # sat at N" (budget starvation) vs "pages free, slots full"
         if self.recorder.enabled:
+            extra = ({"pages_free": self.pool.free_pages}
+                     if self.kv_layout == "paged" else {})
             self.recorder.record(
                 "serving.iteration", iter=self._iters,
                 queue_depth=self.scheduler.queue_depth,
@@ -410,7 +751,7 @@ class ServingEngine:
                 decoding=[r.rid for r in
                           self.scheduler.running.values()],
                 prefilling=[r.rid for r in self.scheduler.prefilling],
-                admitted=[r.rid for r in admitted])
+                admitted=[r.rid for r in admitted], **extra)
 
         req = self.scheduler.next_prefill()
         if req is not None:
@@ -430,6 +771,10 @@ class ServingEngine:
         self.metrics.record_iteration(self.scheduler.queue_depth,
                                       self.scheduler.occupied,
                                       self.num_slots)
+        if self.kv_layout == "paged":
+            self.metrics.record_pages(self.pool.free_pages,
+                                      self.pool.shared_pages,
+                                      self._fragmentation())
         self._iters += 1
         if self._iters % self._RECOMPILE_CHECK_EVERY == 0:
             self._recompile.check()
@@ -520,6 +865,13 @@ class ServingEngine:
         self.scheduler.cancel(req, state)
         if had_slot:
             self._t[req.slot] = self.max_len   # sentinel: slot inert
+            if self.kv_layout == "paged":
+                self.pool.release_slot(req.slot)
+        if getattr(req, "_donor_ref", None) is not None:
+            # admitted with a copy-on-write donor hold but terminated
+            # before its prefill turn consumed it
+            self.pool.decref(req._donor_ref)
+            req._donor_ref = None
         req.error = error
         self.tracer.on_terminal(req.rid, state.value,
                                 len(req.generated))
@@ -551,7 +903,7 @@ class ServingEngine:
             st["breach"] for st in slo_status.values())
         status = ("saturated" if not accepting
                   else "degraded" if breaching else "ok")
-        return {
+        out = {
             "status": status,
             "accepting": accepting,
             "slo": slo_status,
@@ -563,9 +915,22 @@ class ServingEngine:
                          "finished": m.requests_finished,
                          "rejected": m.requests_rejected,
                          "timed_out": m.requests_timed_out,
-                         "cancelled": m.requests_cancelled},
+                         "cancelled": m.requests_cancelled,
+                         "preempted": m.requests_preempted},
             "telemetry": obs.telemetry_snapshot(),
         }
+        if self.kv_layout == "paged":
+            pool = self.pool
+            out["pages"] = {
+                "total": pool.num_pages, "free": pool.free_pages,
+                "shared": pool.shared_pages,
+                "page_len": pool.page_len,
+                "fragmentation": round(self._fragmentation(), 4)}
+            out["prefix_cache"] = (
+                None if self.prefix is None else {
+                    "nodes": len(self.prefix),
+                    "hit_rate": m.prefix_hit_rate})
+        return out
 
     # --- internals --------------------------------------------------------
 
@@ -574,16 +939,46 @@ class ServingEngine:
         # poisoned-request isolation in step(); an injected stall is the
         # slow-prefill scenario (queue grows, deadlines/shedding engage)
         faults.point("serving.prefill")
-        p_len = len(req.prompt)
+        paged = self.kv_layout == "paged"
+        # paged context = prompt, or prompt + generated[:-1] after a
+        # preemption (the resumable-prefill recompute path)
+        toks = req.context_tokens if paged else req.prompt
+        p_len = len(toks)
+        resume = paged and bool(req.generated)
+        if paged and req.prefill_pos == 0:
+            if self.prefix is not None:
+                # pages registered since this request's admission plan
+                # (by requests ahead of it in the prefill stream) are
+                # adopted here — the burst-of-identical-prompts case
+                self._rematch_at_prefill(req)
+                self.metrics.record_prefix_lookup(
+                    getattr(req, "_shared_len", 0), p_len)
+            if getattr(req, "_shared_len", 0):
+                # prefix-cache hit: materialize the shared pages (and
+                # the copy-on-write donor) into the staging cache once,
+                # then skip straight to the first non-shared position —
+                # the shared tokens' prefill compute never runs
+                self._staging = self.pool.load_prefix(
+                    self._staging, req._load_pages, req._shared_len)
+                req.prefill_pos = req._shared_len
+                self.tracer.on_prefix_hit(req.rid, req._shared_len)
+            if getattr(req, "_donor_ref", None) is not None:
+                # the donor's content is in staging now; its hold
+                # (taken at planning so reclaim/eviction could not
+                # free it first) is no longer needed
+                self.pool.decref(req._donor_ref)
+                req._donor_ref = None
+        t0 = req.prefill_pos
         chunk = self.prefill_chunk
-        if chunk is None or p_len <= chunk:
-            t0, q_len, final = 0, p_len, True
+        if chunk is None:
+            q_len, final = p_len - t0, True
         else:
-            t0 = req.prefill_pos
             q_len = min(chunk, p_len - t0)
             final = t0 + q_len >= p_len
-        fn = self._prefill_fn(q_len, t0, final)
-        chunk_toks = jnp.asarray(req.prompt[None, t0:t0 + q_len])
+        # a resume re-prefill never needs logits (its tokens are
+        # already decided), so every chunk runs head-less
+        fn = self._prefill_fn(q_len, t0, final and not resume)
+        chunk_toks = jnp.asarray(toks[None, t0:t0 + q_len])
         logits, self._staging = fn(self._params, self._state,
                                    self._staging, chunk_toks)
         req.prefill_pos = t0 + q_len
@@ -591,7 +986,34 @@ class ServingEngine:
         self.tracer.on_prefill_chunk(req.rid, t0, q_len)
         if not final:
             return
-        self.pool.insert(self._staging, req.slot)
+        if paged:
+            # write ONLY the pages the context fills, minus the shared
+            # prefix pages that already hold identical data (the
+            # copy-on-write donor's logical page IS written — into the
+            # request's private copy)
+            self.pool.insert_pages(self._staging, req.slot,
+                                   getattr(req, "_n_shared_full", 0),
+                                   p_len)
+            if self.prefix is not None:
+                # full context pages are immutable from here (decode
+                # writes start at p_len): share them forward
+                self.prefix.register(toks, self.pool.tables[req.slot])
+        else:
+            self.pool.insert(self._staging, req.slot, n_pos=p_len)
+        s = req.slot
+        if resume:
+            # re-admission after preemption: skip first-token sampling
+            # (TTFT fired long ago), restore the decode vectors and the
+            # snapshotted sampling key, rejoin the batch
+            self.scheduler.to_decoding(req)
+            self._tok[s] = req.generated[-1]
+            self._t[s] = p_len
+            self._temp[s] = req.temperature
+            self._topk[s] = req.top_k
+            self._topp[s] = req.top_p
+            self._keys[s] = np.array(req.rng)
+            self.tracer.on_resume(req.rid)
+            return
         first, req.rng = self._sample_first_fn()(
             logits, jnp.float32(req.temperature),
             jnp.int32(req.top_k), jnp.float32(req.top_p), req.rng)
@@ -603,7 +1025,6 @@ class ServingEngine:
             self._finish(req, finished)
             return
         self.scheduler.to_decoding(req)
-        s = req.slot
         self._tok[s] = token
         self._t[s] = p_len          # where the next decode step writes it
         self._temp[s] = req.temperature
@@ -616,19 +1037,28 @@ class ServingEngine:
         # decode-step error leaves the iteration wholesale-retryable
         # (see step() docstring)
         faults.point("serving.decode")
+        paged = self.kv_layout == "paged"
+        if paged:
+            # page growth happens BEFORE the step (a write with no page
+            # would silently drop); may preempt streams out of
+            # ``running``, so the batch composition reads after it
+            self._ensure_decode_pages()
+            if not self.scheduler.running:
+                return
         t0 = self.metrics.clock()
         n_active = len(self.scheduler.running)
         greedy_only = all(r.temperature <= 0.0
                           for r in self.scheduler.running.values())
+        tables = (self.pool.device_tables(),) if paged else ()
         if greedy_only:
             nxt, self.pool.cache = self._decode_fn(True)(
                 self._params, self._state, self.pool.cache,
-                self._tok, self._t)
+                self._tok, self._t, *tables)
         else:
             nxt, self.pool.cache, keys = self._decode_fn(False)(
                 self._params, self._state, self.pool.cache,
                 self._tok, self._t, self._temp, self._topk, self._topp,
-                self._keys)
+                self._keys, *tables)
             self._keys = np.array(keys)
         # warm baseline AFTER a variant's first call (its one legitimate
         # compile); any cache growth past it is a shape leak
@@ -658,6 +1088,10 @@ class ServingEngine:
         slot = req.slot
         self.scheduler.release(req)
         self._t[slot] = self.max_len          # sentinel: slot inert
+        if self.kv_layout == "paged":
+            # pages return to the budget; registered prompt-prefix
+            # pages survive under the prefix cache's own refcount
+            self.pool.release_slot(slot)
         self.metrics.record_finish(req.rid, len(req.generated))
         self.tracer.on_terminal(req.rid, RequestState.FINISHED.value,
                                 len(req.generated))

@@ -1,25 +1,47 @@
-"""Pooled KV cache: one resident ``[S, max_len]`` buffer set shared by
-every request the engine ever serves.
+"""KV cache pools for the serving engine: the legacy slab pool and the
+block-pooled PAGED cache that replaced it as the engine default.
 
-``generate()`` creates its cache inside each compiled program and drops
-it on exit — correct for one call, hopeless for serving, where cache
-allocation per request would dominate short decodes and fragment HBM.
-The pool is allocated ONCE (slot-major: the same head-major
-``[S, Hkv, max_len, Dh]`` per-layer layout ``init_cache`` builds, with
-the batch axis reinterpreted as slots) and stays on device; a finished
-request's slot is simply reused — stale positions are never read
-because the per-slot decode masks attention at ``<= t`` and the next
-occupant's prefill overwrites the whole row.
+``KVPool`` (slab) reserves one resident ``[S, max_len]`` buffer row per
+slot: occupancy is bounded by WORST-CASE length, so a pool sized for
+8K-token requests wastes ~94% of its HBM on a workload whose median
+request is 500 tokens. ``PagedKVPool`` is the vLLM/PagedAttention fix:
+one fixed pool of ``[num_pages, Hkv, page_len, Dh]`` pages per layer,
+a per-slot page table mapping logical position ``t`` to physical page
+``table[slot, t // page_len]``, pages allocated on demand as requests
+grow and returned the moment they finish. Occupancy tracks ACTUAL
+tokens (within ``page_len`` rounding), which is what turns memory into
+throughput: at equal HBM the paged pool admits however many requests
+fit their real lengths, not ``HBM / max_len``.
 
-Composes with the int8 quantized cache (``dtype="int8"``): the payload
-and per-token-per-head scale planes all carry the slot axis and insert
-together.
+On top of the pool, ``PrefixCache`` hash-conses shared prompt
+prefixes: finished requests register their full (immutable) prompt
+pages under a chained token hash, and a new request whose prompt
+matches reuses those pages read-only (refcounted) — prefill then skips
+the shared positions entirely. A PARTIAL page match is served
+copy-on-write: the donor page is loaded into the prefill staging
+cache, the chunks from the first divergent token overwrite its tail
+there, and the insert writes the result to the request's own private
+page — the shared original is never written.
+
+Refcounting contract: a physical page is held by every slot whose
+table points at it plus (for registered prefix pages) the cache node;
+``decref`` to zero returns it to the free list. Pages the prefix cache
+alone holds (``ref == 1``) are reclaimable LRU-leaf-first when
+allocation pressure needs them.
+
+Both pools compose with the int8 quantized cache (``dtype="int8"``):
+payload and per-token-per-head scale planes share the page tables and
+move together through every insert/load/gather program.
 """
 
 from __future__ import annotations
 
+import itertools
+from typing import Dict, List, Optional, Tuple
+
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from distkeras_tpu.models.decoding import init_cache
@@ -28,15 +50,17 @@ from distkeras_tpu.models.decoding import init_cache
 @jax.jit
 def _insert_row(pool, req_cache, slot):
     """Write a batch-1 request cache into pool row ``slot`` (``slot``
-    is traced — one compiled program serves every slot index)."""
+    is traced — one compiled program serves every slot index). The
+    request cache may be SHORTER than the row (the prompt-length
+    prefix): only its positions are written."""
     def write(pl, rq):
-        return lax.dynamic_update_slice_in_dim(
-            pl, rq.astype(pl.dtype), slot, axis=0)
+        return lax.dynamic_update_slice(
+            pl, rq.astype(pl.dtype), (slot,) + (0,) * (pl.ndim - 1))
     return jax.tree_util.tree_map(write, pool, req_cache)
 
 
 class KVPool:
-    """S-slot pooled KV cache over ``module``'s attention layers.
+    """S-slot slab-pooled KV cache over ``module``'s attention layers.
 
     ``cache`` is the live device pytree (the exact structure
     ``decode_step_slots`` consumes); ``insert`` replaces it — callers
@@ -63,13 +87,427 @@ class KVPool:
         what per-request prefill fills and ``insert`` consumes."""
         return init_cache(self._module, 1, self.max_len, self.dtype)
 
-    def insert(self, req_cache, slot: int) -> None:
+    def insert(self, req_cache, slot: int,
+               n_pos: Optional[int] = None) -> None:
         """Copy a batch-1 request cache (layout of
-        ``make_request_cache``) into row ``slot``. The whole row is
-        written — any stale tail beyond the new request's prompt is
-        overwritten by its own decode steps before the attention mask
-        ever reaches it."""
+        ``make_request_cache``) into row ``slot``. ``n_pos`` bounds the
+        copy to the positions the prompt actually filled — the full-row
+        write (the pre-paged behavior, kept when ``n_pos`` is None) was
+        a measurable admit-latency tax at large ``max_len``: it moved
+        ``max_len``/prompt_len times the bytes the admit needed. The
+        stale tail beyond ``n_pos`` is safe either way: the slot's own
+        decode writes position t before the attention mask admits it.
+        Like the ragged final prefill chunk, each distinct ``n_pos``
+        is its own compiled program (same cardinality, prompt lengths).
+        """
         if not 0 <= slot < self.num_slots:
             raise ValueError(
                 f"slot {slot} out of range [0, {self.num_slots})")
+        if n_pos is not None:
+            if not 0 < n_pos <= self.max_len:
+                raise ValueError(
+                    f"n_pos must be in (0, {self.max_len}], got {n_pos}")
+            req_cache = jax.tree_util.tree_map(
+                lambda x: x[:, :, :n_pos], req_cache)
         self.cache = _insert_row(self.cache, req_cache, slot)
+
+
+# --- paged pool -------------------------------------------------------------
+
+
+#: refcount slot for "no page": table entries >= num_pages are the
+#: unallocated sentinel (scatter drops, gather clamps into masked range)
+
+
+@jax.jit
+def _write_pages(pool, staging, table):
+    """Scatter staging pages into the pool: logical page ``p`` of the
+    batch-1 staging cache lands on physical page ``table[p]``; sentinel
+    entries (>= N) drop. One compiled program serves every insert —
+    which pages to SKIP (shared prefix pages, pages past the prompt)
+    is encoded by the sentinel, not by program shape."""
+    def write(pl, st):
+        page_len = pl.shape[2]
+        if st.ndim == 4:
+            _, h, s_max, d = st.shape
+            pages = st.reshape(h, s_max // page_len, page_len, d) \
+                      .transpose(1, 0, 2, 3)
+        else:
+            _, h, s_max = st.shape
+            pages = st.reshape(h, s_max // page_len, page_len) \
+                      .transpose(1, 0, 2)
+        return pl.at[table].set(pages.astype(pl.dtype), mode="drop")
+    return jax.tree_util.tree_map(write, pool, staging)
+
+
+@jax.jit
+def _load_pages(staging, pool, table, valid):
+    """Gather pool pages into the batch-1 staging cache: logical page
+    ``p`` becomes ``pool[table[p]]`` where ``valid[p]``, else keeps the
+    staging content. The prefix-cache hit path: shared pages (and a
+    copy-on-write donor) materialize as the staging prefix the
+    remaining prefill chunks attend to."""
+    def load(st, pl):
+        page_len = pl.shape[2]
+        g = pl[table]                        # [P, H, page_len, D?]
+        if st.ndim == 4:
+            _, h, s_max, d = st.shape
+            cur = st.reshape(h, s_max // page_len, page_len, d) \
+                    .transpose(1, 0, 2, 3)
+            sel = jnp.where(valid[:, None, None, None],
+                            g.astype(cur.dtype), cur)
+            return sel.transpose(1, 0, 2, 3).reshape(1, h, s_max, d)
+        _, h, s_max = st.shape
+        cur = st.reshape(h, s_max // page_len, page_len) \
+                .transpose(1, 0, 2)
+        sel = jnp.where(valid[:, None, None], g.astype(cur.dtype), cur)
+        return sel.transpose(1, 0, 2).reshape(1, h, s_max)
+    return jax.tree_util.tree_map(load, staging, pool)
+
+
+class PagedKVPool:
+    """Fixed pool of ``num_pages`` KV pages per layer + per-slot page
+    tables + host-side refcounted allocation.
+
+    ``cache`` is the live device pytree ``decode_step_slots_paged``
+    consumes; ``tables`` is the host ``[S, P]`` int32 page-table array
+    (``device_tables()`` returns the cached device mirror, invalidated
+    by any mutation). A table entry of ``num_pages`` is the
+    unallocated sentinel."""
+
+    def __init__(self, module, num_slots: int, max_len: int,
+                 page_len: int = 16, num_pages: Optional[int] = None,
+                 dtype=jnp.float32):
+        if num_slots < 1:
+            raise ValueError(f"num_slots must be >= 1, got {num_slots}")
+        if max_len < 1:
+            raise ValueError(f"max_len must be >= 1, got {max_len}")
+        if page_len < 1:
+            raise ValueError(f"page_len must be >= 1, got {page_len}")
+        self._module = module
+        self.num_slots = int(num_slots)
+        self.max_len = int(max_len)
+        self.page_len = int(page_len)
+        #: logical pages per slot: the page-table width (covers max_len)
+        self.pages_per_slot = -(-self.max_len // self.page_len)
+        if num_pages is None:
+            # capacity parity with the slab pool by default; real
+            # deployments size this to the HBM budget and rely on
+            # cost-aware admission + preemption
+            num_pages = self.num_slots * self.pages_per_slot
+        self.num_pages = int(num_pages)
+        if self.num_pages < 1:
+            raise ValueError(
+                f"num_pages must be >= 1, got {self.num_pages}")
+        # a pool SMALLER than worst-case-per-request is legitimate —
+        # that is what cost-aware admission is for; the engine rejects
+        # individual requests whose own worst case exceeds the pool
+        self.dtype = dtype
+        # page pool: init_cache's batch axis is the PAGE axis; the
+        # position table is validated against max_len (check_len), not
+        # the page length
+        self.cache = init_cache(module, self.num_pages, self.page_len,
+                                dtype, check_len=self.max_len)
+        self.tables = np.full((self.num_slots, self.pages_per_slot),
+                              self.num_pages, np.int32)
+        self.ref = np.zeros(self.num_pages, np.int64)
+        # pop() hands out page 0 first (deterministic placement for
+        # tests/traces, same convention as the slot allocator)
+        self._free = list(range(self.num_pages))[::-1]
+        self._tables_dev = None
+
+    # -- device views -------------------------------------------------------
+
+    def make_request_cache(self):
+        """The batch-1 prefill staging cache: ``pages_per_slot *
+        page_len`` positions (a page-multiple, so page loads/inserts
+        reshape exactly), position-validated at ``max_len`` — prefill
+        never writes past it."""
+        return init_cache(self._module, 1,
+                          self.pages_per_slot * self.page_len,
+                          self.dtype, check_len=self.max_len)
+
+    def device_tables(self):
+        """The [S, P] page tables on device (cached; any host-side
+        table mutation invalidates)."""
+        if self._tables_dev is None:
+            self._tables_dev = jnp.asarray(self.tables)
+        return self._tables_dev
+
+    def _dirty(self):
+        self._tables_dev = None
+
+    # -- allocation ---------------------------------------------------------
+
+    def pages_for(self, n_positions: int) -> int:
+        """Pages required to hold ``n_positions`` cache positions."""
+        return -(-int(n_positions) // self.page_len)
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    @property
+    def shared_pages(self) -> int:
+        """Physical pages with more than one holder (slots and/or the
+        prefix cache) — the prefix-sharing win, measured."""
+        return int((self.ref > 1).sum())
+
+    def alloc_page(self) -> Optional[int]:
+        """One free page with ``ref = 1`` (the caller's), or None."""
+        if not self._free:
+            return None
+        pid = self._free.pop()
+        self.ref[pid] = 1
+        return pid
+
+    def incref(self, pid: int) -> None:
+        self.ref[pid] += 1
+
+    def decref(self, pid: int) -> None:
+        self.ref[pid] -= 1
+        if self.ref[pid] < 0:
+            raise RuntimeError(
+                f"page {pid} refcount went negative (double free)")
+        if self.ref[pid] == 0:
+            self._free.append(pid)
+
+    def assign(self, slot: int, logical: int, pid: int) -> None:
+        """Point ``tables[slot, logical]`` at ``pid`` (the caller has
+        already arranged the refcount)."""
+        self.tables[slot, logical] = pid
+        self._dirty()
+
+    def slot_pages(self, slot: int) -> List[int]:
+        row = self.tables[slot]
+        return [int(p) for p in row if p < self.num_pages]
+
+    def release_slot(self, slot: int) -> int:
+        """Drop the slot's hold on every page it references (pages the
+        prefix cache still holds survive with the cache's ref) and
+        reset its table row to the sentinel; returns the number of
+        pages released."""
+        pages = self.slot_pages(slot)
+        for pid in pages:
+            self.decref(pid)
+        self.tables[slot] = self.num_pages
+        self._dirty()
+        return len(pages)
+
+    # -- staging transfers --------------------------------------------------
+
+    def insert_pages(self, staging, slot: int, skip_pages: int,
+                     n_pos: int) -> None:
+        """Scatter the staging cache's logical pages
+        ``[skip_pages, pages_for(n_pos))`` into the slot's physical
+        pages — ONLY the pages the prompt actually fills and that are
+        not already shared (the prefix-cache pages at the front hold
+        identical data and are skipped wholesale)."""
+        n_needed = self.pages_for(n_pos)
+        tv = np.full(self.pages_per_slot, self.num_pages, np.int32)
+        tv[skip_pages:n_needed] = self.tables[slot, skip_pages:n_needed]
+        self.cache = _write_pages(self.cache, staging, jnp.asarray(tv))
+
+    def load_prefix(self, staging, page_ids: List[int], n_tokens: int):
+        """Materialize a shared prefix into the staging cache: pages
+        ``page_ids`` (full shared pages, plus the copy-on-write donor
+        as the last entry for a partial match) become staging positions
+        ``[0, n_tokens)`` (plus donor tail garbage the prefill chunks
+        overwrite). Returns the new staging pytree."""
+        n_load = self.pages_for(n_tokens)
+        if len(page_ids) < n_load:
+            raise ValueError(
+                f"{len(page_ids)} pages cannot cover {n_tokens} shared "
+                f"tokens ({n_load} pages)")
+        tv = np.full(self.pages_per_slot, self.num_pages, np.int32)
+        tv[:n_load] = page_ids[:n_load]
+        valid = np.arange(self.pages_per_slot) < n_load
+        return _load_pages(staging, self.cache, jnp.asarray(tv),
+                           jnp.asarray(valid))
+
+
+# --- prefix cache -----------------------------------------------------------
+
+
+class _Node:
+    __slots__ = ("nid", "page", "parent", "key", "last_used")
+
+    def __init__(self, nid, page, parent, key, last_used):
+        self.nid = nid
+        self.page = page
+        self.parent = parent
+        self.key = key
+        self.last_used = last_used
+
+
+class PrefixCache:
+    """Hash-consed shared prompt prefixes over a ``PagedKVPool``.
+
+    A trie keyed by page-sized token runs: node ``(parent, tokens)``
+    owns the physical page holding those positions' KV. Finished
+    prefills ``register()`` their full (immutable — decode never
+    writes them) prompt pages; ``match()`` walks the longest chain a
+    new prompt shares and additionally finds the best PARTIAL match
+    among the last node's children (the copy-on-write donor). Matches
+    are capped at ``len(tokens) - 1``: the final prompt position is
+    always recomputed because its logits seed the first sampled token.
+
+    KV sharing is exact up to chunked-prefill fp reassociation: a
+    page's values were computed by SOME request's prefill over the
+    same token prefix; a different total prompt length can place the
+    ragged final chunk differently, which reassociates the softmax
+    sums. Greedy token identity is unaffected at any realistic argmax
+    margin (the oracle tests pin this); bitwise-KV-sensitive callers
+    can disable sharing per engine.
+
+    Eviction is LRU over LEAF nodes whose page only the cache holds
+    (``ref == 1``) — evicting a leaf exposes its parent for the next
+    round, so sustained pressure unwinds whole chains."""
+
+    def __init__(self, pool: PagedKVPool):
+        self._pool = pool
+        self._nodes: Dict[int, _Node] = {}
+        #: parent nid -> {page-token bytes -> node}; 0 is the root
+        self._children: Dict[int, Dict[bytes, _Node]] = {0: {}}
+        #: parent nid -> {first token -> [nodes]}: the partial-match
+        #: candidate index (a donor match needs >= 1 leading token, so
+        #: only children sharing the probe's first token can qualify —
+        #: without this, every lookup scanned ALL children of the
+        #: chain end, O(distinct prompts) per admission)
+        self._first: Dict[int, Dict[int, List[_Node]]] = {}
+        self._nid = itertools.count(1)
+        self._tick = itertools.count()
+
+    def __len__(self) -> int:
+        return len(self._nodes)
+
+    def match(self, tokens) -> Tuple[List[int], int, Optional[int]]:
+        """Longest shared prefix of ``tokens``: returns ``(full_pages,
+        shared_len, donor_page)`` where ``full_pages`` are the chained
+        full-page hits (``len * page_len`` tokens), ``shared_len`` adds
+        the best partial-page match and ``donor_page`` is the page to
+        copy-on-write for it (None for a page-aligned match)."""
+        pool = self._pool
+        pl = pool.page_len
+        toks = np.ascontiguousarray(np.asarray(tokens, np.int32))
+        n = len(toks)
+        tick = next(self._tick)
+        pages: List[int] = []
+        parent = 0
+        pos = 0
+        # full pages, capped so shared_len stays <= n - 1
+        while pos + pl < n:
+            node = self._children.get(parent, {}).get(
+                toks[pos:pos + pl].tobytes())
+            if node is None:
+                break
+            node.last_used = tick
+            pages.append(node.page)
+            parent = node.nid
+            pos += pl
+        # best partial continuation among the chain's children (the
+        # copy-on-write donor); also catches the "whole prompt cached"
+        # case — the last page re-enters here with pl - 1 tokens
+        donor = None
+        best = 0
+        limit = min(pl, n - 1 - pos)
+        if limit > 0:
+            cands = self._first.get(parent, {}).get(int(toks[pos]), [])
+            for node in cands:
+                cand = np.frombuffer(node.key, np.int32)[:limit]
+                m = int((np.cumprod(cand == toks[pos:pos + limit]))
+                        .sum())
+                if m > best:
+                    best, donor = m, node
+        if donor is not None:
+            donor.last_used = tick
+            return pages, pos + best, donor.page
+        return pages, pos, None
+
+    def register(self, tokens, table_row) -> int:
+        """Install every FULL prompt page of ``tokens`` (physical ids
+        from ``table_row``) into the trie; pages already registered
+        along the chain are left as-is (a privately recomputed
+        duplicate stays private and dies with its request). Each new
+        node increfs its page — the cache is a holder. Returns the
+        number of pages newly registered."""
+        pool = self._pool
+        pl = pool.page_len
+        toks = np.ascontiguousarray(np.asarray(tokens, np.int32))
+        tick = next(self._tick)
+        parent = 0
+        added = 0
+        for j in range(len(toks) // pl):
+            key = toks[j * pl:(j + 1) * pl].tobytes()
+            ch = self._children.setdefault(parent, {})
+            node = ch.get(key)
+            if node is None:
+                pid = int(table_row[j])
+                if pid >= pool.num_pages:
+                    break                # unallocated: nothing to share
+                node = _Node(next(self._nid), pid, parent, key, tick)
+                ch[key] = node
+                self._children[node.nid] = {}
+                self._nodes[node.nid] = node
+                self._first.setdefault(parent, {}).setdefault(
+                    int(toks[j * pl]), []).append(node)
+                pool.incref(pid)
+                added += 1
+            node.last_used = tick
+            parent = node.nid
+        return added
+
+    def evict_one(self) -> bool:
+        """Drop the least-recently-used LEAF node whose page only the
+        cache holds, freeing its page. False when nothing is
+        evictable (every cached page is also live in some slot)."""
+        pool = self._pool
+        victim = None
+        for node in self._nodes.values():
+            if self._children.get(node.nid):
+                continue                          # interior: keep chain
+            if pool.ref[node.page] != 1:
+                continue                          # a slot still reads it
+            if victim is None or node.last_used < victim.last_used:
+                victim = node
+        if victim is None:
+            return False
+        del self._children[victim.parent][victim.key]
+        del self._children[victim.nid]
+        del self._nodes[victim.nid]
+        tok0 = int(np.frombuffer(victim.key, np.int32)[0])
+        bucket = self._first.get(victim.parent, {}).get(tok0, [])
+        if victim in bucket:
+            bucket.remove(victim)
+        pool.decref(victim.page)
+        return True
+
+    def evictable_pages(self) -> int:
+        """Pages the cache could EVENTUALLY free under pressure: nodes
+        whose page only the cache holds and whose whole subtree is in
+        the same position (children must evict before their parent).
+        Callers check this BEFORE reclaiming toward a target — a
+        reclaim that cannot reach its goal would drain the whole
+        reusable cache for nothing."""
+        memo: Dict[int, bool] = {}
+
+        def ok(nid: int) -> bool:
+            got = memo.get(nid)
+            if got is not None:
+                return got
+            node = self._nodes[nid]
+            memo[nid] = res = (
+                self._pool.ref[node.page] == 1
+                and all(ok(c.nid)
+                        for c in self._children.get(nid, {}).values()))
+            return res
+
+        return sum(1 for nid in self._nodes if ok(nid))
+
+    def reclaim(self, n_pages: int) -> int:
+        """Evict until ``n_pages`` pages were freed (or nothing more is
+        evictable); returns the number freed."""
+        freed = 0
+        while freed < n_pages and self.evict_one():
+            freed += 1
+        return freed

@@ -73,6 +73,23 @@ class ServingMetrics:
             "serving.requests_cancelled")
         self._decode_toks = self.registry.counter("serving.decode_tokens")
         self._decode_secs = self.registry.counter("serving.decode_seconds")
+        # paged-KV accounting (paged-cache PR): page-budget gauges set
+        # once per iteration, prefix-cache hit counters, preemptions.
+        # Gauges stay unset (None) on a slab engine — summary keys are
+        # additive and layout-honest
+        self._pages_free = self.registry.gauge("serving.pages_free")
+        self._pages_shared = self.registry.gauge("serving.pages_shared")
+        self._page_frag = self.registry.gauge(
+            "serving.page_fragmentation")
+        self._prefix_hits = self.registry.counter("serving.prefix_hits")
+        self._prefix_lookups = self.registry.counter(
+            "serving.prefix_lookups")
+        self._prefix_hit_toks = self.registry.counter(
+            "serving.prefix_hit_tokens")
+        self._prefix_lookup_toks = self.registry.counter(
+            "serving.prefix_lookup_tokens")
+        self._preempted = self.registry.counter(
+            "serving.requests_preempted")
         #: exact (tokens, seconds) aggregation per decoding-slot count —
         #: bounded by the slot count, and authoritative for
         #: ``decode_tokens_per_sec`` (the labeled counters mirror it for
@@ -132,6 +149,30 @@ class ServingMetrics:
         self.first_ts.pop(rid, None)
         self._cancelled.inc()
 
+    def record_preemption(self, rid: int) -> None:
+        """A decoding request evicted back to the queue (page-budget
+        pressure). NOT terminal: its submit/first-token timestamps
+        stay — TTFT already fired and latency measures to the real
+        finish, across however many preemptions."""
+        self._preempted.inc()
+
+    def record_prefix_lookup(self, hit_tokens: int,
+                             total_tokens: int) -> None:
+        """One prefix-cache lookup at admission: ``hit_tokens`` of the
+        request's ``total_tokens`` context came off shared pages."""
+        self._prefix_lookups.inc()
+        self._prefix_lookup_toks.inc(int(total_tokens))
+        if hit_tokens > 0:
+            self._prefix_hits.inc()
+            self._prefix_hit_toks.inc(int(hit_tokens))
+
+    def record_pages(self, free: int, shared: int,
+                     fragmentation: float) -> None:
+        """Per-iteration page-budget gauges (paged engine only)."""
+        self._pages_free.set(int(free))
+        self._pages_shared.set(int(shared))
+        self._page_frag.set(float(fragmentation))
+
     # --- per-iteration ----------------------------------------------------
 
     def record_prefill_chunk(self) -> None:
@@ -178,6 +219,19 @@ class ServingMetrics:
         return int(self._cancelled.value())
 
     @property
+    def requests_preempted(self) -> int:
+        return int(self._preempted.value())
+
+    @property
+    def prefix_hit_rate(self) -> Optional[float]:
+        """Fraction of looked-up context tokens served off shared
+        pages (None before any lookup)."""
+        total = self._prefix_lookup_toks.value()
+        if total <= 0:
+            return None
+        return self._prefix_hit_toks.value() / total
+
+    @property
     def decode_samples(self) -> List:
         """Recent ``(n_decoding, dt)`` pairs (bounded window)."""
         return list(self._decode_recent)
@@ -222,6 +276,7 @@ class ServingMetrics:
         qd = self._qdepth.stats()
         occ = self._occ.stats()
         tokens = self.tokens_generated
+        pages_free = self._pages_free.value()
         return {
             "requests_finished": self.requests_finished,
             # degradation tally (keys ADDED by the resilience PR; all
@@ -229,6 +284,18 @@ class ServingMetrics:
             "requests_rejected": self.requests_rejected,
             "requests_timed_out": self.requests_timed_out,
             "requests_cancelled": self.requests_cancelled,
+            # paged-KV tally (keys ADDED by the paged-cache PR): page
+            # budget at the last iteration, prefix-cache hit rate,
+            # preemption count; "pages" is None on a slab engine
+            "requests_preempted": self.requests_preempted,
+            "pages": (None if pages_free is None else {
+                "free": int(pages_free),
+                "shared": int(self._pages_shared.value() or 0),
+                "fragmentation": self._page_frag.value()}),
+            "prefix_cache": {
+                "lookups": int(self._prefix_lookups.value()),
+                "hits": int(self._prefix_hits.value()),
+                "hit_rate": self.prefix_hit_rate},
             "tokens_generated": tokens,
             # request-level throughput: all generated tokens over the
             # first-submit -> last-finish span (includes queueing +

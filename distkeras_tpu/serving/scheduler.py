@@ -1,9 +1,11 @@
-"""Request scheduling for the continuous-batching engine: FIFO
-admission, per-request state machine, slot allocation/release.
+"""Request scheduling for the continuous-batching engine: admission,
+per-request state machine, slot allocation/release, preemption.
 
 The scheduler is pure host-side bookkeeping — it never touches device
-arrays. Policy (deliberately simple, documented in docs/serving.md;
-degradation semantics in docs/resilience.md):
+arrays. Two policies (docs/serving.md; degradation semantics in
+docs/resilience.md):
+
+``FIFOScheduler`` (the slab-pool engine's policy, deliberately simple):
 
   * FCFS admission: queued requests take free slots in arrival order.
   * BOUNDED queue: with ``max_queue`` set, a submit past the bound
@@ -19,11 +21,30 @@ degradation semantics in docs/resilience.md):
     poisoned-request isolation (``CANCELLED``) — from ANY live state.
   * Double-release is a loud error, never a silent double-free: two
     requests sharing one KV slot would corrupt both streams.
+
+``PriorityScheduler`` (the paged-pool engine's cost-aware policy):
+
+  * Priority classes: lower ``Request.priority`` admits first
+    (0 = interactive, 1 = standard, 2 = batch by convention; any int
+    works). Within a class, FCFS — except preempted requests, which
+    resume AT THE FRONT of their class (they hold progress).
+  * Admission is budgeted: the engine admits head-of-line requests
+    while ``peek()`` fits the free-PAGE budget (plus a free slot),
+    not merely while slots exist — the slab policy's failure mode was
+    admitting by worst-case slot count while HBM sat idle.
+  * PREEMPTION: ``preempt()`` ejects a DECODING request back to the
+    queue (state → QUEUED, slot freed, generated tokens kept). The
+    engine preempts when a decode step needs a page and none is free,
+    or when a strictly-higher-priority request cannot admit; the
+    victim re-prefills its prompt + generated context on re-admission
+    (the resumable ``prefill_chunk_step``) and continues
+    token-identically.
 """
 
 from __future__ import annotations
 
 import enum
+import itertools
 from collections import deque
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
@@ -75,6 +96,8 @@ class Request:
     top_p: float = 1.0
     stop_token: int = -1
     seed: int = 0
+    priority: int = 1                    # lower admits first (0 = most
+    #                                      urgent; 1 = standard default)
     state: RequestState = RequestState.QUEUED
     slot: Optional[int] = None
     prefill_pos: int = 0                 # prompt positions ingested
@@ -84,6 +107,7 @@ class Request:
     #                                      clock); None = no deadline
     submit_t: float = 0.0                # engine-clock submit timestamp
     error: Optional[BaseException] = None  # why CANCELLED (isolation)
+    n_preempted: int = 0                 # times evicted back to queue
 
     @property
     def stopped(self) -> bool:
@@ -93,6 +117,19 @@ class Request:
     @property
     def done(self) -> bool:
         return self.stopped or len(self.generated) >= self.max_new_tokens
+
+    @property
+    def context_tokens(self) -> np.ndarray:
+        """Every token whose KV must be IN CACHE before this request
+        can (re)join decode: the prompt, plus — after a preemption —
+        all generated tokens but the last (the last one is the pending
+        decode input; its KV is written by the resumed step itself).
+        For a fresh request this is just the prompt."""
+        if not self.generated:
+            return self.prompt
+        return np.concatenate(
+            [self.prompt,
+             np.asarray(self.generated[:-1], self.prompt.dtype)])
 
     @property
     def tokens(self) -> np.ndarray:
@@ -220,3 +257,87 @@ class FIFOScheduler:
     def pending(self) -> bool:
         """Any request not yet FINISHED."""
         return bool(self.waiting or self.prefilling or self.running)
+
+    @property
+    def free_slots(self) -> int:
+        return len(self._free)
+
+
+class PriorityScheduler(FIFOScheduler):
+    """Cost-aware scheduling over the same state machine: priority
+    classes, budgeted admission (the engine gates ``admit_one`` on its
+    page budget), and preemption of decoding requests back to the
+    queue. ``waiting`` stays the single deque the base class (and its
+    bounded-admission / cancel paths) already manage; ordering is by
+    ``(priority, order)`` key at ``peek()`` time — queues are short
+    (bounded under overload), so the O(n) min costs nothing next to a
+    device step."""
+
+    def __init__(self, num_slots: int, max_queue: Optional[int] = None):
+        super().__init__(num_slots, max_queue=max_queue)
+        self._order = itertools.count()   # arrival order within class
+        self._front = itertools.count()   # requeue order (preempted)
+
+    def submit(self, req: Request) -> None:
+        # rank 1: fresh arrivals sort after every preempted (rank 0)
+        # request of the same class, FCFS within the rank
+        req._order = (1, next(self._order))
+        super().submit(req)
+
+    def _key(self, req: Request):
+        return (req.priority, getattr(req, "_order", (1, 0)))
+
+    def peek(self) -> Optional[Request]:
+        """The request admission would take next (highest class, FCFS
+        within it, preempted requests first), without taking it."""
+        if not self.waiting:
+            return None
+        return min(self.waiting, key=self._key)
+
+    def admit_one(self, req: Request) -> None:
+        """Admit ONE queued request (the engine calls this only after
+        reserving its pages) into a free slot."""
+        if not self._free:
+            raise RuntimeError("admit_one with no free slot")
+        self.waiting.remove(req)
+        req.slot = self._free.pop()
+        req.state = RequestState.PREFILLING
+        req.prefill_pos = 0
+        self.prefilling.append(req)
+        if self.tracer is not None:
+            self.tracer.on_admit(req.rid, req.slot, len(self.waiting))
+
+    def admit(self) -> List[Request]:
+        """Unbudgeted admission (standalone/scheduler-only use): fill
+        free slots in priority order."""
+        admitted = []
+        while self.waiting and self._free:
+            req = self.peek()
+            self.admit_one(req)
+            admitted.append(req)
+        return admitted
+
+    def preempt(self, req: Request) -> None:
+        """Evict an admitted request back to the queue: slot freed,
+        state → QUEUED, generated tokens kept (its re-prefill context),
+        resumed ahead of its class peers. DECODING victims resume
+        token-identically (the engine snapshots their sampling key);
+        a PREFILLING victim simply discards its staged chunks and
+        re-prefills from scratch — its pages are page-budget holders
+        too, and leaving them unpreemptable would let one mid-prefill
+        request starve a decoding stream into a dead pool."""
+        if req.state is RequestState.DECODING:
+            del self.running[req.slot]
+        elif req.state is RequestState.PREFILLING:
+            self.prefilling.remove(req)
+        else:
+            raise RuntimeError(
+                f"cannot preempt request {req.rid} in state "
+                f"{req.state.value!r}: it holds no page-backed slot")
+        self._free.append(req.slot)
+        req.slot = None
+        req.state = RequestState.QUEUED
+        req.prefill_pos = 0
+        req.n_preempted += 1
+        req._order = (0, next(self._front))
+        self.waiting.append(req)

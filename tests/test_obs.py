@@ -562,7 +562,10 @@ def test_serving_summary_keys_are_backward_compatible():
         "requests_rejected", "requests_timed_out", "requests_cancelled",
         # per-token decode cadence ADDED by the tracing/SLO PR (feeds
         # the tpot_p99 objective)
-        "tpot_s"}
+        "tpot_s",
+        # paged-KV tally ADDED by the paged-cache PR ("pages" is None
+        # on a slab engine / before any iteration)
+        "requests_preempted", "pages", "prefix_cache"}
 
 
 # --- integration: prefetch gauges -------------------------------------------

@@ -14,7 +14,8 @@ from distkeras_tpu.models import Model, zoo
 from distkeras_tpu.models.decoding import (decode_step, decode_step_slots,
                                            generate, init_cache,
                                            _resolve_head_dims)
-from distkeras_tpu.serving import (FIFOScheduler, KVPool, Request,
+from distkeras_tpu.serving import (FIFOScheduler, KVPool, PagedKVPool,
+                                   PriorityScheduler, Request,
                                    RequestState, ServingEngine,
                                    ServingMetrics)
 
@@ -401,6 +402,443 @@ def test_metrics_lifecycle_and_summary():
     # full-occupancy steady state: 2 tokens / 0.25 s
     assert mtr.decode_tokens_per_sec(min_occupancy=2) \
         == pytest.approx(8.0)
+
+
+# --- paged KV cache ---------------------------------------------------------
+#
+# The default engine layout since the paged-cache PR: every oracle test
+# above already runs through the paged data plane (page_len 16 covers
+# those short prompts in one page). The tests below force multi-page
+# requests, prefix sharing, copy-on-write and preemption explicitly.
+
+
+def test_paged_small_pages_oracle_matches_generate(memorized_lm):
+    """Pages far smaller than the prompt (crossing mid-prompt and
+    mid-decode): greedy tokens equal standalone generate()."""
+    m = memorized_lm
+    eng = ServingEngine(m, num_slots=3, max_len=32, page_len=4)
+    prompts = [PATTERN[:5], PATTERN[:7], PATTERN[:3], PATTERN[:6]]
+    budgets = [9, 5, 8, 7]
+    rids = [eng.submit(p, b) for p, b in zip(prompts, budgets)]
+    out = eng.run(max_steps=500)
+    for i, rid in enumerate(rids):
+        ref = generate(m, prompts[i][None], max_new_tokens=budgets[i],
+                       temperature=0.0)
+        np.testing.assert_array_equal(out[rid], ref[0])
+
+
+def test_slab_layout_oracle_still_matches_generate(memorized_lm):
+    """The legacy slab pool stays selectable and token-identical (the
+    equal-HBM bench baseline)."""
+    m = memorized_lm
+    eng = ServingEngine(m, num_slots=2, max_len=32, kv_layout="slab")
+    assert isinstance(eng.pool, KVPool) and eng.prefix is None
+    rid = eng.submit(PATTERN[:4], 7)
+    out = eng.run(max_steps=300)
+    ref = generate(m, PATTERN[None, :4], max_new_tokens=7,
+                   temperature=0.0)
+    np.testing.assert_array_equal(out[rid], ref[0])
+
+
+def test_paged_int8_cache_shares_tables_with_scales(memorized_lm):
+    """int8 quantized cache x paged pool: payload AND scale planes move
+    through the same page tables — token-identical to generate() with
+    the int8 cache, across page boundaries."""
+    m = memorized_lm
+    eng = ServingEngine(m, num_slots=2, max_len=32, cache_dtype="int8",
+                        page_len=4)
+    prompt = np.tile(PATTERN, 2)[:13]
+    rid = eng.submit(prompt, 7)
+    rid2 = eng.submit(PATTERN[:5], 6)
+    out = eng.run(max_steps=300)
+    ref = generate(m, prompt[None], max_new_tokens=7, temperature=0.0,
+                   cache_dtype="int8")
+    np.testing.assert_array_equal(out[rid], ref[0])
+    ref2 = generate(m, PATTERN[None, :5], max_new_tokens=6,
+                    temperature=0.0, cache_dtype="int8")
+    np.testing.assert_array_equal(out[rid2], ref2[0])
+
+
+def test_decode_step_slots_paged_matches_slab_logits():
+    """The paged decode step over scattered physical pages must produce
+    the slab step's logits: same values in logical order after the
+    gather, same masked attention."""
+    from distkeras_tpu.models.decoding import decode_step_slots_paged
+    m = Model.build(
+        zoo.transformer_lm(V, d_model=32, num_heads=4, num_layers=2,
+                           mlp_ratio=2, use_rope=True), (S,), seed=4)
+    _resolve_head_dims(m.module, m.params)
+    rs = np.random.RandomState(1)
+    toks = rs.randint(0, V, (2, 8)).astype(np.int32)
+    slab = [None if a is None else
+            {k: jnp.concatenate([a[k], b[k]], axis=0) for k in a}
+            for a, b in zip(_advance(m, toks[0:1], 4),
+                            _advance(m, toks[1:2], 2))]
+    page_len = 4
+    n_logical = S // page_len                    # 3 logical pages/slot
+    # scrambled physical placement: slot 0 -> pages [5, 2, 0],
+    # slot 1 -> pages [1, 4, 3]
+    tables = np.array([[5, 2, 0], [1, 4, 3]], np.int32)
+    paged = []
+    for layer in slab:
+        if layer is None:
+            paged.append(None)
+            continue
+        entry = {}
+        for k, arr in layer.items():
+            arr = np.asarray(arr)                # [2, H, S, ...]
+            pool = np.zeros((6,) + arr.shape[1:2]
+                            + (page_len,) + arr.shape[3:], arr.dtype)
+            for slot in range(2):
+                for j in range(n_logical):
+                    pool[tables[slot, j]] = \
+                        arr[slot, :, j * page_len:(j + 1) * page_len]
+            entry[k] = jnp.asarray(pool)
+        paged.append(entry)
+    tok = jnp.asarray(np.stack([toks[0, 4], toks[1, 2]]))
+    t = jnp.asarray(np.array([4, 2], np.int32))
+    ref_logits, _ = decode_step_slots(m.module, m.params, m.state,
+                                      slab, tok, t)
+    got_logits, _ = decode_step_slots_paged(
+        m.module, m.params, m.state, paged, tok,
+        t, jnp.asarray(tables), page_len)
+    np.testing.assert_allclose(np.asarray(got_logits),
+                               np.asarray(ref_logits), atol=1e-5)
+
+
+def test_prefix_sharing_skips_prefill_and_matches_generate(memorized_lm):
+    """A second request with an identical prompt reuses the first's
+    registered pages: its prefill runs a single ragged chunk (the
+    recomputed final position), the hit counters move, and both
+    outputs equal standalone generate()."""
+    m = memorized_lm
+    eng = ServingEngine(m, num_slots=2, max_len=48, page_len=4,
+                        prefill_chunk=4)
+    prompt = np.tile(PATTERN, 2)[:12]            # 3 full pages
+    r0 = eng.submit(prompt, 5)
+    out0 = eng.run(max_steps=300)
+    chunks_before = eng.metrics.prefill_chunks
+    r1 = eng.submit(prompt, 5)
+    out1 = eng.run(max_steps=300)
+    ref = generate(m, prompt[None], max_new_tokens=5, temperature=0.0,
+                   prefill_chunk=4)
+    np.testing.assert_array_equal(out0[r0], ref[0])
+    np.testing.assert_array_equal(out1[r1], ref[0])
+    s = eng.metrics.summary()
+    assert s["prefix_cache"]["hits"] == 1        # r1 hit, r0 missed
+    assert s["prefix_cache"]["hit_rate"] > 0.4
+    # 11 of r1's 12 prompt positions came off shared pages: one chunk
+    # (position 11) vs r0's three
+    assert eng.metrics.prefill_chunks - chunks_before == 1
+
+
+def test_prefix_partial_page_copy_on_write(memorized_lm):
+    """A prompt that diverges INSIDE a cached page: the matched head of
+    the donor page is reused (copy-on-write into the new request's
+    private page), the divergent tail is recomputed, and the donor's
+    original content stays valid for its own chain."""
+    m = memorized_lm
+    eng = ServingEngine(m, num_slots=2, max_len=48, page_len=4)
+    a = np.tile(PATTERN, 2)[:12]                 # 3 full cached pages
+    b = a.copy()
+    b[10] = (a[10] + 1) % V                      # diverge inside page 2
+    ra = eng.submit(a, 5)
+    out_a = eng.run(max_steps=300)
+    rb = eng.submit(b, 5)
+    out_b = eng.run(max_steps=300)
+    # b shared a's two full pages + two tokens of page 2 via the donor
+    assert eng.metrics.summary()["prefix_cache"]["hits"] == 1
+    tl = [t for t in eng.tracer.timelines() if t.rid == rb][0]
+    assert tl.prefix_hit_tokens == 10            # 8 full + 2 donor
+    np.testing.assert_array_equal(
+        out_a[ra], generate(m, a[None], 5, temperature=0.0)[0])
+    np.testing.assert_array_equal(
+        out_b[rb], generate(m, b[None], 5, temperature=0.0)[0])
+    # the donor chain is uncorrupted: a re-run of prompt a (full hit
+    # on its own pages now) still matches
+    ra2 = eng.submit(a, 5)
+    out_a2 = eng.run(max_steps=300)
+    np.testing.assert_array_equal(
+        out_a2[ra2], generate(m, a[None], 5, temperature=0.0)[0])
+
+
+def test_preemption_resume_token_identity(memorized_lm):
+    """Two streams outgrow a deliberately small page pool: the younger
+    is preempted mid-decode, resumes via the recompute prefill, and
+    BOTH stay token-identical to standalone generate() — the
+    acceptance bar for preemption correctness. Staggered arrivals."""
+    m = memorized_lm
+    eng = ServingEngine(m, num_slots=2, max_len=32, page_len=4,
+                        num_pages=8, prefix_cache=False)
+    r0 = eng.submit(PATTERN[:5], 16)
+    eng.step()
+    eng.step()
+    r1 = eng.submit(PATTERN[:6], 15)
+    out = eng.run(max_steps=2000)
+    assert eng.metrics.requests_preempted >= 1
+    assert eng.metrics.summary()["requests_preempted"] >= 1
+    np.testing.assert_array_equal(
+        out[r0], generate(m, PATTERN[None, :5], 16, temperature=0.0)[0])
+    np.testing.assert_array_equal(
+        out[r1], generate(m, PATTERN[None, :6], 15, temperature=0.0)[0])
+
+
+def test_preempted_sampled_request_resumes_key_stream(memorized_lm):
+    """A SAMPLED request preempted mid-decode must draw the same
+    tokens as under an ample page budget: its per-slot PRNG key is
+    snapshotted at eviction and restored at resume, so the draw
+    stream depends only on its own seed and step count."""
+    m = memorized_lm
+
+    def run(num_pages):
+        eng = ServingEngine(m, num_slots=2, max_len=32, page_len=4,
+                            num_pages=num_pages, prefix_cache=False)
+        eng.submit(PATTERN[:5], 16)              # greedy page hog
+        srid = eng.submit(PATTERN[:4], 14, temperature=0.9,
+                          top_p=0.95, seed=7)
+        out = eng.run(max_steps=3000)
+        return out[srid], eng.metrics.requests_preempted
+
+    ample, p_ample = run(num_pages=16)
+    tight, p_tight = run(num_pages=8)
+    assert p_ample == 0 and p_tight >= 1
+    np.testing.assert_array_equal(ample, tight)
+
+
+def test_priority_scheduler_order_and_preempt():
+    sched = PriorityScheduler(2)
+    reqs = [_req(0, priority=2), _req(1, priority=0),
+            _req(2, priority=1)]
+    for r in reqs:
+        sched.submit(r)
+    assert sched.peek() is reqs[1]               # class before arrival
+    admitted = sched.admit()
+    assert [r.rid for r in admitted] == [1, 2]
+    sched.to_decoding(reqs[1])
+    sched.preempt(reqs[1])
+    assert reqs[1].state is RequestState.QUEUED
+    assert reqs[1].slot is None and reqs[1].n_preempted == 1
+    # preempted requests resume ahead of their class peers
+    sched.submit(_req(3, priority=0))
+    assert sched.peek() is reqs[1]
+    # PREFILLING requests are preemptable too (they hold budget pages)
+    sched.preempt(reqs[2])
+    assert reqs[2].state is RequestState.QUEUED and reqs[2].slot is None
+    with pytest.raises(RuntimeError, match="preempt"):
+        sched.preempt(reqs[0])                   # QUEUED: holds nothing
+
+
+def test_engine_priority_admission_preempts_lower_class(memorized_lm):
+    """A priority-0 arrival that cannot fit the page budget preempts a
+    decoding batch-class stream; both finish token-identically."""
+    m = memorized_lm
+    eng = ServingEngine(m, num_slots=2, max_len=32, page_len=4,
+                        num_pages=4, prefix_cache=False)
+    low = eng.submit(PATTERN[:9], 6, priority=2)   # 3 admission pages
+    while not eng.scheduler.running:
+        eng.step()
+    high = eng.submit(PATTERN[:6], 4, priority=0)  # needs 2, 1 free
+    out = eng.run(max_steps=2000)
+    assert eng.metrics.requests_preempted >= 1
+    np.testing.assert_array_equal(
+        out[low], generate(m, PATTERN[None, :9], 6, temperature=0.0)[0])
+    np.testing.assert_array_equal(
+        out[high], generate(m, PATTERN[None, :6], 4, temperature=0.0)[0])
+
+
+def test_paged_pool_refcounts_and_partial_insert():
+    """PagedKVPool unit contract: alloc/incref/decref accounting,
+    release returns pages, and insert touches ONLY the pages the
+    prompt fills (the slab pool's full-row admit write, fixed)."""
+    m = Model.build(
+        zoo.transformer_lm(V, d_model=16, num_heads=2, num_layers=2,
+                           mlp_ratio=2, use_rope=True), (S,), seed=1)
+    _resolve_head_dims(m.module, m.params)
+    pool = PagedKVPool(m.module, num_slots=2, max_len=12, page_len=4)
+    assert pool.num_pages == 6 and pool.free_pages == 6
+    pool.cache = jax.tree_util.tree_map(
+        lambda x: jnp.full_like(x, 9.0), pool.cache)
+    staging = jax.tree_util.tree_map(
+        lambda x: jnp.full_like(x, 7.0), pool.make_request_cache())
+    p0, p1 = pool.alloc_page(), pool.alloc_page()
+    pool.assign(0, 0, p0)
+    pool.assign(0, 1, p1)
+    assert pool.free_pages == 4
+    # 6 positions -> exactly 2 pages written; the other 4 untouched
+    pool.insert_pages(staging, 0, skip_pages=0, n_pos=6)
+    for layer in pool.cache:
+        if layer is None:
+            continue
+        arr = np.asarray(layer["k"])
+        for pid in range(pool.num_pages):
+            want = 7.0 if pid in (p0, p1) else 9.0
+            assert (arr[pid] == want).all(), pid
+    # sharing: second holder keeps the page alive past one release
+    pool.incref(p0)
+    assert pool.shared_pages == 1
+    assert pool.release_slot(0) == 2
+    assert pool.free_pages == 5                  # p1 freed, p0 held
+    pool.decref(p0)
+    assert pool.free_pages == 6
+    with pytest.raises(RuntimeError, match="refcount"):
+        pool.decref(p1)
+
+
+def test_slab_insert_writes_only_prompt_positions():
+    """Satellite fix on the legacy pool: admit writes the prompt's
+    rows, not all max_len positions."""
+    m = Model.build(
+        zoo.transformer_lm(V, d_model=16, num_heads=2, num_layers=2,
+                           mlp_ratio=2, use_rope=True), (S,), seed=1)
+    _resolve_head_dims(m.module, m.params)
+    pool = KVPool(m.module, num_slots=3, max_len=10)
+    pool.cache = jax.tree_util.tree_map(
+        lambda x: jnp.full_like(x, 9.0), pool.cache)
+    req = jax.tree_util.tree_map(
+        lambda x: jnp.full_like(x, 7.0), pool.make_request_cache())
+    pool.insert(req, 1, n_pos=3)
+    for layer in pool.cache:
+        if layer is None:
+            continue
+        arr = np.asarray(layer["k"])
+        assert (arr[1][:, :3] == 7.0).all()
+        assert (arr[1][:, 3:] == 9.0).all()      # tail untouched
+        assert (arr[0] == 9.0).all() and (arr[2] == 9.0).all()
+    with pytest.raises(ValueError, match="n_pos"):
+        pool.insert(req, 1, n_pos=11)
+
+
+def test_page_metrics_summary_and_health(memorized_lm):
+    """Satellite: page-accounting gauges + prefix hit counters land in
+    summary() and health(); the slab engine honestly reports None."""
+    m = memorized_lm
+    eng = ServingEngine(m, num_slots=2, max_len=32, page_len=4)
+    eng.submit(np.tile(PATTERN, 2)[:9], 5)
+    eng.submit(np.tile(PATTERN, 2)[:9], 5)
+    eng.run(max_steps=500)
+    s = eng.metrics.summary()
+    assert s["pages"] is not None
+    assert s["pages"]["free"] == eng.pool.free_pages
+    assert 0.0 <= s["pages"]["fragmentation"] <= 1.0
+    assert s["prefix_cache"]["lookups"] == 2
+    h = eng.health()
+    assert h["pages"]["total"] == eng.pool.num_pages
+    assert h["pages"]["page_len"] == 4
+    assert h["prefix_cache"]["nodes"] == len(eng.prefix)
+    assert h["requests"]["preempted"] == 0
+    slab = ServingEngine(m, num_slots=1, max_len=16, kv_layout="slab")
+    slab.submit(PATTERN[:4], 3)
+    slab.run(max_steps=200)
+    assert slab.metrics.summary()["pages"] is None
+    assert "pages" not in slab.health()
+
+
+def test_preemption_lands_in_flight_recorder(memorized_lm):
+    """Satellite: iteration records carry the free-page count and
+    preemptions write their own record — admission stalls are
+    explainable post-mortem."""
+    from distkeras_tpu.obs.recorder import get_recorder, reset_recorder
+    m = memorized_lm
+    reset_recorder()
+    try:
+        eng = ServingEngine(m, num_slots=2, max_len=32, page_len=4,
+                            num_pages=8, prefix_cache=False)
+        eng.submit(PATTERN[:5], 16)
+        eng.submit(PATTERN[:6], 15)
+        eng.run(max_steps=2000)
+        assert eng.metrics.requests_preempted >= 1
+        recs = get_recorder().records()
+        iters = [r for r in recs if r["kind"] == "serving.iteration"]
+        assert iters and all("pages_free" in r for r in iters)
+        pre = [r for r in recs if r["kind"] == "serving.preempted"]
+        assert pre and {"rid", "slot", "pages_freed",
+                        "pages_free"} <= set(pre[0])
+    finally:
+        reset_recorder()
+
+
+def test_prefilling_hog_is_preemptable_not_deadlock(memorized_lm):
+    """Review fix: pages held by a MID-PREFILL request are page-budget
+    holders too — a decoding stream that outgrows the pool preempts
+    the prefilling hog instead of crashing the serve loop with 'page
+    pool exhausted'."""
+    m = memorized_lm
+    eng = ServingEngine(m, num_slots=2, max_len=24, page_len=4,
+                        num_pages=6, prefill_chunk=2,
+                        prefix_cache=False)
+    a = eng.submit(PATTERN[:4], 20)              # worst 6 pages == pool
+    while not eng.scheduler.running:
+        eng.step()
+    b = eng.submit(np.tile(PATTERN, 2)[:13], 4)  # 4 admission pages
+    out = eng.run(max_steps=3000)
+    assert eng.metrics.requests_preempted >= 1
+    np.testing.assert_array_equal(
+        out[a], generate(m, PATTERN[None, :4], 20, temperature=0.0)[0])
+    np.testing.assert_array_equal(
+        out[b], generate(m, np.tile(PATTERN, 2)[None, :13], 4,
+                         temperature=0.0)[0])
+
+
+def test_growth_preemption_never_evicts_higher_priority(memorized_lm):
+    """Review fix: when a LOW-priority stream outgrows the pool and
+    the only other stream is higher-priority, the low stream preempts
+    ITSELF — growing it at the interactive stream's expense would
+    invert the promised priority."""
+    m = memorized_lm
+    eng = ServingEngine(m, num_slots=2, max_len=20, page_len=4,
+                        num_pages=7, prefix_cache=False)
+    hi = eng.submit(PATTERN[:5], 10, priority=0)
+    lo = eng.submit(PATTERN[:5], 10, priority=2)
+    done = {}
+    steps = 0
+    while eng.scheduler.pending:
+        for r in eng.step():
+            done[r.rid] = r
+        steps += 1
+        assert steps < 3000
+    assert done[hi].n_preempted == 0
+    assert done[lo].n_preempted >= 1
+    ref = generate(m, PATTERN[None, :5], 10, temperature=0.0)
+    np.testing.assert_array_equal(done[hi].tokens, ref[0])
+    np.testing.assert_array_equal(done[lo].tokens, ref[0])
+
+
+def test_unfundable_admission_preserves_prefix_cache(memorized_lm):
+    """Review fix: an admission whose page deficit exceeds free +
+    evictable must NOT drain the prefix cache on the way to failing —
+    later same-template requests would lose all sharing for nothing."""
+    m = memorized_lm
+    eng = ServingEngine(m, num_slots=2, max_len=24, page_len=4,
+                        num_pages=6)
+    big_prompt = np.tile(PATTERN, 2)[:17]
+    r0 = eng.submit(np.tile(PATTERN, 2)[:9], 3)  # registers 2 pages
+    out0 = eng.run(max_steps=300)
+    assert len(eng.prefix) == 2
+    hog = eng.submit(PATTERN[:4], 19)            # decoding page hog
+    while not eng.scheduler.running:
+        eng.step()
+    big = eng.submit(big_prompt, 5)              # needs 5 private now
+    eng.step()
+    # unfundable (free 2 + evictable 2 < 5): cache must survive
+    assert len(eng.prefix) == 2
+    assert eng[big].state is RequestState.QUEUED
+    out = eng.run(max_steps=3000)
+    np.testing.assert_array_equal(
+        out[big], generate(m, big_prompt[None], 5, temperature=0.0)[0])
+    np.testing.assert_array_equal(
+        out[hog], generate(m, PATTERN[None, :4], 19, temperature=0.0)[0])
+
+
+def test_paged_submit_rejects_impossible_request(memorized_lm):
+    """A request whose worst case exceeds the whole pool can never
+    finish — refused at submit, not deadlocked at runtime."""
+    eng = ServingEngine(memorized_lm, num_slots=2, max_len=32,
+                        page_len=4, num_pages=4)
+    with pytest.raises(ValueError, match="pages"):
+        eng.submit(PATTERN[:8], 12)              # 5 pages > 4
+    with pytest.raises(ValueError, match="num_pages"):
+        PagedKVPool(memorized_lm.module, num_slots=1, max_len=32,
+                    page_len=4, num_pages=0)
 
 
 def test_engine_records_serving_metrics(memorized_lm):
