@@ -601,13 +601,20 @@ def bench_serving(num_slots: int, prompt_len: int, new_tokens: int,
          availability()],
         clock=eng.metrics.clock)
 
+    # ONE probe engine reused across every pass (bench hygiene, spec-
+    # decode PR): a fresh probe per pass re-paid the prefill + decode
+    # compiles inside the measurement section on every trace variant
+    probe_box = []
+
     def raw_loop_rate(steps):
         """The same compiled per-slot decode step at full batch, driven
         with the engine's per-iteration host sync but zero scheduling —
         what iteration-level batching would cost with no scheduler."""
-        probe = ServingEngine(model, num_slots=num_slots,
-                              max_len=max_len,
-                              prefill_chunk=prefill_chunk)
+        if not probe_box:
+            probe_box.append(ServingEngine(model, num_slots=num_slots,
+                                           max_len=max_len,
+                                           prefill_chunk=prefill_chunk))
+        probe = probe_box[0]
         # maximal budgets: no probe request can finish during the
         # serialized prefill ramp, so full occupancy is reachable (and
         # the loop below cannot spin on a drained scheduler)
@@ -646,7 +653,13 @@ def bench_serving(num_slots: int, prompt_len: int, new_tokens: int,
                             *extra)
             tok = np.asarray(nxt)
             t = t + 1
-        return num_slots * steps / (time.perf_counter() - t0)
+        rate = num_slots * steps / (time.perf_counter() - t0)
+        # recycle the probe for the next pass: the manual loop above
+        # never advanced the scheduler, so every request is still
+        # admitted — cancel them all to free the slots/pages
+        for rid in list(probe._requests):
+            probe.cancel(rid)
+        return rate
 
     full_rates, raw_rates, summaries, slo_statuses = [], [], [], []
     for i in range(n_passes):
@@ -800,14 +813,18 @@ def bench_paged_vs_slab(slab_slots: int, prompt_len: int,
         return n_requests / makespan, eng.metrics
 
     out = {}
+    # ONE warmed engine pair reused across BOTH workloads (bench
+    # hygiene, spec-decode PR): rebuilding per trace variant re-paid
+    # every prefill/insert/decode compile on the second workload
+    engines = {"paged": build("paged"), "slab": build("slab")}
     for kind in ("prefix_heavy", "prefix_free"):
         prompts = make_prompts(kind)
-        engines = {"paged": build("paged"), "slab": build("slab")}
         # warm both OUTSIDE the timed passes with two representative
         # requests: the second one exercises the prefix-hit path on
         # the paged engine (registered pages from the first), so the
         # ragged-resume prefill and page-load programs compile here,
-        # not inside a timed drive
+        # not inside a timed drive (a formality after the first
+        # workload — the programs are already live)
         for eng in engines.values():
             for p in prompts[:2]:
                 eng.submit(p, new_tokens)
@@ -839,6 +856,137 @@ def bench_paged_vs_slab(slab_slots: int, prompt_len: int,
                 None if not hit_rates or hit_rates[-1] is None
                 else round(hit_rates[-1], 3)),
             "preemptions": int(sum(preemptions)),
+        }
+        # drain the prefix cache between workloads (all requests have
+        # finished, so every registered page is cache-only and
+        # evictable): the next kind starts with a clean page budget
+        # instead of the previous kind's resident template pages
+        if engines["paged"].prefix is not None:
+            engines["paged"].prefix.reclaim(
+                engines["paged"].pool.num_pages)
+    return out
+
+
+def bench_spec_decode(num_slots: int, prompt_len: int, new_tokens: int,
+                      n_passes: int, spec_k: int, prefill_chunk=None,
+                      motif_len: int = 16):
+    """Speculative decoding in the serving engine (spec-decode PR):
+    marginal decode tokens/s with n-gram self-drafting ON vs OFF, on
+    the ``--model lm`` config at full occupancy (closed-loop: all
+    ``num_slots`` requests submitted up front, drained to completion —
+    the steady-state decode-rate measurement, no arrival noise).
+
+    The acceptance-rate SWEEP is driven by trace construction:
+
+      * ``repetitive`` — each prompt tiles a short random motif (every
+        request its own motif, so prefix sharing never blurs the
+        decode comparison). Prompt-lookup drafting's home turf: the
+        model's continuation of a periodic context re-occurs in the
+        context, so drafts accept at high rate — the regime where one
+        verify pass emits several tokens.
+      * ``random`` — i.i.d. prompts; whatever the model's continuation
+        is, the n-gram drafter mostly cannot predict it, and the
+        per-request acceptance EMA demotes streams to plain decode —
+        the adversarial end of the sweep (the recorded rate shows what
+        speculation costs when it does NOT work).
+
+    ONE engine serves every variant (spec on/off x trace kind x pass):
+    the decode, verify and prefill programs compile once in the warm-up
+    block and are reused throughout — no variant pays a recompile
+    inside its timed drive (bench hygiene, this PR).
+
+    Returns ``{kind: {spec_tok_s, plain_tok_s, ratio, acceptance_rate,
+    accept_rate_percentiles, spec_passes, plain_passes,
+    disabled_streams}}``."""
+    from distkeras_tpu.models import Model, zoo
+    from distkeras_tpu.serving import (NgramDraft, ServingEngine,
+                                       ServingMetrics)
+    from distkeras_tpu.utils.profiling import percentiles
+
+    cfg = LM_CFG
+    model = Model.build(zoo.transformer_lm(
+        cfg["vocab"], d_model=cfg["d_model"], num_heads=cfg["num_heads"],
+        num_layers=cfg["num_layers"], mlp_ratio=cfg["mlp_ratio"],
+        use_rope=True, dtype="bfloat16"), (cfg["seq"],), seed=0)
+    max_len = prompt_len + new_tokens
+    eng = ServingEngine(model, num_slots=num_slots, max_len=max_len,
+                        prefill_chunk=prefill_chunk,
+                        draft=NgramDraft(), spec_k=spec_k)
+    rs = np.random.RandomState(0)
+
+    def prompts_for(kind):
+        out = []
+        for _ in range(num_slots):
+            if kind == "repetitive":
+                motif = rs.randint(0, cfg["vocab"], (motif_len,))
+                p = np.tile(motif,
+                            -(-prompt_len // motif_len))[:prompt_len]
+            else:
+                p = rs.randint(0, cfg["vocab"], (prompt_len,))
+            out.append(p.astype(np.int32))
+        return out
+
+    # warm-up: compile prefill + verify (spec) + plain decode programs
+    warm = prompts_for("repetitive")[0]
+    eng.submit(warm, new_tokens, speculate=True)
+    eng.run(max_steps=100_000)
+    eng.submit(warm, new_tokens, speculate=False)
+    eng.run(max_steps=100_000)
+
+    def drive(prompts, speculate):
+        eng.metrics = ServingMetrics()
+        for p in prompts:
+            eng.submit(p, new_tokens, speculate=speculate)
+        eng.run(max_steps=200_000)
+        m = eng.metrics
+        rate = m.decode_tokens_per_sec(min_occupancy=num_slots)
+        if rate is None:
+            rate = m.decode_tokens_per_sec()
+        return rate, m
+
+    out = {}
+    for kind in ("repetitive", "random"):
+        spec_rates, plain_rates, accepts = [], [], []
+        rate_samples, disabled = [], 0
+        for i in range(n_passes):
+            prompts = prompts_for(kind)
+            r_spec, m_spec = drive(prompts, True)
+            r_plain, _ = drive(prompts, False)
+            spec_rates.append(r_spec)
+            plain_rates.append(r_plain)
+            accepts.append(m_spec.acceptance_rate)
+            disabled += int(m_spec.summary()["speculation"]
+                            ["disabled_streams"])
+            # pooled across passes so the percentiles describe the same
+            # data the median headline does, not just the last pass
+            rate_samples.extend(m_spec.spec_accept_rates())
+            print(f"spec_decode {kind} pass {i}: "
+                  f"{r_spec:.1f} tok/s spec vs {r_plain:.1f} plain "
+                  f"({r_spec / r_plain:.2f}x), acceptance "
+                  f"{accepts[-1] if accepts[-1] is not None else 0:.2f}",
+                  file=sys.stderr, flush=True)
+        # per-slot per-iteration acceptance percentiles — the
+        # distribution behind the mean (a bimodal mix of accepting and
+        # rejecting streams reads very differently from a uniform
+        # middling rate)
+        rate_pcts = (percentiles(rate_samples, (10, 50, 90, 99))
+                     if rate_samples else None)
+        spec_med = statistics.median(spec_rates)
+        plain_med = statistics.median(plain_rates)
+        out[kind] = {
+            "spec_tok_s": round(spec_med, 1),
+            "plain_tok_s": round(plain_med, 1),
+            "ratio": round(spec_med / plain_med, 3),
+            "acceptance_rate": (
+                None if accepts[-1] is None
+                else round(statistics.median(
+                    a for a in accepts if a is not None), 3)),
+            "accept_rate_percentiles": (
+                None if rate_pcts is None
+                else {k: round(v, 3) for k, v in rate_pcts.items()}),
+            "spec_passes": [round(r, 1) for r in spec_rates],
+            "plain_passes": [round(r, 1) for r in plain_rates],
+            "disabled_streams": disabled,
         }
     return out
 
@@ -1257,11 +1405,13 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", choices=["all", "resnet50", "lm", "lm_big",
                                         "generate", "generate_long",
-                                        "serving", "moe", "overlap"],
+                                        "serving", "spec_decode", "moe",
+                                        "overlap"],
                     default="all",
                     help="'all' (default) runs resnet50 + lm + generate + "
                     "generate_long (P=2048/8192 serving grid) + serving "
-                    "(continuous-batching engine, open-loop trace) + moe "
+                    "(continuous-batching engine, open-loop trace) + "
+                    "spec_decode (speculative decoding on/off) + moe "
                     "+ lm_big, one JSON line each (ResNet headline "
                     "first, cumulative summary line last)")
     ap.add_argument("--profile", default=None,
@@ -1300,7 +1450,8 @@ def main():
         base_profile = args.profile
         records = []
         for mode in ("resnet50", "lm", "overlap", "generate",
-                     "generate_long", "serving", "moe", "lm_big"):
+                     "generate_long", "serving", "spec_decode", "moe",
+                     "lm_big"):
             if base_profile:
                 args.profile = f"{base_profile.rstrip('/')}/{mode}"
             try:
@@ -1647,6 +1798,52 @@ def _run_mode(mode, args, on_accel, peak, device_kind):
                     "tokens/s over full-occupancy iterations; "
                     "vs_baseline = value / raw slot-batched decode "
                     "loop (same compiled step, no scheduler)",
+            "device_kind": device_kind,
+        }
+        return _emit(rec)
+
+    if mode == "spec_decode":
+        if on_accel:
+            # the deep-prompt regime ROADMAP item 3 names: marginal
+            # decode tok/s at p8192, where the cache read dominates and
+            # amortizing the weight read over k+1 tokens pays most
+            num_slots, prompt_len, new_tokens = 4, 8192, 128
+            n_passes, spec_k, chunk = 3, 4, 1024
+        else:
+            num_slots, prompt_len, new_tokens = 2, 24, 16
+            n_passes, spec_k, chunk = 1, 3, None
+        out = bench_spec_decode(num_slots, prompt_len, new_tokens,
+                                n_passes, spec_k, prefill_chunk=chunk)
+        rep, rnd = out["repetitive"], out["random"]
+        rec = {
+            "metric": "serving_spec_decode_tokens_per_sec_per_chip",
+            "value": rep["spec_tok_s"],
+            "unit": "tokens/sec",
+            # the acceptance ratio: speculative vs plain marginal
+            # decode rate on the high-acceptance trace, SAME warmed
+            # engine back to back (>= 1.3 documented target on
+            # accelerators; >= 1.0 CPU-smoke criterion; the below-
+            # anchor tripwire flags < 0.9)
+            "vs_baseline": rep["ratio"],
+            "repetitive": rep,
+            "random": rnd,
+            "spec_k": spec_k,
+            "draft_source": "ngram (prompt lookup, max_ngram=3)",
+            "num_slots": num_slots,
+            "prompt_len": prompt_len,
+            "new_tokens": new_tokens,
+            "prefill_chunk": chunk,
+            "criterion": ">= 1.3x marginal decode tok/s vs plain "
+                         "decode on the high-acceptance trace on "
+                         "accelerators (>= 1.0x CPU smoke); the "
+                         "random trace documents the cost when "
+                         "drafting fails (EMA demotes streams)",
+            "note": "closed-loop full-occupancy drives; value = spec-on "
+                    "decode tokens/s over full-occupancy iterations on "
+                    "the repetitive trace; vs_baseline = value / "
+                    "spec-off rate of the same engine; "
+                    "accept_rate_percentiles = per-slot per-iteration "
+                    "draft acceptance distribution",
             "device_kind": device_kind,
         }
         return _emit(rec)

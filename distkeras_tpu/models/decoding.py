@@ -633,23 +633,34 @@ def _slot_attn_readout(attn: MultiHeadAttention, p, q, kv, t, dt):
     a logically contiguous ``[S, H, L, D]`` kv view — a slab pool or a
     page gather in logical-position order — plus the output projection.
     Shared by the slab and paged decode paths so the two are bitwise
-    identical wherever the view holds identical values."""
+    identical wherever the view holds identical values.
+
+    ``q`` is ``[S, W, H, D]`` for a W-position window at per-slot
+    positions ``t .. t+W-1`` (the speculative-verify step; W = 1 is the
+    plain decode step): window query ``j`` of slot ``s`` attends cache
+    positions ``<= t[s] + j`` — causal WITHIN the window too, so the
+    drafts just written at ``t+1 .. t+j`` are visible to later window
+    positions while rejected-tail garbage stays masked for every query
+    that must not see it."""
     scale = (attn.head_dim or q.shape[-1]) ** -0.5
     b = q.shape[0]
+    w_len = q.shape[1]
     hkv = attn.kv_heads
     g = attn.num_heads // hkv
     dh = q.shape[-1]
     L = kv["k"].shape[2]
     qg = (q.astype(jnp.float32) * scale).reshape(
-        b, 1, hkv, g, dh)                                # [S, 1, Hkv, G, D]
-    s = _decode_scores(qg, kv)                           # [S, Hkv, G, 1, L]
-    valid = jnp.arange(L)[None, :] <= t[:, None]         # [S, L]
+        b, w_len, hkv, g, dh)                        # [S, W, Hkv, G, D]
+    s = _decode_scores(qg, kv)                       # [S, Hkv, G, W, L]
+    pos = t[:, None] + jnp.arange(w_len)             # [S, W]
+    valid = jnp.arange(L)[None, None, :] <= pos[:, :, None]   # [S, W, L]
     if attn.attn_window is not None:
-        valid &= jnp.arange(L)[None, :] > (t - attn.attn_window)[:, None]
-    s = jnp.where(valid[:, None, None, None, :], s, NEG_INF)
+        valid &= jnp.arange(L)[None, None, :] \
+            > (pos - attn.attn_window)[:, :, None]
+    s = jnp.where(valid[:, None, None, :, :], s, NEG_INF)
     w = jax.nn.softmax(s, axis=-1)
-    out = _decode_mix(w, kv).astype(dt)
-    out = out.reshape(b, 1, attn.num_heads, dh)
+    out = _decode_mix(w, kv).astype(dt)              # [S, W, Hkv, G, D]
+    out = out.reshape(b, w_len, attn.num_heads, dh)
     return jnp.einsum("bshe,hed->bsd", out, p["wo"].astype(dt))
 
 
@@ -815,6 +826,106 @@ def decode_step_slots_paged(module: Sequential, params, state, cache,
         else:
             x, _ = layer.apply(p, s, x, training=False)
     return x[:, 0], new_cache                            # [S, V]
+
+
+# --- batched speculative verify (serving engine, spec-decode PR) ------------
+#
+# Speculative decoding amortizes ONE target forward over k candidate
+# tokens: the engine proposes drafts d_1..d_k per slot (n-gram lookup or
+# a small draft model), then the verify step runs the [S, W = k+1]
+# window [tok, d_1, .., d_k] through the stack at per-slot positions
+# t..t+k in one program. logits[:, j] is the target's next-token
+# distribution AFTER consuming window token j, so the longest prefix of
+# drafts matching the target's own choices is accepted and the
+# (m+1)-th candidate comes free — between 1 and k+1 tokens per target
+# pass. Cache contract: every window position's K/V is written (slab
+# one-hot / page-table scatter, same sentinels as the 1-token steps);
+# positions past the accepted count hold rejected-draft garbage, which
+# is EXACTLY the slab stale-tail situation — masked (`<= t + j`) until
+# the stream's own later writes overwrite them, position by position,
+# before the mask ever admits them. No explicit rollback needed; an
+# unallocated page simply drops the write (the engine only lets a slot
+# CONSUME candidates whose supporting positions have allocated pages).
+
+
+def _decode_block_slots_window(block: TransformerBlock, p, s, kv, x, t,
+                               table=None, page_len: int = 0):
+    """One TransformerBlock over a [S, W, d] window at per-slot
+    positions ``t .. t+W-1``: project the window's q/k/v, write ALL W
+    positions into the cache (slab one-hot writes, or page-table
+    scatters when ``table`` is given), then run the shared windowed
+    readout."""
+    attn = block.attn
+    h, _ = block.norm1.apply(p["norm1"], s["norm1"], x)
+    dt = jnp.dtype(attn.dtype)
+    xc = h.astype(dt)
+    q, k, v = _project_qkv(attn, p["attn"], xc)          # [S, W, H, D]
+    w_len = q.shape[1]
+    if attn.use_rope:
+        pos = t[:, None] + jnp.arange(w_len)             # [S, W]
+        q = apply_rope(q, pos, scale=attn.rope_scale)
+        k = apply_rope(k, pos, scale=attn.rope_scale)
+    for j in range(w_len):
+        if table is None:
+            kv = _cache_write_slots(kv, k[:, j:j + 1], v[:, j:j + 1],
+                                    t + j)
+        else:
+            kv = _cache_write_pages(kv, k[:, j:j + 1], v[:, j:j + 1],
+                                    t + j, table, page_len)
+    view = kv if table is None else _gather_pages(kv, table)
+    y = _slot_attn_readout(attn, p["attn"], q, view, t, dt)
+    x = x + y.astype(x.dtype)
+    h, _ = block.norm2.apply(p["norm2"], s["norm2"], x)
+    m, _ = block.mlp.apply(p["mlp"], s["mlp"], h, training=False)
+    return x + m, kv
+
+
+def _verify_window(module: Sequential, params, state, cache, toks, t,
+                   table, page_len: int):
+    """Shared body of the verify steps: [S, W] window tokens through the
+    whole stack at per-slot positions; returns ([S, W, V] logits,
+    cache)."""
+    x = toks                                             # [S, W] int
+    w_len = toks.shape[1]
+    new_cache = list(cache)
+    for i, layer in enumerate(module.layers):
+        p, s, kv = params[i], state[i], cache[i]
+        block = _decode_block_of(layer)
+        if block is not None:
+            x, new_cache[i] = _decode_block_slots_window(
+                block, p, s, kv, x, t, table, page_len)
+        elif isinstance(layer, PositionalEmbedding):
+            pos = t[:, None] + jnp.arange(w_len)         # [S, W]
+            x = x + p["embeddings"][pos].astype(x.dtype)
+        elif isinstance(layer, Dropout):
+            pass                                         # eval: identity
+        else:
+            x, _ = layer.apply(p, s, x, training=False)
+    return x, new_cache                                  # [S, W, V]
+
+
+def verify_step_slots(module: Sequential, params, state, cache, toks, t):
+    """Batched speculative VERIFY against the slab pool: toks [S, W]
+    int (window token 0 is the slot's pending decode input, tokens
+    1..W-1 its drafts), t [S] int per-slot window start positions;
+    returns ([S, W, V] logits, cache). ``logits[:, j]`` is the target
+    distribution over the token FOLLOWING window position j — the
+    greedy accept rule is ``argmax(logits[:, j-1]) == toks[:, j]``.
+    Sentinel slots (t out of range) write nothing and produce garbage
+    logits, exactly like ``decode_step_slots``."""
+    return _verify_window(module, params, state, cache, toks, t,
+                          None, 0)
+
+
+def verify_step_slots_paged(module: Sequential, params, state, cache,
+                            toks, t, table, page_len: int):
+    """The paged mirror of :func:`verify_step_slots`: window writes
+    scatter through the [S, P] page tables (unallocated logical pages
+    drop their writes — the engine pre-allocates pages for every
+    position a slot may CONSUME, so dropped writes only ever land on
+    the rejected tail)."""
+    return _verify_window(module, params, state, cache, toks, t,
+                          table, page_len)
 
 
 def _sample(logits, temperature, top_k, rng, top_p=None):

@@ -23,6 +23,9 @@ timestamp on the engine clock):
 * ``decode`` — AGGREGATED: one event per ``decode_agg`` engine
   iterations (not per token — the hot loop stays cheap), plus a final
   flush at terminal;
+* ``spec_verify`` — AGGREGATED like ``decode`` (flushed on the same
+  cadence): draft tokens proposed vs accepted for this request's
+  speculative verify steps since the last flush;
 * ``preempted`` / ``resumed`` — the paged engine evicted the
   request's pages back to the queue under budget pressure / brought
   it back after the recompute prefill (tokens generated so far
@@ -84,7 +87,9 @@ class RequestTimeline:
                  "state", "slot", "queue_depth_at_submit",
                  "queue_depth_at_admit", "prefill_chunks", "decode_iters",
                  "n_tokens", "events", "dropped_events", "_agg_count",
-                 "_agg_t0", "n_preempted", "prefix_hit_tokens")
+                 "_agg_t0", "n_preempted", "prefix_hit_tokens",
+                 "spec_proposed", "spec_accepted", "_spec_agg_proposed",
+                 "_spec_agg_accepted")
 
     def __init__(self, rid: int):
         self.rid = rid
@@ -105,6 +110,10 @@ class RequestTimeline:
         self._agg_t0: Optional[float] = None
         self.n_preempted = 0         # page-budget evictions survived
         self.prefix_hit_tokens = 0   # context tokens off shared pages
+        self.spec_proposed = 0       # draft tokens offered to verify
+        self.spec_accepted = 0       # drafts the target accepted
+        self._spec_agg_proposed = 0  # since last spec_verify flush
+        self._spec_agg_accepted = 0
 
     def add_event(self, name: str, t: float, max_events: int,
                   **fields) -> None:
@@ -117,12 +126,19 @@ class RequestTimeline:
         self.events.append(ev)
 
     def flush_decode(self, t: float, max_events: int) -> None:
-        """Close the open aggregated-decode window (if any)."""
+        """Close the open aggregated-decode window (if any), and the
+        speculative-verify aggregation riding on the same cadence."""
         if self._agg_count:
             self.add_event("decode", t, max_events,
                            iters=self._agg_count, t0=self._agg_t0)
             self._agg_count = 0
             self._agg_t0 = None
+        if self._spec_agg_proposed:
+            self.add_event("spec_verify", t, max_events,
+                           proposed=self._spec_agg_proposed,
+                           accepted=self._spec_agg_accepted)
+            self._spec_agg_proposed = 0
+            self._spec_agg_accepted = 0
 
     def durations(self) -> Dict[str, float]:
         """Per-phase durations. By construction the emitted phases
@@ -170,6 +186,9 @@ class RequestTimeline:
             out["n_preempted"] = self.n_preempted
         if self.prefix_hit_tokens:
             out["prefix_hit_tokens"] = self.prefix_hit_tokens
+        if self.spec_proposed:
+            out["spec_proposed"] = self.spec_proposed
+            out["spec_accepted"] = self.spec_accepted
         if self.dropped_events:
             out["dropped_events"] = self.dropped_events
         return out
@@ -196,6 +215,9 @@ class _NullTracer:
         pass
 
     def on_decode(self, rids):
+        pass
+
+    def on_spec_verify(self, items):
         pass
 
     def on_preempt(self, rid, n_generated=0):
@@ -347,6 +369,21 @@ class RequestTracer:
                 tl._agg_count += 1
                 if tl._agg_count >= self.decode_agg:
                     tl.flush_decode(t, self.max_events)
+
+    def on_spec_verify(self, items) -> None:
+        """One speculative verify step's per-request outcomes:
+        ``items`` is an iterable of ``(rid, proposed, accepted)``.
+        Aggregated onto the decode-event cadence (flushed together), so
+        speculation adds no per-iteration event volume."""
+        with self._lock:
+            for rid, proposed, accepted in items:
+                tl = self._live.get(rid)
+                if tl is None:
+                    continue
+                tl.spec_proposed += int(proposed)
+                tl.spec_accepted += int(accepted)
+                tl._spec_agg_proposed += int(proposed)
+                tl._spec_agg_accepted += int(accepted)
 
     def on_terminal(self, rid: int, state: str, n_tokens: int = 0) -> None:
         t = self.clock()

@@ -23,6 +23,12 @@ per-step decode kernels and an actual serving workload:
                    prefill interleaved between decode iterations with
                    shared prefixes skipped, page-budget admission and
                    preemption/resume, per-slot sampling state
+    speculation.py ``DraftSource`` draft proposers for speculative
+                   decoding — ``NgramDraft`` (prompt-lookup
+                   self-drafting, zero extra weights) and
+                   ``DraftModel`` (a small LM with its own paged KV) —
+                   verified k-at-a-time by one batched target pass
+                   (``models.decoding.verify_step_slots[_paged]``)
     metrics.py     TTFT, TPOT, request latency, queue depth, slot
                    occupancy, tokens/s, page-budget gauges and
                    prefix-cache hit rates — the numbers ``bench.py
@@ -44,3 +50,5 @@ from distkeras_tpu.serving.scheduler import (AdmissionRejected,  # noqa: F401
                                              FIFOScheduler,
                                              PriorityScheduler, Request,
                                              RequestState, TERMINAL_STATES)
+from distkeras_tpu.serving.speculation import (DraftModel,  # noqa: F401
+                                               DraftSource, NgramDraft)

@@ -73,10 +73,13 @@ from distkeras_tpu.models.decoding import (_attn_compute_dtype,
                                            _sample_vec, _serving_params,
                                            decode_step_slots,
                                            decode_step_slots_paged,
-                                           prefill, prefill_chunk_step)
+                                           prefill, prefill_chunk_step,
+                                           verify_step_slots,
+                                           verify_step_slots_paged)
 from distkeras_tpu.resilience import faults
 from distkeras_tpu.serving.kv_pool import (KVPool, PagedKVPool,
                                            PrefixCache)
+from distkeras_tpu.serving.speculation import DraftSource
 from distkeras_tpu.serving.metrics import ServingMetrics
 from distkeras_tpu.serving.scheduler import (AdmissionRejected,
                                              FIFOScheduler,
@@ -133,6 +136,22 @@ class ServingEngine:
       appears (the same hazard as novel prompt lengths,
       docs/serving.md follow-ups). Set to ``page_len`` to keep
       sharing page-granular and the program set bounded.
+
+    Speculative-decoding knobs (docs/serving.md §Speculative decoding):
+
+    * ``draft`` — a ``DraftSource`` (``NgramDraft()`` for zero-weight
+      prompt-lookup self-drafting, ``DraftModel(small_lm)`` for a
+      learned drafter). None (default) disables speculation.
+    * ``spec_k`` — drafts proposed per slot per iteration (STATIC: one
+      compiled ``[S, k+1]`` verify program per sampler variant). Each
+      verify emits 1..k+1 tokens per slot; the sweet spot tracks the
+      workload's acceptance rate (≈2-4 for mixed traffic, higher for
+      templated/repetitive streams).
+    * ``spec_disable_below`` / ``spec_warmup`` — per-request acceptance
+      EMA floor: after ``spec_warmup`` verifies, a stream whose EMA
+      acceptance is below the floor stops speculating (the verify
+      window costs a (k+1)-wide forward; on a never-accepting stream
+      that is pure overhead). Sticky per request.
     """
 
     def __init__(self, model: Model, *, num_slots: int = 4,
@@ -145,7 +164,10 @@ class ServingEngine:
                  kv_layout: str = "paged", page_len: int = 16,
                  num_pages: Optional[int] = None,
                  prefix_cache: bool = True,
-                 prefix_granularity: int = 1):
+                 prefix_granularity: int = 1,
+                 draft: Optional[DraftSource] = None, spec_k: int = 4,
+                 spec_disable_below: float = 0.1,
+                 spec_warmup: int = 8):
         module = model.module
         if not isinstance(module, Sequential):
             raise TypeError("ServingEngine expects a Sequential LM "
@@ -251,6 +273,31 @@ class ServingEngine:
         self._prefill_fns = {}
         self._first_fn = None
 
+        # speculative decoding (spec-decode PR): a DraftSource proposes
+        # k candidate tokens per slot; ONE compiled verify step scores
+        # the whole [S, k+1] window (fixed k — static shapes, one
+        # program per sampler variant). A per-request acceptance EMA
+        # (spec_disable_below / spec_warmup) kicks streams the draft
+        # cannot predict back to plain decode — speculation is an
+        # accelerator, never a correctness or admission dependency.
+        if draft is not None and not isinstance(draft, DraftSource):
+            raise TypeError(
+                f"draft must be a DraftSource (NgramDraft / DraftModel "
+                f"/ custom), got {type(draft).__name__}")
+        self._draft = draft
+        self.spec_k = int(spec_k)
+        if self.spec_k < 1:
+            raise ValueError(f"spec_k must be >= 1, got {spec_k}")
+        if not 0.0 <= float(spec_disable_below) <= 1.0:
+            raise ValueError(
+                f"spec_disable_below must be in [0, 1], "
+                f"got {spec_disable_below}")
+        self.spec_disable_below = float(spec_disable_below)
+        self.spec_warmup = int(spec_warmup)
+        self._spec_fns = {}                  # greedy_only -> jit verify
+        if draft is not None:
+            draft.bind(self)
+
         # telemetry: the CURRENT metrics window joins the unified
         # obs.telemetry_snapshot() under "serving" (weakref-bound, so a
         # dropped engine detaches itself); the decode steps — compiled
@@ -295,7 +342,8 @@ class ServingEngine:
                top_p: Optional[float] = None,
                stop_token: Optional[int] = None, seed: int = 0,
                deadline_s: Optional[float] = None,
-               priority: int = 1) -> int:
+               priority: int = 1,
+               speculate: Optional[bool] = None) -> int:
         """Enqueue one request; returns its id. Sampling defaults match
         ``generate()`` (greedy); ``None`` knobs mean disabled.
 
@@ -308,7 +356,15 @@ class ServingEngine:
         ``priority`` (paged engine): lower admits first — 0
         interactive, 1 standard (default), 2 batch. A queued priority-0
         request may PREEMPT lower-priority decoding streams when the
-        page budget is short; ignored by the slab engine's FCFS."""
+        page budget is short; ignored by the slab engine's FCFS.
+
+        ``speculate`` (engines built with ``draft=``): whether this
+        request joins draft-and-verify decode iterations. ``None``
+        (default) means yes whenever the engine has a draft source;
+        ``False`` opts out; ``True`` on a draftless engine raises.
+        Greedy speculative output is token-identical to plain decode
+        (and to ``generate()``); sampled streams keep their exact
+        per-request key stream either way."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size < 1:
             raise ValueError("prompt must hold at least one token")
@@ -335,6 +391,11 @@ class ServingEngine:
                     f"request needs up to {worst} pages but the pool "
                     f"holds {self.pool.num_pages}; raise num_pages or "
                     "lower max_new_tokens")
+        if speculate and self._draft is None:
+            raise ValueError(
+                "speculate=True needs an engine built with a draft "
+                "source (ServingEngine(draft=NgramDraft()) or "
+                "DraftModel(...))")
         req = Request(
             rid=next(self._rid), prompt=prompt,
             max_new_tokens=max_new_tokens,
@@ -343,7 +404,9 @@ class ServingEngine:
             top_p=1.0 if top_p is None else float(top_p),
             stop_token=-1 if stop_token is None else int(stop_token),
             seed=int(seed), priority=int(priority),
-            deadline_s=None if deadline_s is None else float(deadline_s))
+            deadline_s=None if deadline_s is None else float(deadline_s),
+            speculate=(self._draft is not None if speculate is None
+                       else bool(speculate)))
         req.rng = jax.random.PRNGKey(req.seed)
         req.submit_t = self.metrics.clock()
         try:
@@ -420,6 +483,126 @@ class ServingEngine:
                 "serving.decode_greedy" if greedy_only
                 else "serving.decode_sampled", fn)
         return fn
+
+    def _verify_fn(self, greedy_only: bool):
+        """Two compiled speculative-verify variants, mirroring
+        ``_decode_fn``'s greedy/sampled split. Each scores the whole
+        ``[S, k+1]`` window ``[tok, d_1 .. d_k]`` in one target
+        forward and computes acceptance IN-PROGRAM:
+
+        * greedy — candidates are per-position argmaxes; accepted
+          count = the longest prefix where the target's own choice
+          equals the draft (exact match, so the emitted stream is the
+          plain greedy stream by construction);
+        * sampled — one PRNG split per potentially emitted token, in
+          the exact order plain decode would split (one per emitted
+          token), with the slot's post-step key selected by the
+          accepted count. Sampling from the target and accepting while
+          it equals the (deterministic) draft IS exact rejection
+          sampling for a point-mass draft distribution — and, unlike
+          the general-q rule, keeps sampled streams byte-identical to
+          plain decode, not merely distribution-equivalent.
+
+        ``active`` force-rejects rows (accepted = 0), which makes a
+        verify step exactly a plain decode step for opted-out /
+        EMA-disabled slots — one program serves mixed batches."""
+        fn = self._spec_fns.get(greedy_only)
+        if fn is None:
+            module = self.module
+            paged = self.kv_layout == "paged"
+            page_len = self.page_len
+            k = self.spec_k
+
+            def vstep(params, state, cache, toks, t, tables):
+                if paged:
+                    return verify_step_slots_paged(
+                        module, params, state, cache, toks, t, tables,
+                        page_len)
+                return verify_step_slots(
+                    module, params, state, cache, toks, t)
+
+            def accept(cand, toks, active):
+                # longest prefix of drafts matching the target's own
+                # choices: cand[:, j] continues window position j, so
+                # draft toks[:, j+1] is accepted iff it equals cand[:, j]
+                match = (cand[:, :-1] == toks[:, 1:]).astype(jnp.int32)
+                n_acc = jnp.sum(jnp.cumprod(match, axis=1), axis=1)
+                return jnp.where(active, n_acc, 0)
+
+            if greedy_only:
+                @jax.jit
+                def fn(params, state, cache, toks, t, active,
+                       tables=None):
+                    logits, cache = vstep(params, state, cache, toks, t,
+                                          tables)
+                    cand = jnp.argmax(logits, axis=-1)     # [S, k+1]
+                    return cand, accept(cand, toks, active), cache
+            else:
+                @jax.jit
+                def fn(params, state, cache, toks, t, active, temp,
+                       topk, topp, keys, tables=None):
+                    logits, cache = vstep(params, state, cache, toks, t,
+                                          tables)
+                    cands, carries = [], []
+                    cur = keys
+                    for j in range(k + 1):
+                        split = jax.vmap(jax.random.split)(cur)
+                        cur = split[:, 0]
+                        cands.append(_sample_vec(
+                            logits[:, j], temp, topk, topp,
+                            split[:, 1]))
+                        carries.append(cur)
+                    cand = jnp.stack(cands, axis=1)        # [S, k+1]
+                    n_acc = accept(cand, toks, active)
+                    # the slot emitted n_acc + 1 tokens, so its key
+                    # advanced n_acc + 1 splits — exactly what n_acc+1
+                    # plain decode iterations would have done
+                    new_keys = jnp.stack(carries, axis=1)[
+                        jnp.arange(cand.shape[0]), n_acc]
+                    return cand, n_acc, cache, new_keys
+
+            self._spec_fns[greedy_only] = fn
+            self._recompile.watch(
+                "serving.verify_greedy" if greedy_only
+                else "serving.verify_sampled", fn)
+        return fn
+
+    # --- speculation bookkeeping ------------------------------------------
+
+    def _spec_eligible(self, req: Request) -> bool:
+        """Could this request speculate (knob on, EMA has not killed
+        it)? Slot-independent — used at begin_slot time too."""
+        return (self._draft is not None and req.speculate
+                and not req.spec_disabled)
+
+    def _spec_slots(self):
+        """Decoding slots that speculate THIS iteration."""
+        return [slot for slot, r in self.scheduler.running.items()
+                if self._spec_eligible(r)]
+
+    def _spec_disable(self, req: Request) -> None:
+        """Sticky per-request kill switch (adversarial-stream escape
+        hatch): the stream decodes plainly from here on."""
+        req.spec_disabled = True
+        self.metrics.record_spec_disabled()
+        if self._draft is not None and req.slot is not None:
+            self._draft.end_slot(req.slot)
+
+    def _observe_acceptance(self, req: Request, rate: float) -> None:
+        """Update the per-request acceptance EMA; below the floor after
+        warm-up, speculation stops paying for itself (every verify
+        step costs a (k+1)-wide forward to emit ~1 token) and the
+        stream is kicked back to plain decode."""
+        a = self._SPEC_EMA_ALPHA
+        req.spec_ema = (rate if req.spec_ema is None
+                        else (1.0 - a) * req.spec_ema + a * rate)
+        req.spec_checks += 1
+        if req.spec_checks >= self.spec_warmup \
+                and req.spec_ema < self.spec_disable_below:
+            self._spec_disable(req)
+
+    #: EMA smoothing for per-request draft acceptance
+    _SPEC_EMA_ALPHA = 0.25
 
     #: prefill-program cache cap: every DISTINCT (q_len, t0, final)
     #: triple is its own XLA program (the final chunk's key differs for
@@ -645,6 +828,8 @@ class ServingEngine:
         if victim.state is RequestState.DECODING:
             victim.rng = np.array(self._keys[slot])
         self.scheduler.preempt(victim)
+        if self._draft is not None:
+            self._draft.end_slot(slot)   # draft KV freed with the slot
         freed = self.pool.release_slot(slot)
         self._t[slot] = self.max_len          # sentinel: slot inert
         if getattr(victim, "_donor_ref", None) is not None:
@@ -663,13 +848,23 @@ class ServingEngine:
                 n_generated=len(victim.generated), pages_freed=freed,
                 pages_free=self.pool.free_pages)
 
-    def _ensure_decode_pages(self) -> None:
+    def _ensure_decode_pages(self, lookahead=None) -> None:
         """Before a decode step: every running slot whose next write
         position crosses into an unallocated logical page gets one —
         from the free list, then by reclaiming cache-only prefix
         pages, then by preempting the youngest lowest-priority OTHER
         stream. Serviced oldest-highest-priority first, so pressure
-        lands on the back of the line."""
+        lands on the back of the line.
+
+        ``lookahead`` ([S] ints, speculative iterations): the verify
+        step also writes positions ``t+1 .. t+lookahead[slot]``, so
+        every logical page under that span must be allocated — a
+        dropped write there would silently corrupt an ACCEPTED draft's
+        KV. The engine passes ``min(spec_k, remaining_budget - 1)``
+        per speculating slot: pages are only ever demanded for
+        positions the slot could actually consume (verify writes
+        beyond that may drop — their candidates are discarded
+        host-side)."""
         pool = self.pool
         by_rank = sorted(self.scheduler.running.values(),
                          key=lambda r: (r.priority, r.rid))
@@ -677,26 +872,33 @@ class ServingEngine:
             if req.state is not RequestState.DECODING:
                 continue                      # preempted this pass
             slot = req.slot
-            lp = int(self._t[slot]) // pool.page_len
-            if pool.tables[slot, lp] < pool.num_pages:
-                continue                      # page already allocated
-            while True:
-                pid = pool.alloc_page()
-                if pid is not None:
-                    pool.assign(slot, lp, pid)
-                    break
-                if self.prefix is not None and self.prefix.evict_one():
-                    continue
-                if not self._preempt_victim(beneficiary=req,
-                                            strict_priority=False):
-                    raise RuntimeError(
-                        "page pool exhausted: no free page, nothing "
-                        "evictable, no preemptable stream (submit "
-                        "validation should have prevented this)")
+            t = int(self._t[slot])
+            hi = t if lookahead is None else t + int(lookahead[slot])
+            hi = min(hi, pool.pages_per_slot * pool.page_len - 1)
+            for lp in range(t // pool.page_len,
+                            hi // pool.page_len + 1):
                 if req.state is not RequestState.DECODING:
-                    break    # the beneficiary was the worst-ranked
-                    #          stream and preempted ITSELF; its pages
-                    #          are back in the budget
+                    break                     # self-preempted below
+                if pool.tables[slot, lp] < pool.num_pages:
+                    continue                  # page already allocated
+                while True:
+                    pid = pool.alloc_page()
+                    if pid is not None:
+                        pool.assign(slot, lp, pid)
+                        break
+                    if self.prefix is not None \
+                            and self.prefix.evict_one():
+                        continue
+                    if not self._preempt_victim(beneficiary=req,
+                                                strict_priority=False):
+                        raise RuntimeError(
+                            "page pool exhausted: no free page, nothing "
+                            "evictable, no preemptable stream (submit "
+                            "validation should have prevented this)")
+                    if req.state is not RequestState.DECODING:
+                        break    # the beneficiary was the worst-ranked
+                        #          stream and preempted ITSELF; its
+                        #          pages are back in the budget
 
     def _fragmentation(self) -> float:
         """Wasted tail positions across live slots: 1 - used/allocated
@@ -865,6 +1067,8 @@ class ServingEngine:
         self.scheduler.cancel(req, state)
         if had_slot:
             self._t[req.slot] = self.max_len   # sentinel: slot inert
+            if self._draft is not None:
+                self._draft.end_slot(req.slot)
             if self.kv_layout == "paged":
                 self.pool.release_slot(req.slot)
         if getattr(req, "_donor_ref", None) is not None:
@@ -1012,6 +1216,7 @@ class ServingEngine:
             self._topk[s] = req.top_k
             self._topp[s] = req.top_p
             self._keys[s] = np.array(req.rng)
+            self._begin_draft(req, toks)
             self.tracer.on_resume(req.rid)
             return
         first, req.rng = self._sample_first_fn()(
@@ -1031,6 +1236,17 @@ class ServingEngine:
         self._topk[s] = req.top_k
         self._topp[s] = req.top_p
         self._keys[s] = np.array(req.rng)
+        self._begin_draft(req, toks)
+
+    def _begin_draft(self, req: Request, context) -> None:
+        """Hand the draft source this request's context the moment it
+        joins decode. A source that cannot serve the slot (its own
+        pool is dry) disables speculation for THIS request only —
+        admission and decode proceed untouched."""
+        if not self._spec_eligible(req):
+            return
+        if not self._draft.begin_slot(req.slot, context):
+            self._spec_disable(req)
 
     def _advance_decode(self, finished: List[Request]):
         # chaos hook: fires BEFORE any state mutates, so an injected
@@ -1038,18 +1254,37 @@ class ServingEngine:
         # (see step() docstring)
         faults.point("serving.decode")
         paged = self.kv_layout == "paged"
+        spec = bool(self._spec_slots())
         if paged:
             # page growth happens BEFORE the step (a write with no page
             # would silently drop); may preempt streams out of
-            # ``running``, so the batch composition reads after it
-            self._ensure_decode_pages()
+            # ``running``, so the batch composition reads after it.
+            # Speculating slots demand pages for their whole verify
+            # window up front (only as far as their budget can consume)
+            look = None
+            if spec:
+                look = np.zeros(self.num_slots, np.int64)
+                for slot, r in self.scheduler.running.items():
+                    if self._spec_eligible(r):
+                        look[slot] = min(
+                            self.spec_k,
+                            r.max_new_tokens - len(r.generated) - 1)
+            self._ensure_decode_pages(look)
             if not self.scheduler.running:
                 return
+            spec = bool(self._spec_slots())  # preemption may have
+            #                                  evicted the speculators
         t0 = self.metrics.clock()
         n_active = len(self.scheduler.running)
         greedy_only = all(r.temperature <= 0.0
                           for r in self.scheduler.running.values())
         tables = (self.pool.device_tables(),) if paged else ()
+        if spec:
+            n_emitted = self._spec_step(greedy_only, tables, finished)
+            self.metrics.record_decode(
+                n_active, self.metrics.clock() - t0,
+                n_tokens=n_emitted)
+            return
         if greedy_only:
             nxt, self.pool.cache = self._decode_fn(True)(
                 self._params, self._state, self.pool.cache,
@@ -1084,10 +1319,78 @@ class ServingEngine:
                 self._finish(req, finished)
         self.metrics.record_decode(n_active, self.metrics.clock() - t0)
 
+    def _spec_step(self, greedy_only: bool, tables,
+                   finished: List[Request]) -> int:
+        """One speculative draft-and-verify iteration over the decode
+        batch; returns the number of tokens emitted (the
+        ``record_decode`` token count). Non-speculating slots ride the
+        same program with their drafts force-rejected — for them the
+        verify step IS a plain decode step."""
+        k = self.spec_k
+        running = self.scheduler.running
+        active = np.zeros(self.num_slots, bool)
+        for slot, r in running.items():
+            if self._spec_eligible(r):
+                active[slot] = True
+        drafts = np.zeros((self.num_slots, k), np.int32)
+        self._draft.propose(dict(running), self._tok, self._t, drafts,
+                            active)
+        toks = np.concatenate([self._tok[:, None], drafts],
+                              axis=1).astype(np.int32)
+        active_dev = jnp.asarray(active)
+        if greedy_only:
+            cand, n_acc, self.pool.cache = self._verify_fn(True)(
+                self._params, self._state, self.pool.cache, toks,
+                self._t, active_dev, *tables)
+        else:
+            cand, n_acc, self.pool.cache, keys = self._verify_fn(False)(
+                self._params, self._state, self.pool.cache, toks,
+                self._t, active_dev, self._temp, self._topk,
+                self._topp, self._keys, *tables)
+            self._keys = np.array(keys)
+        name = ("serving.verify_greedy" if greedy_only
+                else "serving.verify_sampled")
+        if name not in self._warmed:
+            self._warmed.add(name)
+            self._recompile.mark_warm(name)
+        cand = np.asarray(cand)
+        n_acc = np.asarray(n_acc)
+        if self.tracer.enabled:
+            self.tracer.on_decode([r.rid for r in running.values()])
+        n_emitted = 0
+        spec_items = []
+        done_reqs = []
+        for slot, req in list(running.items()):
+            m = int(n_acc[slot])
+            appended = 0
+            for token in cand[slot, :m + 1]:
+                req.generated.append(int(token))
+                appended += 1
+                if req.done:
+                    break           # stop token / budget mid-window
+            n_emitted += appended
+            self._tok[slot] = req.generated[-1]
+            self._t[slot] += appended
+            if active[slot]:
+                self.metrics.record_spec_verify(k, m)
+                spec_items.append((req.rid, k, m))
+                self._observe_acceptance(req, m / k)
+            if req.done:
+                done_reqs.append(req)
+        # spec events BEFORE terminal transitions: on_terminal retires
+        # the timeline, and the final verify's outcome belongs on it
+        if spec_items and self.tracer.enabled:
+            self.tracer.on_spec_verify(spec_items)
+        for req in done_reqs:
+            self._finish(req, finished)
+        return n_emitted
+
     def _finish(self, req: Request, finished: List[Request]):
         slot = req.slot
         self.scheduler.release(req)
         self._t[slot] = self.max_len          # sentinel: slot inert
+        if self._draft is not None:
+            self._draft.end_slot(slot)
         if self.kv_layout == "paged":
             # pages return to the budget; registered prompt-prefix
             # pages survive under the prefix cache's own refcount

@@ -90,6 +90,17 @@ class ServingMetrics:
             "serving.prefix_lookup_tokens")
         self._preempted = self.registry.counter(
             "serving.requests_preempted")
+        # speculative decoding (spec-decode PR): drafts offered to the
+        # verify step vs drafts the target accepted, plus a per-slot
+        # per-iteration acceptance-rate histogram (the bench's
+        # percentile source) and streams the acceptance EMA kicked
+        # back to plain decode
+        self._spec_proposed = self.registry.counter("serving.spec_proposed")
+        self._spec_accepted = self.registry.counter("serving.spec_accepted")
+        self._spec_rate = self.registry.histogram(
+            "serving.spec_accept_rate")
+        self._spec_disabled = self.registry.counter(
+            "serving.spec_disabled")
         #: exact (tokens, seconds) aggregation per decoding-slot count —
         #: bounded by the slot count, and authoritative for
         #: ``decode_tokens_per_sec`` (the labeled counters mirror it for
@@ -173,6 +184,20 @@ class ServingMetrics:
         self._pages_shared.set(int(shared))
         self._page_frag.set(float(fragmentation))
 
+    def record_spec_verify(self, proposed: int, accepted: int) -> None:
+        """One slot's outcome in one speculative verify step:
+        ``proposed`` drafts offered (the engine's fixed k), ``accepted``
+        of them matched the target's own choices."""
+        proposed, accepted = int(proposed), int(accepted)
+        self._spec_proposed.inc(proposed)
+        self._spec_accepted.inc(accepted)
+        if proposed > 0:
+            self._spec_rate.observe(accepted / proposed)
+
+    def record_spec_disabled(self) -> None:
+        """The acceptance EMA kicked one stream back to plain decode."""
+        self._spec_disabled.inc()
+
     # --- per-iteration ----------------------------------------------------
 
     def record_prefill_chunk(self) -> None:
@@ -183,12 +208,20 @@ class ServingMetrics:
         self._qdepth.observe(int(queue_depth))
         self._occ.observe(occupied / num_slots)
 
-    def record_decode(self, n_decoding: int, dt: float) -> None:
+    def record_decode(self, n_decoding: int, dt: float,
+                      n_tokens: Optional[int] = None) -> None:
+        """One decode iteration over ``n_decoding`` slots taking ``dt``
+        seconds. ``n_tokens`` is the tokens actually emitted — it
+        defaults to one per decoding slot (the plain step) and exceeds
+        it under speculation (a verify step emits ``1 + accepted`` per
+        slot), so ``decode_tokens_per_sec`` prices speculation's win
+        without any caller-side special-casing."""
         n, dt = int(n_decoding), float(dt)
+        toks = n if n_tokens is None else int(n_tokens)
         agg = self._decode_agg.setdefault(n, [0.0, 0.0])
-        agg[0] += n
+        agg[0] += toks
         agg[1] += dt
-        self._decode_toks.inc(n, slots=n)
+        self._decode_toks.inc(toks, slots=n)
         self._decode_secs.inc(dt, slots=n)
         self._decode_recent.append((n, dt))
 
@@ -223,6 +256,23 @@ class ServingMetrics:
         return int(self._preempted.value())
 
     @property
+    def spec_proposed(self) -> int:
+        return int(self._spec_proposed.value())
+
+    @property
+    def spec_accepted(self) -> int:
+        return int(self._spec_accepted.value())
+
+    @property
+    def acceptance_rate(self) -> Optional[float]:
+        """Fraction of proposed draft tokens the target accepted (None
+        before any speculative verify ran)."""
+        prop = self._spec_proposed.value()
+        if prop <= 0:
+            return None
+        return self._spec_accepted.value() / prop
+
+    @property
     def prefix_hit_rate(self) -> Optional[float]:
         """Fraction of looked-up context tokens served off shared
         pages (None before any lookup)."""
@@ -245,6 +295,11 @@ class ServingMetrics:
 
     def latencies(self) -> List[float]:
         return self._latency.samples()
+
+    def spec_accept_rates(self) -> List[float]:
+        """Per-slot per-iteration draft acceptance-rate samples (the
+        histogram reservoir) — bench reduces these to percentiles."""
+        return self._spec_rate.samples()
 
     def decode_tokens_per_sec(self,
                               min_occupancy: int = 0) -> Optional[float]:
@@ -296,6 +351,15 @@ class ServingMetrics:
                 "lookups": int(self._prefix_lookups.value()),
                 "hits": int(self._prefix_hits.value()),
                 "hit_rate": self.prefix_hit_rate},
+            # speculative decoding (keys ADDED by the spec-decode PR):
+            # aggregate acceptance plus the per-slot-per-iteration
+            # acceptance-rate percentiles bench records
+            "acceptance_rate": self.acceptance_rate,
+            "speculation": {
+                "proposed": self.spec_proposed,
+                "accepted": self.spec_accepted,
+                "disabled_streams": int(self._spec_disabled.value()),
+                "accept_rate": self._pcts(self._spec_rate)},
             "tokens_generated": tokens,
             # request-level throughput: all generated tokens over the
             # first-submit -> last-finish span (includes queueing +

@@ -108,6 +108,14 @@ class Request:
     submit_t: float = 0.0                # engine-clock submit timestamp
     error: Optional[BaseException] = None  # why CANCELLED (isolation)
     n_preempted: int = 0                 # times evicted back to queue
+    # speculative decoding (spec-decode PR): whether this request
+    # participates in draft-and-verify iterations, the acceptance EMA
+    # that decides it keeps paying off, and the sticky kill switch the
+    # engine throws for adversarial (never-accepting) streams
+    speculate: bool = False
+    spec_disabled: bool = False
+    spec_ema: Optional[float] = None     # EMA of per-verify accept rate
+    spec_checks: int = 0                 # verify steps observed
 
     @property
     def stopped(self) -> bool:

@@ -1,0 +1,317 @@
+"""Draft sources for speculative decoding in the serving engine.
+
+Decode is memory-bandwidth-bound: every iteration moves the whole
+parameter set plus the KV pages to emit ONE token per slot. Speculative
+decoding (Leviathan et al.) amortizes one target-model pass over ``k``
+candidate tokens: a cheap DRAFT proposes ``d_1..d_k`` per slot, the
+target scores the whole ``[tok, d_1, .., d_k]`` window in one batched
+verify step (``models.decoding.verify_step_slots[_paged]``), and the
+longest prefix of drafts matching the target's own choices is accepted
+— plus the target's next candidate for free. High-acceptance streams
+emit up to ``k + 1`` tokens per target pass; the worst case emits the
+1 token plain decode would have.
+
+Two draft sources, one interface:
+
+``NgramDraft`` — prompt-lookup / n-gram SELF-drafting: propose the
+    continuation that followed the most recent earlier occurrence of
+    the stream's current suffix (searched over prompt + generated
+    tokens, host-side, zero extra weights and zero device work).
+    Excellent on repetitive / templated / retrieval-grounded streams
+    (summarization, code edits, RAG quoting its context); near-zero
+    acceptance on text whose continuation never re-occurs — which the
+    engine's per-request acceptance EMA detects, kicking the stream
+    back to plain decode.
+
+``DraftModel`` — a small target-compatible model (same vocab) decoded
+    greedily ``k`` steps ahead through the EXISTING paged machinery:
+    its own ``PagedKVPool`` (sized worst-case up front, so drafting can
+    never starve the target pool's admission budget mid-flight), its
+    own per-slot page tables, ``decode_step_slots_paged`` as the draft
+    step. Context enters via a head-less chunk prefill at the moment a
+    request joins decode (``begin_slot``); after every verify the
+    engine's position vector is the single source of truth, so the
+    draft cache's rejected-tail garbage self-heals exactly like the
+    target's (each position is re-written the iteration it becomes
+    current, before any mask admits it).
+
+Drafts are DETERMINISTIC (argmax / lookup) by design: a point-mass
+draft distribution makes the exact rejection-sampling acceptance rule
+collapse to "sample from the target, accept while it equals the
+draft" — which keeps sampled streams byte-identical to plain decode
+(same per-request key stream, one split per emitted token) instead of
+merely distribution-equivalent. See docs/serving.md §Speculative
+decoding for the acceptance math.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import numpy as np
+
+__all__ = ["DraftSource", "NgramDraft", "DraftModel"]
+
+
+class DraftSource:
+    """Interface the serving engine drives. Implementations fill a
+    fixed ``[S, k]`` draft buffer per iteration; all hooks are
+    host-side calls on the engine thread (no locking needed).
+
+    ``begin_slot`` returns False when the source cannot draft for this
+    request (e.g. its own KV pool is dry) — the engine then disables
+    speculation for THAT request and admission proceeds untouched:
+    drafting is an accelerator, never a gate."""
+
+    def bind(self, engine) -> None:
+        """Called once from ``ServingEngine.__init__`` with the owning
+        engine (slot count, max_len, spec_k are known here)."""
+
+    def begin_slot(self, slot: int, context: np.ndarray) -> bool:
+        """A request joined the decode batch in ``slot`` with
+        ``context`` tokens already in the TARGET cache (prompt, plus
+        generated[:-1] after a preemption resume). Returns whether this
+        source can draft for the slot."""
+        return True
+
+    def end_slot(self, slot: int) -> None:
+        """The slot's request left decode (finish/preempt/cancel).
+        Must be tolerant of slots never begun."""
+
+    def propose(self, requests: Dict[int, object], tok: np.ndarray,
+                t: np.ndarray, out: np.ndarray,
+                active: np.ndarray) -> None:
+        """Fill ``out[slot, :k]`` with draft tokens continuing after
+        ``tok[slot]`` (the slot's pending decode input at position
+        ``t[slot]``) for every slot with ``active[slot]``.
+        ``requests`` maps slot -> Request (token history access).
+        Rows left untouched are harmless — inactive slots' drafts are
+        force-rejected in the verify program."""
+        raise NotImplementedError
+
+
+class NgramDraft(DraftSource):
+    """Prompt-lookup self-drafting: suffix-match over each stream's own
+    prompt + generated tokens.
+
+    For suffix lengths ``max_ngram`` down to ``min_ngram``, find the
+    most recent EARLIER occurrence of the stream's current suffix and
+    propose the ``k`` tokens that followed it (preferring an occurrence
+    with a full ``k``-token continuation). No weights, no device work —
+    the proposal is a numpy scan over at most ``max_context`` recent
+    tokens. Streams whose continuation never re-occurs get filler
+    drafts that the verify step rejects; the engine's acceptance EMA
+    then disables speculation for them."""
+
+    def __init__(self, max_ngram: int = 3, min_ngram: int = 1,
+                 max_context: int = 4096):
+        if not 1 <= min_ngram <= max_ngram:
+            raise ValueError(
+                f"need 1 <= min_ngram <= max_ngram, got "
+                f"{min_ngram}/{max_ngram}")
+        if max_context < max_ngram + 1:
+            raise ValueError(
+                f"max_context ({max_context}) must exceed max_ngram")
+        self.max_ngram = int(max_ngram)
+        self.min_ngram = int(min_ngram)
+        self.max_context = int(max_context)
+
+    def propose(self, requests, tok, t, out, active):
+        k = out.shape[1]
+        cap = self.max_context
+        for slot, req in requests.items():
+            if not active[slot]:
+                continue
+            # slice BEFORE concatenating: the cap must bound the
+            # per-iteration host copy too, not just the scan — at long
+            # prompts the full-history concat was the hot-loop cost
+            gen = req.generated[-cap:]
+            head = req.prompt[-max(0, cap - len(gen)):] \
+                if len(gen) < cap else req.prompt[:0]
+            ctx = np.concatenate(
+                [head, np.asarray(gen, np.int32)])
+            out[slot] = self.lookup(ctx, k)
+
+    def lookup(self, ctx: np.ndarray, k: int) -> np.ndarray:
+        """The k-token proposal continuing ``ctx`` (which ends with the
+        pending decode input). Zeros when no suffix re-occurs — filler
+        the verify step will reject."""
+        buf = np.zeros(k, np.int32)
+        n_hi = min(self.max_ngram, len(ctx) - 1)
+        for n in range(n_hi, self.min_ngram - 1, -1):
+            suffix = ctx[-n:]
+            # candidate starts 0 .. len-1-n: every hit has at least one
+            # continuation token; the suffix's own occurrence (start
+            # len-n) is excluded by construction
+            win = np.lib.stride_tricks.sliding_window_view(ctx[:-1], n)
+            hits = np.flatnonzero((win == suffix).all(axis=1))
+            if not hits.size:
+                continue
+            # most recent occurrence, preferring one whose continuation
+            # covers the full k tokens (periodic streams: the last
+            # overlapping hit may sit too close to the end)
+            full = hits[hits + n + k <= len(ctx)]
+            i = int(full[-1] if full.size else hits[-1])
+            cont = ctx[i + n:i + n + k]
+            buf[:len(cont)] = cont
+            if 0 < len(cont) < k:
+                buf[len(cont):] = cont[-1]       # pad; tail likely rejects
+            return buf
+        return buf
+
+
+class DraftModel(DraftSource):
+    """A small target-compatible LM drafting ``k`` greedy steps ahead
+    through its own paged KV machinery.
+
+    The draft pool is provisioned at ``bind`` time — by default at
+    worst-case parity (``num_slots * ceil(max_len / page_len)`` pages),
+    so draft-KV memory is a FIXED budget decided up front and the
+    target pool's admission arithmetic never competes with drafting. A
+    smaller explicit ``num_pages`` is allowed: ``begin_slot`` then
+    allocates a slot's worst case eagerly and reports False when the
+    draft pool is dry, which disables speculation for that request
+    only — admission is never blocked on draft pages.
+
+    The draft model must share the target's tokenizer/vocab (the
+    proposals are target token ids); architecture and size are free —
+    the win condition is ``k`` draft steps + one (k+1)-wide target pass
+    beating ``acc + 1`` plain target steps."""
+
+    def __init__(self, model, *, page_len: int = 16,
+                 num_pages: Optional[int] = None, cache_dtype=None,
+                 weights_dtype="auto"):
+        from distkeras_tpu.models.core import Sequential
+        module = model.module
+        if not isinstance(module, Sequential):
+            raise TypeError("DraftModel expects a Sequential LM "
+                            f"(got {type(module).__name__})")
+        from distkeras_tpu.models.decoding import (_attn_compute_dtype,
+                                                   _resolve_head_dims,
+                                                   _serving_params)
+        self.model = model
+        self.module = module
+        _resolve_head_dims(module, model.params)
+        compute_dt = _attn_compute_dtype(module)
+        import jax.numpy as jnp
+        if cache_dtype is None:
+            cache_dtype = (compute_dt if compute_dt is not None
+                           else jnp.float32)
+        if weights_dtype == "auto":
+            weights_dtype = compute_dt if (
+                compute_dt is not None
+                and compute_dt != jnp.dtype(jnp.float32)) else None
+        self._params = (model.params if weights_dtype is None
+                        else _serving_params(model.params, weights_dtype))
+        self._state = model.state
+        self._page_len = int(page_len)
+        self._num_pages = num_pages
+        self._cache_dtype = cache_dtype
+        self.pool = None                     # built at bind()
+        self._staging = None
+        self._prefill_fns = {}               # length-keyed LRU, engine cap
+        self._step_fn = None
+        self._active = set()                 # slots with live draft KV
+
+    #: same LRU bound the engine uses for its ragged prefill programs
+    MAX_PREFILL_PROGRAMS = 64
+
+    def bind(self, engine) -> None:
+        from distkeras_tpu.serving.kv_pool import PagedKVPool
+        self.pool = PagedKVPool(self.module, engine.num_slots,
+                                engine.max_len, page_len=self._page_len,
+                                num_pages=self._num_pages,
+                                dtype=self._cache_dtype)
+        self._staging = self.pool.make_request_cache()
+
+    def begin_slot(self, slot: int, context: np.ndarray) -> bool:
+        import jax.numpy as jnp
+        self.end_slot(slot)                  # tolerate re-begin
+        pool = self.pool
+        # eager worst-case allocation: the draft step never needs a
+        # mid-decode growth path (and with the default parity sizing
+        # this can never fail)
+        pids = []
+        for _ in range(pool.pages_per_slot):
+            pid = pool.alloc_page()
+            if pid is None:
+                for p in pids:
+                    pool.decref(p)
+                return False                 # draft pool dry: no drafting
+            pids.append(pid)
+        for j, pid in enumerate(pids):
+            pool.assign(slot, j, pid)
+        n = len(context)
+        fn = self._prefill_fn(n)
+        self._staging = fn(self._params, self._state, self._staging,
+                           jnp.asarray(np.asarray(context,
+                                                  np.int32)[None]))
+        pool.insert_pages(self._staging, slot, 0, n)
+        self._active.add(slot)
+        return True
+
+    def end_slot(self, slot: int) -> None:
+        if self.pool is not None and slot in self._active:
+            self.pool.release_slot(slot)
+            self._active.discard(slot)
+
+    def _prefill_fn(self, n: int):
+        """Head-less whole-context chunk prefill at batch 1 (the draft
+        only ever needs cache entries, never logits). One program per
+        context length, LRU-capped like the engine's."""
+        fn = self._prefill_fns.pop(n, None)
+        if fn is None:
+            from distkeras_tpu.models.decoding import prefill_chunk_step
+            module = self.module
+
+            def f(params, state, cache, chunk):
+                _, cache = prefill_chunk_step(module, params, state,
+                                              cache, chunk, 0,
+                                              final=False)
+                return cache
+
+            fn = jax.jit(f)
+        self._prefill_fns[n] = fn
+        while len(self._prefill_fns) > self.MAX_PREFILL_PROGRAMS:
+            self._prefill_fns.pop(next(iter(self._prefill_fns)))
+        return fn
+
+    def _decode_fn(self):
+        if self._step_fn is None:
+            from distkeras_tpu.models.decoding import \
+                decode_step_slots_paged
+            import jax.numpy as jnp
+            module = self.module
+            page_len = self.pool.page_len
+
+            @jax.jit
+            def fn(params, state, cache, tok, t, tables):
+                logits, cache = decode_step_slots_paged(
+                    module, params, state, cache, tok, t, tables,
+                    page_len)
+                return jnp.argmax(logits, axis=-1), cache
+
+            self._step_fn = fn
+        return self._step_fn
+
+    def propose(self, requests, tok, t, out, active):
+        import jax.numpy as jnp
+        if not self._active:
+            return
+        k = out.shape[1]
+        fn = self._decode_fn()
+        tables = self.pool.device_tables()
+        # slots without live draft KV (speculation disabled, or the
+        # draft pool was dry at begin) run at the inert sentinel so
+        # their writes drop and their garbage proposals stay inactive
+        tt = np.where([s in self._active for s in range(len(t))],
+                      t, self.pool.max_len).astype(np.int32)
+        cur = np.asarray(tok, np.int32).copy()
+        for j in range(k):
+            nxt, self.pool.cache = fn(self._params, self._state,
+                                      self.pool.cache, jnp.asarray(cur),
+                                      jnp.asarray(tt), tables)
+            cur = np.asarray(nxt).astype(np.int32)
+            out[:, j] = cur
+            tt = tt + 1

@@ -42,6 +42,14 @@ def test_continuous_batching_example(capsys):
     assert matches >= 3       # every greedy request passed its oracle
 
 
+def test_speculative_serving_example(capsys):
+    matches = run_example("examples.speculative_serving")
+    out = capsys.readouterr().out
+    assert "token-identical to generate()" in out
+    assert "kicked back to plain decode" in out
+    assert matches == 5       # every speculative request passed its oracle
+
+
 def test_vit_finetune_callbacks_example(capsys):
     acc = run_example("examples.vit_finetune_callbacks")
     out = capsys.readouterr().out

@@ -565,7 +565,10 @@ def test_serving_summary_keys_are_backward_compatible():
         "tpot_s",
         # paged-KV tally ADDED by the paged-cache PR ("pages" is None
         # on a slab engine / before any iteration)
-        "requests_preempted", "pages", "prefix_cache"}
+        "requests_preempted", "pages", "prefix_cache",
+        # speculative decoding ADDED by the spec-decode PR
+        # ("acceptance_rate" is None before any verify ran)
+        "acceptance_rate", "speculation"}
 
 
 # --- integration: prefetch gauges -------------------------------------------
