@@ -163,18 +163,23 @@ def _regression_check(rec, prev_heads, src, prev_kind=None):
     in-run anchor regardless of history (``vs_baseline`` is a same-run
     speed ratio for every family — the standing moe_lm_train 0.735x
     regression is exactly this case, and without the below_anchor flag
-    it persists silently once both rounds carry it). Cross-HARDWARE
-    comparisons are skipped: a CPU smoke run against a TPU-captured
-    record would flag a bogus ~100x "drop" on every family, drowning
-    the signal (the below-anchor check is in-run, so it still applies).
-    None when there is nothing to compare and nothing flagged."""
+    it persists silently once both rounds carry it). Anchors carry
+    ``device_kind``: a prior-round record captured on DIFFERENT
+    hardware reports as a STALE ANCHOR (the ``stale_anchor`` key,
+    surfaced by the summary line) instead of flagging every run — a
+    CPU smoke against a TPU capture would otherwise flag a bogus ~100x
+    "drop" on every family, drowning the signal (the below-anchor
+    check is in-run, so it still applies). None when there is nothing
+    to compare and nothing flagged."""
     flags = []
     out = {}
     prev = (prev_heads or {}).get(rec.get("metric")) or {}
     if prev_kind is not None and rec.get("device_kind") is not None \
             and rec["device_kind"] != prev_kind:
-        out["prev_skipped"] = (f"{src}: device_kind {prev_kind!r} != "
-                               f"{rec['device_kind']!r}")
+        out["stale_anchor"] = (
+            f"{src} was captured on device_kind {prev_kind!r}, this "
+            f"run is {rec['device_kind']!r}: cross-device anchor is "
+            "stale, vs-prev comparison skipped")
         prev = {}
     elif src:
         out["prev_source"] = src
@@ -200,7 +205,7 @@ def _regression_check(rec, prev_heads, src, prev_kind=None):
         flags.append(f"below_anchor: vs_baseline {vb} < {REGRESSION_DROP}")
     if flags:
         out["flags"] = flags
-    return out if (flags or "prev_skipped" in out or "value_vs_prev" in out
+    return out if (flags or "stale_anchor" in out or "value_vs_prev" in out
                    or "vs_baseline_vs_prev" in out) else None
 
 
@@ -649,8 +654,8 @@ def bench_serving(num_slots: int, prompt_len: int, new_tokens: int,
                 f"prefill ramp (max_len={max_len}, t={t.tolist()})")
         t0 = time.perf_counter()
         for _ in range(steps):
-            nxt, cache = fn(probe._params, probe._state, cache, tok, t,
-                            *extra)
+            nxt, cache, _moe = fn(probe._params, probe._state, cache,
+                                  tok, t, *extra)
             tok = np.asarray(nxt)
             t = t + 1
         rate = num_slots * steps / (time.perf_counter() - t0)
@@ -989,6 +994,188 @@ def bench_spec_decode(num_slots: int, prompt_len: int, new_tokens: int,
             "disabled_streams": disabled,
         }
     return out
+
+
+#: the serving_moe bench's MoE LM shape (accelerator tier): every block
+#: MoE, E=8 top-2, expert ratio 2 — the serving-side sibling of the
+#: moe_lm_train family's config, scaled to a decode-bound engine run
+MOE_SERVE_CFG = dict(vocab=8192, d_model=512, num_heads=8, num_layers=4,
+                     mlp_ratio=2, num_experts=8)
+
+
+def _build_moe_serve_model(cfg, expert_axis=None):
+    from distkeras_tpu.models import Model, zoo
+    return Model.build(zoo.transformer_lm(
+        cfg["vocab"], d_model=cfg["d_model"], num_heads=cfg["num_heads"],
+        num_layers=cfg["num_layers"], mlp_ratio=cfg["mlp_ratio"],
+        use_rope=True, dtype="bfloat16", moe_every=1,
+        num_experts=cfg["num_experts"], moe_dispatch="dense",
+        moe_expert_axis=expert_axis), (64,), seed=0)
+
+
+def bench_serving_moe(num_slots: int, prompt_len: int, new_tokens: int,
+                      n_requests: int, n_passes: int, prefill_chunk=None,
+                      cfg=None):
+    """MoE-native serving (MoE-serving PR, ROADMAP item 4): marginal
+    decode tokens/s of the DISPATCHED MoE decode path
+    (``moe_decode="dispatched"`` — drop-free decode dispatch,
+    ``MoE.decode_apply``) vs the dense-routing baseline
+    (``moe_decode="dense"`` — every expert on every token, the
+    pre-this-PR behavior), on one MoE LM served through TWO warmed
+    engines driven by the SAME seeded open-loop arrival trace
+    (bench_serving's protocol: first ``num_slots`` at t=0, exponential
+    inter-arrivals at ~2x decode capacity, rate scaled from the
+    dispatched engine's measured warm step).
+
+    Both engines are token-identical to the dense-routing
+    ``generate()`` oracle (the drop-free contract,
+    tests/test_moe_serving.py); this family prices the SPEED of the
+    dispatch at decode shapes. Returns ``(disp_rates, dense_rates,
+    summaries)`` across passes — ``summaries`` are the dispatched
+    engine's, carrying the expert-load/entropy picture."""
+    from distkeras_tpu.serving import ServingEngine, ServingMetrics
+
+    cfg = cfg or MOE_SERVE_CFG
+    model = _build_moe_serve_model(cfg)
+    max_len = prompt_len + new_tokens
+    rs = np.random.RandomState(0)
+    prompts = [rs.randint(0, cfg["vocab"], (prompt_len,))
+               .astype(np.int32) for _ in range(n_requests)]
+
+    engines = {
+        "dispatched": ServingEngine(model, num_slots=num_slots,
+                                    max_len=max_len,
+                                    prefill_chunk=prefill_chunk,
+                                    moe_decode="dispatched"),
+        "dense": ServingEngine(model, num_slots=num_slots,
+                               max_len=max_len,
+                               prefill_chunk=prefill_chunk,
+                               moe_decode="dense"),
+    }
+    # warm both (compiles prefill/insert/decode) and scale the arrival
+    # rate from the dispatched engine's measured warm decode step
+    for eng in engines.values():
+        eng.submit(prompts[0], new_tokens)
+        eng.run(max_steps=100_000)
+    warm = [dt for _, dt in
+            engines["dispatched"].metrics.decode_samples[1:]]
+    step_dt = statistics.median(warm) if warm else 1e-3
+    mean_ia = step_dt * new_tokens / (2.0 * num_slots)
+
+    def drive(eng, arrivals):
+        eng.metrics = ServingMetrics()
+        t0 = time.perf_counter()
+        j = 0
+        while j < n_requests or eng.scheduler.pending:
+            now = time.perf_counter() - t0
+            while j < n_requests and arrivals[j] <= now:
+                eng.submit(prompts[j], new_tokens)
+                j += 1
+            if eng.scheduler.pending:
+                eng.step()
+            elif j < n_requests:               # open-loop idle gap
+                time.sleep(min(arrivals[j] - now, 1e-3))
+        m = eng.metrics
+        rate = m.decode_tokens_per_sec(min_occupancy=num_slots)
+        if rate is None:                       # pool never saturated
+            rate = m.decode_tokens_per_sec()
+        return rate, m
+
+    disp_rates, dense_rates, summaries = [], [], []
+    for i in range(n_passes):
+        arrivals = np.concatenate([
+            np.zeros(min(num_slots, n_requests)),
+            np.cumsum(rs.exponential(
+                mean_ia, size=max(0, n_requests - num_slots)))])
+        r_disp, m_disp = drive(engines["dispatched"], arrivals)
+        r_dense, _ = drive(engines["dense"], arrivals)
+        disp_rates.append(r_disp)
+        dense_rates.append(r_dense)
+        summaries.append(m_disp.summary())
+        print(f"serving_moe pass {i}: dispatched {r_disp:.1f} tok/s vs "
+              f"dense-routing {r_dense:.1f} "
+              f"({r_disp / r_dense:.2f}x); moe "
+              f"{summaries[-1]['moe']}",
+              file=sys.stderr, flush=True)
+    return disp_rates, dense_rates, summaries
+
+
+def bench_serving_moe_ep(num_slots: int = 2, prompt_len: int = 8,
+                         new_tokens: int = 8, cfg=None):
+    """The expert-parallel serving_moe variant — runs in ITS OWN
+    subprocess under a forced multi-device CPU mesh
+    (``--xla_force_host_platform_device_count=8``; the parent's
+    backend has one device and XLA flags are fixed at client init).
+    Builds the SAME MoE LM with ``moe_expert_axis`` set, serves it
+    through a shard_map-wrapped engine (``ep_mesh``: expert weights
+    sharded E/A per device), and checks the output token-identical to
+    the single-device dense-routing ``generate()`` oracle — the
+    correctness half of EP decode; per-chip weight-traffic scaling is
+    an accelerator claim this CPU smoke cannot price."""
+    import jax as _jax
+    from jax.sharding import Mesh
+    from distkeras_tpu.models.decoding import generate
+    from distkeras_tpu.serving import ServingEngine
+
+    cfg = cfg or dict(vocab=256, d_model=64, num_heads=4, num_layers=2,
+                      mlp_ratio=2, num_experts=8)
+    devices = _jax.devices()
+    mesh = Mesh(np.array(devices), ("expert",))
+    model_ep = _build_moe_serve_model(cfg, expert_axis="expert")
+    model_ref = _build_moe_serve_model(cfg)   # same seed -> same params
+    max_len = prompt_len + new_tokens
+    eng = ServingEngine(model_ep, num_slots=num_slots, max_len=max_len,
+                        ep_mesh=mesh)
+    rs = np.random.RandomState(0)
+    prompts = [rs.randint(0, cfg["vocab"], (prompt_len,))
+               .astype(np.int32) for _ in range(num_slots)]
+    # warm, then one timed closed-loop drain at full occupancy
+    eng.submit(prompts[0], new_tokens)
+    eng.run(max_steps=100_000)
+    from distkeras_tpu.serving import ServingMetrics
+    eng.metrics = ServingMetrics()
+    rids = [eng.submit(p, new_tokens) for p in prompts]
+    out = eng.run(max_steps=100_000)
+    rate = eng.metrics.decode_tokens_per_sec()
+    matches = all(
+        np.array_equal(out[rid],
+                       generate(model_ref, p[None], new_tokens,
+                                temperature=0.0)[0])
+        for rid, p in zip(rids, prompts))
+    return {"ep_devices": len(devices),
+            "tokens_per_sec": round(rate, 1) if rate else None,
+            "matches_oracle": bool(matches),
+            "note": "shard_map EP decode on a forced multi-device CPU "
+                    "mesh: correctness + code-path proof (weight-"
+                    "traffic scaling is the accelerator claim)"}
+
+
+def _serving_moe_ep_subprocess(timeout=560):
+    """Spawn the EP variant under a forced 8-device CPU mesh (the flags
+    must be set before the child's CPU client instantiates, which is
+    why it cannot run in this process)."""
+    import subprocess
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    try:
+        r = subprocess.run(
+            [sys.executable, __file__, "--model", "serving_moe",
+             "--serving-moe-ep"],
+            capture_output=True, text=True, timeout=timeout, env=env)
+        for ln in reversed(r.stdout.splitlines()):
+            if ln.startswith("{"):
+                parsed = json.loads(ln)
+                if "ep_devices" in parsed:
+                    return parsed
+        print(f"serving_moe ep: no output (rc {r.returncode})\n"
+              f"{r.stderr[-2000:]}", file=sys.stderr, flush=True)
+    except Exception:
+        traceback.print_exc(file=sys.stderr)
+    return None
 
 
 #: configs the default (driver-facing) MoE bench runs. dense_dispatch is
@@ -1346,23 +1533,48 @@ def bench_decode_batch_curve(kv_heads, cache_dt, p_len, batches,
     return curve
 
 
-def _isolated_mode(mode, timeout, profile=None):
-    """Run one bench family in its own subprocess and relay its LAST
-    JSON line (the family record) onto THIS stdout. Process isolation is
-    the HBM fence on the tunneled backend (see bench_moe_isolated)."""
+def _isolated_mode(mode, timeout, profile=None, args=None):
+    """Run one bench family in its own subprocess and relay its family
+    record onto THIS stdout. Process isolation is the HBM fence on the
+    tunneled backend (see bench_moe_isolated).
+
+    CLI overrides the outer ``--model all`` invocation was given
+    (``--lm-batch``, ``--steps``, ``--passes``) forward to the child —
+    previously they were silently dropped, so an operator's sized-down
+    ``all`` run still launched the full-size isolated family
+    (ADVICE r5). The child's record is identified by its ``"metric"``
+    key, not by being the last ``{``-prefixed stdout line — any other
+    JSON-ish line (a stray print, a nested family) would break that."""
     import subprocess
     cmd = [sys.executable, __file__, "--model", mode]
     if profile:
         cmd += ["--profile", profile]
+    if args is not None:
+        if args.lm_batch:
+            cmd += ["--lm-batch", str(args.lm_batch)]
+        if args.steps:
+            cmd += ["--steps", str(args.steps)]
+        if args.passes:
+            cmd += ["--passes", str(args.passes)]
     r = subprocess.run(cmd, capture_output=True, text=True,
                        timeout=timeout)
-    lines = [ln for ln in r.stdout.splitlines() if ln.startswith("{")]
-    if not lines:
-        print(f"{mode}: no output (rc {r.returncode})\n{r.stderr[-2000:]}",
+    rec = None
+    for ln in r.stdout.splitlines():
+        if not ln.startswith("{"):
+            continue
+        try:
+            parsed = json.loads(ln)
+        except ValueError:
+            continue
+        if isinstance(parsed, dict) and parsed.get("metric") \
+                and parsed["metric"] != "headline_summary":
+            rec = parsed               # last family record wins
+    if rec is None:
+        print(f"{mode}: no record (rc {r.returncode})\n{r.stderr[-2000:]}",
               file=sys.stderr, flush=True)
         return None
-    print(lines[-1], flush=True)
-    return json.loads(lines[-1])
+    print(json.dumps(rec), flush=True)
+    return rec
 
 
 def _summary_line(records, device_kind):
@@ -1374,6 +1586,7 @@ def _summary_line(records, device_kind):
     completed even if a later family dies or times out."""
     heads = {}
     regressions = {}
+    stale = {}
     for rec in records:
         h = {"value": rec.get("value"),
              "vs_baseline": rec.get("vs_baseline")}
@@ -1384,6 +1597,9 @@ def _summary_line(records, device_kind):
         flags = (rec.get("regression") or {}).get("flags")
         if flags:
             regressions[rec["metric"]] = flags
+        sa = (rec.get("regression") or {}).get("stale_anchor")
+        if sa:
+            stale[rec["metric"]] = sa
     first = records[0] if records else {}
     out = {
         "metric": "headline_summary",
@@ -1398,6 +1614,12 @@ def _summary_line(records, device_kind):
         # previous BENCH_r*.json) and below-anchor family, in the LAST
         # line the driver is guaranteed to capture
         out["regressions"] = regressions
+    if stale:
+        # anchors carry device_kind: prior-round records captured on
+        # different hardware are reported stale here (one shared note,
+        # not per-family flags) instead of flagging every family
+        out["stale_anchors"] = sorted(stale)
+        out["stale_anchor_note"] = next(iter(stale.values()))
     return json.dumps(out)
 
 
@@ -1405,19 +1627,33 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", choices=["all", "resnet50", "lm", "lm_big",
                                         "generate", "generate_long",
-                                        "serving", "spec_decode", "moe",
+                                        "serving", "spec_decode",
+                                        "serving_moe", "moe",
                                         "overlap"],
                     default="all",
                     help="'all' (default) runs resnet50 + lm + generate + "
                     "generate_long (P=2048/8192 serving grid) + serving "
                     "(continuous-batching engine, open-loop trace) + "
-                    "spec_decode (speculative decoding on/off) + moe "
-                    "+ lm_big, one JSON line each (ResNet headline "
-                    "first, cumulative summary line last)")
+                    "spec_decode (speculative decoding on/off) + "
+                    "serving_moe (dispatched vs dense-routing MoE "
+                    "decode) + moe + lm_big, one JSON line each (ResNet "
+                    "headline first, cumulative summary line last)")
     ap.add_argument("--profile", default=None,
                     help="capture an XProf trace of the last pass here")
     ap.add_argument("--lm-batch", type=int, default=None,
-                    help="override the LM batch-size ladder with one size")
+                    help="override the LM batch-size ladder with one size "
+                    "(lm and lm_big)")
+    ap.add_argument("--steps", type=int, default=None,
+                    help="override the per-pass step count of the "
+                    "training families (resnet50 / lm / lm_big)")
+    ap.add_argument("--passes", type=int, default=None,
+                    help="override the timed-pass count of the training "
+                    "families (resnet50 / lm / lm_big)")
+    ap.add_argument("--serving-moe-ep", action="store_true",
+                    help="internal: run ONLY the expert-parallel "
+                    "serving_moe variant in this process and print its "
+                    "partial JSON (the parent spawns this under a "
+                    "forced multi-device CPU mesh)")
     ap.add_argument("--fused-head", action="store_true",
                     help="use the chunked fused vocab-projection+CE for "
                     "--model lm (measured: the memory lever for batch "
@@ -1438,6 +1674,17 @@ def main():
     ap.add_argument("--moe-passes", type=int, default=None)
     args = ap.parse_args()
 
+    if args.serving_moe_ep:
+        # the EP child: its forced CPU mesh came in via env (XLA_FLAGS,
+        # set before this interpreter started). The platform switch is
+        # ALSO asserted programmatically — on TPU hosts the
+        # sitecustomize forces the hardware platform and env vars alone
+        # do not switch (docs/VERIFY gotcha); no device has been touched
+        # yet in this process, so the update still takes effect.
+        jax.config.update("jax_platforms", "cpu")
+        print(json.dumps(bench_serving_moe_ep()), flush=True)
+        return
+
     # harness sizing, not a kernel fork:
     on_accel = jax.default_backend() != "cpu"  # lint: allow-backend-sniff
     peak, device_kind = detect_peak_flops()
@@ -1450,8 +1697,8 @@ def main():
         base_profile = args.profile
         records = []
         for mode in ("resnet50", "lm", "overlap", "generate",
-                     "generate_long", "serving", "spec_decode", "moe",
-                     "lm_big"):
+                     "generate_long", "serving", "spec_decode",
+                     "serving_moe", "moe", "lm_big"):
             if base_profile:
                 args.profile = f"{base_profile.rstrip('/')}/{mode}"
             try:
@@ -1462,7 +1709,8 @@ def main():
                     # buffers to THIS process (same fence as bench_moe)
                     rec = _isolated_mode("lm_big", timeout=1500,
                                          profile=args.profile
-                                         if base_profile else None)
+                                         if base_profile else None,
+                                         args=args)
                 else:
                     rec = _run_mode(mode, args, on_accel, peak,
                                     device_kind)
@@ -1478,8 +1726,8 @@ def main():
 def _run_mode(mode, args, on_accel, peak, device_kind):
     _begin_family()
     if mode == "resnet50":
-        steps = 50 if on_accel else 2
-        n_passes = 3 if on_accel else 1
+        steps = args.steps or (50 if on_accel else 2)
+        n_passes = args.passes or (3 if on_accel else 1)
         batches = [256, 128, 64, 32] if on_accel else [8]
         (rates, flops_per_img), bs = _with_fallbacks(
             lambda b: bench_resnet50(b, steps, n_passes, args.profile),
@@ -1499,6 +1747,73 @@ def _run_mode(mode, args, on_accel, peak, device_kind):
             "device_kind": device_kind,
             "bf16_peak_tflops": round(peak / 1e12) if peak else None,
             "mfu": round(mfu, 4) if mfu else None,
+        }
+        return _emit(rec)
+
+    if mode == "serving_moe":
+        if on_accel:
+            cfg = MOE_SERVE_CFG
+            num_slots, prompt_len, new_tokens = 8, 64, 64
+            n_requests, n_passes, chunk = 24, 3, 32
+        else:
+            # smoke shape chosen so the expert MLPs dominate the step
+            # (hid = 4*d): the dispatched-vs-dense ratio is then the
+            # dispatch machinery's, not attention noise — measured
+            # ~2x here vs ~1.0x at d=64/hid=128
+            cfg = dict(vocab=256, d_model=128, num_heads=4, num_layers=2,
+                       mlp_ratio=4, num_experts=8)
+            # 3 passes x 6 requests x 16 tokens: enough full-occupancy
+            # iterations that the per-pass ratio median clears host
+            # noise (1 pass x 8 tokens measured anywhere in 0.87-1.4x)
+            num_slots, prompt_len, new_tokens = 2, 8, 16
+            n_requests, n_passes, chunk = 6, 3, None
+        disp, dense, summaries = bench_serving_moe(
+            num_slots, prompt_len, new_tokens, n_requests, n_passes,
+            prefill_chunk=chunk, cfg=cfg)
+        ep = _serving_moe_ep_subprocess()
+        value = statistics.median(disp)
+        mid = summaries[len(summaries) // 2]
+        rec = {
+            "metric": "serving_moe_decode_tokens_per_sec_per_chip",
+            "value": round(value, 1),
+            "unit": "tokens/sec",
+            # the acceptance ratio: dispatched MoE decode vs the
+            # dense-routing engine on the SAME seeded open-loop trace
+            # (>= 1.5x documented accelerator target; >= 1.0x CPU
+            # smoke; the below-anchor tripwire flags < 0.9). Median of
+            # the per-pass ratios — each pass drives both engines back
+            # to back, so host drift cancels
+            "vs_baseline": round(statistics.median(
+                d / r for d, r in zip(disp, dense)), 3),
+            "dense_routing_tokens_per_sec": round(
+                statistics.median(dense), 1),
+            "dispatched_passes": [round(r, 1) for r in disp],
+            "dense_passes": [round(r, 1) for r in dense],
+            "moe": mid.get("moe"),
+            "ep": ep,
+            "num_slots": num_slots,
+            "prompt_len": prompt_len,
+            "new_tokens": new_tokens,
+            "requests": n_requests,
+            "prefill_chunk": chunk,
+            "moe_config": f"{cfg['num_layers']}L all-MoE, "
+                          f"E={cfg['num_experts']} top-2, d_model "
+                          f"{cfg['d_model']}, expert ratio "
+                          f"{cfg['mlp_ratio']}",
+            "criterion": "dispatched >= 1.5x dense-routing marginal "
+                         "decode tok/s on accelerators (>= 1.0x CPU "
+                         "smoke); outputs token-identical to the "
+                         "dense-routing generate() oracle either way "
+                         "(drop-free decode dispatch); ep variant "
+                         "proves shard_map expert-parallel decode on a "
+                         "forced multi-device CPU mesh",
+            "note": "open-loop exponential arrivals at ~2x decode "
+                    "capacity through TWO warmed engines "
+                    "(moe_decode='dispatched' vs 'dense'), same seeded "
+                    "trace to both; value = dispatched full-occupancy "
+                    "decode tokens/s; moe = expert-load/entropy/"
+                    "concentration of the median dispatched pass",
+            "device_kind": device_kind,
         }
         return _emit(rec)
 
@@ -1546,6 +1861,19 @@ def _run_mode(mode, args, on_accel, peak, device_kind):
                           "round-5 dispatch (drop/unique scatter + "
                           "structured combine) vs round-6 fused Pallas "
                           "dispatch (gather-into-GEMM, no HBM buffer)",
+            # re-anchor note (MoE-serving PR): the standing 0.735x flag
+            # is BENCH_r05's ROUND-5 TPU capture, taken BEFORE the
+            # round-6 fused kernel landed; the current code measured
+            # vs_baseline 1.057 on the round-13 CPU smoke
+            # (docs/PERF.md §MoE re-anchor). Cross-device prior-round
+            # comparisons are reported as stale_anchor, not flagged;
+            # the in-run below-anchor check resets the moment a TPU
+            # run of the current kernel is captured.
+            "anchor_note": "0.735x is the round-5 pre-fused-kernel TPU "
+                           "anchor; fused dispatch landed round 6 — "
+                           "in-run vs_baseline reflects the current "
+                           "kernel (1.057 on the round-13 CPU smoke), "
+                           "TPU re-capture pending",
             "device_kind": device_kind,
         }
         return _emit(rec)
@@ -1861,15 +2189,17 @@ def _run_mode(mode, args, on_accel, peak, device_kind):
         cfg = LM_BIG_CFG if on_accel else dict(
             d_model=128, num_heads=2, num_layers=2, mlp_ratio=4,
             vocab=512, seq=128)
-        steps = 10 if on_accel else 2
+        steps = args.steps or (10 if on_accel else 2)
         # 3 passes, same protocol as every other family (VERDICT r5
         # item 2: lm_big was the lone 2-pass holdout, which left its
         # published spread without a median distinct from the extremes)
-        n_passes = 3 if on_accel else 1
+        n_passes = args.passes or (3 if on_accel else 1)
         # start at the measured-fitting batch: a failed bigger attempt
         # poisons this backend's HBM for the rest of the process (the
         # round-5 L16 run OOM'd at b2 only because b8/b4 failed first)
         batches = [4, 2] if on_accel else [2]
+        if args.lm_batch:
+            batches = [args.lm_batch]
         (rates_f, fpt), bs = _with_fallbacks(
             lambda b: bench_lm("flash", b, steps, n_passes, args.profile,
                                fused_head=True, cfg=cfg),
@@ -1924,8 +2254,8 @@ def _run_mode(mode, args, on_accel, peak, device_kind):
         return _emit(rec)
 
     # LM mode: measure BOTH attention paths; headline = the winner
-    steps = 20 if on_accel else 2
-    n_passes = 3 if on_accel else 1
+    steps = args.steps or (20 if on_accel else 2)
+    n_passes = args.passes or (3 if on_accel else 1)
     batches = [8, 4, 2] if on_accel else [2]
     if args.lm_batch:
         batches = [args.lm_batch]

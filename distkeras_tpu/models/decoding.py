@@ -21,9 +21,15 @@ design:
 
 Works on ``zoo.transformer_lm``-shaped models: a ``Sequential`` of
 Embedding / PositionalEmbedding / TransformerBlock (optionally
-Remat-wrapped) / norm / Dense. MoE blocks decode fine (dense routing is
-per-token already). Sequence-parallel ``attn_impl`` settings are ignored
-at decode time — generation is a single-device (or TP-sharded) path.
+Remat-wrapped) / norm / Dense. MoE blocks: ``generate()``'s scalar path
+runs each block's configured routing (dense routing is per-token
+already — it is the serving oracle); the SLOT-level steps below default
+to the decode-specialized DISPATCHED path (``MoE.decode_apply`` —
+drop-free by construction, fused Pallas gather-into-GEMM on TPU, the
+XLA tokens floor elsewhere; MoE-serving PR), which equals dense routing
+token-for-token while engaging the sparse-dispatch machinery at decode
+shapes. Sequence-parallel ``attn_impl`` settings are ignored at decode
+time — generation is a single-device (or TP/EP-sharded) path.
 """
 
 from __future__ import annotations
@@ -604,6 +610,64 @@ def decode_step(module: Sequential, params, state, cache, tok, t):
 # per-slot, and rope positions are per-slot. The fused Pallas decode
 # kernel takes a scalar step and is not used here; the einsum path's
 # per-slot masks cost nothing extra (the mask was already materialized).
+#
+# MoE blocks (MoE-serving PR): the slot steps run MoE MLPs through the
+# decode-specialized dispatched path by default (``moe_dispatched=True``
+# -> ``MoE.decode_apply``: capacity = the slot-token count, so routing
+# can never drop and a slot's output is independent of its batch
+# neighbours; fused kernel on TPU, tokens path elsewhere).
+# ``moe_dispatched=False`` opts back into each layer's own ``apply`` —
+# the dense-routing baseline the bench prices the dispatch against.
+# ``moe_stats`` (an int: the live-position bound, the engine's
+# ``max_len``) makes the step ALSO return per-expert load and router
+# entropy over live slots — the serving engine's expert telemetry.
+
+
+def _apply_mlp_decode(mlp, p, s, x, moe_dispatched, routing):
+    """MLP application for the slot decode steps: MoE layers take the
+    decode-specialized dispatched path (:meth:`MoE.decode_apply` —
+    drop-free, fused on TPU) unless the caller opts back into the
+    layer's own ``apply`` (``moe_dispatched=False``, the dense-routing
+    baseline); plain MLPs are untouched. ``routing`` (a list, or None)
+    collects per-MoE-layer ``(num_experts, (topi, full))`` for the
+    expert-load telemetry."""
+    from distkeras_tpu.models.moe import MoE
+    if moe_dispatched and isinstance(mlp, MoE):
+        if routing is None:
+            return mlp.decode_apply(p, x)
+        out, r = mlp.decode_apply(p, x, return_routing=True)
+        routing.append((mlp.num_experts, r))
+        return out
+    out, _ = mlp.apply(p, s, x, training=False)
+    return out
+
+
+def _moe_route_stats(routing, t, w_len: int, live_len: int):
+    """Reduce the collected per-layer routing to the step's expert
+    telemetry: ``expert_load`` [E] (routing-slot assignments per expert,
+    summed over MoE layers — layers whose expert count differs from the
+    first are skipped) and ``router_entropy`` (mean nats of the full
+    router softmax), both masked to LIVE slots (``t < live_len``; the
+    engine's free-slot sentinel routes garbage that must not pollute
+    the load picture). Returns None when no MoE layer ran."""
+    if not routing:
+        return None
+    live = ((t >= 0) & (t < live_len)).astype(jnp.float32)     # [S]
+    e0 = routing[0][0]
+    load = jnp.zeros((e0,), jnp.float32)
+    ent_sum = jnp.zeros((), jnp.float32)
+    n_layers = 0
+    for e, (topi, full) in routing:
+        if e != e0:
+            continue
+        oh = jax.nn.one_hot(topi, e0, dtype=jnp.float32).sum(-2)
+        load = load + (oh * live[:, None, None]).sum((0, 1))
+        p = full.astype(jnp.float32)
+        ent = -(p * jnp.log(p + 1e-9)).sum(-1)                 # [S, W]
+        ent_sum = ent_sum + (ent * live[:, None]).sum()
+        n_layers += 1
+    n_tok = jnp.maximum(live.sum() * w_len * n_layers, 1.0)
+    return {"expert_load": load, "router_entropy": ent_sum / n_tok}
 
 
 def _cache_write_slots(kv, k, v, t):
@@ -679,35 +743,48 @@ def _decode_attn_slots(attn: MultiHeadAttention, p, kv, x, t):
     return y.astype(x.dtype), kv
 
 
-def _decode_block_slots(block: TransformerBlock, p, s, kv, x, t):
+def _decode_block_slots(block: TransformerBlock, p, s, kv, x, t,
+                        moe_dispatched=True, routing=None):
     h, _ = block.norm1.apply(p["norm1"], s["norm1"], x)
     a, kv = _decode_attn_slots(block.attn, p["attn"], kv, h, t)
     x = x + a
     h, _ = block.norm2.apply(p["norm2"], s["norm2"], x)
-    m, _ = block.mlp.apply(p["mlp"], s["mlp"], h, training=False)
+    m = _apply_mlp_decode(block.mlp, p["mlp"], s["mlp"], h,
+                          moe_dispatched, routing)
     return x + m, kv
 
 
-def decode_step_slots(module: Sequential, params, state, cache, tok, t):
+def decode_step_slots(module: Sequential, params, state, cache, tok, t,
+                      *, moe_dispatched: bool = True, moe_stats=None):
     """One token through the stack at PER-SLOT positions: tok [S] int,
     t [S] int; returns ([S, V] logits, cache). Slots whose ``t`` is out
     of cache range (the serving engine's free-slot sentinel) produce
     garbage logits and write nothing — the engine discards them
     host-side. The position-table gather clamps for such slots, which
-    is safe exactly because their output is never consumed."""
+    is safe exactly because their output is never consumed.
+
+    MoE blocks run the decode-specialized dispatched path
+    (``moe_dispatched``; see the section comment above). ``moe_stats``
+    (an int live-position bound) appends a third return value: the
+    ``_moe_route_stats`` dict (None for MoE-free models)."""
     x = tok[:, None]                                     # [S, 1]
     new_cache = list(cache)
+    routing = [] if moe_stats is not None else None
     for i, layer in enumerate(module.layers):
         p, s, kv = params[i], state[i], cache[i]
         block = _decode_block_of(layer)
         if block is not None:
-            x, new_cache[i] = _decode_block_slots(block, p, s, kv, x, t)
+            x, new_cache[i] = _decode_block_slots(
+                block, p, s, kv, x, t, moe_dispatched, routing)
         elif isinstance(layer, PositionalEmbedding):
             x = x + p["embeddings"][t][:, None, :].astype(x.dtype)
         elif isinstance(layer, Dropout):
             pass                                         # eval: identity
         else:
             x, _ = layer.apply(p, s, x, training=False)
+    if moe_stats is not None:
+        return x[:, 0], new_cache, _moe_route_stats(
+            routing, t, 1, int(moe_stats))
     return x[:, 0], new_cache                            # [S, V]
 
 
@@ -795,36 +872,46 @@ def _decode_attn_slots_paged(attn: MultiHeadAttention, p, kv, x, t,
 
 
 def _decode_block_slots_paged(block: TransformerBlock, p, s, kv, x, t,
-                              table, page_len: int):
+                              table, page_len: int,
+                              moe_dispatched=True, routing=None):
     h, _ = block.norm1.apply(p["norm1"], s["norm1"], x)
     a, kv = _decode_attn_slots_paged(block.attn, p["attn"], kv, h, t,
                                      table, page_len)
     x = x + a
     h, _ = block.norm2.apply(p["norm2"], s["norm2"], x)
-    m, _ = block.mlp.apply(p["mlp"], s["mlp"], h, training=False)
+    m = _apply_mlp_decode(block.mlp, p["mlp"], s["mlp"], h,
+                          moe_dispatched, routing)
     return x + m, kv
 
 
 def decode_step_slots_paged(module: Sequential, params, state, cache,
-                            tok, t, table, page_len: int):
+                            tok, t, table, page_len: int,
+                            *, moe_dispatched: bool = True,
+                            moe_stats=None):
     """One token through the stack against a PAGED pooled cache: tok
     [S] int, t [S] int, table [S, P] int page tables; returns
     ([S, V] logits, cache). The paged mirror of ``decode_step_slots``
-    — same garbage-logits contract for sentinel slots."""
+    — same garbage-logits contract for sentinel slots, same
+    ``moe_dispatched``/``moe_stats`` MoE-decode contract."""
     x = tok[:, None]                                     # [S, 1]
     new_cache = list(cache)
+    routing = [] if moe_stats is not None else None
     for i, layer in enumerate(module.layers):
         p, s, kv = params[i], state[i], cache[i]
         block = _decode_block_of(layer)
         if block is not None:
             x, new_cache[i] = _decode_block_slots_paged(
-                block, p, s, kv, x, t, table, page_len)
+                block, p, s, kv, x, t, table, page_len,
+                moe_dispatched, routing)
         elif isinstance(layer, PositionalEmbedding):
             x = x + p["embeddings"][t][:, None, :].astype(x.dtype)
         elif isinstance(layer, Dropout):
             pass                                         # eval: identity
         else:
             x, _ = layer.apply(p, s, x, training=False)
+    if moe_stats is not None:
+        return x[:, 0], new_cache, _moe_route_stats(
+            routing, t, 1, int(moe_stats))
     return x[:, 0], new_cache                            # [S, V]
 
 
@@ -849,7 +936,8 @@ def decode_step_slots_paged(module: Sequential, params, state, cache,
 
 
 def _decode_block_slots_window(block: TransformerBlock, p, s, kv, x, t,
-                               table=None, page_len: int = 0):
+                               table=None, page_len: int = 0,
+                               moe_dispatched=True, routing=None):
     """One TransformerBlock over a [S, W, d] window at per-slot
     positions ``t .. t+W-1``: project the window's q/k/v, write ALL W
     positions into the cache (slab one-hot writes, or page-table
@@ -876,24 +964,30 @@ def _decode_block_slots_window(block: TransformerBlock, p, s, kv, x, t,
     y = _slot_attn_readout(attn, p["attn"], q, view, t, dt)
     x = x + y.astype(x.dtype)
     h, _ = block.norm2.apply(p["norm2"], s["norm2"], x)
-    m, _ = block.mlp.apply(p["mlp"], s["mlp"], h, training=False)
+    m = _apply_mlp_decode(block.mlp, p["mlp"], s["mlp"], h,
+                          moe_dispatched, routing)
     return x + m, kv
 
 
 def _verify_window(module: Sequential, params, state, cache, toks, t,
-                   table, page_len: int):
+                   table, page_len: int, moe_dispatched: bool = True,
+                   moe_stats=None):
     """Shared body of the verify steps: [S, W] window tokens through the
     whole stack at per-slot positions; returns ([S, W, V] logits,
-    cache)."""
+    cache). MoE blocks see the [S, W] window as ONE slot-token batch
+    through the dispatched decode path (capacity = S*W: drop-free even
+    when every window position routes to one expert)."""
     x = toks                                             # [S, W] int
     w_len = toks.shape[1]
     new_cache = list(cache)
+    routing = [] if moe_stats is not None else None
     for i, layer in enumerate(module.layers):
         p, s, kv = params[i], state[i], cache[i]
         block = _decode_block_of(layer)
         if block is not None:
             x, new_cache[i] = _decode_block_slots_window(
-                block, p, s, kv, x, t, table, page_len)
+                block, p, s, kv, x, t, table, page_len,
+                moe_dispatched, routing)
         elif isinstance(layer, PositionalEmbedding):
             pos = t[:, None] + jnp.arange(w_len)         # [S, W]
             x = x + p["embeddings"][pos].astype(x.dtype)
@@ -901,10 +995,14 @@ def _verify_window(module: Sequential, params, state, cache, toks, t,
             pass                                         # eval: identity
         else:
             x, _ = layer.apply(p, s, x, training=False)
+    if moe_stats is not None:
+        return x, new_cache, _moe_route_stats(
+            routing, t, w_len, int(moe_stats))
     return x, new_cache                                  # [S, W, V]
 
 
-def verify_step_slots(module: Sequential, params, state, cache, toks, t):
+def verify_step_slots(module: Sequential, params, state, cache, toks, t,
+                      *, moe_dispatched: bool = True, moe_stats=None):
     """Batched speculative VERIFY against the slab pool: toks [S, W]
     int (window token 0 is the slot's pending decode input, tokens
     1..W-1 its drafts), t [S] int per-slot window start positions;
@@ -912,20 +1010,23 @@ def verify_step_slots(module: Sequential, params, state, cache, toks, t):
     distribution over the token FOLLOWING window position j — the
     greedy accept rule is ``argmax(logits[:, j-1]) == toks[:, j]``.
     Sentinel slots (t out of range) write nothing and produce garbage
-    logits, exactly like ``decode_step_slots``."""
+    logits, exactly like ``decode_step_slots`` — whose
+    ``moe_dispatched``/``moe_stats`` MoE contract also applies."""
     return _verify_window(module, params, state, cache, toks, t,
-                          None, 0)
+                          None, 0, moe_dispatched, moe_stats)
 
 
 def verify_step_slots_paged(module: Sequential, params, state, cache,
-                            toks, t, table, page_len: int):
+                            toks, t, table, page_len: int,
+                            *, moe_dispatched: bool = True,
+                            moe_stats=None):
     """The paged mirror of :func:`verify_step_slots`: window writes
     scatter through the [S, P] page tables (unallocated logical pages
     drop their writes — the engine pre-allocates pages for every
     position a slot may CONSUME, so dropped writes only ever land on
     the rejected tail)."""
     return _verify_window(module, params, state, cache, toks, t,
-                          table, page_len)
+                          table, page_len, moe_dispatched, moe_stats)
 
 
 def _sample(logits, temperature, top_k, rng, top_p=None):

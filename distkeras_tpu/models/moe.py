@@ -288,9 +288,15 @@ class MoE(Layer):
         h = act(jnp.einsum("ecd,edf->ecf", xe, w1) + b1[:, None, :])
         return jnp.einsum("ecf,efd->ecd", h, w2) + b2[:, None, :]
 
-    def _apply_dispatched(self, params, x, *, fused=False):
+    def _apply_dispatched(self, params, x, *, fused=False, capacity=None,
+                          return_routing=False):
         """Capacity-based (sort-free) dispatch — static shapes; see
-        module doc.
+        module doc. ``capacity`` overrides the training-time
+        ``_capacity`` formula (the decode path passes the full token
+        count — drop-free by construction, see :meth:`decode_apply`);
+        ``return_routing`` appends the top-k expert ids ``[B, S, K]``
+        to the return tuple (the serving engine's expert-load
+        telemetry reads them).
 
         Round 5 (dispatch-traffic restructure, measured in docs/PERF.md
         §MoE): slot ``s = k*N + n`` is CHOICE-major, so the slot->token
@@ -314,7 +320,7 @@ class MoE(Layer):
         b, s, d = x.shape
         n = b * s
         e, k = self.num_experts, self.top_k
-        c = self._capacity(n)
+        c = self._capacity(n) if capacity is None else int(capacity)
         full, topi, gates, mask = self._route(x, params["gate"])
 
         dest, _st, sg, keep = _dispatch_plan(
@@ -352,6 +358,8 @@ class MoE(Layer):
                     xt, w1, b1, w2, b2, sg, dest_l, keep_l,
                     capacity=c, activation=self.activation)
                 out = lax.psum(out, self.expert_axis_name)
+            if return_routing:
+                return out.reshape(b, s, d), full, mask, topi
             return out.reshape(b, s, d), full, mask
 
         src = jnp.broadcast_to(xt[None], (k, n, d)).reshape(k * n, d)
@@ -392,7 +400,51 @@ class MoE(Layer):
         safe = jnp.where(keep[:, None], ye_flat[dest], jnp.zeros((), dt))
         contrib = safe * sg[:, None].astype(dt)
         out = contrib.reshape(k, n, d).sum(axis=0)
+        if return_routing:
+            return out.reshape(b, s, d), full, mask, topi
         return out.reshape(b, s, d), full, mask
+
+    def decode_apply(self, params, x, *, return_routing=False):
+        """Decode-specialized dispatched MoE (the serving engine's
+        per-step path; MoE-serving PR).
+
+        ``x`` is the ``[S, W, d]`` slot-token batch of one decode step
+        (W = 1) or speculative-verify window (W = k+1). Capacity is
+        sized to the FULL token count ``n = S * W``: a token's top-k
+        expert ids are distinct, so no expert can receive more than
+        ``n`` arrivals — the dispatch is drop-free BY CONSTRUCTION and
+        the output equals dense routing exactly (same ``_route``
+        weights, same per-token dot products), up to fp reassociation.
+        That is the serving correctness contract: routing can never
+        alter a stream's tokens, and a slot's output is independent of
+        which neighbours share the batch (a dropped slot's keep-flag
+        would otherwise flip with batch composition).
+
+        Execution ignores the layer's configured ``dispatch`` mode —
+        decode-time dispatch is the ENGINE's choice: the fused Pallas
+        gather-into-GEMM runs at decode shapes on TPU
+        (``moe_kernels.fused_supported``, same plan, same %8-padded
+        capacity), the XLA ``tokens`` floor everywhere else. At the
+        small-n decode regime both beat the dense path's
+        ``[S, E, W, f]`` broadcast einsums (measured ~1.1-1.8x per
+        layer on CPU; docs/serving.md §MoE serving has the table).
+
+        Under shard_map expert parallelism (``expert_axis_name``) the
+        weights arrive pre-sliced and the combine psums over the axis
+        — per-chip expert-weight traffic shrinks with the mesh.
+
+        Returns ``[S, W, d]`` (no aux-loss state: decode never
+        trains); with ``return_routing`` also ``(topi [S, W, K], full
+        [S, W, E])`` — the top-k expert ids and the full router softmax
+        — for expert-load/entropy telemetry."""
+        from distkeras_tpu.ops import moe_kernels
+        b, s, _d = x.shape
+        out, full, _mask, topi = self._apply_dispatched(
+            params, x, fused=moe_kernels.fused_supported(),
+            capacity=b * s, return_routing=True)
+        if return_routing:
+            return out.astype(x.dtype), (topi, full)
+        return out.astype(x.dtype)
 
     def apply(self, params, state, x, *, training=False, rng=None):
         dt = jnp.dtype(self.dtype)

@@ -26,6 +26,11 @@ timestamp on the engine clock):
 * ``spec_verify`` — AGGREGATED like ``decode`` (flushed on the same
   cadence): draft tokens proposed vs accepted for this request's
   speculative verify steps since the last flush;
+* ``moe_route`` — AGGREGATED like ``decode`` (flushed on the same
+  cadence, MoE engines only): mean router entropy and the max
+  top-expert share over the iterations this request decoded since the
+  last flush — per-request visibility into the routing concentration
+  that shapes MoE decode cost;
 * ``preempted`` / ``resumed`` — the paged engine evicted the
   request's pages back to the queue under budget pressure / brought
   it back after the recompute prefill (tokens generated so far
@@ -89,7 +94,8 @@ class RequestTimeline:
                  "n_tokens", "events", "dropped_events", "_agg_count",
                  "_agg_t0", "n_preempted", "prefix_hit_tokens",
                  "spec_proposed", "spec_accepted", "_spec_agg_proposed",
-                 "_spec_agg_accepted")
+                 "_spec_agg_accepted", "_moe_agg_n", "_moe_agg_entropy",
+                 "_moe_agg_top")
 
     def __init__(self, rid: int):
         self.rid = rid
@@ -114,6 +120,9 @@ class RequestTimeline:
         self.spec_accepted = 0       # drafts the target accepted
         self._spec_agg_proposed = 0  # since last spec_verify flush
         self._spec_agg_accepted = 0
+        self._moe_agg_n = 0          # MoE iters since last flush
+        self._moe_agg_entropy = 0.0  # summed router entropy (nats)
+        self._moe_agg_top = 0.0      # max top-expert share seen
 
     def add_event(self, name: str, t: float, max_events: int,
                   **fields) -> None:
@@ -139,6 +148,16 @@ class RequestTimeline:
                            accepted=self._spec_agg_accepted)
             self._spec_agg_proposed = 0
             self._spec_agg_accepted = 0
+        if self._moe_agg_n:
+            self.add_event(
+                "moe_route", t, max_events,
+                entropy=round(self._moe_agg_entropy / self._moe_agg_n,
+                              4),
+                top_share=round(self._moe_agg_top, 4),
+                iters=self._moe_agg_n)
+            self._moe_agg_n = 0
+            self._moe_agg_entropy = 0.0
+            self._moe_agg_top = 0.0
 
     def durations(self) -> Dict[str, float]:
         """Per-phase durations. By construction the emitted phases
@@ -218,6 +237,9 @@ class _NullTracer:
         pass
 
     def on_spec_verify(self, items):
+        pass
+
+    def on_moe_route(self, rids, entropy, top_share):
         pass
 
     def on_preempt(self, rid, n_generated=0):
@@ -384,6 +406,23 @@ class RequestTracer:
                 tl.spec_accepted += int(accepted)
                 tl._spec_agg_proposed += int(proposed)
                 tl._spec_agg_accepted += int(accepted)
+
+    def on_moe_route(self, rids, entropy: float,
+                     top_share: float) -> None:
+        """One MoE decode iteration's routing picture for the decoding
+        batch ``rids``: mean router entropy (nats) and the top
+        expert's share of routing assignments. Aggregated onto the
+        decode-event cadence (flushed with ``decode``), so MoE
+        telemetry adds no per-iteration event volume."""
+        with self._lock:
+            for rid in rids:
+                tl = self._live.get(rid)
+                if tl is None:
+                    continue
+                tl._moe_agg_n += 1
+                tl._moe_agg_entropy += float(entropy)
+                if top_share > tl._moe_agg_top:
+                    tl._moe_agg_top = float(top_share)
 
     def on_terminal(self, rid: int, state: str, n_tokens: int = 0) -> None:
         t = self.clock()

@@ -22,7 +22,11 @@ per-step decode kernels and an actual serving workload:
                    traced argument, jit compiled once), chunked
                    prefill interleaved between decode iterations with
                    shared prefixes skipped, page-budget admission and
-                   preemption/resume, per-slot sampling state
+                   preemption/resume, per-slot sampling state; MoE
+                   models decode through the drop-free dispatched
+                   path (optionally shard_map expert-parallel over
+                   ``ep_mesh``) with expert-load telemetry and a
+                   routing-concentration admission cost
     speculation.py ``DraftSource`` draft proposers for speculative
                    decoding — ``NgramDraft`` (prompt-lookup
                    self-drafting, zero extra weights) and

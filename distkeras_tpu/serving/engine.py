@@ -33,6 +33,13 @@ prefill/decode machinery:
   * Per-slot sampling state (temperature / top_k / top_p / stop_token
     vectors through ``_sample_vec``, per-slot PRNG keys) lets greedy
     and sampled requests with different stop tokens share one batch.
+  * MoE models decode DISPATCHED (``moe_decode="dispatched"``, the
+    default): drop-free by construction (``MoE.decode_apply``), so a
+    stream's tokens are independent of its batch neighbours; optional
+    shard_map expert parallelism (``ep_mesh``) shards expert weights
+    over the mesh; expert-load/entropy telemetry and a routing-
+    concentration admission cost ride along (docs/serving.md §MoE
+    serving).
   * ``ServingMetrics`` records TTFT, TPOT, request latency, queue
     depth, slot occupancy and the per-iteration decode rate; the
     request-level layer rides along — per-request timelines
@@ -69,6 +76,7 @@ from distkeras_tpu.obs.slo import SLOEngine
 from distkeras_tpu.obs.tracing import resolve_tracer
 from distkeras_tpu.models.core import Model, Sequential
 from distkeras_tpu.models.decoding import (_attn_compute_dtype,
+                                           _decode_block_of,
                                            _resolve_head_dims,
                                            _sample_vec, _serving_params,
                                            decode_step_slots,
@@ -76,6 +84,7 @@ from distkeras_tpu.models.decoding import (_attn_compute_dtype,
                                            prefill, prefill_chunk_step,
                                            verify_step_slots,
                                            verify_step_slots_paged)
+from distkeras_tpu.models.moe import MoE
 from distkeras_tpu.resilience import faults
 from distkeras_tpu.serving.kv_pool import (KVPool, PagedKVPool,
                                            PrefixCache)
@@ -152,6 +161,35 @@ class ServingEngine:
       acceptance is below the floor stops speculating (the verify
       window costs a (k+1)-wide forward; on a never-accepting stream
       that is pure overhead). Sticky per request.
+
+    MoE knobs (docs/serving.md §MoE serving):
+
+    * ``moe_decode`` — how the decode/verify steps run MoE MLPs:
+      ``"dispatched"`` (default) takes the decode-specialized
+      dispatched path (``MoE.decode_apply`` — capacity = the
+      slot-token count, DROP-FREE by construction, fused Pallas kernel
+      on TPU, tokens path elsewhere), regardless of each layer's
+      configured training ``dispatch``; ``"dense"`` opts back into the
+      layers' own ``apply`` (the dense-routing baseline the
+      ``serving_moe`` bench prices the dispatch against). Either way
+      greedy outputs are token-identical to the dense-routing
+      ``generate()`` oracle — the drop-free capacity is what makes a
+      slot's tokens independent of its batch neighbours.
+    * ``ep_mesh`` — expert-parallel decode: REQUIRED when the model's
+      MoE layers were built with ``expert_axis_name`` (they cannot run
+      outside a shard_map). Every compiled serving program is wrapped
+      in ``shard_map`` over this mesh with the stacked expert weights
+      sharded on the expert axis (everything else replicated), so
+      per-chip expert-weight traffic shrinks with mesh size; the MoE
+      combine psums over the axis inside the program.
+
+    A dispatched-MoE engine also feeds MoE telemetry: per-expert load
+    and router-entropy gauges (``ServingMetrics.record_moe_route``), a
+    ``moe_route`` tracer event on the decode cadence, and a smoothed
+    routing-concentration estimate the paged admission consults
+    (concentrated routing makes the marginal stream more expensive, so
+    admission demands spare-page headroom proportional to it —
+    ``_moe_admit_extra``).
     """
 
     def __init__(self, model: Model, *, num_slots: int = 4,
@@ -167,7 +205,9 @@ class ServingEngine:
                  prefix_granularity: int = 1,
                  draft: Optional[DraftSource] = None, spec_k: int = 4,
                  spec_disable_below: float = 0.1,
-                 spec_warmup: int = 8):
+                 spec_warmup: int = 8,
+                 moe_decode: str = "dispatched",
+                 ep_mesh=None):
         module = model.module
         if not isinstance(module, Sequential):
             raise TypeError("ServingEngine expects a Sequential LM "
@@ -199,6 +239,25 @@ class ServingEngine:
         self._params = (model.params if weights_dtype is None
                         else _serving_params(model.params, weights_dtype))
         self._state = model.state
+
+        # --- MoE serving (MoE-serving PR) -------------------------------
+        if moe_decode not in ("dispatched", "dense"):
+            raise ValueError(
+                f"moe_decode must be 'dispatched' or 'dense', "
+                f"got {moe_decode!r}")
+        self.moe_decode = moe_decode
+        #: the model's MoE MLPs (inside TransformerBlocks), in layer order
+        self._moe = [blk.mlp for blk in
+                     (_decode_block_of(layer) for layer in module.layers)
+                     if blk is not None and isinstance(blk.mlp, MoE)]
+        self._moe_dispatched = bool(self._moe) and \
+            moe_decode == "dispatched"
+        # expert telemetry rides only on the dispatched path (the dense
+        # baseline keeps generate()'s exact program shape)
+        self._moe_stats_on = self._moe_dispatched
+        self._moe_conc: Optional[float] = None   # routing-concentration EMA
+        self._moe_iter = 0                       # stats-throttle counter
+        self._setup_expert_parallel(ep_mesh)
 
         if kv_layout not in ("paged", "slab"):
             raise ValueError(
@@ -322,6 +381,144 @@ class ServingEngine:
     _RECOMPILE_CHECK_EVERY = 64
     #: engine iterations between SLO evaluations (when ``slo`` is set)
     _SLO_EVAL_EVERY = 32
+    #: EMA smoothing for the router-concentration estimate
+    _MOE_CONC_ALPHA = 0.25
+    #: decode iterations between MoE routing-stats reads. The stats are
+    #: computed IN-PROGRAM every step (negligible), but pulling them to
+    #: the host costs extra device syncs per iteration — measured 4x on
+    #: the CPU smoke step when done every iteration. Sampling every
+    #: 16th step keeps the gauges/EMA fresh at decode-agg cadence while
+    #: the hot loop pays one sync set per 16 steps. The FIRST decode
+    #: step always reports (tests and short runs see the picture).
+    _MOE_STATS_EVERY = 16
+    #: admission headroom per unit concentration (pages, as a fraction
+    #: of the request's context pages) — see ``_moe_admit_extra``
+    _MOE_ADMIT_ALPHA = 0.5
+
+    # --- expert-parallel decode (MoE-serving PR) -------------------------
+
+    def _setup_expert_parallel(self, ep_mesh) -> None:
+        """Wire shard_map expert parallelism: models whose MoE layers
+        carry ``expert_axis_name`` must run inside a shard_map, so the
+        engine wraps every compiled program over ``ep_mesh`` with the
+        stacked expert weights sharded on that axis (pre-placed here —
+        each chip holds its E/A experts; everything else replicated).
+        Outputs are replicated: the MoE combine psums over the axis
+        in-program, exactly the layer's existing EP contract."""
+        axes = {m.expert_axis_name for m in self._moe
+                if m.expert_axis_name is not None}
+        self._ep_mesh = self._ep_axis = self._ep_pspec = None
+        if not axes:
+            if ep_mesh is not None:
+                raise ValueError(
+                    "ep_mesh given but no MoE layer carries "
+                    "expert_axis_name — build the model with "
+                    "moe_expert_axis=<axis> to serve expert-parallel")
+            return
+        if len(axes) > 1:
+            raise ValueError(
+                f"MoE layers disagree on expert_axis_name: {axes}")
+        axis = axes.pop()
+        if ep_mesh is None:
+            raise ValueError(
+                f"MoE layers carry expert_axis_name={axis!r}: they can "
+                "only run inside a shard_map — pass "
+                "ServingEngine(ep_mesh=Mesh(...)) carrying that axis")
+        if axis not in ep_mesh.axis_names:
+            raise ValueError(
+                f"ep_mesh axes {ep_mesh.axis_names} do not include the "
+                f"model's expert axis {axis!r}")
+        n_dev = ep_mesh.shape[axis]
+        for m in self._moe:
+            if m.num_experts % n_dev:
+                raise ValueError(
+                    f"num_experts {m.num_experts} not divisible by the "
+                    f"{axis!r} mesh axis size {n_dev}")
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        pspec = jax.tree_util.tree_map(lambda _: P(), self._params)
+        shardings = jax.tree_util.tree_map(
+            lambda _: NamedSharding(ep_mesh, P()), self._params)
+        for i, layer in enumerate(self.module.layers):
+            blk = _decode_block_of(layer)
+            if blk is None or not isinstance(blk.mlp, MoE) \
+                    or blk.mlp.expert_axis_name is None:
+                continue
+            for kk in ("w1", "b1", "w2", "b2"):
+                pspec[i]["mlp"][kk] = P(axis)
+                shardings[i]["mlp"][kk] = NamedSharding(ep_mesh, P(axis))
+        self._ep_mesh, self._ep_axis, self._ep_pspec = ep_mesh, axis, pspec
+        # pre-slice the expert weights onto their chips once — the
+        # whole point: per-chip weight traffic shrinks with the mesh
+        self._params = jax.device_put(self._params, shardings)
+
+    def _jit_serving(self, f, n_args: int):
+        """Compile one serving program: plain ``jax.jit``, or — under
+        expert parallelism — ``jit(shard_map(f))`` with the params
+        (always argument 0) split by the expert specs and every other
+        argument/output replicated (the MoE psum makes outputs agree
+        across the axis)."""
+        if self._ep_mesh is None:
+            return jax.jit(f)
+        from jax.sharding import PartitionSpec as P
+        from distkeras_tpu.compat import shard_map
+        return jax.jit(shard_map(
+            f, mesh=self._ep_mesh,
+            in_specs=(self._ep_pspec,) + (P(),) * (n_args - 1),
+            out_specs=P()))
+
+    # --- MoE routing telemetry / admission cost ---------------------------
+
+    def _note_moe_route(self, stats) -> None:
+        """Host-side sink for one step's MoE routing stats (the extra
+        output of the dispatched decode/verify programs): update the
+        expert-load/entropy gauges, the concentration EMA the paged
+        admission reads, and the per-request ``moe_route`` tracer
+        aggregation (decode cadence). THROTTLED to every
+        ``_MOE_STATS_EVERY``-th decode iteration — reading the device
+        stats costs host syncs the hot loop must not pay per step."""
+        if stats is None:
+            return
+        n = self._moe_iter
+        self._moe_iter = n + 1
+        if n % self._MOE_STATS_EVERY:
+            return                       # unread device arrays just drop
+        load = np.asarray(stats["expert_load"], np.float64)
+        entropy = float(stats["router_entropy"])
+        total = float(load.sum())
+        e = len(load)
+        share = float(load.max()) / total if total > 0 else 0.0
+        if total > 0 and e > 1:
+            # normalize against uniform routing: 0 = balanced, 1 = all
+            # assignments on one expert
+            conc = max(0.0, (share - 1.0 / e) / (1.0 - 1.0 / e))
+            a = self._MOE_CONC_ALPHA
+            self._moe_conc = (conc if self._moe_conc is None
+                              else (1.0 - a) * self._moe_conc + a * conc)
+        self.metrics.record_moe_route(load, entropy,
+                                      self._moe_conc or 0.0)
+        if self.tracer.enabled:
+            self.tracer.on_moe_route(
+                [r.rid for r in self.scheduler.running.values()],
+                entropy, share)
+
+    def _moe_admit_extra(self, req: Request, n_logical: int) -> int:
+        """MoE-aware admission cost: pages of HEADROOM (beyond the
+        request's own context pages) the free-page budget must show
+        before this admission, proportional to the smoothed router
+        concentration. Rationale: under concentrated routing the
+        dispatched decode's per-expert rows pile onto few experts (and,
+        expert-parallel, onto few CHIPS), so the marginal stream buys
+        less throughput — admitting to the last page then forces the
+        preemption churn the budget exists to avoid. Capped so a
+        feasible request can ALWAYS admit into an idle pool: worst-case
+        context + headroom never exceeds the pool (no starvation)."""
+        if not self._moe_stats_on or not self._moe_conc:
+            return 0
+        import math
+        extra = int(math.ceil(
+            self._MOE_ADMIT_ALPHA * self._moe_conc * n_logical))
+        worst = self.pool.pages_for(len(req.prompt) + req.max_new_tokens)
+        return max(0, min(extra, self.pool.num_pages - worst))
 
     def _telemetry_summary(self):
         """obs.attach provider: the CURRENT metrics window's summary
@@ -449,35 +646,60 @@ class ServingEngine:
             module = self.module
             paged = self.kv_layout == "paged"
             page_len = self.page_len
+            moe_kw = dict(
+                moe_dispatched=self._moe_dispatched,
+                moe_stats=self.max_len if self._moe_stats_on else None)
+            stats_on = self._moe_stats_on
 
             def step(params, state, cache, tok, t, tables):
                 if paged:
-                    return decode_step_slots_paged(
+                    out = decode_step_slots_paged(
                         module, params, state, cache, tok, t, tables,
-                        page_len)
-                return decode_step_slots(
-                    module, params, state, cache, tok, t)
+                        page_len, **moe_kw)
+                else:
+                    out = decode_step_slots(
+                        module, params, state, cache, tok, t, **moe_kw)
+                # every variant returns a routing-stats slot (None on
+                # MoE-free / dense-baseline engines) so call sites
+                # unpack one shape
+                return out if stats_on else (out + (None,))
 
             if greedy_only:
-                @jax.jit
-                def fn(params, state, cache, tok, t, tables=None):
-                    logits, cache = step(params, state, cache, tok, t,
-                                         tables)
-                    return jnp.argmax(logits, axis=-1), cache
+                if paged:
+                    def fn(params, state, cache, tok, t, tables):
+                        logits, cache, moe = step(params, state, cache,
+                                                  tok, t, tables)
+                        return jnp.argmax(logits, axis=-1), cache, moe
+                    n_args = 6
+                else:
+                    def fn(params, state, cache, tok, t):
+                        logits, cache, moe = step(params, state, cache,
+                                                  tok, t, None)
+                        return jnp.argmax(logits, axis=-1), cache, moe
+                    n_args = 5
             else:
-                @jax.jit
-                def fn(params, state, cache, tok, t, temp, topk, topp,
-                       keys, tables=None):
-                    logits, cache = step(params, state, cache, tok, t,
-                                         tables)
+                def body(params, state, cache, tok, t, temp, topk, topp,
+                         keys, tables):
+                    logits, cache, moe = step(params, state, cache,
+                                              tok, t, tables)
                     # per-slot key streams: a request's draws depend
                     # only on its own seed, not on which neighbours
                     # share the batch
                     split = jax.vmap(jax.random.split)(keys)
                     nxt = _sample_vec(logits, temp, topk, topp,
                                       split[:, 1])
-                    return nxt, cache, split[:, 0]
+                    return nxt, cache, split[:, 0], moe
 
+                if paged:
+                    fn, n_args = body, 10
+                else:
+                    def fn(params, state, cache, tok, t, temp, topk,
+                           topp, keys):
+                        return body(params, state, cache, tok, t, temp,
+                                    topk, topp, keys, None)
+                    n_args = 9
+
+            fn = self._jit_serving(fn, n_args)
             self._step_fns[greedy_only] = fn
             self._recompile.watch(
                 "serving.decode_greedy" if greedy_only
@@ -512,14 +734,20 @@ class ServingEngine:
             paged = self.kv_layout == "paged"
             page_len = self.page_len
             k = self.spec_k
+            moe_kw = dict(
+                moe_dispatched=self._moe_dispatched,
+                moe_stats=self.max_len if self._moe_stats_on else None)
+            stats_on = self._moe_stats_on
 
             def vstep(params, state, cache, toks, t, tables):
                 if paged:
-                    return verify_step_slots_paged(
+                    out = verify_step_slots_paged(
                         module, params, state, cache, toks, t, tables,
-                        page_len)
-                return verify_step_slots(
-                    module, params, state, cache, toks, t)
+                        page_len, **moe_kw)
+                else:
+                    out = verify_step_slots(
+                        module, params, state, cache, toks, t, **moe_kw)
+                return out if stats_on else (out + (None,))
 
             def accept(cand, toks, active):
                 # longest prefix of drafts matching the target's own
@@ -530,19 +758,24 @@ class ServingEngine:
                 return jnp.where(active, n_acc, 0)
 
             if greedy_only:
-                @jax.jit
-                def fn(params, state, cache, toks, t, active,
-                       tables=None):
-                    logits, cache = vstep(params, state, cache, toks, t,
-                                          tables)
+                def body(params, state, cache, toks, t, active, tables):
+                    logits, cache, moe = vstep(params, state, cache,
+                                               toks, t, tables)
                     cand = jnp.argmax(logits, axis=-1)     # [S, k+1]
-                    return cand, accept(cand, toks, active), cache
+                    return cand, accept(cand, toks, active), cache, moe
+
+                if paged:
+                    fn, n_args = body, 7
+                else:
+                    def fn(params, state, cache, toks, t, active):
+                        return body(params, state, cache, toks, t,
+                                    active, None)
+                    n_args = 6
             else:
-                @jax.jit
-                def fn(params, state, cache, toks, t, active, temp,
-                       topk, topp, keys, tables=None):
-                    logits, cache = vstep(params, state, cache, toks, t,
-                                          tables)
+                def body(params, state, cache, toks, t, active, temp,
+                         topk, topp, keys, tables):
+                    logits, cache, moe = vstep(params, state, cache,
+                                               toks, t, tables)
                     cands, carries = [], []
                     cur = keys
                     for j in range(k + 1):
@@ -559,8 +792,19 @@ class ServingEngine:
                     # plain decode iterations would have done
                     new_keys = jnp.stack(carries, axis=1)[
                         jnp.arange(cand.shape[0]), n_acc]
-                    return cand, n_acc, cache, new_keys
+                    return cand, n_acc, cache, new_keys, moe
 
+                if paged:
+                    fn, n_args = body, 11
+                else:
+                    def fn(params, state, cache, toks, t, active, temp,
+                           topk, topp, keys):
+                        return body(params, state, cache, toks, t,
+                                    active, temp, topk, topp, keys,
+                                    None)
+                    n_args = 10
+
+            fn = self._jit_serving(fn, n_args)
             self._spec_fns[greedy_only] = fn
             self._recompile.watch(
                 "serving.verify_greedy" if greedy_only
@@ -633,7 +877,9 @@ class ServingEngine:
                     return prefill_chunk_step(module, params, state,
                                               cache, chunk, t0,
                                               final=final)
-            fn = jax.jit(f)
+            # EP models shard_map-wrap here too: prefill runs the MoE
+            # layers' own apply, which psums over the expert axis
+            fn = self._jit_serving(f, 4)
         # re-insert at the back: dict order is the LRU order
         self._prefill_fns[key] = fn
         while len(self._prefill_fns) > self.MAX_PREFILL_PROGRAMS:
@@ -706,15 +952,19 @@ class ServingEngine:
         if donor is not None:
             pool.incref(donor)           # held until loaded to staging
         n_private = n_logical - len(full)
-        if pool.free_pages < n_private and self.prefix is not None:
-            deficit = n_private - pool.free_pages
+        # MoE-aware admission cost: under concentrated routing the
+        # free-page budget must also show headroom pages (never
+        # allocated — just required free) before this stream admits
+        need = n_private + self._moe_admit_extra(req, n_logical)
+        if pool.free_pages < need and self.prefix is not None:
+            deficit = need - pool.free_pages
             # reclaim ONLY when it can actually close the gap: an
             # unfundable admission must not drain the reusable prefix
             # cache for nothing (it would strip sharing from every
             # later same-template request while the head stays queued)
             if self.prefix.evictable_pages() >= deficit:
                 self.prefix.reclaim(deficit)
-        if pool.free_pages < n_private:
+        if pool.free_pages < need:
             for pid in full:
                 pool.decref(pid)
             if donor is not None:
@@ -1123,6 +1373,15 @@ class ServingEngine:
                          "preempted": m.requests_preempted},
             "telemetry": obs.telemetry_snapshot(),
         }
+        if self._moe:
+            out["moe"] = {
+                "decode": self.moe_decode,
+                "layers": len(self._moe),
+                "concentration": (None if self._moe_conc is None
+                                  else round(self._moe_conc, 4)),
+                "expert_parallel": (None if self._ep_mesh is None
+                                    else int(self._ep_mesh.shape[
+                                        self._ep_axis]))}
         if self.kv_layout == "paged":
             pool = self.pool
             out["pages"] = {
@@ -1286,11 +1545,11 @@ class ServingEngine:
                 n_tokens=n_emitted)
             return
         if greedy_only:
-            nxt, self.pool.cache = self._decode_fn(True)(
+            nxt, self.pool.cache, moe = self._decode_fn(True)(
                 self._params, self._state, self.pool.cache,
                 self._tok, self._t, *tables)
         else:
-            nxt, self.pool.cache, keys = self._decode_fn(False)(
+            nxt, self.pool.cache, keys, moe = self._decode_fn(False)(
                 self._params, self._state, self.pool.cache,
                 self._tok, self._t, self._temp, self._topk, self._topp,
                 self._keys, *tables)
@@ -1305,6 +1564,7 @@ class ServingEngine:
         # the per-iteration host sync: the scheduler must see token ids
         # to detect stops and free slots (docs/serving.md, follow-ups)
         nxt = np.asarray(nxt)
+        self._note_moe_route(moe)
         if self.tracer.enabled:
             # one aggregated decode tick per running request (the
             # tracer folds decode_agg of these into one stored event)
@@ -1339,11 +1599,12 @@ class ServingEngine:
                               axis=1).astype(np.int32)
         active_dev = jnp.asarray(active)
         if greedy_only:
-            cand, n_acc, self.pool.cache = self._verify_fn(True)(
+            cand, n_acc, self.pool.cache, moe = self._verify_fn(True)(
                 self._params, self._state, self.pool.cache, toks,
                 self._t, active_dev, *tables)
         else:
-            cand, n_acc, self.pool.cache, keys = self._verify_fn(False)(
+            (cand, n_acc, self.pool.cache, keys,
+             moe) = self._verify_fn(False)(
                 self._params, self._state, self.pool.cache, toks,
                 self._t, active_dev, self._temp, self._topk,
                 self._topp, self._keys, *tables)
@@ -1355,6 +1616,7 @@ class ServingEngine:
             self._recompile.mark_warm(name)
         cand = np.asarray(cand)
         n_acc = np.asarray(n_acc)
+        self._note_moe_route(moe)
         if self.tracer.enabled:
             self.tracer.on_decode([r.rid for r in running.values()])
         n_emitted = 0

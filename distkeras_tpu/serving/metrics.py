@@ -33,6 +33,8 @@ from __future__ import annotations
 from collections import deque
 from typing import Dict, List, Optional
 
+import numpy as np
+
 from distkeras_tpu.obs import MetricsRegistry
 from distkeras_tpu.utils.profiling import StepTimer, now
 
@@ -101,6 +103,17 @@ class ServingMetrics:
             "serving.spec_accept_rate")
         self._spec_disabled = self.registry.counter(
             "serving.spec_disabled")
+        # MoE serving (MoE-serving PR): per-expert routing load (one
+        # gauge series per expert id — BOUNDED by the model's expert
+        # count), the router-entropy gauge, and the concentration the
+        # engine's MoE-aware admission reads. Unset (None) on MoE-free
+        # engines — summary keys stay layout-honest like "pages"
+        self._moe_load = self.registry.gauge("serving.moe_expert_load")
+        self._moe_entropy = self.registry.gauge(
+            "serving.moe_router_entropy")
+        self._moe_conc = self.registry.gauge(
+            "serving.moe_concentration")
+        self._moe_experts = 0            # label-set bound, for summary
         #: exact (tokens, seconds) aggregation per decoding-slot count —
         #: bounded by the slot count, and authoritative for
         #: ``decode_tokens_per_sec`` (the labeled counters mirror it for
@@ -198,6 +211,21 @@ class ServingMetrics:
         """The acceptance EMA kicked one stream back to plain decode."""
         self._spec_disabled.inc()
 
+    def record_moe_route(self, expert_load, entropy: float,
+                         concentration: float) -> None:
+        """One decode iteration's MoE routing picture: ``expert_load``
+        [E] routing-slot assignments per expert (summed over the
+        model's MoE layers, live slots only), the mean router entropy
+        (nats), and the engine's smoothed concentration (0 = uniform
+        routing, 1 = everything on one expert). One gauge series per
+        expert id — the label set is bounded by E."""
+        load = np.asarray(expert_load, np.float64)
+        self._moe_experts = max(self._moe_experts, len(load))
+        for e, v in enumerate(load):
+            self._moe_load.set(float(v), expert=str(e))
+        self._moe_entropy.set(float(entropy))
+        self._moe_conc.set(float(concentration))
+
     # --- per-iteration ----------------------------------------------------
 
     def record_prefill_chunk(self) -> None:
@@ -271,6 +299,15 @@ class ServingMetrics:
         if prop <= 0:
             return None
         return self._spec_accepted.value() / prop
+
+    @property
+    def moe_expert_load(self) -> Optional[List[float]]:
+        """Last-iteration per-expert routing load (None on MoE-free
+        engines or before the first MoE decode step)."""
+        if not self._moe_experts:
+            return None
+        return [self._moe_load.value(expert=str(e)) or 0.0
+                for e in range(self._moe_experts)]
 
     @property
     def prefix_hit_rate(self) -> Optional[float]:
@@ -354,6 +391,13 @@ class ServingMetrics:
             # speculative decoding (keys ADDED by the spec-decode PR):
             # aggregate acceptance plus the per-slot-per-iteration
             # acceptance-rate percentiles bench records
+            # MoE serving (keys ADDED by the MoE-serving PR): the
+            # last iteration's expert-load picture; None on MoE-free
+            # engines
+            "moe": (None if not self._moe_experts else {
+                "expert_load": self.moe_expert_load,
+                "router_entropy": self._moe_entropy.value(),
+                "concentration": self._moe_conc.value()}),
             "acceptance_rate": self.acceptance_rate,
             "speculation": {
                 "proposed": self.spec_proposed,

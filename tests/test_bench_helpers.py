@@ -154,20 +154,40 @@ def test_summary_line_surfaces_regression_flags():
 
 def test_regression_check_skips_cross_hardware_comparison():
     """A CPU smoke run vs a TPU-captured record must not flag a bogus
-    100x 'drop' — the vs-prev comparison is gated on device_kind (the
-    in-run below-anchor check still applies)."""
+    100x 'drop' — anchors carry device_kind, and a prior-round record
+    from different hardware reports as a STALE ANCHOR instead of
+    flagging every run (the in-run below-anchor check still applies)."""
     prev = {"m": {"value": 64000.0, "vs_baseline": 2.0}}
     rec = {"metric": "m", "value": 600.0, "vs_baseline": 2.0,
            "device_kind": "cpu"}
     out = bench._regression_check(rec, prev, "BENCH_r05.json",
                                   prev_kind="TPU v5 lite")
     assert "flags" not in out and "value_vs_prev" not in out
-    assert "device_kind" in out["prev_skipped"]
+    assert "device_kind" in out["stale_anchor"]
+    assert "stale" in out["stale_anchor"]
     # same hardware: the comparison runs and flags
     out = bench._regression_check(dict(rec, device_kind="TPU v5 lite"),
                                   prev, "BENCH_r05.json",
                                   prev_kind="TPU v5 lite")
     assert any("dropped" in f for f in out["flags"])
+
+
+def test_summary_line_surfaces_stale_anchors():
+    """The cumulative summary line names the families whose prior-round
+    anchor came from different hardware (one shared note, not flags)."""
+    records = [
+        {"metric": "a", "value": 1.0, "vs_baseline": 1.1,
+         "regression": {"stale_anchor":
+                        "BENCH_r05.json was captured on device_kind "
+                        "'TPU v5 lite', this run is 'cpu': cross-device "
+                        "anchor is stale, vs-prev comparison skipped"}},
+        {"metric": "b", "value": 2.0, "vs_baseline": 1.5,
+         "regression": None},
+    ]
+    parsed = json.loads(bench._summary_line(records, "cpu"))
+    assert parsed["stale_anchors"] == ["a"]
+    assert "stale" in parsed["stale_anchor_note"]
+    assert "regressions" not in parsed
 
 
 def test_regression_check_inverts_for_lower_is_better_metric():

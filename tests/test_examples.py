@@ -101,6 +101,15 @@ def test_packed_moe_serving_example(capsys):
     assert "cross-document logit leak" in out and "OK" in out
 
 
+def test_moe_serving_example(capsys):
+    matches = run_example("examples.moe_serving")
+    out = capsys.readouterr().out
+    assert "token-identical to generate()" in out
+    assert "expert_load" in out and "moe_route" in out
+    assert "expert-parallel decode over" in out
+    assert matches == 4 and "OK" in out
+
+
 def test_telemetry_tour_example(capsys):
     acc = run_example("examples.telemetry_tour")
     out = capsys.readouterr().out

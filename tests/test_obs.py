@@ -568,7 +568,10 @@ def test_serving_summary_keys_are_backward_compatible():
         "requests_preempted", "pages", "prefix_cache",
         # speculative decoding ADDED by the spec-decode PR
         # ("acceptance_rate" is None before any verify ran)
-        "acceptance_rate", "speculation"}
+        "acceptance_rate", "speculation",
+        # expert-load tally ADDED by the MoE-serving PR ("moe" is None
+        # on MoE-free / dense-baseline engines)
+        "moe"}
 
 
 # --- integration: prefetch gauges -------------------------------------------
