@@ -996,6 +996,103 @@ def bench_spec_decode(num_slots: int, prompt_len: int, new_tokens: int,
     return out
 
 
+def bench_serving_overlap(num_slots: int, prompt_len: int,
+                          new_tokens: int, n_passes: int,
+                          fuse_steps: int = 8, cfg=None):
+    """Zero-bubble serving loop (this PR): engine decode tokens/s with
+    pipelined dispatch (``overlap=True``, the engine default) and the
+    fused multi-step window (``fuse_steps=K``) vs the synchronous
+    launch-and-wait loop (``overlap=False``), on a DELIBERATELY TINY
+    model. Tiny is the point: the zero-bubble machinery hides the
+    per-iteration HOST work behind device execution, so its win is
+    proportional to host-time/step-time — a model whose decode step is
+    a few hundred microseconds puts that ratio near 1 and makes the
+    A/B a sensitive host-bubble meter on any backend (on the big
+    configs the same host work vanishes into multi-ms steps and the
+    families below resolve nothing). Closed-loop drive (all
+    ``num_slots`` requests up front, drained): steady-state decode
+    rate, no arrival noise.
+
+    Each variant is ONE warmed engine reused across passes (bench
+    hygiene); the ``host_loop_us_per_iter`` telemetry rider records
+    wall-seconds-minus-sanctioned-fetch-wait per engine iteration —
+    the host loop's own cost, the number this PR drives toward zero.
+
+    Returns ``{variant: {tok_s, passes, host_loop_us_per_iter}}`` for
+    variants ``sync`` / ``overlap`` / ``fused``."""
+    from distkeras_tpu.models import Model, zoo
+    from distkeras_tpu.serving import ServingEngine, ServingMetrics
+
+    cfg = cfg or dict(vocab=128, d_model=64, num_heads=2, num_layers=2,
+                      mlp_ratio=2)
+    max_len = prompt_len + new_tokens
+    model = Model.build(zoo.transformer_lm(
+        cfg["vocab"], d_model=cfg["d_model"], num_heads=cfg["num_heads"],
+        num_layers=cfg["num_layers"], mlp_ratio=cfg["mlp_ratio"],
+        use_rope=True), (max_len,), seed=0)
+    rs = np.random.RandomState(0)
+    prompts = [rs.randint(0, cfg["vocab"], (prompt_len,))
+               .astype(np.int32) for _ in range(num_slots)]
+    engines = {
+        "sync": ServingEngine(model, num_slots=num_slots,
+                              max_len=max_len, overlap=False),
+        "overlap": ServingEngine(model, num_slots=num_slots,
+                                 max_len=max_len),
+        "fused": ServingEngine(model, num_slots=num_slots,
+                               max_len=max_len, fuse_steps=fuse_steps),
+    }
+    for eng in engines.values():
+        # warm-up: compiles prefill + decode (+ the fused window)
+        eng.submit(prompts[0], new_tokens)
+        eng.run(max_steps=100_000)
+
+    def drive(eng):
+        eng.metrics = ServingMetrics()
+        it0, f0 = eng._iters, eng.fetch_seconds
+        t0 = time.perf_counter()
+        for p in prompts:
+            eng.submit(p, new_tokens)
+        eng.run(max_steps=200_000)
+        wall = time.perf_counter() - t0
+        # WALL tokens/s, not the decode-phase rate: the A/B's whole
+        # point is end-to-end throughput of identical token work, and
+        # every variant pays the same prefill ramp inside the window
+        rate = num_slots * new_tokens / wall
+        iters = max(1, eng._iters - it0)
+        return rate, (wall - (eng.fetch_seconds - f0)) / iters * 1e6
+
+    # every variant runs back to back WITHIN each pass, so machine-
+    # load drift across passes cancels in the per-pass ratios (the
+    # same interleave discipline as bench_serving's raw-loop probe) —
+    # the shared-core smoke box swings 2x over tens of seconds
+    rates = {n: [] for n in engines}
+    host_us = {n: [] for n in engines}
+    for i in range(n_passes):
+        for name, eng in engines.items():
+            r, us = drive(eng)
+            rates[name].append(r)
+            host_us[name].append(us)
+        line = ", ".join(
+            f"{n} {rates[n][-1]:.0f} tok/s ({host_us[n][-1]:.0f} "
+            f"us/iter host)" for n in engines)
+        print(f"serving_overlap pass {i}: {line}",
+              file=sys.stderr, flush=True)
+    out = {}
+    for name in engines:
+        out[name] = {
+            "tok_s": round(statistics.median(rates[name]), 1),
+            "passes": [round(r, 1) for r in rates[name]],
+            "host_loop_us_per_iter": round(
+                statistics.median(host_us[name]), 1),
+        }
+        if name != "sync":
+            # median of PER-PASS ratios (not ratio of medians): each
+            # pass's variant and sync ran back to back
+            out[name]["ratio_vs_sync"] = round(statistics.median(
+                r / s for r, s in zip(rates[name], rates["sync"])), 3)
+    return out
+
+
 #: the serving_moe bench's MoE LM shape (accelerator tier): every block
 #: MoE, E=8 top-2, expert ratio 2 — the serving-side sibling of the
 #: moe_lm_train family's config, scaled to a decode-bound engine run
@@ -1628,6 +1725,7 @@ def main():
     ap.add_argument("--model", choices=["all", "resnet50", "lm", "lm_big",
                                         "generate", "generate_long",
                                         "serving", "spec_decode",
+                                        "serving_overlap",
                                         "serving_moe", "moe",
                                         "overlap"],
                     default="all",
@@ -1635,6 +1733,8 @@ def main():
                     "generate_long (P=2048/8192 serving grid) + serving "
                     "(continuous-batching engine, open-loop trace) + "
                     "spec_decode (speculative decoding on/off) + "
+                    "serving_overlap (zero-bubble loop vs synchronous "
+                    "A/B on a tiny host-bound model) + "
                     "serving_moe (dispatched vs dense-routing MoE "
                     "decode) + moe + lm_big, one JSON line each (ResNet "
                     "headline first, cumulative summary line last)")
@@ -1698,7 +1798,7 @@ def main():
         records = []
         for mode in ("resnet50", "lm", "overlap", "generate",
                      "generate_long", "serving", "spec_decode",
-                     "serving_moe", "moe", "lm_big"):
+                     "serving_overlap", "serving_moe", "moe", "lm_big"):
             if base_profile:
                 args.profile = f"{base_profile.rstrip('/')}/{mode}"
             try:
@@ -2172,6 +2272,53 @@ def _run_mode(mode, args, on_accel, peak, device_kind):
                     "spec-off rate of the same engine; "
                     "accept_rate_percentiles = per-slot per-iteration "
                     "draft acceptance distribution",
+            "device_kind": device_kind,
+        }
+        return _emit(rec)
+
+    if mode == "serving_overlap":
+        if on_accel:
+            num_slots, prompt_len, new_tokens = 8, 32, 96
+            n_passes, fuse_k = 3, 8
+        else:
+            # 5 passes: the tiny-model rates are host-noise-sensitive
+            # (shared cores on the CPU smoke); the median needs the
+            # extra samples to be stable run over run
+            num_slots, prompt_len, new_tokens = 4, 8, 48
+            n_passes, fuse_k = 5, 8
+        out = bench_serving_overlap(num_slots, prompt_len, new_tokens,
+                                    n_passes, fuse_steps=fuse_k)
+        sync, ov, fu = out["sync"], out["overlap"], out["fused"]
+        best = max(ov, fu, key=lambda v: v["ratio_vs_sync"])
+        rec = {
+            "metric": "serving_overlap_decode_tokens_per_sec_per_chip",
+            "value": best["tok_s"],
+            "unit": "tokens/sec",
+            # the acceptance ratio: the zero-bubble loop's best variant
+            # (pipelined or fused) vs the synchronous launch-and-wait
+            # loop on the tiny host-bound model (>= 1.3 CPU-smoke
+            # criterion; the below-anchor tripwire flags < 0.9)
+            "vs_baseline": best["ratio_vs_sync"],
+            "sync": sync,
+            "overlap": ov,
+            "fused": fu,
+            "overlap_ratio": ov["ratio_vs_sync"],
+            "fused_ratio": fu["ratio_vs_sync"],
+            "host_loop_us_per_iter": {
+                k: v["host_loop_us_per_iter"] for k, v in out.items()},
+            "fuse_steps": fuse_k,
+            "num_slots": num_slots,
+            "prompt_len": prompt_len,
+            "new_tokens": new_tokens,
+            "criterion": ">= 1.3x engine decode tok/s vs the "
+                         "synchronous loop on the tiny-model smoke "
+                         "(step time ~ host time); existing serving "
+                         "families must hold >= 0.95x and the raw-loop "
+                         "ratio >= 0.9",
+            "note": "deliberately tiny model: the win is proportional "
+                    "to host-time/step-time, so this family meters the "
+                    "host bubble itself; host_loop_us_per_iter = wall "
+                    "minus sanctioned-fetch wait per engine iteration",
             "device_kind": device_kind,
         }
         return _emit(rec)

@@ -1029,6 +1029,97 @@ def verify_step_slots_paged(module: Sequential, params, state, cache,
                           table, page_len, moe_dispatched, moe_stats)
 
 
+# --- fused multi-step decode (zero-bubble serving PR) -----------------------
+#
+# In steady-state serving (no admissions, no prefill, no speculation)
+# every iteration is the SAME per-slot decode step; dispatching them one
+# at a time leaves a host gap between device steps — on TPU, where a
+# step is ~1-5 ms, that gap is the throughput ceiling. The fused window
+# compiles K plain iterations as ONE ``lax.scan`` program: the carry
+# feeds each step's sampled token back as the next step's input
+# (device-side — the host never sees intermediate tokens), per-slot
+# ``done`` masks reproduce ``generate()``'s stop-token padding (a slot
+# that emits its stop keeps emitting it for the rest of the window, so
+# the host can truncate the emitted buffer at the first stop), and the
+# program emits the whole [S, K] token block in one fetch. Every step
+# inside the window is bitwise the single-step program's computation —
+# same cache writes, same sampler, same per-slot key splits — so fused
+# output is token-identical (byte-identical for sampled streams) to K
+# separate iterations.
+
+
+def decode_fused_slots(module: Sequential, params, state, cache, tok, t,
+                       stop, num_steps: int, table=None,
+                       page_len: int = 0, *, temperature=None,
+                       top_k=None, top_p=None, keys=None,
+                       moe_dispatched: bool = True, moe_stats=None):
+    """``num_steps`` consecutive ``decode_step_slots[_paged]``
+    iterations as one compiled scan. tok/t: [S] ints (per-slot pending
+    input and write position); ``stop``: [S] int per-slot stop tokens
+    (-1 = never). Greedy when ``temperature`` is None; otherwise
+    ``temperature``/``top_k``/``top_p`` are the [S] per-slot sampling
+    vectors and ``keys`` the [S] per-slot PRNG keys, split once per
+    step exactly like the single-step sampled program (byte-identical
+    streams). Returns ``(toks [S, num_steps], cache, keys_or_None,
+    moe_stats_or_None)`` — ``toks[:, j]`` is the token emitted by
+    window step j; after a slot's stop token fires, its remaining
+    window positions repeat the stop (``generate()``'s padding rule).
+    Sentinel slots (t out of range) ride along writing nothing.
+
+    Cache contract: step j writes position ``t + j`` for every slot —
+    the caller must have every page under ``t .. t+num_steps-1``
+    allocated for positions it intends to CONSUME (paged writes to
+    unallocated pages drop; post-stop writes land as stale-tail
+    garbage, overwritten before any mask admits them)."""
+    greedy = temperature is None
+    stats_on = moe_stats is not None
+
+    def body(carry, _):
+        if greedy:
+            cache, cur, tcur, done = carry
+        else:
+            cache, cur, tcur, done, ks = carry
+        kw = dict(moe_dispatched=moe_dispatched, moe_stats=moe_stats)
+        if table is not None:
+            out = decode_step_slots_paged(module, params, state, cache,
+                                          cur, tcur, table, page_len,
+                                          **kw)
+        else:
+            out = decode_step_slots(module, params, state, cache, cur,
+                                    tcur, **kw)
+        if stats_on:
+            logits, cache, st = out
+        else:
+            logits, cache = out
+        if greedy:
+            nxt = jnp.argmax(logits, axis=-1).astype(cur.dtype)
+        else:
+            split = jax.vmap(jax.random.split)(ks)
+            ks = split[:, 0]
+            nxt = _sample_vec(logits, temperature, top_k, top_p,
+                              split[:, 1]).astype(cur.dtype)
+        # generate()'s stop rule, per slot: done rows hold the stop
+        # token (padding the window), and a freshly emitted stop marks
+        # the row done for the remaining steps
+        nxt = jnp.where(done, stop.astype(cur.dtype), nxt)
+        done = done | ((nxt == stop) & (stop >= 0))
+        carry = (cache, nxt, tcur + 1, done) + (() if greedy else (ks,))
+        return carry, ((nxt,) if not stats_on else (nxt, st))
+
+    done0 = jnp.zeros(tok.shape, bool)
+    carry0 = (cache, tok, t, done0) + (() if greedy else (keys,))
+    carry, ys = lax.scan(body, carry0, None, length=int(num_steps))
+    toks = jnp.swapaxes(ys[0], 0, 1)                     # [S, K]
+    new_cache = carry[0]
+    new_keys = None if greedy else carry[4]
+    stats = None
+    if stats_on:
+        # the LAST window step's routing picture (the engine's stats
+        # throttle reads at most one sample per window anyway)
+        stats = jax.tree_util.tree_map(lambda a: a[-1], ys[1])
+    return toks, new_cache, new_keys, stats
+
+
 def _sample(logits, temperature, top_k, rng, top_p=None):
     if temperature == 0.0:
         return jnp.argmax(logits, axis=-1)

@@ -20,9 +20,13 @@ timestamp on the engine clock):
   ``ceil(max_len / prefill_chunk)`` per request);
 * ``first_token`` — prefill complete, first sample emitted (the TTFT
   edge);
-* ``decode`` — AGGREGATED: one event per ``decode_agg`` engine
-  iterations (not per token — the hot loop stays cheap), plus a final
-  flush at terminal;
+* ``decode`` — AGGREGATED: one event per ``decode_agg`` decode ticks
+  (not per token — the hot loop stays cheap), plus a final flush at
+  terminal. Since the zero-bubble serving loop, the engine delivers
+  ticks in deferred batches (``on_decode_batch``, one call per host
+  window rather than one ``on_decode`` per iteration), back-dated to
+  the window start — totals are exact, event timestamps are
+  window-granular;
 * ``spec_verify`` — AGGREGATED like ``decode`` (flushed on the same
   cadence): draft tokens proposed vs accepted for this request's
   speculative verify steps since the last flush;
@@ -236,6 +240,9 @@ class _NullTracer:
     def on_decode(self, rids):
         pass
 
+    def on_decode_batch(self, ticks, t0=None):
+        pass
+
     def on_spec_verify(self, items):
         pass
 
@@ -378,17 +385,34 @@ class RequestTracer:
     def on_decode(self, rids) -> None:
         """One engine decode iteration over ``rids`` (the decoding
         batch). Aggregated: one stored event per ``decode_agg``
-        iterations per request."""
+        iterations per request. One tick per rid — the aggregation
+        rule lives in :meth:`on_decode_batch`."""
+        ticks: Dict[int, int] = {}
+        for rid in rids:
+            ticks[rid] = ticks.get(rid, 0) + 1
+        self.on_decode_batch(ticks)
+
+    def on_decode_batch(self, ticks: Dict[int, int],
+                        t0: Optional[float] = None) -> None:
+        """Deferred decode ticks (zero-bubble serving loop): ``ticks``
+        maps ``rid -> n`` decode ticks accumulated since the engine's
+        last host-window flush (one tick per emitted token — for plain
+        decode that IS one per iteration; a fused K-step window ticks
+        once per token it emitted). ``t0`` back-dates the window start
+        so the aggregated ``decode`` events still bracket the real
+        span. Equivalent to ``n`` single-rid ``on_decode`` calls,
+        batched so the serving hot loop pays one lock/clock per window
+        instead of one per iteration."""
         t = self.clock()
         with self._lock:
-            for rid in rids:
+            for rid, n in ticks.items():
                 tl = self._live.get(rid)
                 if tl is None:
                     continue
-                tl.decode_iters += 1
+                tl.decode_iters += int(n)
                 if tl._agg_count == 0:
-                    tl._agg_t0 = t
-                tl._agg_count += 1
+                    tl._agg_t0 = t0 if t0 is not None else t
+                tl._agg_count += int(n)
                 if tl._agg_count >= self.decode_agg:
                     tl.flush_decode(t, self.max_events)
 

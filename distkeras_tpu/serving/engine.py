@@ -53,12 +53,25 @@ Greedy outputs are token-identical per request to a standalone
 ``prefill_chunk_step`` programs at batch 1, and the per-slot decode
 step is the same storage-dtype einsum attention with a per-slot mask.
 
-Deliberate scope (docs/serving.md spells out the follow-ups): the
-decode loop syncs next-token ids to the host every iteration (the
-scheduler needs them for stop detection) — on-device stop handling and
-cache-buffer donation are TPU-latency follow-ups; weight trees support
-``weights_dtype="auto"``-style pre-casting but not int8; prompts longer
-than ``max_len - max_new_tokens`` are rejected at submit.
+Zero-bubble loop (this PR, docs/serving.md §Zero-bubble loop): the
+decode path no longer blocks on next-token ids every iteration.
+``overlap=True`` (the default) pipelines dispatch — iteration i+1's
+step is launched with iteration i's token ids fed back DEVICE-side
+(JAX async dispatch keeps the device busy) while the host consumes a
+LAGGED fetch of iteration i's tokens; ``fuse_steps=K`` additionally
+compiles K consecutive decode iterations as one ``lax.scan`` program
+(``models.decoding.decode_fused_slots`` — in-program per-slot stop
+masks, engaged only when the scheduler is quiescent), eliminating
+per-iteration dispatch entirely in steady state. Host-side per-request
+bookkeeping (tracer ticks, metrics, recorder-ring composition) is
+batched onto a deferred per-window cadence. Outputs stay
+token-identical (byte-identical for sampled streams) to the
+synchronous loop (``overlap=False``) — the oracle suite pins it.
+
+Remaining deliberate scope: cache-buffer donation is a TPU-latency
+follow-up; weight trees support ``weights_dtype="auto"``-style
+pre-casting but not int8; prompts longer than
+``max_len - max_new_tokens`` are rejected at submit.
 """
 
 from __future__ import annotations
@@ -79,6 +92,7 @@ from distkeras_tpu.models.decoding import (_attn_compute_dtype,
                                            _decode_block_of,
                                            _resolve_head_dims,
                                            _sample_vec, _serving_params,
+                                           decode_fused_slots,
                                            decode_step_slots,
                                            decode_step_slots_paged,
                                            prefill, prefill_chunk_step,
@@ -112,6 +126,41 @@ class DegradedRequest(RuntimeError):
             "— drive with step() to observe terminal states, or "
             "run(on_degraded='return') to accept partial tokens")
         self.request = request
+
+
+def _snap(a: np.ndarray):
+    """Device snapshot of a host mirror for an ASYNC launch. The CPU
+    client zero-copy aliases suitably aligned numpy buffers into device
+    arguments (the round-6 checkpoint-aliasing finding, reproduced for
+    jit call arguments: ~half of fresh small-int32 allocations alias),
+    and the zero-bubble loop mutates mirrors while the launched program
+    is still executing — so the program must read a private copy. The
+    copy is a few dozen bytes per mirror per launch; the temp is owned
+    by the runtime from here and never mutated."""
+    return jnp.asarray(a.copy())
+
+
+class _PendingStep:
+    """One launched-but-unfetched decode step (the pipelined-dispatch
+    in-flight record): the device futures its program returned plus the
+    host snapshot needed to consume them later. ``nxt`` is the [S]
+    token array of a single step or the [S, K] block of a fused
+    window; ``last`` is the [S] device-side feedback array the NEXT
+    launch chains from; ``slots`` pins (slot, rid) pairs at launch so a
+    slot recycled in the meantime discards its stale tokens."""
+
+    __slots__ = ("nxt", "last", "keys", "moe", "slots", "covers",
+                 "count", "launch_t")
+
+    def __init__(self, nxt, last, keys, moe, slots, count, launch_t):
+        self.nxt = nxt
+        self.last = last
+        self.keys = keys
+        self.moe = moe
+        self.slots = slots                   # tuple of (slot, rid)
+        self.covers = {s: r for s, r in slots}
+        self.count = count                   # tokens per covered slot
+        self.launch_t = launch_t
 
 
 class ServingEngine:
@@ -162,6 +211,31 @@ class ServingEngine:
       window costs a (k+1)-wide forward; on a never-accepting stream
       that is pure overhead). Sticky per request.
 
+    Zero-bubble knobs (docs/serving.md §Zero-bubble loop):
+
+    * ``overlap`` — pipelined dispatch (default True): each decode
+      step's token ids feed back into the NEXT step device-side and
+      the host consumes a lagged fetch one iteration behind, so the
+      device never waits on per-iteration Python. Host-visible state
+      (``req.generated``, metrics, timelines) lags by at most one
+      iteration while a stream decodes; outputs are token-identical
+      (byte-identical sampled) to ``overlap=False``, the synchronous
+      loop kept as the A/B baseline (``bench.py --model
+      serving_overlap`` prices the gap). Host bookkeeping batches onto
+      a deferred per-``_HOST_WINDOW`` cadence (counts stay exact).
+    * ``fuse_steps`` — fused multi-step decode: when >= 2, a QUIESCENT
+      iteration (no queued or prefilling requests, no speculating
+      slot, no slot within ``fuse_steps`` of its budget, no deadline
+      in the batch) runs ``fuse_steps`` plain decode iterations as ONE
+      compiled ``lax.scan`` program with in-program per-slot stop
+      masks — zero per-iteration dispatch in steady state. Pages for
+      the whole window are pre-grown; if that growth preempts a
+      stream, the iteration falls back to single-step and fused decode
+      rejoins when quiescence returns. 0 (default) disables. Pick K so
+      a window is a few ms of device time (4-8 typical): larger K
+      amortizes more dispatch but coarsens deadline/SLO checks and
+      admission latency to K-step granularity.
+
     MoE knobs (docs/serving.md §MoE serving):
 
     * ``moe_decode`` — how the decode/verify steps run MoE MLPs:
@@ -207,7 +281,8 @@ class ServingEngine:
                  spec_disable_below: float = 0.1,
                  spec_warmup: int = 8,
                  moe_decode: str = "dispatched",
-                 ep_mesh=None):
+                 ep_mesh=None,
+                 overlap: bool = True, fuse_steps: int = 0):
         module = model.module
         if not isinstance(module, Sequential):
             raise TypeError("ServingEngine expects a Sequential LM "
@@ -296,6 +371,43 @@ class ServingEngine:
         # raise AdmissionRejected instead of growing the queue without
         # bound under overload; None keeps the open-queue behavior
         self.scheduler = scheduler
+
+        # --- zero-bubble loop state (zero-bubble PR) --------------------
+        self.overlap = bool(overlap)
+        fuse_steps = int(fuse_steps)
+        if fuse_steps < 0:
+            raise ValueError(
+                f"fuse_steps must be >= 0, got {fuse_steps}")
+        #: fused multi-step decode window (engaged when >= 2)
+        self.fuse_steps = fuse_steps
+        self._fused_fns = {}                 # greedy_only -> jit scan
+        #: the launched-but-unfetched decode step (lag-1 pipeline)
+        self._pending: Optional[_PendingStep] = None
+        #: slots whose next input token the HOST owns (True) vs the
+        #: in-flight step's device output (False)
+        self._chain_dirty = np.ones(int(num_slots), bool)
+        #: terminal requests produced by out-of-band pipeline flushes
+        #: (preemption, cancel); drained by the next step()
+        self._finish_buf: List[Request] = []
+        #: cumulative seconds blocked in the sanctioned lagged fetch —
+        #: the bench's host_loop_us_per_iter rider subtracts this
+        self.fetch_seconds = 0.0
+        # deferred host work (flushed every _HOST_WINDOW iterations and
+        # at every composition change — counts are exact, only their
+        # recording is batched off the critical path)
+        self._host_window = self._HOST_WINDOW if self.overlap else 1
+        self._decode_buf: List = []          # (n_slots, dt, n_tokens)
+        self._iter_buf: List = []            # (queue_depth, occupied)
+        self._spec_buf: List = []            # (k, accepted) replay
+        self._trace_decode: Dict[int, int] = {}   # rid -> decode ticks
+        self._trace_decode_t0: Optional[float] = None
+        self._trace_spec: Dict[int, List[int]] = {}  # rid -> [prop, acc]
+        #: batch-composition version: bumped on admit / to-decoding /
+        #: finish / preempt / terminate so steady-state iterations skip
+        #: rebuilding the recorder's per-iteration rid lists
+        self._comp_ver = 0
+        self._rec_cache = (-1, None)
+
         self.metrics = metrics if metrics is not None else ServingMetrics()
         # request-level observability (obs.tracing / obs.recorder /
         # obs.slo): the tracer shares the metrics clock so timeline
@@ -325,6 +437,9 @@ class ServingEngine:
         self._temp = np.zeros(s, np.float32)
         self._topk = np.zeros(s, np.int32)
         self._topp = np.ones(s, np.float32)
+        #: per-slot stop tokens (-1 = never): the fused window's
+        #: in-program done masks read these
+        self._stop = np.full(s, -1, np.int32)
         self._keys = np.stack(
             [np.array(jax.random.PRNGKey(0))] * s)       # [S, key]
 
@@ -379,6 +494,12 @@ class ServingEngine:
 
     #: engine iterations between recompile-detector polls
     _RECOMPILE_CHECK_EVERY = 64
+    #: engine iterations between deferred host-work flushes (tracer
+    #: ticks, metrics samples, spec counters) in overlap mode; 1 (the
+    #: synchronous loop) flushes every iteration. Composition changes
+    #: (finish/preempt/terminal) always flush immediately, so counts
+    #: are exact — only their RECORDING is batched off the hot loop.
+    _HOST_WINDOW = 8
     #: engine iterations between SLO evaluations (when ``slo`` is set)
     _SLO_EVAL_EVERY = 32
     #: EMA smoothing for the router-concentration estimate
@@ -483,7 +604,7 @@ class ServingEngine:
         if n % self._MOE_STATS_EVERY:
             return                       # unread device arrays just drop
         load = np.asarray(stats["expert_load"], np.float64)
-        entropy = float(stats["router_entropy"])
+        entropy = float(np.asarray(stats["router_entropy"]))
         total = float(load.sum())
         e = len(load)
         share = float(load.max()) / total if total > 0 else 0.0
@@ -525,12 +646,301 @@ class ServingEngine:
         (``self.metrics`` is swapped per reporting interval), plus the
         compact per-request timelines and the latest SLO status —
         additive keys on the established component shape."""
+        self._flush_host_window()    # deferred samples land first
         snap = self.metrics.summary()
         if self.tracer.enabled:
             snap["requests"] = self.tracer.summaries()
         if self.slo is not None:
             snap["slo"] = self.slo.status()
         return snap
+
+    # --- zero-bubble loop: pipelined dispatch + deferred host work --------
+
+    @property
+    def metrics(self) -> ServingMetrics:
+        return self._metrics
+
+    @metrics.setter
+    def metrics(self, value: ServingMetrics) -> None:
+        """Swapping the metrics window (the per-reporting-interval
+        pattern) first drains the pipeline and the deferred host-work
+        buffers into the OLD window, so no sample leaks across."""
+        old = getattr(self, "_metrics", None)
+        if old is not None:
+            self._flush_pending(self._finish_buf)
+            self._flush_host_window()
+        self._metrics = value
+
+    def _fetch(self, *arrays):
+        """THE serving loop's single sanctioned device->host sync: the
+        lagged fetch of a completed decode/verify step's outputs (and
+        the spec path's in-iteration verify fetch). Every other sync in
+        the step/decode path is a lint finding
+        (``tools/lint_host_sync.py``). Accumulates blocking time in
+        ``fetch_seconds`` for the bench's host-loop rider."""
+        t0 = self._metrics.clock()
+        out = [np.asarray(a) for a in arrays]  # lint: allow-host-sync (the lagged fetch)
+        self.fetch_seconds += self._metrics.clock() - t0
+        return out
+
+    def _flush_pending(self, out: Optional[List[Request]] = None) -> None:
+        """Consume the in-flight decode step (if any): fetch its
+        tokens, append them to their requests, finish what completed.
+        After this the HOST owns every slot's next input token."""
+        p = self._pending
+        if p is None:
+            return
+        self._pending = None
+        self._process_step(p, out if out is not None
+                           else self._finish_buf)
+        self._chain_dirty[:] = True
+
+    def _process_step(self, p: _PendingStep, finished: List[Request],
+                      t0: Optional[float] = None) -> None:
+        """Consume one launched step's outputs. Slots whose request
+        changed since launch (finished by an earlier flush, preempted,
+        recycled) discard their tokens — the overshoot contract: at
+        lag 1 a stream is stepped at most once past its stop token,
+        and the extra token/KV write is never consumed.
+
+        ``t0`` is the CONSUMING iteration's decode-phase start: the
+        recorded decode sample spans this phase (dispatch + lagged
+        fetch + consume), matching the synchronous loop's attribution.
+        Without it (out-of-band flushes: preempt, cancel, metrics
+        swap) the sample falls back to launch-to-consume wall, which
+        overstates dt by whatever ran in between — rare enough not to
+        skew the steady-state rate."""
+        running = self.scheduler.running
+        if not any(running.get(s) is not None and running[s].rid == r
+                   for s, r in p.slots):
+            return      # every covered stream retired: drop wholesale
+        fetched = self._fetch(*((p.nxt,) if p.keys is None
+                                else (p.nxt, p.keys)))
+        nxt = fetched[0]
+        if p.keys is not None:
+            # chain-live slots take the program's post-split keys; a
+            # slot the host overrode since launch (fresh admission)
+            # keeps its host mirror — the launch never consumed it
+            live = ~self._chain_dirty
+            self._keys[live] = fetched[1][live]
+        toks = nxt if nxt.ndim == 2 else nxt[:, None]    # [S, count]
+        self._note_moe_route(p.moe)
+        now_ = self._metrics.clock()
+        trace_on = self.tracer.enabled
+        done_reqs: List[Request] = []
+        n_emitted = 0
+        for slot, rid in p.slots:
+            req = running.get(slot)
+            if req is None or req.rid != rid:
+                continue                     # recycled slot: discard
+            n_app = 0
+            for j in range(p.count):
+                req.generated.append(int(toks[slot, j]))
+                n_app += 1
+                if req.done:
+                    break                    # stop / budget mid-window
+            n_emitted += n_app
+            self._tok[slot] = req.generated[-1]
+            if trace_on and n_app:
+                self._trace_decode[rid] = \
+                    self._trace_decode.get(rid, 0) + n_app
+                if self._trace_decode_t0 is None:
+                    self._trace_decode_t0 = now_
+            if req.done:
+                done_reqs.append(req)
+        self._decode_buf.append(
+            (len(p.slots),
+             now_ - (p.launch_t if t0 is None else t0), n_emitted))
+        if done_reqs:
+            self._flush_host_window()        # ticks precede terminals
+            for req in done_reqs:
+                self._finish(req, finished)
+
+    def _flush_host_window(self) -> None:
+        """Apply the deferred host-work buffers to the live metrics
+        window and tracer: per-iteration queue/occupancy samples, exact
+        decode token/time aggregation, spec verify counters, and the
+        batched per-request decode ticks. Runs every ``_HOST_WINDOW``
+        iterations, before every terminal transition, and on
+        metrics-window swaps — so every count is exact, just recorded
+        off the per-iteration critical path."""
+        m = self._metrics
+        if self._iter_buf:
+            for qd, occ in self._iter_buf:
+                m.record_iteration(qd, occ, self.num_slots)
+            self._iter_buf.clear()
+            if self.kv_layout == "paged":
+                m.record_pages(self.pool.free_pages,
+                               self.pool.shared_pages,
+                               self._fragmentation())
+        if self._decode_buf:
+            for n, dt, toks in self._decode_buf:
+                m.record_decode(n, dt, n_tokens=toks)
+            self._decode_buf.clear()
+        if self._spec_buf:
+            for k, acc in self._spec_buf:
+                m.record_spec_verify(k, acc)
+            self._spec_buf.clear()
+        if self._trace_decode:
+            if self.tracer.enabled:
+                self.tracer.on_decode_batch(self._trace_decode,
+                                            t0=self._trace_decode_t0)
+            self._trace_decode = {}
+            self._trace_decode_t0 = None
+        if self._trace_spec:
+            if self.tracer.enabled:
+                self.tracer.on_spec_verify(
+                    [(rid, pa[0], pa[1])
+                     for rid, pa in self._trace_spec.items()])
+            self._trace_spec = {}
+
+    def _inflight(self) -> Dict[int, int]:
+        """slot -> tokens in flight for the slot's CURRENT request (0
+        when the pending step predates the occupant)."""
+        p = self._pending
+        if p is None:
+            return {}
+        running = self.scheduler.running
+        out = {}
+        for slot, rid in p.slots:
+            req = running.get(slot)
+            if req is not None and req.rid == rid:
+                out[slot] = p.count
+        return out
+
+    def _merge_keys(self, prev: Optional[_PendingStep], dirty):
+        """Per-slot PRNG keys for the next launch: the in-flight
+        step's post-split keys wherever the chain is live, the host
+        mirror where the host overrode the slot since. Mirrors are
+        snapshotted (``_snap``) — the launched program reads them
+        after dispatch returns."""
+        if prev is None or prev.keys is None:
+            return _snap(self._keys)
+        if dirty.any():
+            return jnp.where(_snap(dirty)[:, None],
+                             _snap(self._keys), prev.keys)
+        return prev.keys
+
+    def _fuse_window(self) -> int:
+        """Fused-window size for THIS iteration: ``fuse_steps`` when
+        the scheduler is quiescent, else 0 (single-step). Quiescent =
+        nothing queued or prefilling (admission latency would coarsen
+        to K steps), no deadline in the batch (expiry checks are
+        per-iteration), and every stream's remaining budget — net of
+        in-flight tokens — covers a whole window (the in-program stop
+        masks handle stop tokens; the budget has no in-program
+        analogue, so the window must fit under it)."""
+        k = self.fuse_steps
+        if k < 2:
+            return 0
+        sch = self.scheduler
+        if sch.queue_depth or sch.prefilling:
+            return 0
+        running = sch.running
+        if not running:
+            return 0
+        infl = self._inflight()
+        for slot, r in running.items():
+            if r.deadline_s is not None:
+                return 0
+            if r.max_new_tokens - len(r.generated) \
+                    - infl.get(slot, 0) < k:
+                return 0
+        return k
+
+    def _launch_step(self, greedy_only: bool, tables, fuse: int,
+                     prev: Optional[_PendingStep],
+                     t0: float) -> _PendingStep:
+        """Dispatch one decode unit — a single step, or a ``fuse``-wide
+        fused window — WITHOUT waiting on its outputs. The input token
+        vector chains device-side from the in-flight step's feedback
+        (``prev.last``) wherever the chain is live, falling back to the
+        host mirror for slots the host overrode since (fresh
+        admissions, post-flush iterations). Host mirrors advance
+        eagerly: ``_t`` moves past the positions this launch writes, so
+        page growth and the next launch see the true frontier."""
+        running = self.scheduler.running
+        dirty = self._chain_dirty
+        # every host mirror crossing the device boundary here is
+        # snapshotted (_snap): dispatch returns while the program still
+        # READS its arguments, and the CPU client zero-copy aliases
+        # aligned numpy buffers — the eager mirror updates below (and
+        # later iterations' bookkeeping) must not race the in-flight
+        # read. The synchronous loop never saw this: it blocked on the
+        # step's outputs before touching any mirror.
+        t_dev = _snap(self._t)
+        if prev is None:
+            tok = _snap(self._tok)
+        elif dirty.any():
+            tok = jnp.where(_snap(dirty), _snap(self._tok), prev.last)
+        else:
+            tok = prev.last
+        keys = None
+        if fuse:
+            if greedy_only:
+                nxt, cache, moe = self._fused_fn(True)(
+                    self._params, self._state, self.pool.cache, tok,
+                    t_dev, _snap(self._stop), *tables)
+            else:
+                nxt, cache, keys, moe = self._fused_fn(False)(
+                    self._params, self._state, self.pool.cache, tok,
+                    t_dev, _snap(self._stop), _snap(self._temp),
+                    _snap(self._topk), _snap(self._topp),
+                    self._merge_keys(prev, dirty), *tables)
+            last, count = nxt[:, -1], fuse
+            warm = ("serving.decode_fused_greedy" if greedy_only
+                    else "serving.decode_fused_sampled")
+        else:
+            if greedy_only:
+                nxt, cache, moe = self._decode_fn(True)(
+                    self._params, self._state, self.pool.cache, tok,
+                    t_dev, *tables)
+            else:
+                nxt, cache, keys, moe = self._decode_fn(False)(
+                    self._params, self._state, self.pool.cache, tok,
+                    t_dev, _snap(self._temp), _snap(self._topk),
+                    _snap(self._topp),
+                    self._merge_keys(prev, dirty), *tables)
+            last, count = nxt, 1
+            warm = ("serving.decode_greedy" if greedy_only
+                    else "serving.decode_sampled")
+        self.pool.cache = cache
+        # warm baseline AFTER a variant's first call (its one
+        # legitimate compile); cache growth past it is a shape leak
+        if warm not in self._warmed:
+            self._warmed.add(warm)
+            self._recompile.mark_warm(warm)
+        slots = tuple((slot, r.rid) for slot, r in running.items())
+        for slot, _ in slots:
+            self._t[slot] += count
+            dirty[slot] = False          # chain live until overridden
+        return _PendingStep(nxt, last, keys, moe, slots, count, t0)
+
+    def _record_iteration(self, admitted: List[Request]) -> None:
+        """Flight-recorder iteration entry, written BEFORE
+        prefill/decode run so a mid-iteration fault dump contains the
+        failing iteration itself. The per-iteration rid lists rebuild
+        only when the batch composition changed (``_comp_ver``);
+        steady-state iterations reuse the cached lists and, in overlap
+        mode, only write a ring entry on the host-window cadence."""
+        if not self.recorder.enabled:
+            return
+        sch = self.scheduler
+        ver = self._rec_cache[0]
+        if self._comp_ver != ver:
+            self._rec_cache = (self._comp_ver, (
+                [r.rid for r in sch.running.values()],
+                [r.rid for r in sch.prefilling]))
+        elif self._iters % self._host_window:
+            return                      # steady state: window cadence
+        decoding, prefilling = self._rec_cache[1]
+        extra = ({"pages_free": self.pool.free_pages}
+                 if self.kv_layout == "paged" else {})
+        self.recorder.record(
+            "serving.iteration", iter=self._iters,
+            queue_depth=sch.queue_depth, occupied=sch.occupied,
+            decoding=decoding, prefilling=prefilling,
+            admitted=[r.rid for r in admitted], **extra)
 
     # --- request intake ---------------------------------------------------
 
@@ -704,6 +1114,67 @@ class ServingEngine:
             self._recompile.watch(
                 "serving.decode_greedy" if greedy_only
                 else "serving.decode_sampled", fn)
+        return fn
+
+    def _fused_fn(self, greedy_only: bool):
+        """The fused multi-step window: ``fuse_steps`` plain decode
+        iterations as ONE compiled ``lax.scan``
+        (``decoding.decode_fused_slots``), mirroring ``_decode_fn``'s
+        greedy/sampled split. Returns ``(toks [S, K], cache, keys?,
+        moe?)`` with the same routing-stats slot convention."""
+        fn = self._fused_fns.get(greedy_only)
+        if fn is None:
+            module = self.module
+            paged = self.kv_layout == "paged"
+            page_len = self.page_len
+            k = self.fuse_steps
+            moe_kw = dict(
+                moe_dispatched=self._moe_dispatched,
+                moe_stats=self.max_len if self._moe_stats_on else None)
+            stats_on = self._moe_stats_on
+
+            if greedy_only:
+                def body(params, state, cache, tok, t, stop, tables):
+                    toks, cache, _, moe = decode_fused_slots(
+                        module, params, state, cache, tok, t, stop, k,
+                        table=tables, page_len=page_len or 0, **moe_kw)
+                    return toks, cache, (moe if stats_on else None)
+
+                if paged:
+                    def fn(params, state, cache, tok, t, stop, tables):
+                        return body(params, state, cache, tok, t, stop,
+                                    tables)
+                    n_args = 7
+                else:
+                    def fn(params, state, cache, tok, t, stop):
+                        return body(params, state, cache, tok, t, stop,
+                                    None)
+                    n_args = 6
+            else:
+                def body(params, state, cache, tok, t, stop, temp,
+                         topk, topp, keys, tables):
+                    toks, cache, keys, moe = decode_fused_slots(
+                        module, params, state, cache, tok, t, stop, k,
+                        table=tables, page_len=page_len or 0,
+                        temperature=temp, top_k=topk, top_p=topp,
+                        keys=keys, **moe_kw)
+                    return toks, cache, keys, \
+                        (moe if stats_on else None)
+
+                if paged:
+                    fn, n_args = body, 11
+                else:
+                    def fn(params, state, cache, tok, t, stop, temp,
+                           topk, topp, keys):
+                        return body(params, state, cache, tok, t, stop,
+                                    temp, topk, topp, keys, None)
+                    n_args = 10
+
+            fn = self._jit_serving(fn, n_args)
+            self._fused_fns[greedy_only] = fn
+            self._recompile.watch(
+                "serving.decode_fused_greedy" if greedy_only
+                else "serving.decode_fused_sampled", fn)
         return fn
 
     def _verify_fn(self, greedy_only: bool):
@@ -911,7 +1382,10 @@ class ServingEngine:
         allocated); when the budget is short, a strictly-higher-
         priority arrival preempts lower-priority decoding streams."""
         if self.kv_layout != "paged":
-            return self.scheduler.admit()
+            admitted = self.scheduler.admit()
+            if admitted:
+                self._comp_ver += 1
+            return admitted
         admitted: List[Request] = []
         sch = self.scheduler
         while sch.free_slots:
@@ -921,6 +1395,7 @@ class ServingEngine:
             plan = self._page_plan(req)
             if plan is not None:
                 sch.admit_one(req)
+                self._comp_ver += 1
                 self._apply_page_plan(req, plan)
                 admitted.append(req)
                 continue
@@ -1074,10 +1549,17 @@ class ServingEngine:
         stream resumes EXACTLY where it left off (schedule-independent
         draws); a prefilling victim keeps its submit-time key (its
         first token has not been sampled yet)."""
+        # the snapshot below (generated tokens, sampling key) must see
+        # the in-flight step's outputs — drain the pipeline first
+        self._flush_pending()
+        if victim.state in TERMINAL_STATES:
+            return               # the flush finished (or expired) it
         slot = victim.slot
         if victim.state is RequestState.DECODING:
             victim.rng = np.array(self._keys[slot])
         self.scheduler.preempt(victim)
+        self._comp_ver += 1
+        self._chain_dirty[slot] = True
         if self._draft is not None:
             self._draft.end_slot(slot)   # draft KV freed with the slot
         freed = self.pool.release_slot(slot)
@@ -1116,7 +1598,23 @@ class ServingEngine:
         beyond that may drop — their candidates are discarded
         host-side)."""
         pool = self.pool
-        by_rank = sorted(self.scheduler.running.values(),
+        running = self.scheduler.running
+        if not running:
+            return
+        # steady-state fast path (zero-bubble PR): ONE vectorized scan
+        # over the numpy table/position mirrors decides "no growth
+        # needed" — the common case — without the per-slot int() loop
+        # that used to cost O(num_slots) Python per iteration
+        slots = np.fromiter(running.keys(), np.int64, len(running))
+        t = self._t[slots].astype(np.int64)
+        hi = t if lookahead is None else t + lookahead[slots]
+        hi = np.minimum(hi, pool.pages_per_slot * pool.page_len - 1)
+        lp = pool.page_index
+        span = (lp >= (t // pool.page_len)[:, None]) \
+            & (lp <= (hi // pool.page_len)[:, None])
+        if not (span & (pool.tables[slots] >= pool.num_pages)).any():
+            return
+        by_rank = sorted(running.values(),
                          key=lambda r: (r.priority, r.rid))
         for req in by_rank:
             if req.state is not RequestState.DECODING:
@@ -1155,13 +1653,20 @@ class ServingEngine:
         (an allocated page holds ``page_len`` positions; the slot uses
         ``t`` of them so far). 0 = perfectly packed."""
         pool = self.pool
+        sch = self.scheduler
         used = alloc = 0
-        for slot, req in self.scheduler.running.items():
-            alloc += len(pool.slot_pages(slot))
-            used += int(self._t[slot])
-        for req in self.scheduler.prefilling:
-            alloc += len(pool.slot_pages(req.slot))
-            used += req.prefill_pos
+        if sch.running:
+            # vector numpy over the table/position mirrors — no
+            # per-slot python loop (zero-bubble PR)
+            slots = np.fromiter(sch.running.keys(), np.int64,
+                                len(sch.running))
+            alloc += int((pool.tables[slots] < pool.num_pages).sum())
+            used += int(self._t[slots].sum())
+        if sch.prefilling:
+            pslots = np.fromiter((r.slot for r in sch.prefilling),
+                                 np.int64, len(sch.prefilling))
+            alloc += int((pool.tables[pslots] < pool.num_pages).sum())
+            used += sum(r.prefill_pos for r in sch.prefilling)
         if alloc == 0:
             return 0.0
         return max(0.0, 1.0 - used / (alloc * pool.page_len))
@@ -1183,27 +1688,19 @@ class ServingEngine:
         engine state mutates, so ``step()`` can simply be called again
         (the failed iteration retries wholesale)."""
         finished: List[Request] = []
+        if self._finish_buf:
+            # terminals produced by out-of-band pipeline flushes
+            # (cancel, preemption, metrics swap) since the last step
+            finished.extend(self._finish_buf)
+            self._finish_buf.clear()
         self._expire_deadlines(finished)
         admitted = self._admit()
-
-        # flight-recorder ring: this iteration's composition, written
-        # BEFORE prefill/decode run so a mid-iteration fault dump
-        # contains the failing iteration itself (field assembly gated
-        # on a live recorder — the disabled path costs one check).
-        # Paged engines add the free-page count: an admission stall in
-        # a post-mortem dump reads directly as "queue grew while pages
+        # flight-recorder ring entry (composition-cached, window
+        # cadence in steady state — see _record_iteration). Paged
+        # engines add the free-page count: an admission stall in a
+        # post-mortem dump reads directly as "queue grew while pages
         # sat at N" (budget starvation) vs "pages free, slots full"
-        if self.recorder.enabled:
-            extra = ({"pages_free": self.pool.free_pages}
-                     if self.kv_layout == "paged" else {})
-            self.recorder.record(
-                "serving.iteration", iter=self._iters,
-                queue_depth=self.scheduler.queue_depth,
-                occupied=self.scheduler.occupied,
-                decoding=[r.rid for r in
-                          self.scheduler.running.values()],
-                prefilling=[r.rid for r in self.scheduler.prefilling],
-                admitted=[r.rid for r in admitted], **extra)
+        self._record_iteration(admitted)
 
         req = self.scheduler.next_prefill()
         if req is not None:
@@ -1220,19 +1717,26 @@ class ServingEngine:
                     obs.span("serving.decode"):
                 self._advance_decode(finished)
 
-        self.metrics.record_iteration(self.scheduler.queue_depth,
-                                      self.scheduler.occupied,
-                                      self.num_slots)
-        if self.kv_layout == "paged":
-            self.metrics.record_pages(self.pool.free_pages,
-                                      self.pool.shared_pages,
-                                      self._fragmentation())
+        # per-iteration samples land in the deferred buffers; the live
+        # window sees them on the host-window cadence (every iteration
+        # when overlap is off) and whenever the engine drains idle
+        self._iter_buf.append((self.scheduler.queue_depth,
+                               self.scheduler.occupied))
         self._iters += 1
+        if self._iters % self._host_window == 0 \
+                or not self.scheduler.pending:
+            self._flush_host_window()
         if self._iters % self._RECOMPILE_CHECK_EVERY == 0:
             self._recompile.check()
         if self.slo is not None \
                 and self._iters % self._SLO_EVAL_EVERY == 0:
+            self._flush_host_window()
             self.slo.evaluate(self.metrics)
+        if self._finish_buf:
+            # a mid-iteration flush (preemption funding, deadline
+            # sweep) finished requests: return them from THIS step
+            finished.extend(self._finish_buf)
+            self._finish_buf.clear()
         return finished
 
     def run(self, max_steps: Optional[int] = None,
@@ -1282,7 +1786,15 @@ class ServingEngine:
         expired = [r for r in self._requests.values()
                    if r.deadline_s is not None
                    and now_ - r.submit_t >= r.deadline_s]
+        if not expired:
+            return
+        # the expiring requests' in-flight tokens must land first (a
+        # timed-out request keeps everything it generated) — and the
+        # flush may FINISH one of them, beating the deadline
+        self._flush_pending(finished)
         for r in expired:
+            if r.rid not in self._requests:
+                continue                 # finished during the flush
             self._terminate(r, RequestState.TIMED_OUT, finished)
             self.metrics.record_timeout(r.rid)
 
@@ -1300,6 +1812,15 @@ class ServingEngine:
         """Cancel an in-flight request by id (client disconnect etc.);
         returns the terminal Request (evicted from the engine)."""
         req = self._requests[rid]
+        # land the in-flight tokens first (partial output is part of
+        # the cancel contract); the flush may FINISH the request, in
+        # which case the terminal FINISHED record wins
+        self._flush_pending()
+        if rid not in self._requests:
+            for i, r in enumerate(self._finish_buf):
+                if r.rid == rid:
+                    return self._finish_buf.pop(i)
+            raise KeyError(rid)          # unreachable: flush owns it
         out: List[Request] = []
         self._terminate(req, RequestState.CANCELLED, out)
         self.metrics.record_cancelled(rid)
@@ -1315,8 +1836,10 @@ class ServingEngine:
         had_slot = req.state in (RequestState.PREFILLING,
                                  RequestState.DECODING)
         self.scheduler.cancel(req, state)
+        self._comp_ver += 1
         if had_slot:
             self._t[req.slot] = self.max_len   # sentinel: slot inert
+            self._chain_dirty[req.slot] = True
             if self._draft is not None:
                 self._draft.end_slot(req.slot)
             if self.kv_layout == "paged":
@@ -1344,6 +1867,7 @@ class ServingEngine:
         trigger — a probe keeps the instance but weights traffic
         away). The ``slo`` key carries the freshly evaluated
         per-objective status (None without objectives)."""
+        self._flush_host_window()    # deferred samples land first
         sch = self.scheduler
         accepting = (sch.max_queue is None
                      or sch.queue_depth < sch.max_queue)
@@ -1469,12 +1993,15 @@ class ServingEngine:
             # (TTFT fired long ago), restore the decode vectors and the
             # snapshotted sampling key, rejoin the batch
             self.scheduler.to_decoding(req)
+            self._comp_ver += 1
             self._tok[s] = req.generated[-1]
             self._t[s] = p_len
             self._temp[s] = req.temperature
             self._topk[s] = req.top_k
             self._topp[s] = req.top_p
+            self._stop[s] = req.stop_token
             self._keys[s] = np.array(req.rng)
+            self._chain_dirty[s] = True    # host owns the next input
             self._begin_draft(req, toks)
             self.tracer.on_resume(req.rid)
             return
@@ -1489,12 +2016,15 @@ class ServingEngine:
             self._finish(req, finished)
             return
         self.scheduler.to_decoding(req)
+        self._comp_ver += 1
         self._tok[s] = token
         self._t[s] = p_len          # where the next decode step writes it
         self._temp[s] = req.temperature
         self._topk[s] = req.top_k
         self._topp[s] = req.top_p
+        self._stop[s] = req.stop_token
         self._keys[s] = np.array(req.rng)
+        self._chain_dirty[s] = True        # host owns the next input
         self._begin_draft(req, toks)
 
     def _begin_draft(self, req: Request, context) -> None:
@@ -1508,18 +2038,31 @@ class ServingEngine:
             self._spec_disable(req)
 
     def _advance_decode(self, finished: List[Request]):
-        # chaos hook: fires BEFORE any state mutates, so an injected
-        # decode-step error leaves the iteration wholesale-retryable
-        # (see step() docstring)
+        # chaos hook: fires BEFORE any state mutates THIS iteration
+        # (the in-flight step, if any, was launched by a prior
+        # iteration and stays consumable), so an injected decode-step
+        # error leaves the iteration wholesale-retryable (see step()
+        # docstring)
         faults.point("serving.decode")
         paged = self.kv_layout == "paged"
         spec = bool(self._spec_slots())
+        if spec:
+            # draft proposals read host-side token state, so a
+            # speculative iteration is synchronous: drain the pipeline
+            # first, then the verify fetch below is the sanctioned
+            # in-iteration sync
+            self._flush_pending(finished)
+            if not self.scheduler.running:
+                return                  # the flush drained the batch
+        fuse = 0 if spec else self._fuse_window()
         if paged:
             # page growth happens BEFORE the step (a write with no page
             # would silently drop); may preempt streams out of
             # ``running``, so the batch composition reads after it.
             # Speculating slots demand pages for their whole verify
-            # window up front (only as far as their budget can consume)
+            # window up front (only as far as their budget can
+            # consume); a fused window demands pages for all
+            # ``fuse_steps`` write positions
             look = None
             if spec:
                 look = np.zeros(self.num_slots, np.int64)
@@ -1528,64 +2071,50 @@ class ServingEngine:
                         look[slot] = min(
                             self.spec_k,
                             r.max_new_tokens - len(r.generated) - 1)
+            elif fuse:
+                look = np.zeros(self.num_slots, np.int64)
+                for slot in self.scheduler.running:
+                    look[slot] = fuse - 1
             self._ensure_decode_pages(look)
             if not self.scheduler.running:
                 return
-            spec = bool(self._spec_slots())  # preemption may have
-            #                                  evicted the speculators
+            if spec:
+                spec = bool(self._spec_slots())  # preemption may have
+                #                                  evicted speculators
+            elif fuse and self.scheduler.queue_depth:
+                # funding the window preempted a stream: quiescence is
+                # gone, fall back to single-step and rejoin later (the
+                # pre-grown pages stay — they are legitimate write
+                # positions)
+                fuse = 0
         t0 = self.metrics.clock()
-        n_active = len(self.scheduler.running)
         greedy_only = all(r.temperature <= 0.0
                           for r in self.scheduler.running.values())
         tables = (self.pool.device_tables(),) if paged else ()
         if spec:
-            n_emitted = self._spec_step(greedy_only, tables, finished)
-            self.metrics.record_decode(
-                n_active, self.metrics.clock() - t0,
-                n_tokens=n_emitted)
+            self._spec_step(greedy_only, tables, finished, t0)
             return
-        if greedy_only:
-            nxt, self.pool.cache, moe = self._decode_fn(True)(
-                self._params, self._state, self.pool.cache,
-                self._tok, self._t, *tables)
+        prev = self._pending
+        pend = self._launch_step(greedy_only, tables, fuse, prev, t0)
+        if self.overlap:
+            # pipelined dispatch: the new step runs on device while the
+            # host consumes the LAGGED fetch of the previous one (its
+            # decode sample covers THIS phase, t0 onward)
+            self._pending = pend
+            if prev is not None:
+                self._process_step(prev, finished, t0)
         else:
-            nxt, self.pool.cache, keys, moe = self._decode_fn(False)(
-                self._params, self._state, self.pool.cache,
-                self._tok, self._t, self._temp, self._topk, self._topp,
-                self._keys, *tables)
-            self._keys = np.array(keys)
-        # warm baseline AFTER a variant's first call (its one legitimate
-        # compile); any cache growth past it is a shape leak
-        if greedy_only not in self._warmed:
-            self._warmed.add(greedy_only)
-            self._recompile.mark_warm(
-                "serving.decode_greedy" if greedy_only
-                else "serving.decode_sampled")
-        # the per-iteration host sync: the scheduler must see token ids
-        # to detect stops and free slots (docs/serving.md, follow-ups)
-        nxt = np.asarray(nxt)
-        self._note_moe_route(moe)
-        if self.tracer.enabled:
-            # one aggregated decode tick per running request (the
-            # tracer folds decode_agg of these into one stored event)
-            self.tracer.on_decode(
-                [r.rid for r in self.scheduler.running.values()])
-        for slot, req in list(self.scheduler.running.items()):
-            token = int(nxt[slot])
-            req.generated.append(token)
-            self._tok[slot] = token
-            self._t[slot] += 1
-            if req.done:
-                self._finish(req, finished)
-        self.metrics.record_decode(n_active, self.metrics.clock() - t0)
+            # the synchronous A/B baseline: launch-and-wait, exactly
+            # the pre-zero-bubble loop
+            self._process_step(pend, finished, t0)
 
     def _spec_step(self, greedy_only: bool, tables,
-                   finished: List[Request]) -> int:
+                   finished: List[Request], t0: float) -> None:
         """One speculative draft-and-verify iteration over the decode
-        batch; returns the number of tokens emitted (the
-        ``record_decode`` token count). Non-speculating slots ride the
-        same program with their drafts force-rejected — for them the
-        verify step IS a plain decode step."""
+        batch. Non-speculating slots ride the same program with their
+        drafts force-rejected — for them the verify step IS a plain
+        decode step. Host bookkeeping (metrics, tracer items, the
+        acceptance EMA) defers onto the host-window buffers."""
         k = self.spec_k
         running = self.scheduler.running
         active = np.zeros(self.num_slots, bool)
@@ -1602,25 +2131,27 @@ class ServingEngine:
             cand, n_acc, self.pool.cache, moe = self._verify_fn(True)(
                 self._params, self._state, self.pool.cache, toks,
                 self._t, active_dev, *tables)
+            cand, n_acc = self._fetch(cand, n_acc)
         else:
             (cand, n_acc, self.pool.cache, keys,
              moe) = self._verify_fn(False)(
                 self._params, self._state, self.pool.cache, toks,
                 self._t, active_dev, self._temp, self._topk,
                 self._topp, self._keys, *tables)
-            self._keys = np.array(keys)
+            cand, n_acc, new_keys = self._fetch(cand, n_acc, keys)
+            # the fetch hands back read-only views of device memory;
+            # the key mirror stays host-writable (per-slot restores on
+            # admission/resume write into it)
+            self._keys = new_keys.copy()
         name = ("serving.verify_greedy" if greedy_only
                 else "serving.verify_sampled")
         if name not in self._warmed:
             self._warmed.add(name)
             self._recompile.mark_warm(name)
-        cand = np.asarray(cand)
-        n_acc = np.asarray(n_acc)
         self._note_moe_route(moe)
-        if self.tracer.enabled:
-            self.tracer.on_decode([r.rid for r in running.values()])
+        now_ = self._metrics.clock()
+        trace_on = self.tracer.enabled
         n_emitted = 0
-        spec_items = []
         done_reqs = []
         for slot, req in list(running.items()):
             m = int(n_acc[slot])
@@ -1633,24 +2164,40 @@ class ServingEngine:
             n_emitted += appended
             self._tok[slot] = req.generated[-1]
             self._t[slot] += appended
+            if trace_on:
+                self._trace_decode[req.rid] = \
+                    self._trace_decode.get(req.rid, 0) + appended
+                if self._trace_decode_t0 is None:
+                    self._trace_decode_t0 = now_
             if active[slot]:
-                self.metrics.record_spec_verify(k, m)
-                spec_items.append((req.rid, k, m))
+                self._spec_buf.append((k, m))
+                # the EMA updates INLINE (not on the host-window
+                # cadence): a spec iteration is already synchronous —
+                # the verify fetch above paid the sync — and the
+                # warm-up/kill-switch contract (spec_warmup checks,
+                # then disable) is exact-count, not windowed
                 self._observe_acceptance(req, m / k)
+                if trace_on:
+                    pa = self._trace_spec.setdefault(req.rid, [0, 0])
+                    pa[0] += k
+                    pa[1] += m
             if req.done:
                 done_reqs.append(req)
-        # spec events BEFORE terminal transitions: on_terminal retires
-        # the timeline, and the final verify's outcome belongs on it
-        if spec_items and self.tracer.enabled:
-            self.tracer.on_spec_verify(spec_items)
-        for req in done_reqs:
-            self._finish(req, finished)
-        return n_emitted
+        self._decode_buf.append((len(running), now_ - t0, n_emitted))
+        if done_reqs:
+            # spec events / decode ticks BEFORE terminal transitions:
+            # on_terminal retires the timeline, and the final verify's
+            # outcome belongs on it
+            self._flush_host_window()
+            for req in done_reqs:
+                self._finish(req, finished)
 
     def _finish(self, req: Request, finished: List[Request]):
         slot = req.slot
         self.scheduler.release(req)
+        self._comp_ver += 1
         self._t[slot] = self.max_len          # sentinel: slot inert
+        self._chain_dirty[slot] = True
         if self._draft is not None:
             self._draft.end_slot(slot)
         if self.kv_layout == "paged":

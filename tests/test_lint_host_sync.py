@@ -12,13 +12,75 @@ REPO = Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(REPO / "tools"))
 
 from lint_host_sync import (  # noqa: E402
-    ALLOW_MARK, EPOCH_LOOP_MODULES, check_source, check_tree)
+    ALLOW_MARK, EPOCH_LOOP_MODULES, SERVING_ALLOWED_MARKS,
+    SERVING_LOOP_FUNCS, SERVING_LOOP_MODULE, check_source, check_tree)
 
 
 def test_repo_epoch_loops_are_free_of_host_syncs():
     findings = check_tree(REPO)
     assert not findings, "\n".join(
         f"{f}:{ln}: {msg}" for f, ln, msg in findings)
+
+
+# --- the serving iteration loop scope (zero-bubble PR) ---------------------
+
+
+def test_serving_scope_covers_the_decode_path():
+    # the zero-bubble loop's hot path must stay in scope
+    for fn in ("step", "_advance_decode", "_launch_step",
+               "_process_step", "_spec_step", "_fetch"):
+        assert fn in SERVING_LOOP_FUNCS
+    assert SERVING_LOOP_MODULE.endswith("serving/engine.py")
+
+
+def test_serving_loop_has_exactly_one_marked_lagged_fetch():
+    src = (REPO / SERVING_LOOP_MODULE).read_text()
+    import ast
+    tree = ast.parse(src)
+    lines = src.splitlines()
+    marked = [
+        ln for n in ast.walk(tree)
+        if isinstance(n, ast.FunctionDef) and n.name in SERVING_LOOP_FUNCS
+        for ln in range(n.lineno, (n.end_lineno or n.lineno) + 1)
+        if ALLOW_MARK in lines[ln - 1]]
+    assert len(marked) == SERVING_ALLOWED_MARKS == 1, marked
+
+
+def test_serving_checker_flags_np_fetch_in_scope_only():
+    src = ("class E:\n"
+           "    def step(self):\n"
+           "        nxt = np.asarray(self._pending.nxt)\n"
+           "        t = np.array(keys)\n"
+           "    def submit(self, prompt):\n"
+           "        return np.asarray(prompt)\n")   # out of scope
+    findings = check_source(src, "e.py", only_funcs=SERVING_LOOP_FUNCS,
+                            ban_np_fetch=True)
+    assert [ln for _, ln, _ in findings] == [3, 4]
+    assert all("serving iteration loop" in m for _, _, m in findings)
+
+
+def test_serving_checker_requires_exactly_one_mark():
+    one = ("class E:\n"
+           "    def _fetch(self, a):\n"
+           f"        return np.asarray(a)  # {ALLOW_MARK}\n")
+    assert check_source(one, "e.py", only_funcs=SERVING_LOOP_FUNCS,
+                        ban_np_fetch=True, allowed_marks=1) == []
+    zero = one.replace(f"  # {ALLOW_MARK}", "")
+    f = check_source(zero, "e.py", only_funcs=SERVING_LOOP_FUNCS,
+                     ban_np_fetch=True, allowed_marks=1)
+    assert any("mark" in m for _, _, m in f)        # count violation
+    two = one + ("    def step(self):\n"
+                 f"        x = np.asarray(y)  # {ALLOW_MARK}\n")
+    f = check_source(two, "e.py", only_funcs=SERVING_LOOP_FUNCS,
+                     ban_np_fetch=True, allowed_marks=1)
+    assert any("mark" in m for _, _, m in f)
+
+
+def test_serving_checker_np_rule_needs_opt_in():
+    # epoch-loop modules keep the original three rules: np.asarray
+    # there is host-side numpy, not a fetch
+    src = "x = np.asarray(v)\n"
+    assert check_source(src, "x.py") == []
 
 
 def test_scope_covers_the_three_trainer_loops():

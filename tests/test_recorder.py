@@ -109,7 +109,12 @@ def test_chaos_fault_dump_contains_failing_and_preceding_iterations(
     mid-run; the auto-dump holds the failing iteration (recorded at
     step() entry, before the fault site runs) plus the preceding
     iterations still in the ring."""
-    eng = ServingEngine(tiny_lm, num_slots=2, max_len=32)
+    # the synchronous loop records every iteration on the ring; the
+    # pipelined default batches steady-state ring writes onto the
+    # host-window cadence (tested in test_serving_overlap.py), which
+    # would thin the preceding-history this test pins down
+    eng = ServingEngine(tiny_lm, num_slots=2, max_len=32,
+                        overlap=False)
     assert eng.recorder is _isolation
     # build up preceding history: several full iterations first
     eng.submit(PATTERN[:4], 6)

@@ -29,6 +29,17 @@ epoch-boundary fetches, callback-API ``get_weights`` providers and
 end-of-train result fetches — carry the marker comment
 ``# lint: allow-host-sync`` on the offending line.
 
+THE SERVING ITERATION LOOP (zero-bubble PR, docs/serving.md
+§Zero-bubble loop) is the second blocking-sync-free zone: the
+step/decode-path methods of ``serving/engine.py`` listed in
+``SERVING_LOOP_FUNCS``. There the pipelined-dispatch contract is that
+the device NEVER waits on per-iteration Python, so on top of the three
+rules above, ``np.asarray(...)``/``np.array(...)`` — the fetch idiom
+that used to sync every decode iteration — is banned too. Exactly ONE
+marked site is sanctioned: the lagged fetch in ``_fetch()``; zero
+marks (someone deleted the contract) or a second mark (someone snuck a
+new sync past review) are both findings.
+
 Exit status 1 when findings exist (wired into tier-1 as
 ``tests/test_lint_host_sync.py``).
 """
@@ -50,6 +61,26 @@ EPOCH_LOOP_MODULES = (
     "distkeras_tpu/parallel/distributed.py",
     "distkeras_tpu/parallel/engine.py",
 )
+
+#: the serving engine module whose iteration loop is the second zone
+SERVING_LOOP_MODULE = "distkeras_tpu/serving/engine.py"
+
+#: the step/decode-path methods forming the serving iteration loop.
+#: Out of scope by design: submit/prefill intake (one-off per-request
+#: work), ``_note_moe_route`` (the throttled stats tap — it reads
+#: arrays of an already-consumed step on a 1-in-16 cadence), and the
+#: out-of-band control surface (cancel, health, telemetry summaries).
+SERVING_LOOP_FUNCS = frozenset({
+    "step", "_advance_decode", "_spec_step", "_launch_step",
+    "_process_step", "_flush_pending", "_flush_host_window", "_fetch",
+    "_fuse_window", "_inflight", "_merge_keys", "_ensure_decode_pages",
+    "_fragmentation", "_record_iteration", "_finish", "_admit",
+    "_expire_deadlines",
+})
+
+#: how many ``# lint: allow-host-sync`` marks the serving loop may
+#: carry: exactly one — the lagged fetch in ``_fetch()``
+SERVING_ALLOWED_MARKS = 1
 
 Finding = Tuple[str, int, str]
 
@@ -74,14 +105,38 @@ def _init_ranges(tree: ast.AST) -> List[Tuple[int, int]]:
             and n.name == "__init__"]
 
 
-def check_source(src: str, rel: str) -> List[Finding]:
-    """Findings for one file's source text."""
+def _func_ranges(tree: ast.AST, names) -> List[Tuple[int, int]]:
+    return [(n.lineno, n.end_lineno or n.lineno)
+            for n in ast.walk(tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and n.name in names]
+
+
+def check_source(src: str, rel: str, only_funcs=None,
+                 ban_np_fetch: bool = False,
+                 allowed_marks: int = None) -> List[Finding]:
+    """Findings for one file's source text. With ``only_funcs`` (a set
+    of function names) only statements inside those functions are
+    checked; ``ban_np_fetch`` adds the ``np.asarray``/``np.array`` rule
+    (the serving-loop fetch idiom); ``allowed_marks`` asserts the exact
+    number of ``# lint: allow-host-sync`` marks inside the scope."""
     try:
         tree = ast.parse(src, filename=rel)
     except SyntaxError as e:  # a broken file is its own finding
         return [(rel, e.lineno or 0, f"syntax error: {e.msg}")]
     lines = src.splitlines()
     inits = _init_ranges(tree)
+    scope = (None if only_funcs is None
+             else _func_ranges(tree, only_funcs))
+    if scope is not None and not scope:
+        # the zone evaporated (e.g. the loop methods were renamed
+        # without updating the func set) — that is a finding, not a
+        # silently-green empty scope
+        return [(rel, 0,
+                 "none of the scoped serving-loop functions "
+                 f"({', '.join(sorted(only_funcs))}) exist in this "
+                 "file — update the lint's function set so the zone "
+                 "keeps covering the loop")]
     out: List[Finding] = []
 
     def line_of(node: ast.AST) -> str:
@@ -92,7 +147,28 @@ def check_source(src: str, rel: str) -> List[Finding]:
         ln = getattr(node, "lineno", 0)
         return any(lo <= ln <= hi for lo, hi in inits)
 
+    def in_scope(node: ast.AST) -> bool:
+        if scope is None:
+            return True
+        ln = getattr(node, "lineno", 0)
+        return any(lo <= ln <= hi for lo, hi in scope)
+
+    if allowed_marks is not None:
+        n_marks = sum(
+            1 for lo, hi in (scope or [(1, len(lines))])
+            for ln in range(lo, hi + 1)
+            if ln <= len(lines) and ALLOW_MARK in lines[ln - 1])
+        if n_marks != allowed_marks:
+            out.append((rel, 0,
+                        f"{n_marks} '{ALLOW_MARK}' mark(s) in the "
+                        f"serving loop scope, expected exactly "
+                        f"{allowed_marks} (the _fetch lagged-fetch "
+                        f"site) — a new sync needs a design review, "
+                        f"not a marker"))
+
     for node in ast.walk(tree):
+        if not in_scope(node):
+            continue
         if isinstance(node, ast.Call):
             f = node.func
             if isinstance(f, ast.Attribute) and f.attr == "device_get" \
@@ -111,6 +187,17 @@ def check_source(src: str, rel: str) -> List[Finding]:
                                 ".block_until_ready() in an epoch-loop "
                                 "module — a blocking device sync; let the "
                                 "boundary fetch bound the epoch"))
+            elif ban_np_fetch and isinstance(f, ast.Attribute) \
+                    and f.attr in ("asarray", "array") \
+                    and isinstance(f.value, ast.Name) \
+                    and f.value.id in ("np", "numpy"):
+                if not _allowed(line_of(node)):
+                    out.append((rel, node.lineno,
+                                f"np.{f.attr}() in the serving iteration "
+                                "loop — the fetch idiom blocks the host "
+                                "on the device here; consume tokens "
+                                "through the lagged _fetch() or defer "
+                                "the work to a host-window buffer"))
             elif isinstance(f, ast.Name) and f.id == "float" \
                     and node.args and not isinstance(node.args[0],
                                                      ast.Constant) \
@@ -139,6 +226,12 @@ def check_tree(root: Path) -> List[Finding]:
         p = root / entry
         if p.exists():
             findings.extend(check_source(p.read_text(), entry))
+    p = root / SERVING_LOOP_MODULE
+    if p.exists():
+        findings.extend(check_source(
+            p.read_text(), SERVING_LOOP_MODULE,
+            only_funcs=SERVING_LOOP_FUNCS, ban_np_fetch=True,
+            allowed_marks=SERVING_ALLOWED_MARKS))
     return findings
 
 
