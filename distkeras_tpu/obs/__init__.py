@@ -141,6 +141,36 @@ def components() -> list:
     return list(_components)
 
 
+def aggregate_serving(snapshot: Optional[Dict] = None) -> Dict:
+    """Cross-replica serving aggregation (serving-router PR): collect
+    every serving component from a ``telemetry_snapshot()`` — with N
+    live engines each attaches under its own name (``"serving"`` /
+    ``"serving[<engine_id>]"``) — and sum the fleet-wide counters.
+    Returns ``{"replicas": {component name: summary}, "totals":
+    {counter: fleet sum}}``; per-replica detail (percentiles, pages,
+    SLO status, request timelines — each timeline tagged with its
+    engine id) stays under ``"replicas"`` because percentiles do not
+    sum."""
+    snap = snapshot if snapshot is not None else telemetry_snapshot()
+    replicas = {
+        name: comp
+        for name, comp in (snap.get("components") or {}).items()
+        if name == "serving" or name.startswith("serving[")}
+    keys = ("requests_finished", "requests_rejected",
+            "requests_timed_out", "requests_cancelled",
+            "requests_preempted", "requests_transferred",
+            "tokens_generated", "prefill_chunks")
+    totals: Dict[str, float] = {k: 0 for k in keys}
+    for comp in replicas.values():
+        if not isinstance(comp, dict):
+            continue
+        for k in keys:
+            v = comp.get(k)
+            if isinstance(v, (int, float)):
+                totals[k] += v
+    return {"replicas": replicas, "totals": totals}
+
+
 def telemetry_snapshot(registry: Optional[MetricsRegistry] = None) -> Dict:
     """THE unified view: registry metrics + span tree + compile totals
     + device-memory stats + every attached component's snapshot."""

@@ -221,6 +221,7 @@ class _NullTracer:
     """Disabled tracing: every hook a no-op (single shared instance)."""
 
     enabled = False
+    engine = None
 
     def on_submit(self, rid, queue_depth):
         pass
@@ -294,6 +295,13 @@ class RequestTracer:
         self.max_requests = int(max_requests)
         self.decode_agg = int(decode_agg)
         self.max_events = int(max_events)
+        #: engine/replica tag (serving router): with N live engines,
+        #: each engine's tracer stamps its summaries and Chrome-trace
+        #: tracks with the engine id so cross-replica aggregations stay
+        #: separable. Set by the engine at construction
+        #: (``resolve_tracer(engine=...)``); None on a standalone
+        #: tracer.
+        self.engine: Optional[str] = None
         self._lock = threading.Lock()
         self._live: Dict[int, RequestTimeline] = {}
         self._done: deque = deque(maxlen=self.max_requests)
@@ -475,8 +483,16 @@ class RequestTracer:
     def summaries(self) -> Dict[int, Dict]:
         """``{rid: compact summary}`` for every retained timeline —
         the view the serving engine merges into
-        ``telemetry_snapshot()["components"]["serving"]``."""
-        return {tl.rid: tl.summary() for tl in self.timelines()}
+        ``telemetry_snapshot()["components"]["serving"]``. Each
+        summary carries the tracer's ``engine`` tag when set, so
+        cross-replica aggregations can tell whose request rid 3 was."""
+        out = {}
+        for tl in self.timelines():
+            s = tl.summary()
+            if self.engine is not None:
+                s["engine"] = self.engine
+            out[tl.rid] = s
+        return out
 
     # -- Chrome trace export ----------------------------------------------
 
@@ -490,11 +506,12 @@ class RequestTracer:
         request (queued/prefill/decode complete events), plus one
         ``s``/``f`` flow pair per request tying its submission to its
         completion across tracks. Durations in microseconds."""
+        tag = f"[{self.engine}]" if self.engine is not None else ""
         events: List[Dict] = [
             {"ph": "M", "pid": 0, "name": "process_name",
-             "args": {"name": "kv_slots"}},
+             "args": {"name": f"kv_slots{tag}"}},
             {"ph": "M", "pid": 1, "name": "process_name",
-             "args": {"name": "requests"}},
+             "args": {"name": f"requests{tag}"}},
         ]
         slots_seen = set()
         for tl in self.timelines():
@@ -566,14 +583,25 @@ class RequestTracer:
         return path
 
 
-def resolve_tracer(tracer=None, clock=now):
+def resolve_tracer(tracer=None, clock=now, engine=None):
     """THE engine ``tracer=`` kwarg policy (mirrors
     ``obs.resolve_tape``): ``False`` (or obs disabled) ->
     ``NULL_TRACER``; ``None`` -> a fresh auto tracer on ``clock``;
-    anything else is a user-configured tracer used as-is."""
+    anything else is a user-configured tracer used as-is.
+
+    ``engine`` stamps the tracer's engine/replica tag: a fresh auto
+    tracer always takes it; a user-configured tracer takes it only if
+    it has none yet (the first engine a shared tracer sees names it —
+    sharing one tracer across engines is not separable per request
+    and a router deployment should give each replica its own)."""
     from distkeras_tpu import obs
     if tracer is False or not obs.enabled():
         return NULL_TRACER
     if tracer is None:
-        return RequestTracer(clock=clock)
+        t = RequestTracer(clock=clock)
+        t.engine = engine
+        return t
+    if engine is not None and tracer.enabled \
+            and getattr(tracer, "engine", None) is None:
+        tracer.engine = engine
     return tracer

@@ -40,9 +40,16 @@ per-step decode kernels and an actual serving workload:
                    the flight-recorder ring and declarative SLOs live
                    in ``distkeras_tpu.obs`` (tracing/recorder/slo) and
                    are wired through the engine
+    router/        the horizontal tier: N engine replicas behind a
+                   prefix-affinity/least-loaded ``Router`` with
+                   lifecycle-managed ``EngineReplica``s, disaggregated
+                   prefill/decode pools (handoff = the engine's
+                   ``transfer_out``/``transfer_in`` re-entry path),
+                   replica-death mass failover and an
+                   ``SLOBurnController`` drain loop
 
-See ``docs/serving.md`` for the architecture, the paged-KV design and
-the scheduling policy.
+See ``docs/serving.md`` for the architecture, the paged-KV design,
+the scheduling policy and the router tier.
 """
 
 from distkeras_tpu.serving.engine import (DegradedRequest,  # noqa: F401
@@ -56,3 +63,10 @@ from distkeras_tpu.serving.scheduler import (AdmissionRejected,  # noqa: F401
                                              RequestState, TERMINAL_STATES)
 from distkeras_tpu.serving.speculation import (DraftModel,  # noqa: F401
                                                DraftSource, NgramDraft)
+from distkeras_tpu.serving.router import (EngineReplica,  # noqa: F401
+                                          LeastLoaded, PlacementPolicy,
+                                          PrefixAffinity, ReplicaDead,
+                                          ReplicaState,
+                                          ReplicaUnavailable, Router,
+                                          RouterClient,
+                                          SLOBurnController)

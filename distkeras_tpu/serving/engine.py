@@ -282,7 +282,8 @@ class ServingEngine:
                  spec_warmup: int = 8,
                  moe_decode: str = "dispatched",
                  ep_mesh=None,
-                 overlap: bool = True, fuse_steps: int = 0):
+                 overlap: bool = True, fuse_steps: int = 0,
+                 engine_id: Optional[str] = None):
         module = model.module
         if not isinstance(module, Sequential):
             raise TypeError("ServingEngine expects a Sequential LM "
@@ -408,6 +409,32 @@ class ServingEngine:
         self._comp_ver = 0
         self._rec_cache = (-1, None)
 
+        # --- engine identity (serving-router PR) ------------------------
+        # ``engine_id`` tags every process-global record this engine
+        # emits — flight-recorder ring entries, tracer timelines — and
+        # names its telemetry_snapshot() component: with N live engines
+        # behind a router the records would otherwise interleave
+        # indistinguishably. Default keeps the single-engine contract:
+        # the first live engine is plain "serving", later ones get a
+        # unique suffix.
+        if engine_id is None:
+            name = "serving"
+            if name in obs.components():
+                name = f"serving[{id(self):x}]"
+            self.engine_id = name
+        else:
+            self.engine_id = str(engine_id)
+            name = f"serving[{self.engine_id}]"
+            if name in obs.components():
+                # an alive engine already owns this id: disambiguate
+                # the id ITSELF (not just the component name) — two
+                # engines sharing a record tag is exactly the
+                # indistinguishable interleaving engine_id exists to
+                # prevent
+                self.engine_id = f"{self.engine_id}#{id(self):x}"
+                name = f"serving[{self.engine_id}]"
+        self._component_name = name
+
         self.metrics = metrics if metrics is not None else ServingMetrics()
         # request-level observability (obs.tracing / obs.recorder /
         # obs.slo): the tracer shares the metrics clock so timeline
@@ -417,7 +444,8 @@ class ServingEngine:
         # disabled); ``slo`` takes an SLOEngine or a sequence of
         # Objectives (evaluated every _SLO_EVAL_EVERY iterations and
         # reported by health())
-        self.tracer = resolve_tracer(tracer, clock=self.metrics.clock)
+        self.tracer = resolve_tracer(tracer, clock=self.metrics.clock,
+                                     engine=self.engine_id)
         self.scheduler.tracer = (self.tracer if self.tracer.enabled
                                  else None)
         self.recorder = resolve_recorder()
@@ -481,16 +509,13 @@ class ServingEngine:
         self._recompile = obs.RecompileDetector()
         self._warmed = set()                 # decode variants marked warm
         self._iters = 0
-        # first live engine owns the plain "serving" name; further
-        # engines get a unique suffix instead of silently displacing it
-        # (a displaced-then-GC'd registration would otherwise leave the
-        # still-alive first engine invisible in the snapshot). The bound
-        # method is WeakMethod-held by attach, so the registry never
-        # keeps this engine (and its KV pool) alive.
-        name = "serving"
-        if name in obs.components():
-            name = f"serving[{id(self):x}]"
-        obs.attach(name, self._telemetry_summary, owner=self)
+        # component name resolved in the engine-identity block above
+        # (first live engine owns plain "serving"; explicit engine_id
+        # attaches as "serving[<id>]"). The bound method is
+        # WeakMethod-held by attach, so the registry never keeps this
+        # engine (and its KV pool) alive.
+        obs.attach(self._component_name, self._telemetry_summary,
+                   owner=self)
 
     #: engine iterations between recompile-detector polls
     _RECOMPILE_CHECK_EVERY = 64
@@ -937,7 +962,8 @@ class ServingEngine:
         extra = ({"pages_free": self.pool.free_pages}
                  if self.kv_layout == "paged" else {})
         self.recorder.record(
-            "serving.iteration", iter=self._iters,
+            "serving.iteration", engine=self.engine_id,
+            iter=self._iters,
             queue_depth=sch.queue_depth, occupied=sch.occupied,
             decoding=decoding, prefilling=prefilling,
             admitted=[r.rid for r in admitted], **extra)
@@ -1024,7 +1050,8 @@ class ServingEngine:
             # storm detection lives in the recorder: enough sheds since
             # the last dump auto-snapshot the ring (overload forensics)
             self.recorder.note_rejection(
-                rid=req.rid, queue_depth=self.scheduler.queue_depth,
+                rid=req.rid, engine=self.engine_id,
+                queue_depth=self.scheduler.queue_depth,
                 max_queue=self.scheduler.max_queue)
             raise
         self._requests[req.rid] = req
@@ -1576,7 +1603,8 @@ class ServingEngine:
         self.tracer.on_preempt(victim.rid, len(victim.generated))
         if self.recorder.enabled:
             self.recorder.record(
-                "serving.preempted", rid=victim.rid, slot=slot,
+                "serving.preempted", engine=self.engine_id,
+                rid=victim.rid, slot=slot,
                 n_generated=len(victim.generated), pages_freed=freed,
                 pages_free=self.pool.free_pages)
 
@@ -1825,6 +1853,109 @@ class ServingEngine:
         self._terminate(req, RequestState.CANCELLED, out)
         self.metrics.record_cancelled(rid)
         return out[0]
+
+    # --- replica handoff (serving-router PR) ------------------------------
+
+    def transfer_out(self, rid: int) -> Optional[Request]:
+        """Detach a LIVE request from this engine so another engine can
+        ``transfer_in`` it — the serving router's handoff primitive
+        (prefill→decode disaggregation, drain rebalancing). An admitted
+        request first leaves through the proven preempt path (pipeline
+        drained, pages freed, sampling key snapshotted on ``req.rng``),
+        then exits the queue and the engine entirely; a queued request
+        just leaves the queue. Returns the detached ``Request``
+        (QUEUED, slotless — ready for ``transfer_in``), or None when
+        draining the pipeline FINISHED the request instead (it will be
+        returned by this engine's next ``step()`` like any terminal)."""
+        req = self._requests[rid]
+        if req.state in (RequestState.PREFILLING,
+                         RequestState.DECODING):
+            if self.kv_layout != "paged":
+                raise RuntimeError(
+                    "transfer_out of an admitted request needs the "
+                    "paged engine (the resumable re-prefill path)")
+            self._preempt(req)
+            if req.state in TERMINAL_STATES:
+                return None          # the pipeline flush finished it
+        if req.state is not RequestState.QUEUED:
+            raise RuntimeError(
+                f"cannot transfer request {rid} in state "
+                f"{req.state.value!r}")
+        self.scheduler.waiting.remove(req)
+        del self._requests[rid]
+        self.metrics.record_transfer(rid)
+        # ticks precede terminals (the _finish rule): the deferred
+        # host-window buffers may hold this request's decode ticks,
+        # and on_terminal retires its timeline — flush first or the
+        # transferred timeline undercounts decode_iters
+        self._flush_host_window()
+        self.tracer.on_terminal(rid, "transferred", len(req.generated))
+        if self.recorder.enabled:
+            self.recorder.record(
+                "serving.transferred", engine=self.engine_id, rid=rid,
+                n_generated=len(req.generated))
+        return req
+
+    def transfer_in(self, req: Request) -> int:
+        """Admit a request detached from another engine
+        (``transfer_out``) or reconstructed by the router after a
+        replica death. Re-entry is exactly the preemption-resume
+        contract: the context (``prompt + generated[:-1]``) re-prefills
+        HEAD-LESS here and decode continues from ``req.rng`` — token-
+        identically (byte-identically for sampled streams) to an
+        uninterrupted single-engine run. Mints a fresh LOCAL rid
+        (returned; the router keeps the stable fleet-wide id). A
+        ``deadline_s`` restarts on this engine's clock — cross-replica
+        deadline budgets are the router's concern. Raises
+        ``AdmissionRejected`` when this engine's bounded queue is full
+        (the router then tries the next replica)."""
+        prompt = np.asarray(req.prompt, np.int32).reshape(-1)
+        if prompt.size < 1:
+            raise ValueError("request prompt is empty")
+        if prompt.size + req.max_new_tokens > self.max_len:
+            raise ValueError(
+                f"prompt ({prompt.size}) + max_new_tokens "
+                f"({req.max_new_tokens}) exceeds the slot capacity "
+                f"max_len={self.max_len}")
+        if req.generated and self.kv_layout != "paged":
+            raise ValueError(
+                "transfer_in of a decode-progress request needs the "
+                "paged engine (the resumable re-prefill path)")
+        if self.kv_layout == "paged":
+            worst = self.pool.pages_for(prompt.size + req.max_new_tokens)
+            if worst > self.pool.num_pages:
+                raise ValueError(
+                    f"request needs up to {worst} pages but the pool "
+                    f"holds {self.pool.num_pages}")
+        req.prompt = prompt
+        req.rid = next(self._rid)
+        req.slot = None
+        req.prefill_pos = 0
+        req.error = None
+        # scrub SOURCE-engine-local bookkeeping: shared-prefix lengths
+        # and page ids refer to the other engine's pool — stale values
+        # here would make this engine's prefill load foreign page ids
+        req._shared_len = 0
+        req._n_shared_full = 0
+        req._load_pages = []
+        req._donor_ref = None
+        if req.rng is None:
+            req.rng = jax.random.PRNGKey(req.seed)
+        try:
+            self.scheduler.submit(req)
+        except AdmissionRejected:
+            self.metrics.record_rejected()
+            self.tracer.on_reject()
+            self.recorder.note_rejection(
+                rid=req.rid, engine=self.engine_id,
+                queue_depth=self.scheduler.queue_depth,
+                max_queue=self.scheduler.max_queue)
+            raise
+        self._requests[req.rid] = req
+        req.submit_t = self.metrics.clock()
+        self.metrics.record_submit(req.rid)
+        self.tracer.on_submit(req.rid, self.scheduler.queue_depth)
+        return req.rid
 
     def _terminate(self, req: Request, state, finished: List[Request],
                    error: Optional[BaseException] = None) -> None:

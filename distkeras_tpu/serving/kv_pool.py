@@ -395,11 +395,39 @@ class PrefixCache:
         #: without this, every lookup scanned ALL children of the
         #: chain end, O(distinct prompts) per admission)
         self._first: Dict[int, Dict[int, List[_Node]]] = {}
+        #: routing signal (serving router): first-page key -> how many
+        #: times ``match()`` served a chain rooted at that page. The
+        #: dict is bounded by the root's live children (entries die
+        #: with their node in ``evict_one``)
+        self._hits: Dict[bytes, int] = {}
         self._nid = itertools.count(1)
         self._tick = itertools.count()
 
     def __len__(self) -> int:
         return len(self._nodes)
+
+    # -- router affinity signal ---------------------------------------------
+
+    def affinity_key(self, tokens) -> bytes:
+        """Cheap placement key for prefix-affinity routing: the byte
+        string of the prompt's FIRST page-sized token run — the trie's
+        root edge, so two prompts share cached pages only if their
+        affinity keys agree. A prompt shorter than one full page can
+        never share a full page; its (short) raw bytes come back and
+        ``probe()`` simply misses."""
+        toks = np.ascontiguousarray(np.asarray(tokens, np.int32))
+        return toks[:self._pool.page_len].tobytes()
+
+    def probe(self, key: bytes) -> Optional[int]:
+        """Side-effect-free affinity probe (no LRU touch, no counter
+        bump — a router may call this per replica per submit): ``None``
+        when no registered chain starts with this page run, else the
+        number of times ``match()`` has served a chain rooted at it
+        (0 = resident but not yet re-used). The serving router ranks
+        replicas by this signal (``serving.router.PrefixAffinity``)."""
+        if key not in self._children.get(0, {}):
+            return None
+        return self._hits.get(key, 0)
 
     def match(self, tokens) -> Tuple[List[int], int, Optional[int]]:
         """Longest shared prefix of ``tokens``: returns ``(full_pages,
@@ -417,11 +445,15 @@ class PrefixCache:
         pos = 0
         # full pages, capped so shared_len stays <= n - 1
         while pos + pl < n:
-            node = self._children.get(parent, {}).get(
-                toks[pos:pos + pl].tobytes())
+            key = toks[pos:pos + pl].tobytes()
+            node = self._children.get(parent, {}).get(key)
             if node is None:
                 break
             node.last_used = tick
+            if parent == 0:
+                # affinity hit counter: this chain's root page served
+                # a match (the router's "hot prefix" signal)
+                self._hits[key] = self._hits.get(key, 0) + 1
             pages.append(node.page)
             parent = node.nid
             pos += pl
@@ -495,6 +527,8 @@ class PrefixCache:
         del self._children[victim.parent][victim.key]
         del self._children[victim.nid]
         del self._nodes[victim.nid]
+        if victim.parent == 0:
+            self._hits.pop(victim.key, None)
         tok0 = int(np.frombuffer(victim.key, np.int32)[0])
         bucket = self._first.get(victim.parent, {}).get(tok0, [])
         if victim in bucket:

@@ -92,6 +92,11 @@ class ServingMetrics:
             "serving.prefix_lookup_tokens")
         self._preempted = self.registry.counter(
             "serving.requests_preempted")
+        # serving router (router PR): requests detached from this
+        # engine for re-admission on another replica (prefill->decode
+        # handoff, drain rebalancing) — NOT terminal, NOT preemptions
+        self._transferred = self.registry.counter(
+            "serving.requests_transferred")
         # speculative decoding (spec-decode PR): drafts offered to the
         # verify step vs drafts the target accepted, plus a per-slot
         # per-iteration acceptance-rate histogram (the bench's
@@ -179,6 +184,15 @@ class ServingMetrics:
         stay — TTFT already fired and latency measures to the real
         finish, across however many preemptions."""
         self._preempted.inc()
+
+    def record_transfer(self, rid: int) -> None:
+        """A request left this engine ALIVE (``transfer_out``: router
+        handoff or rebalancing). Its in-flight timestamps are evicted —
+        the window must not leak entries for requests that will finish
+        on another replica's metrics window."""
+        self.submit_ts.pop(rid, None)
+        self.first_ts.pop(rid, None)
+        self._transferred.inc()
 
     def record_prefix_lookup(self, hit_tokens: int,
                              total_tokens: int) -> None:
@@ -284,6 +298,10 @@ class ServingMetrics:
         return int(self._preempted.value())
 
     @property
+    def requests_transferred(self) -> int:
+        return int(self._transferred.value())
+
+    @property
     def spec_proposed(self) -> int:
         return int(self._spec_proposed.value())
 
@@ -380,6 +398,9 @@ class ServingMetrics:
             # budget at the last iteration, prefix-cache hit rate,
             # preemption count; "pages" is None on a slab engine
             "requests_preempted": self.requests_preempted,
+            # serving-router tally (key ADDED by the router PR):
+            # live departures to another replica
+            "requests_transferred": self.requests_transferred,
             "pages": (None if pages_free is None else {
                 "free": int(pages_free),
                 "shared": int(self._pages_shared.value() or 0),

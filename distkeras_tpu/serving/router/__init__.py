@@ -1,0 +1,31 @@
+"""Horizontal serving tier: N ``ServingEngine`` replicas behind a
+prefix-affinity router with disaggregated prefill/decode and
+SLO-burn-driven drain (ROADMAP item 2; docs/serving.md §Router).
+
+    replica.py     ``EngineReplica`` — one engine + the
+                   STARTING→SERVING→DRAINING→DEAD lifecycle, cheap
+                   placement signals, per-replica record labels
+    policies.py    ``LeastLoaded`` (queue depth + free-page budget)
+                   and ``PrefixAffinity`` (route prompts whose leading
+                   pages are hot on a replica's ``PrefixCache`` there)
+    router.py      ``Router`` — the submit/step/run/stream client
+                   surface over the fleet, prefill→decode handoff via
+                   the engine's ``transfer_out``/``transfer_in``
+                   re-entry path, replica-death mass failover with
+                   seed-replayed sampling keys
+    controller.py  ``SLOBurnController`` — drain replicas burning
+                   their SLO error budget, rebalance their queues,
+                   resume on recovery
+
+Everything the router does preserves the oracle contract: tokens are
+identical (byte-identical sampled) to a single engine / ``generate()``.
+"""
+
+from distkeras_tpu.serving.router.controller import (  # noqa: F401
+    SLOBurnController)
+from distkeras_tpu.serving.router.policies import (  # noqa: F401
+    LeastLoaded, PlacementPolicy, PrefixAffinity)
+from distkeras_tpu.serving.router.replica import (  # noqa: F401
+    EngineReplica, ReplicaDead, ReplicaState, ReplicaUnavailable)
+from distkeras_tpu.serving.router.router import (  # noqa: F401
+    Router, RouterClient)

@@ -1,0 +1,124 @@
+"""SLO-burn drain controller: the pressure loop that turns per-replica
+SLO burn rates (``obs.slo``) into fleet actions.
+
+The single-engine degradation story ends at ``health() ==
+"degraded"`` — a probe's hint. With a fleet there is a real action to
+take: a replica burning its error budget faster than
+``drain_above`` stops taking traffic (``drain()`` — in-flight streams
+finish, queued work is rebalanced onto the rest of the fleet through
+the token-identical transfer path) and returns to service once its
+burn has recovered below ``resume_below`` (hysteresis, so a replica
+hovering at the threshold does not flap). ``min_serving`` replicas are
+always left serving — draining the whole fleet is worse than serving
+degraded.
+
+Wire it with ``router.attach_controller(ctl)`` (ticked every
+``Router._CTL_EVERY`` steps) or call ``tick()`` on your own cadence.
+Burn rates come from each replica's own ``SLOEngine``
+(``ServingEngine(slo=[...])``); replicas without objectives are left
+alone.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from distkeras_tpu import obs
+from distkeras_tpu.obs.recorder import resolve_recorder
+from distkeras_tpu.serving.router.replica import ReplicaState
+
+__all__ = ["SLOBurnController"]
+
+
+class SLOBurnController:
+    """Drain replicas whose max SLO burn rate exceeds ``drain_above``;
+    resume them below ``resume_below`` (must be <= ``drain_above``).
+    A burn rate of 1.0 means the error budget spends exactly as fast
+    as it accrues, so the default 2.0 drains a replica burning at
+    twice budget — the SRE-workbook "fast burn" alert shape."""
+
+    def __init__(self, router, *, drain_above: float = 2.0,
+                 resume_below: float = 1.0, min_serving: int = 1,
+                 rebalance: bool = True):
+        if drain_above <= 0:
+            raise ValueError(
+                f"drain_above must be > 0, got {drain_above}")
+        if not 0 <= resume_below <= drain_above:
+            raise ValueError(
+                f"resume_below must be in [0, drain_above], got "
+                f"{resume_below}")
+        if min_serving < 1:
+            raise ValueError(
+                f"min_serving must be >= 1, got {min_serving}")
+        self.router = router
+        self.drain_above = float(drain_above)
+        self.resume_below = float(resume_below)
+        self.min_serving = int(min_serving)
+        self.rebalance = bool(rebalance)
+        self.recorder = resolve_recorder()
+        reg = obs.get_registry()
+        self._c_drain = reg.counter("router.slo_drains")
+        self._c_resume = reg.counter("router.slo_resumes")
+        #: replicas THIS controller drained (only these are auto-resumed
+        #: — an operator's manual drain() is never overridden)
+        self._drained: Dict[str, bool] = {}
+
+    def tick(self) -> Dict[str, str]:
+        """One control pass; returns ``{replica name: action}`` for the
+        replicas acted on (``"drain"`` / ``"resume"``)."""
+        actions: Dict[str, str] = {}
+        # prune stale drain ownership: a replica an operator manually
+        # resumed (or that died) is no longer "ours" — a LATER manual
+        # drain() must stand instead of being auto-resumed against the
+        # documented contract
+        for name in list(self._drained):
+            rep = next((r for r in self.router.replicas
+                        if r.name == name), None)
+            if rep is None or rep.state is not ReplicaState.DRAINING:
+                self._drained.pop(name, None)
+        serving = [r for r in self.router.replicas
+                   if r.state is ReplicaState.SERVING]
+        for r in list(serving):
+            burn = r.slo_burn()
+            if burn is None or burn <= self.drain_above:
+                continue
+            if len(serving) - 1 < self.min_serving:
+                break                 # never drain below the floor
+            r.drain()
+            serving.remove(r)
+            self._drained[r.name] = True
+            self._c_drain.inc(replica=r.name)
+            actions[r.name] = "drain"
+            if self.recorder.enabled:
+                self.recorder.record(
+                    "router.slo_drain", replica=r.name,
+                    burn_rate=round(burn, 4),
+                    threshold=self.drain_above)
+            if self.rebalance:
+                self.router.rebalance_queued(r)
+        for r in self.router.replicas:
+            if r.state is not ReplicaState.DRAINING \
+                    or not self._drained.get(r.name):
+                continue
+            burn = self._recovered_burn(r)
+            if burn is not None and burn > self.resume_below:
+                continue
+            r.resume()
+            self._drained.pop(r.name, None)
+            self._c_resume.inc(replica=r.name)
+            actions[r.name] = "resume"
+            if self.recorder.enabled:
+                self.recorder.record(
+                    "router.slo_resume", replica=r.name,
+                    burn_rate=None if burn is None else round(burn, 4))
+        return actions
+
+    def _recovered_burn(self, replica) -> Optional[float]:
+        """Burn rate used for the resume decision. The metrics window
+        that breached keeps its bad samples forever (reservoirs are
+        windowless), so operators typically swap a fresh
+        ``ServingMetrics`` window per reporting interval — with the old
+        window still attached the replica simply resumes once the
+        breach samples age out of a swapped window or the burn math
+        recovers."""
+        return replica.slo_burn()

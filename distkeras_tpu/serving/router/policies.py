@@ -1,0 +1,102 @@
+"""Placement policies: which replica a new request lands on.
+
+A policy ranks the admission-capable candidates; the router tries them
+in order (the next candidate absorbs an ``AdmissionRejected`` from the
+first, so a full queue degrades placement instead of shedding the
+request while capacity exists elsewhere).
+
+``LeastLoaded`` is the load-signal baseline: emptiest queue first,
+then the biggest free-page budget — exactly the two numbers
+``health()`` exposes, read through the replica's cheap accessors (no
+device sync, no SLO evaluation).
+
+``PrefixAffinity`` is the KV-locality policy the prefix cache makes
+profitable: route a prompt to the replica whose ``PrefixCache``
+already holds its leading pages, so prefill skips the shared positions
+there (``PrefixCache.affinity_key`` is the O(1) routing key;
+``probe()`` the side-effect-free hot-counter accessor). Replicas that
+have never seen the prefix fall through to the load order — which also
+spreads DISTINCT templates across the fleet (each template sticks to
+the replica that first served it), partitioning the fleet's aggregate
+prefix-cache capacity instead of duplicating every template
+everywhere. See ``docs/serving.md`` §Router.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+from distkeras_tpu.serving.router.replica import EngineReplica
+
+__all__ = ["LeastLoaded", "PlacementPolicy", "PrefixAffinity",
+           "resolve_policy"]
+
+
+class PlacementPolicy:
+    """Rank candidate replicas for one placement, best first. The
+    router calls ``rank`` with the SERVING, role-eligible candidates
+    and tries them in order."""
+
+    def rank(self, candidates: Sequence[EngineReplica],
+             prompt) -> List[EngineReplica]:
+        raise NotImplementedError
+
+
+class LeastLoaded(PlacementPolicy):
+    """Emptiest queue, then largest free-page budget, then fewest
+    occupied slots; replica name as the deterministic tiebreak (tests
+    and traces stay reproducible)."""
+
+    def rank(self, candidates, prompt):
+        return sorted(
+            candidates,
+            key=lambda r: (r.queue_depth, -r.free_pages, r.occupied,
+                           r.name))
+
+
+class PrefixAffinity(PlacementPolicy):
+    """Replicas whose prefix cache holds the prompt's leading page
+    first (hottest chain wins); everything else in the fallback
+    policy's order. A replica drowning in backlog is skipped even on a
+    cache hit (``max_queue_advantage``): affinity is a prefill
+    discount, not a reason to queue behind ``n`` strangers."""
+
+    def __init__(self, fallback: PlacementPolicy = None,
+                 max_queue_advantage: int = 4):
+        self.fallback = fallback if fallback is not None else LeastLoaded()
+        self.max_queue_advantage = int(max_queue_advantage)
+
+    def rank(self, candidates, prompt):
+        ordered = self.fallback.rank(candidates, prompt)
+        if not ordered:
+            return ordered
+        min_depth = min(r.queue_depth for r in ordered)
+        hot, cold = [], []
+        for r in ordered:
+            cache = r.engine.prefix
+            hits = None
+            if cache is not None:
+                hits = cache.probe(cache.affinity_key(prompt))
+            if hits is not None and (
+                    r.queue_depth - min_depth
+                    <= self.max_queue_advantage):
+                hot.append((hits, r))
+            else:
+                cold.append(r)
+        # hottest chain first; the fallback order breaks hit ties
+        hot.sort(key=lambda hr: -hr[0])
+        return [r for _, r in hot] + cold
+
+
+def resolve_policy(policy) -> PlacementPolicy:
+    """Router kwarg policy: a ``PlacementPolicy`` passes through;
+    ``"least_loaded"`` / ``"prefix_affinity"`` name the built-ins."""
+    if isinstance(policy, PlacementPolicy):
+        return policy
+    if policy == "least_loaded":
+        return LeastLoaded()
+    if policy == "prefix_affinity":
+        return PrefixAffinity()
+    raise ValueError(
+        f"unknown placement policy {policy!r}: pass 'least_loaded', "
+        "'prefix_affinity' or a PlacementPolicy instance")

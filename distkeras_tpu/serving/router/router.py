@@ -1,0 +1,492 @@
+"""The router: N engine replicas behind one submit/step/run surface.
+
+``Router`` mirrors the single-engine client API (``submit`` →
+fleet-wide request id, ``step`` → finished requests, ``run`` → drain,
+``stream`` → incremental tokens, ``cancel``, ``health``) over a fleet
+of ``EngineReplica``s, adding the three fleet-only behaviors:
+
+* **Placement** (``policies``): every submit is dispatched to one
+  SERVING replica — prefix-affinity (route prompts whose leading pages
+  are hot on a replica's ``PrefixCache`` to that replica) or
+  least-loaded (queue depth + free-page budget). A replica that sheds
+  (``AdmissionRejected``) falls through to the next candidate; the
+  router sheds only when EVERY eligible replica refused.
+
+* **Disaggregated prefill/decode** (replica ``role``): fresh requests
+  land on prefill-class replicas; the moment a stream emits its first
+  token the router hands it to a decode-class replica through
+  ``ServingEngine.transfer_out``/``transfer_in`` — the proven
+  preempt/resume re-entry path, so the handoff is a token-identical
+  re-prefill of ``prompt + generated[:-1]`` on the target (page
+  shipping is the documented follow-up; the oracle stays this path).
+
+* **Failure + pressure handling**: any exception escaping a replica's
+  ``step()`` (the ``replica.die`` chaos point included) marks it DEAD
+  and mass-fails-over its in-flight requests — re-admitted elsewhere
+  from the router-visible request log alone (host token mirror; the
+  sampling key is REPLAYED from the request seed, one split per
+  emitted token — the engine's exact key-stream rule — so sampled
+  streams complete byte-identically without trusting any dead-engine
+  state). ``drain()``ed replicas shed new work while in-flight streams
+  finish; the ``SLOBurnController`` drives drains from SLO burn rates
+  and rebalances queued work off draining replicas.
+
+Token-identity contract (the oracle tests pin it): every request
+routed, handed off, failed over or drained through the router produces
+the same tokens — byte-identical for sampled streams — as a single
+engine (equivalently ``generate()``).
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from distkeras_tpu import obs
+from distkeras_tpu.obs.recorder import resolve_recorder
+from distkeras_tpu.resilience import faults
+from distkeras_tpu.serving.engine import DegradedRequest, ServingEngine
+from distkeras_tpu.serving.router.policies import resolve_policy
+from distkeras_tpu.serving.router.replica import (EngineReplica,
+                                                  ReplicaState)
+from distkeras_tpu.serving.scheduler import (AdmissionRejected, Request,
+                                             RequestState,
+                                             TERMINAL_STATES)
+
+__all__ = ["Router", "RouterClient"]
+
+
+def _replay_key(seed: int, n_tokens: int) -> np.ndarray:
+    """The sampling key of a live stream that has emitted ``n_tokens``
+    tokens, reconstructed from its seed alone: the engine's key stream
+    advances by exactly ONE ``split`` (carrying row 0) per emitted
+    token — first token, plain decode, fused windows and speculative
+    verify all keep that rule — so failover needs no key state from
+    the dead replica."""
+    key = jax.random.PRNGKey(int(seed))
+    for _ in range(int(n_tokens)):
+        key = jax.random.split(key)[0]
+    return np.array(key)
+
+
+class _Tracked:
+    """Router-side record of one in-flight request: the stable
+    fleet-wide id, the replica currently serving it, and the live
+    ``Request`` object (the router's request log — its host token
+    mirror is what failover trusts)."""
+
+    __slots__ = ("grid", "replica", "req", "handoffs", "failovers")
+
+    def __init__(self, grid: int, replica: EngineReplica, req: Request):
+        self.grid = grid
+        self.replica = replica          # None while orphaned
+        self.req = req
+        self.handoffs = 0
+        self.failovers = 0
+
+
+class Router:
+    """See module doc. ``replicas`` is a sequence of ``EngineReplica``
+    (or bare paged ``ServingEngine``s, auto-wrapped ``role="both"``
+    with their ``engine_id`` as the replica name). Roles either all
+    ``"both"`` (homogeneous fleet) or at least one ``"prefill"`` AND
+    one ``"decode"`` (disaggregated; ``"both"`` replicas then serve in
+    both pools). ``policy`` places fresh admissions;
+    decode-handoff/failover placement always uses the same policy over
+    the decode-capable pool."""
+
+    #: router steps between attached-controller ticks
+    _CTL_EVERY = 16
+
+    def __init__(self, replicas, *, policy="prefix_affinity",
+                 start: bool = True):
+        reps: List[EngineReplica] = []
+        for r in replicas:
+            if isinstance(r, ServingEngine):
+                r = EngineReplica(r)
+            reps.append(r)
+        if not reps:
+            raise ValueError("Router needs at least one replica")
+        names = [r.name for r in reps]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate replica names: {names}")
+        roles = {r.role for r in reps}
+        if roles - {"both"} and not (
+                {"prefill", "both"} & roles and {"decode", "both"} & roles):
+            raise ValueError(
+                "disaggregated fleets need at least one prefill-capable "
+                "AND one decode-capable replica "
+                f"(roles: {sorted(roles)})")
+        self.replicas = reps
+        self.policy = resolve_policy(policy)
+        #: disaggregated = any role-split replica exists: the router
+        #: then migrates streams off prefill-class replicas at first
+        #: token
+        self.disaggregated = bool(roles - {"both"})
+        self.controller = None
+        self._grid = itertools.count()
+        self._requests: Dict[int, _Tracked] = {}
+        #: (id(replica), local rid) -> grid
+        self._local: Dict[Tuple[int, int], int] = {}
+        #: detached requests awaiting a replica (all targets shed)
+        self._orphans: List[_Tracked] = []
+        #: terminals surfaced out-of-band (death sweep, cancel races)
+        self._finish_buf: List[Tuple[int, Request]] = []
+        self._steps = 0
+        self.recorder = resolve_recorder()
+        # registry series for exporters (labeled by replica where it
+        # means something) + plain totals for counters()/bench reads
+        reg = obs.get_registry()
+        self._c_dispatch = reg.counter("router.dispatched")
+        self._c_handoff = reg.counter("router.handoffs")
+        self._c_failover = reg.counter("router.failovers")
+        self._c_rebalance = reg.counter("router.rebalanced")
+        self._c_shed = reg.counter("router.rejected")
+        self._n: Dict[str, int] = {
+            "dispatched": 0, "handoffs": 0, "failovers": 0,
+            "rebalanced": 0, "rejected": 0}
+        if start:
+            for r in reps:
+                if r.state is ReplicaState.STARTING:
+                    r.start()
+
+    # -- pools -------------------------------------------------------------
+
+    def _admission_pool(self) -> List[EngineReplica]:
+        """Replicas a FRESH request may land on."""
+        return [r for r in self.replicas
+                if r.state is ReplicaState.SERVING
+                and r.role in ("both", "prefill")]
+
+    def _decode_pool(self) -> List[EngineReplica]:
+        """Replicas a decode-progress stream may land on."""
+        return [r for r in self.replicas
+                if r.state is ReplicaState.SERVING
+                and r.role in ("both", "decode")]
+
+    def replica(self, name: str) -> EngineReplica:
+        for r in self.replicas:
+            if r.name == name:
+                return r
+        raise KeyError(name)
+
+    def attach_controller(self, controller) -> None:
+        """Tick ``controller`` every ``_CTL_EVERY`` router steps (the
+        SLO-burn drain controller's cadence)."""
+        self.controller = controller
+
+    # -- client surface ----------------------------------------------------
+
+    def submit(self, prompt, max_new_tokens: int, **kw) -> int:
+        """Place one request on the fleet; returns its FLEET-WIDE id
+        (stable across handoffs and failovers — local engine rids are
+        an implementation detail). Tries the policy's ranked candidates
+        in order; raises ``AdmissionRejected`` only when every eligible
+        replica shed."""
+        # chaos hook: a dispatch fault fires BEFORE any placement or
+        # tracking state mutates, so a failed dispatch leaves the
+        # router consistent (the caller retries wholesale)
+        faults.point("router.dispatch")
+        candidates = self._admission_pool()
+        last_shed: Optional[AdmissionRejected] = None
+        for r in self.policy.rank(candidates, prompt):
+            try:
+                rid = r.submit(prompt, max_new_tokens, **kw)
+            except AdmissionRejected as e:
+                last_shed = e
+                continue
+            grid = next(self._grid)
+            tr = _Tracked(grid, r, r.engine[rid])
+            self._requests[grid] = tr
+            self._local[(id(r), rid)] = grid
+            self._c_dispatch.inc(replica=r.name)
+            self._n["dispatched"] += 1
+            return grid
+        self._c_shed.inc()
+        self._n["rejected"] += 1
+        if last_shed is not None:
+            raise last_shed
+        raise AdmissionRejected(0, 0)    # no admission-capable replica
+
+    def __getitem__(self, grid: int) -> Request:
+        """The live ``Request`` behind a fleet id (its host token
+        mirror — the object may move between replicas)."""
+        return self._requests[grid].req
+
+    @property
+    def pending(self) -> bool:
+        return bool(self._requests or self._finish_buf)
+
+    def step(self) -> Dict[int, Request]:
+        """One fleet iteration: every live replica advances one engine
+        iteration (a replica failure here triggers the failover sweep,
+        not an exception), then — disaggregated fleets — streams whose
+        first token just landed on a prefill-class replica hand off to
+        the decode pool. Returns ``{fleet id: terminal Request}``."""
+        finished: Dict[int, Request] = {}
+        for grid, req in self._finish_buf:
+            finished[grid] = req
+        self._finish_buf.clear()
+        for r in list(self.replicas):
+            if r.state is ReplicaState.DEAD or not r.pending:
+                continue
+            try:
+                done = r.step()
+            except Exception as e:     # lint: allow-swallow (fleet failover: the error is kept on the replica and every request is re-homed)
+                self._on_replica_death(r, e)
+                continue
+            for req in done:
+                grid = self._local.pop((id(r), req.rid), None)
+                if grid is None:
+                    continue           # not router-placed (direct use)
+                self._requests.pop(grid, None)
+                finished[grid] = req
+        if self.disaggregated:
+            self._handoff_pass()
+        if self._orphans:
+            self._retry_orphans()
+        self._steps += 1
+        if self.controller is not None \
+                and self._steps % self._CTL_EVERY == 0:
+            self.controller.tick()
+        for grid, req in self._finish_buf:
+            finished[grid] = req       # produced by handoff/cancel races
+        self._finish_buf.clear()
+        return finished
+
+    def run(self, max_steps: Optional[int] = None,
+            on_degraded: str = "raise") -> Dict[int, np.ndarray]:
+        """Drive ``step()`` until every routed request is terminal;
+        returns ``{fleet id: tokens}`` — the same contract as
+        ``ServingEngine.run`` (``DegradedRequest`` on TIMED_OUT /
+        CANCELLED drains unless ``on_degraded="return"``)."""
+        if on_degraded not in ("raise", "return"):
+            raise ValueError(
+                f"on_degraded must be 'raise' or 'return', "
+                f"got {on_degraded!r}")
+        out: Dict[int, np.ndarray] = {}
+        steps = 0
+        while self.pending:
+            for grid, req in self.step().items():
+                if req.state is not RequestState.FINISHED \
+                        and on_degraded == "raise":
+                    self.recorder.auto_dump(
+                        f"degraded_request:{req.state.value}")
+                    raise DegradedRequest(req)
+                out[grid] = req.tokens
+            steps += 1
+            if max_steps is not None and steps >= max_steps \
+                    and self.pending:
+                raise RuntimeError(
+                    f"router made no full drain in {max_steps} steps "
+                    f"({len(self._requests)} requests in flight)")
+        return out
+
+    def stream(self, grid: int):
+        """Generator of this request's GENERATED tokens as the fleet
+        produces them (drives ``step()`` while waiting — single-thread
+        streaming; finished neighbours drained meanwhile surface via
+        later ``step()``/``run`` calls is NOT supported here, so use
+        one driver). The stream is seamless across handoffs and
+        failovers: the router-side token log persists while the
+        request moves."""
+        tr = self._requests.get(grid)
+        if tr is None:
+            raise KeyError(grid)
+        sent = 0
+        while True:
+            gen = tr.req.generated
+            while sent < len(gen):
+                yield int(gen[sent])
+                sent += 1
+            if tr.req.state in TERMINAL_STATES \
+                    and sent >= len(tr.req.generated):
+                return
+            self.step()
+
+    def cancel(self, grid: int) -> Request:
+        """Cancel a routed request wherever it currently lives."""
+        tr = self._requests.pop(grid)
+        if tr.replica is None:                    # orphaned: no engine
+            self._orphans = [o for o in self._orphans if o is not tr]
+            tr.req.state = RequestState.CANCELLED
+            return tr.req
+        self._local.pop((id(tr.replica), tr.req.rid), None)
+        return tr.replica.engine.cancel(tr.req.rid)
+
+    # -- migration ---------------------------------------------------------
+
+    def _targets_for(self, req: Request) -> List[EngineReplica]:
+        pool = (self._decode_pool() if req.generated
+                else self._admission_pool())
+        return self.policy.rank(pool, req.prompt)
+
+    def _place(self, tr: _Tracked, req: Request,
+               exclude: Optional[EngineReplica] = None):
+        """THE placement loop (every migration/failover/retry path
+        funnels through here so the mapping bookkeeping cannot drift):
+        try the policy's ranked targets; on success bind ``tr`` to the
+        target and return it, else detach ``tr`` onto the orphan retry
+        queue and return None."""
+        for target in self._targets_for(req):
+            if target is exclude:
+                continue
+            try:
+                new_rid = target.transfer_in(req)
+            except AdmissionRejected:
+                continue
+            tr.replica = target
+            self._local[(id(target), new_rid)] = tr.grid
+            return target
+        tr.replica = None
+        if tr not in self._orphans:
+            self._orphans.append(tr)
+        return None
+
+    def _migrate(self, tr: _Tracked, counter, kind: str,
+                 nkey: str) -> bool:
+        """Move one live request off its replica through
+        ``transfer_out``/``transfer_in``. Returns True when it landed
+        somewhere; False when it finished during the pipeline drain
+        (stays on the source for delivery) or no target accepted (the
+        request is orphaned and retried next step)."""
+        src = tr.replica
+        old_key = (id(src), tr.req.rid)
+        req = src.engine.transfer_out(tr.req.rid)
+        if req is None:
+            return False       # finished mid-drain; src delivers it
+        self._local.pop(old_key, None)
+        target = self._place(tr, req, exclude=src)
+        if target is None:
+            return False
+        counter.inc()
+        self._n[nkey] += 1
+        if self.recorder.enabled:
+            self.recorder.record(
+                f"router.{kind}", grid=tr.grid,
+                src=src.name, dst=target.name,
+                n_generated=len(req.generated))
+        return True
+
+    def _handoff_pass(self) -> None:
+        """Disaggregated fleets: a stream whose first token landed on a
+        prefill-class replica moves to the decode pool (token-identical
+        re-prefill re-entry on the target)."""
+        for tr in list(self._requests.values()):
+            if tr.replica is None or tr.replica.role != "prefill":
+                continue
+            if tr.req.state is RequestState.DECODING \
+                    and tr.req.generated:
+                if self._migrate(tr, self._c_handoff, "handoff",
+                                 "handoffs"):
+                    tr.handoffs += 1
+
+    def _retry_orphans(self) -> None:
+        """Place detached requests that had nowhere to go (every
+        target shed when they left their replica)."""
+        orphans, self._orphans = self._orphans, []
+        for tr in orphans:
+            target = self._place(tr, tr.req)
+            if target is not None and self.recorder.enabled:
+                self.recorder.record(
+                    "router.placed", grid=tr.grid, dst=target.name,
+                    n_generated=len(tr.req.generated))
+
+    def rebalance_queued(self, replica: EngineReplica) -> int:
+        """Move a (typically draining) replica's QUEUED requests to the
+        rest of the fleet; admitted streams stay and finish in place —
+        the drain contract. Returns the number moved."""
+        moved = 0
+        for tr in list(self._requests.values()):
+            if tr.replica is not replica:
+                continue
+            if tr.req.state is RequestState.QUEUED:
+                if self._migrate(tr, self._c_rebalance, "rebalance",
+                                 "rebalanced"):
+                    moved += 1
+        return moved
+
+    # -- failure handling --------------------------------------------------
+
+    def _on_replica_death(self, replica: EngineReplica,
+                          error: BaseException) -> None:
+        """Replica failure = mass preemption at fleet scope: every
+        in-flight request is re-admitted elsewhere from the router's
+        request log alone — generated-token mirror plus a seed-replayed
+        sampling key — and completes token-identically. Nothing from
+        the dead engine (device state, pipeline, KV pages) is
+        trusted."""
+        replica.mark_dead(error)
+        failed_over = 0
+        for tr in list(self._requests.values()):
+            if tr.replica is not replica:
+                continue
+            req = tr.req
+            self._local.pop((id(replica), req.rid), None)
+            if req.state in TERMINAL_STATES:
+                # terminal but undelivered (the dying step's finished
+                # list was lost with the exception): surface it now
+                self._requests.pop(tr.grid, None)
+                self._finish_buf.append((tr.grid, req))
+                continue
+            # discard everything engine-local: the in-flight pipeline
+            # step (recomputed identically), page/prefix bookkeeping,
+            # and the slot key — replayed from the seed instead
+            req.rng = _replay_key(req.seed, len(req.generated))
+            tr.failovers += 1
+            self._place(tr, req)
+            self._c_failover.inc()
+            self._n["failovers"] += 1
+            failed_over += 1
+        if self.recorder.enabled:
+            self.recorder.record(
+                "router.replica_dead", replica=replica.name,
+                error=repr(error), failed_over=failed_over)
+        self.recorder.auto_dump(f"replica_dead:{replica.name}")
+
+    # -- views -------------------------------------------------------------
+
+    def counters(self) -> Dict[str, int]:
+        """Plain fleet totals (the registry carries the same series,
+        labeled by replica, for exporters)."""
+        return dict(self._n)
+
+    def health(self) -> Dict:
+        """Fleet readiness: per-replica ``health()`` plus the fleet
+        verdict — ``"ok"`` while every live replica is clean,
+        ``"degraded"`` while any replica is breaching/draining/dead but
+        admission is still possible somewhere, ``"saturated"`` when no
+        replica accepts."""
+        reps = {r.name: r.health() for r in self.replicas}
+        accepting = any(r.accepting for r in self._admission_pool())
+        clean = all(
+            st.get("status") == "ok" for st in reps.values())
+        status = ("ok" if accepting and clean
+                  else "degraded" if accepting else "saturated")
+        return {
+            "status": status,
+            "accepting": accepting,
+            "replicas": reps,
+            "in_flight": len(self._requests),
+            "orphans": len(self._orphans),
+            "counters": self.counters(),
+        }
+
+    def telemetry(self) -> Dict:
+        """Cross-replica telemetry: ``obs.aggregate_serving()`` over
+        the unified snapshot (per-replica component summaries + summed
+        fleet totals) plus router counters and replica lifecycle
+        states."""
+        agg = obs.aggregate_serving()
+        agg["router"] = self.counters()
+        agg["states"] = {r.name: r.state.value for r in self.replicas}
+        return agg
+
+
+#: the client-facing alias: ``Router`` IS the client surface
+#: (submit/run/stream mirror the single-engine API); the name exists
+#: so call sites can say what they hold
+RouterClient = Router

@@ -50,6 +50,18 @@ def test_speculative_serving_example(capsys):
     assert matches == 5       # every speculative request passed its oracle
 
 
+def test_router_serving_example(capsys):
+    matches = run_example("examples.router_serving")
+    out = capsys.readouterr().out
+    assert "token-identical to generate()" in out
+    assert "prefix-affinity hit rates" in out
+    assert "handed off, outputs token-identical" in out
+    assert "failed over and completed token-identically" in out
+    assert "'slow': 'drain'" in out and "'slow': 'resume'" in out
+    assert "OK" in out
+    assert matches == 11    # every oracle-checked request matched
+
+
 def test_vit_finetune_callbacks_example(capsys):
     acc = run_example("examples.vit_finetune_callbacks")
     out = capsys.readouterr().out

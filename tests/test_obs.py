@@ -571,7 +571,10 @@ def test_serving_summary_keys_are_backward_compatible():
         "acceptance_rate", "speculation",
         # expert-load tally ADDED by the MoE-serving PR ("moe" is None
         # on MoE-free / dense-baseline engines)
-        "moe"}
+        "moe",
+        # live departures to another replica ADDED by the
+        # serving-router PR (transfer_out handoffs/rebalances)
+        "requests_transferred"}
 
 
 # --- integration: prefetch gauges -------------------------------------------
