@@ -160,85 +160,48 @@ class StreamingPredictor(Predictor):
         self.batch_size = int(batch_size)
 
     def predict_stream(self, source):
-        """``source``: iterable of ``[n_i, ...]`` feature arrays (n_i <=
-        batch_size). Yields ``[n_i, ...]`` prediction arrays in order."""
+        """``source``: LAZY iterable of ``[n_i, ...]`` feature arrays
+        (n_i <= batch_size) — a generator, a Kafka consumer, a socket
+        reader; it is consumed one batch at a time on the staging
+        thread, never materialized. Yields ``[n_i, ...]`` prediction
+        arrays in order.
+
+        Folded onto :class:`utils.prefetch.Prefetcher` (this PR — the
+        predictors.py:210 follow-up): the hand-rolled staging thread
+        here and the Prefetcher carried parallel copies of the polling
+        shutdown protocol; now there is exactly one, and the
+        Prefetcher itself is lazy. Padding/coercion run as the
+        prefetch ``fn`` and the H2D ``device_put`` as its ``place``
+        hook (on the producer thread, once a queue slot is free — the
+        depth-bounded device-memory cap), so the consumer receives
+        device-resident batches. Source/validation errors re-raise
+        here with their original type; early ``close()`` of the
+        generator reaps the staging thread without dropping
+        already-staged results."""
         if self._fn is None:
             self._build()
         params, state = self._place_params()
 
-        import queue
-        import threading
+        def stage(batch):
+            xb = self._coerce(batch)
+            if len(xb) > self.batch_size:
+                raise ValueError(
+                    f"stream batch of {len(xb)} exceeds "
+                    f"batch_size {self.batch_size}")
+            return self._pad_to(xb, self.batch_size)
 
-        q: "queue.Queue" = queue.Queue(maxsize=2)  # double buffer
-        SENTINEL = object()
-        err: list = []
-        stop = threading.Event()  # consumer broke out early
+        def place(item):
+            xb, pad = item
+            return (jax.device_put(jnp.asarray(xb), self._in_sharding),
+                    pad)
 
-        def put(item) -> bool:
-            """Blocking put that aborts when the consumer went away (same
-            stop-flag pattern as utils.prefetch.Prefetcher)."""
-            while not stop.is_set():
-                try:
-                    q.put(item, timeout=0.1)
-                    return True
-                except queue.Full:
-                    continue
-            return False
-
-        def stage():
-            try:
-                for batch in source:
-                    xb = self._coerce(batch)
-                    if len(xb) > self.batch_size:
-                        raise ValueError(
-                            f"stream batch of {len(xb)} exceeds "
-                            f"batch_size {self.batch_size}")
-                    xb, pad = self._pad_to(xb, self.batch_size)
-                    dev = jax.device_put(jnp.asarray(xb), self._in_sharding)
-                    if not put((dev, pad)):
-                        return  # consumer gone; release source and exit
-            except BaseException as e:  # lint: allow-swallow — surfaced
-                #                         in the consumer thread
-                err.append(e)
-            finally:
-                put(SENTINEL)
-
-        t = threading.Thread(target=stage, daemon=True)
-        # NOTE: this loop and utils.prefetch.Prefetcher carry parallel
-        # copies of the polling shutdown protocol — a fix to either
-        # must be mirrored until predict_stream is folded onto a
-        # lazy-iterable Prefetcher (docs/serving.md follow-ups)
+        from distkeras_tpu.utils.prefetch import Prefetcher
+        pf = Prefetcher(stage, source, depth=2, name="predict_stream",
+                        place=place)
         # exposed for shutdown tests: callers (and the test suite) can
         # assert the producer actually terminated after gen.close()
-        self._stage_thread = t
-        t.start()
-        try:
-            while True:
-                try:
-                    # polling get (this PR, same shutdown contract as
-                    # utils.prefetch.Prefetcher): a blocking get() could
-                    # wait forever if the stage thread died between its
-                    # last successful put and the SENTINEL put while the
-                    # consumer held the queue full — poll and re-check
-                    # liveness so shutdown can never deadlock the
-                    # consumer
-                    item = q.get(timeout=0.05)
-                except queue.Empty:
-                    if not t.is_alive() and q.empty():
-                        break        # producer gone, stream fully drained
-                    continue
-                if item is SENTINEL:
-                    break
-                dev, pad = item
+        self._stage_thread = pf._thread
+        with pf:
+            for _, (dev, pad) in pf:
                 yb = np.asarray(self._fn(params, state, dev))
                 yield yb[:self.batch_size - pad] if pad else yb
-            t.join()
-            if err:
-                raise err[0]
-        finally:
-            # early break / close(): unblock and reap the stage thread.
-            # The thread's puts poll ``stop`` every 100 ms, so a put
-            # blocked on the full double-buffer exits on its own — no
-            # queue draining, no dropped already-staged results.
-            stop.set()
-            t.join(timeout=5.0)

@@ -855,11 +855,53 @@ def _gather_pages(kv, table):
     return out
 
 
+def _use_paged_kernel(kv, page_len: int, paged_kernel) -> bool:
+    """Should the paged readout take the Pallas page-table kernel?
+    ``paged_kernel`` is the caller's tri-state: None = the repo-wide
+    backend convention (TPU only), True = force (off-TPU the kernel
+    runs in interpreter mode — the tier-1 oracle hook), False = the
+    ``_gather_pages`` reference path. Either way an unaligned
+    ``page_len`` (Mosaic sublane rule — ``paged_attention
+    .page_aligned``) falls back to the gather path."""
+    from distkeras_tpu.ops.paged_attention import page_aligned
+    if paged_kernel is None:
+        paged_kernel = backend_is_tpu()
+    return bool(paged_kernel) and page_aligned(page_len,
+                                               "k_scale" in kv)
+
+
+def _paged_attn_readout(attn: MultiHeadAttention, p, q, kv, t, table,
+                        page_len: int, dt, paged_kernel):
+    """Readout for the paged decode/verify paths: the Pallas
+    paged-attention kernel (K/V gathered HBM -> VMEM through the page
+    table inside the kernel — no materialized [S, H, L, D] view) when
+    enabled, else ``_gather_pages`` + the shared slab readout (the
+    off-TPU/interpret fallback and the kernel's oracle)."""
+    if not _use_paged_kernel(kv, page_len, paged_kernel):
+        return _slot_attn_readout(attn, p, q,
+                                  _gather_pages(kv, table), t, dt)
+    from distkeras_tpu.ops.paged_attention import paged_decode_attention
+    b, w_len, nh, dh = q.shape
+    hkv = attn.kv_heads
+    g = nh // hkv
+    scale = (attn.head_dim or dh) ** -0.5
+    qg = q.astype(jnp.float32).reshape(b, w_len, hkv, g, dh)
+    sc = {}
+    if "k_scale" in kv:
+        sc = {"k_scale": kv["k_scale"], "v_scale": kv["v_scale"]}
+    o = paged_decode_attention(
+        qg, kv["k"], kv["v"], t, table, scale=scale,
+        window=attn.attn_window,
+        interpret=None if backend_is_tpu() else True, **sc)
+    out = o.reshape(b, w_len, nh, dh).astype(dt)
+    return jnp.einsum("bshe,hed->bsd", out, p["wo"].astype(dt))
+
+
 def _decode_attn_slots_paged(attn: MultiHeadAttention, p, kv, x, t,
-                             table, page_len: int):
+                             table, page_len: int, paged_kernel=None):
     """One-token attention against the PAGED pool at per-slot
-    positions: scatter the new k/v through the page tables, then run
-    the slab readout over the gathered per-slot view."""
+    positions: scatter the new k/v through the page tables, then read
+    back through the paged kernel (or the gathered per-slot view)."""
     dt = jnp.dtype(attn.dtype)
     xc = x.astype(dt)
     q, k, v = _project_qkv(attn, p, xc)
@@ -867,16 +909,18 @@ def _decode_attn_slots_paged(attn: MultiHeadAttention, p, kv, x, t,
         q = apply_rope(q, t[:, None], scale=attn.rope_scale)
         k = apply_rope(k, t[:, None], scale=attn.rope_scale)
     kv = _cache_write_pages(kv, k, v, t, table, page_len)
-    y = _slot_attn_readout(attn, p, q, _gather_pages(kv, table), t, dt)
+    y = _paged_attn_readout(attn, p, q, kv, t, table, page_len, dt,
+                            paged_kernel)
     return y.astype(x.dtype), kv
 
 
 def _decode_block_slots_paged(block: TransformerBlock, p, s, kv, x, t,
                               table, page_len: int,
-                              moe_dispatched=True, routing=None):
+                              moe_dispatched=True, routing=None,
+                              paged_kernel=None):
     h, _ = block.norm1.apply(p["norm1"], s["norm1"], x)
     a, kv = _decode_attn_slots_paged(block.attn, p["attn"], kv, h, t,
-                                     table, page_len)
+                                     table, page_len, paged_kernel)
     x = x + a
     h, _ = block.norm2.apply(p["norm2"], s["norm2"], x)
     m = _apply_mlp_decode(block.mlp, p["mlp"], s["mlp"], h,
@@ -887,12 +931,17 @@ def _decode_block_slots_paged(block: TransformerBlock, p, s, kv, x, t,
 def decode_step_slots_paged(module: Sequential, params, state, cache,
                             tok, t, table, page_len: int,
                             *, moe_dispatched: bool = True,
-                            moe_stats=None):
+                            moe_stats=None, paged_kernel=None):
     """One token through the stack against a PAGED pooled cache: tok
     [S] int, t [S] int, table [S, P] int page tables; returns
     ([S, V] logits, cache). The paged mirror of ``decode_step_slots``
     — same garbage-logits contract for sentinel slots, same
-    ``moe_dispatched``/``moe_stats`` MoE-decode contract."""
+    ``moe_dispatched``/``moe_stats`` MoE-decode contract.
+
+    ``paged_kernel`` selects the readout (decode-kernel PR): None =
+    the Pallas page-table kernel on TPU and the ``_gather_pages``
+    reference elsewhere; True forces the kernel (interpret mode
+    off-TPU — the oracle hook); False forces the gather path."""
     x = tok[:, None]                                     # [S, 1]
     new_cache = list(cache)
     routing = [] if moe_stats is not None else None
@@ -902,7 +951,7 @@ def decode_step_slots_paged(module: Sequential, params, state, cache,
         if block is not None:
             x, new_cache[i] = _decode_block_slots_paged(
                 block, p, s, kv, x, t, table, page_len,
-                moe_dispatched, routing)
+                moe_dispatched, routing, paged_kernel)
         elif isinstance(layer, PositionalEmbedding):
             x = x + p["embeddings"][t][:, None, :].astype(x.dtype)
         elif isinstance(layer, Dropout):
@@ -937,7 +986,8 @@ def decode_step_slots_paged(module: Sequential, params, state, cache,
 
 def _decode_block_slots_window(block: TransformerBlock, p, s, kv, x, t,
                                table=None, page_len: int = 0,
-                               moe_dispatched=True, routing=None):
+                               moe_dispatched=True, routing=None,
+                               paged_kernel=None):
     """One TransformerBlock over a [S, W, d] window at per-slot
     positions ``t .. t+W-1``: project the window's q/k/v, write ALL W
     positions into the cache (slab one-hot writes, or page-table
@@ -960,8 +1010,11 @@ def _decode_block_slots_window(block: TransformerBlock, p, s, kv, x, t,
         else:
             kv = _cache_write_pages(kv, k[:, j:j + 1], v[:, j:j + 1],
                                     t + j, table, page_len)
-    view = kv if table is None else _gather_pages(kv, table)
-    y = _slot_attn_readout(attn, p["attn"], q, view, t, dt)
+    if table is None:
+        y = _slot_attn_readout(attn, p["attn"], q, kv, t, dt)
+    else:
+        y = _paged_attn_readout(attn, p["attn"], q, kv, t, table,
+                                page_len, dt, paged_kernel)
     x = x + y.astype(x.dtype)
     h, _ = block.norm2.apply(p["norm2"], s["norm2"], x)
     m = _apply_mlp_decode(block.mlp, p["mlp"], s["mlp"], h,
@@ -971,7 +1024,7 @@ def _decode_block_slots_window(block: TransformerBlock, p, s, kv, x, t,
 
 def _verify_window(module: Sequential, params, state, cache, toks, t,
                    table, page_len: int, moe_dispatched: bool = True,
-                   moe_stats=None):
+                   moe_stats=None, paged_kernel=None):
     """Shared body of the verify steps: [S, W] window tokens through the
     whole stack at per-slot positions; returns ([S, W, V] logits,
     cache). MoE blocks see the [S, W] window as ONE slot-token batch
@@ -987,7 +1040,7 @@ def _verify_window(module: Sequential, params, state, cache, toks, t,
         if block is not None:
             x, new_cache[i] = _decode_block_slots_window(
                 block, p, s, kv, x, t, table, page_len,
-                moe_dispatched, routing)
+                moe_dispatched, routing, paged_kernel)
         elif isinstance(layer, PositionalEmbedding):
             pos = t[:, None] + jnp.arange(w_len)         # [S, W]
             x = x + p["embeddings"][pos].astype(x.dtype)
@@ -1019,14 +1072,18 @@ def verify_step_slots(module: Sequential, params, state, cache, toks, t,
 def verify_step_slots_paged(module: Sequential, params, state, cache,
                             toks, t, table, page_len: int,
                             *, moe_dispatched: bool = True,
-                            moe_stats=None):
+                            moe_stats=None, paged_kernel=None):
     """The paged mirror of :func:`verify_step_slots`: window writes
     scatter through the [S, P] page tables (unallocated logical pages
     drop their writes — the engine pre-allocates pages for every
     position a slot may CONSUME, so dropped writes only ever land on
-    the rejected tail)."""
+    the rejected tail). ``paged_kernel`` selects the readout exactly
+    as in :func:`decode_step_slots_paged` — the kernel's ``[S, W]``
+    window-causal mask generalization is what lets the speculative
+    verify ride it too."""
     return _verify_window(module, params, state, cache, toks, t,
-                          table, page_len, moe_dispatched, moe_stats)
+                          table, page_len, moe_dispatched, moe_stats,
+                          paged_kernel)
 
 
 # --- fused multi-step decode (zero-bubble serving PR) -----------------------
@@ -1052,7 +1109,8 @@ def decode_fused_slots(module: Sequential, params, state, cache, tok, t,
                        stop, num_steps: int, table=None,
                        page_len: int = 0, *, temperature=None,
                        top_k=None, top_p=None, keys=None,
-                       moe_dispatched: bool = True, moe_stats=None):
+                       moe_dispatched: bool = True, moe_stats=None,
+                       paged_kernel=None):
     """``num_steps`` consecutive ``decode_step_slots[_paged]``
     iterations as one compiled scan. tok/t: [S] ints (per-slot pending
     input and write position); ``stop``: [S] int per-slot stop tokens
@@ -1083,6 +1141,7 @@ def decode_fused_slots(module: Sequential, params, state, cache, tok, t,
         if table is not None:
             out = decode_step_slots_paged(module, params, state, cache,
                                           cur, tcur, table, page_len,
+                                          paged_kernel=paged_kernel,
                                           **kw)
         else:
             out = decode_step_slots(module, params, state, cache, cur,

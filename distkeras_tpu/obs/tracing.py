@@ -37,9 +37,13 @@ timestamp on the engine clock):
   that shapes MoE decode cost;
 * ``preempted`` / ``resumed`` — the paged engine evicted the
   request's pages back to the queue under budget pressure / brought
-  it back after the recompute prefill (tokens generated so far
-  attached; the request stays live — ``admitted`` fires again on
-  re-admission);
+  it back after the recompute prefill or the host-page swap-in
+  (tokens generated so far attached; the request stays live —
+  ``admitted`` fires again on re-admission);
+* ``swap_out`` / ``swap_in`` — the victim's KV pages moved D2H into
+  the host pool at eviction / back H2D at re-admission (offload PR:
+  ``n_pages`` attached; a preemption WITHOUT ``swap_out`` resumes by
+  re-prefill instead);
 * ``finished`` / ``timed_out`` / ``cancelled`` — terminal.
 
 Memory is bounded everywhere: completed timelines live in a
@@ -253,6 +257,12 @@ class _NullTracer:
     def on_preempt(self, rid, n_generated=0):
         pass
 
+    def on_swap_out(self, rid, n_pages):
+        pass
+
+    def on_swap_in(self, rid, n_pages):
+        pass
+
     def on_resume(self, rid):
         pass
 
@@ -380,9 +390,32 @@ class RequestTracer:
             tl.add_event("preempted", t, self.max_events,
                          n_generated=int(n_generated))
 
+    def on_swap_out(self, rid: int, n_pages: int) -> None:
+        """The preemption victim's KV pages were offloaded D2H to the
+        host pool (offload PR) — its resume will be a page swap-in,
+        not a re-prefill."""
+        t = self.clock()
+        with self._lock:
+            tl = self._live.get(rid)
+            if tl is None:
+                return
+            tl.add_event("swap_out", t, self.max_events,
+                         n_pages=int(n_pages))
+
+    def on_swap_in(self, rid: int, n_pages: int) -> None:
+        """Host pages restored H2D into fresh pool pages; the request
+        rejoined decode without recomputing its context."""
+        t = self.clock()
+        with self._lock:
+            tl = self._live.get(rid)
+            if tl is None:
+                return
+            tl.add_event("swap_in", t, self.max_events,
+                         n_pages=int(n_pages))
+
     def on_resume(self, rid: int) -> None:
-        """Recompute prefill finished after a preemption; the request
-        rejoined the decode batch."""
+        """Recompute prefill (or a page swap-in) finished after a
+        preemption; the request rejoined the decode batch."""
         t = self.clock()
         with self._lock:
             tl = self._live.get(rid)

@@ -1,0 +1,250 @@
+"""True paged-attention decode as a Pallas TPU kernel: K/V read
+THROUGH the page table, no materialized logical view.
+
+The paged serving data plane (rounds 12+) stored every layer's cache
+as ``[N, Hkv, page_len, D]`` fixed-size pages with per-slot page
+tables, but the decode step still paid one large HBM round trip per
+iteration: ``models.decoding._gather_pages`` gathered each slot's
+pages back into a logically contiguous ``[S, H, L, D]`` view in HBM
+before ``_slot_attn_readout`` ran — writing AND re-reading the whole
+resident working set every step, which is why the equal-HBM
+paged-vs-slab bench sat at ~1.4x instead of the >= 2x accelerator
+target (ROADMAP item 3a).
+
+This kernel removes that copy. The grid is ``(S, P)`` — one program
+per (slot, logical page) — and the PAGE TABLE IS THE INDEX MAP: the
+k/v BlockSpecs look up ``table[s, p]`` from the scalar-prefetch
+operand and DMA the physical page HBM -> VMEM directly. Scores,
+masking, online softmax and the value mix all happen on that one
+streaming read; nothing intermediate ever touches HBM. Structure
+mirrors the proven slab-decode kernel (``ops.decode_attention``):
+per-program state in VMEM scratch carried across the ``arbitrary``
+page dimension, init at page 0, finalize at the last page, Hkv heads
+unrolled inside the program so per-program DMA amortizes.
+
+Feature contract (everything the gather path supports):
+
+  * **GQA** — queries arrive grouped ``[S, W, Hkv, G, D]``; the
+    ``W * G`` rows sharing one KV head are the matmul M dimension.
+  * **Window-causal [S, W] verify windows** — window query ``j`` of
+    slot ``s`` admits cache positions ``<= t[s] + j`` (and
+    ``> t[s] + j - window`` for SWA models), exactly
+    ``_slot_attn_readout``'s mask, so speculative
+    ``verify_step_slots_paged`` rides the same kernel with W > 1.
+  * **int8 caches** — per-token scales ``[N, Hkv, page_len]`` ride
+    the same page-table index map; dequant happens on the VPU inside
+    the kernel (scores * k_scale after the D contraction,
+    probabilities * v_scale before the V contraction), so HBM traffic
+    stays int8 + scales.
+  * **Sentinels** — a table entry >= N (unallocated logical page)
+    clamps in the index map and its program skips compute; pages
+    entirely past ``t + W - 1`` (or entirely before a sliding
+    window's reach) skip too, so a mostly-empty slot costs its live
+    pages only.
+
+Numerics: the page-blocked online softmax is algebraically exact but
+reassociates the softmax sums relative to the gather path's one-shot
+softmax — the same contract as ``ops.decode_attention`` vs the einsum
+oracle (and chunked vs one-pass prefill). Greedy token identity holds
+at any realistic argmax margin; ``tests/test_paged_kernel.py`` pins
+the kernel against the ``_gather_pages`` reference in interpreter
+mode (the off-TPU/CI oracle) across GQA/int8/window/W>1/scrambled
+page orders, and end-to-end through the serving engine.
+
+Tiling: the page block's second-to-last dim is ``page_len``, so the
+Mosaic sublane rule wants ``page_len % 8 == 0`` for float caches and
+``% 32`` for int8; ``page_aligned`` is the shared gate — callers fall
+back to the gather path for unaligned pools (the engine default
+``page_len=16`` qualifies for float caches).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+try:
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover
+    pltpu = None
+
+from distkeras_tpu.compat import backend_is_tpu
+from distkeras_tpu.ops.attention import NEG_INF
+
+
+def page_aligned(page_len: int, quantized: bool) -> bool:
+    """Can the kernel tile this pool? The page block's sublane dim is
+    ``page_len``: Mosaic wants multiples of 8 (f32/bf16) / 32 (int8)."""
+    return int(page_len) % (32 if quantized else 8) == 0
+
+
+def _kernel(t_ref, tb_ref, *refs, scale: float, page_len: int,
+            g: int, w_len: int, hkv: int, window, quantized: bool,
+            n_pages: int):
+    if quantized:
+        (q_ref, k_ref, v_ref, ks_ref, vs_ref, o_ref,
+         m_ref, l_ref, acc_ref) = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref = refs
+        ks_ref = vs_ref = None
+    si = pl.program_id(0)
+    pi = pl.program_id(1)
+    npp = pl.num_programs(1)
+    t = t_ref[si]
+    rows = q_ref.shape[2]                      # W*G, padded to % 8
+
+    @pl.when(pi == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    start = pi * page_len
+    # a page participates iff it holds any position some window query
+    # admits: the union of the per-query ranges is (t - window, t+W-1]
+    run = jnp.logical_and(start <= t + (w_len - 1),
+                          tb_ref[si, pi] < n_pages)
+    if window is not None:
+        run = jnp.logical_and(run, start + page_len - 1 > t - window)
+
+    @pl.when(run)
+    def _compute():
+        # per-row window index j = row // G (pad rows past W*G read a
+        # too-permissive mask — their output is sliced off), per-column
+        # global position: the _slot_attn_readout mask, page-local
+        j_idx = lax.broadcasted_iota(jnp.int32, (rows, page_len), 0) // g
+        pos = start + lax.broadcasted_iota(
+            jnp.int32, (rows, page_len), 1)
+        valid = pos <= t + j_idx
+        if window is not None:
+            valid = jnp.logical_and(valid, pos > t + j_idx - window)
+        # unrolled per-KV-head loop: each h is one independent
+        # online-softmax update (static Python unroll, hkv copies —
+        # the bh_block amortization of ops.decode_attention)
+        for h in range(hkv):
+            q = q_ref[0, h]                    # [rows, D]
+            kblk = k_ref[0, h].astype(q.dtype) if quantized else k_ref[0, h]
+            s = lax.dot_general(q, kblk, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) \
+                * scale
+            if ks_ref is not None:
+                s = s * ks_ref[0, h][None, :]  # dequant scores
+            s = jnp.where(valid, s, NEG_INF)
+            m_prev = m_ref[h]
+            l_prev = l_ref[h]
+            acc_prev = acc_ref[h]
+            m_new = jnp.maximum(m_prev,
+                                jnp.max(s, axis=-1, keepdims=True))
+            alpha = jnp.exp(m_prev - m_new)
+            p = jnp.exp(s - m_new)             # [rows, page_len] f32
+            m_ref[h] = m_new
+            l_ref[h] = l_prev * alpha + jnp.sum(p, axis=-1,
+                                                keepdims=True)
+            if vs_ref is not None:
+                p = p * vs_ref[0, h][None, :]  # dequant values
+            vblk = v_ref[0, h].astype(q.dtype) if quantized else v_ref[0, h]
+            acc_ref[h] = acc_prev * alpha + lax.dot_general(
+                p.astype(vblk.dtype), vblk, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+
+    @pl.when(pi == npp - 1)
+    def _finalize():
+        l = l_ref[:]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
+
+
+def paged_decode_attention(q, k_pages, v_pages, t, table, *,
+                           scale: Optional[float] = None,
+                           window: Optional[int] = None,
+                           k_scale=None, v_scale=None,
+                           interpret: Optional[bool] = None):
+    """Window decode attention straight off the page pool.
+
+    q: ``[S, W, Hkv, G, D]`` (W = 1 for plain decode, k+1 for the
+    speculative verify window); k_pages/v_pages: ``[N, Hkv, page_len,
+    D]`` (int8 with ``k_scale``/``v_scale`` ``[N, Hkv, page_len]``);
+    t: ``[S]`` int32 per-slot window start positions; table:
+    ``[S, P]`` int32 page tables (entries >= N are the unallocated
+    sentinel — skipped). Returns ``[S, W, Hkv, G, D]`` f32, the
+    masked-softmax attention of each window query over its slot's
+    cache positions (``window`` adds the SWA band)."""
+    s, w_len, hkv, g, d = q.shape
+    n_pages, _, page_len, _ = k_pages.shape
+    n_logical = table.shape[1]
+    quantized = k_scale is not None
+    if not page_aligned(page_len, quantized):
+        raise ValueError(
+            f"page_len {page_len} is not kernel-tileable "
+            f"({'int8 wants % 32' if quantized else 'wants % 8'}); "
+            "use models.decoding._gather_pages instead")
+    if scale is None:
+        scale = d ** -0.5
+    if interpret is None:
+        interpret = not backend_is_tpu()
+    if pltpu is None:  # pragma: no cover — no Pallas TPU support
+        raise RuntimeError(
+            "paged_decode_attention requires Pallas TPU support")
+    # rows = W*G is the per-head matmul M dim; pad to the 8-row
+    # sublane rule (zero rows are independent softmaxes, sliced off)
+    rows = w_len * g
+    qr = q.transpose(0, 2, 1, 3, 4).reshape(s, hkv, rows, d)
+    pad = (-rows) % 8
+    if pad:
+        qr = jnp.pad(qr, ((0, 0), (0, 0), (0, pad), (0, 0)))
+    rows_p = rows + pad
+
+    def q_map(si, pi, *_):
+        return (si, 0, 0, 0)
+
+    def kv_map(si, pi, t_ref, tb_ref):
+        # THE page-table indirection: the physical page id is the
+        # block index (sentinels clamp; their program skips compute)
+        return (jnp.minimum(tb_ref[si, pi], n_pages - 1), 0, 0, 0)
+
+    def sc_map(si, pi, t_ref, tb_ref):
+        return (jnp.minimum(tb_ref[si, pi], n_pages - 1), 0, 0)
+
+    in_specs = [
+        pl.BlockSpec((1, hkv, rows_p, d), q_map),
+        pl.BlockSpec((1, hkv, page_len, d), kv_map),
+        pl.BlockSpec((1, hkv, page_len, d), kv_map),
+    ]
+    operands = [qr, k_pages, v_pages]
+    if quantized:
+        in_specs += [pl.BlockSpec((1, hkv, page_len), sc_map),
+                     pl.BlockSpec((1, hkv, page_len), sc_map)]
+        operands += [k_scale, v_scale]
+    kernel = functools.partial(
+        _kernel, scale=float(scale), page_len=int(page_len), g=int(g),
+        w_len=int(w_len), hkv=int(hkv), window=window,
+        quantized=quantized, n_pages=int(n_pages))
+    kwargs = {}
+    if not interpret:
+        kwargs["compiler_params"] = pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(s, n_logical),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, hkv, rows_p, d), q_map),
+        scratch_shapes=[
+            pltpu.VMEM((hkv, rows_p, 1), jnp.float32),
+            pltpu.VMEM((hkv, rows_p, 1), jnp.float32),
+            pltpu.VMEM((hkv, rows_p, d), jnp.float32),
+        ])
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((s, hkv, rows_p, d), jnp.float32),
+        interpret=interpret,
+        **kwargs,
+    )(jnp.asarray(t, jnp.int32), jnp.asarray(table, jnp.int32),
+      *operands)
+    return out[:, :, :rows].reshape(s, hkv, w_len, g, d) \
+        .transpose(0, 2, 1, 3, 4)

@@ -9,9 +9,13 @@ per-step decode kernels and an actual serving workload:
 
     kv_pool.py     ``PagedKVPool`` — fixed pool of per-layer KV pages,
                    per-slot page tables, refcounted on-demand
-                   allocation — plus ``PrefixCache`` (hash-consed
+                   allocation, and (``host_pages=``) the HOST offload
+                   tier: async D2H/H2D page copies that turn
+                   preemption into a swap and multiply prefix-cache
+                   capacity — plus ``PrefixCache`` (hash-consed
                    shared prompt prefixes, copy-on-write partial
-                   pages) and the legacy slab ``KVPool``
+                   pages, spill-to-host eviction) and the legacy
+                   slab ``KVPool``
     scheduler.py   admission queue + per-request state machine
                    (queued -> prefilling -> decoding -> finished) with
                    slot allocation/release; ``PriorityScheduler`` adds
@@ -19,10 +23,14 @@ per-step decode kernels and an actual serving workload:
     engine.py      the slot-based decode loop: ONE compiled
                    ``decode_step_slots_paged`` over all slots per
                    iteration (static shapes, the page table is a
-                   traced argument, jit compiled once), chunked
+                   traced argument, jit compiled once; on TPU the
+                   readout is the ``ops.paged_attention`` page-table
+                   Pallas kernel — ``decode_kernel=``), chunked
                    prefill interleaved between decode iterations with
                    shared prefixes skipped, page-budget admission and
-                   preemption/resume, per-slot sampling state; MoE
+                   preemption/resume (a page SWAP through the host
+                   tier when ``host_kv_pages=`` is set, a recompute
+                   prefill otherwise), per-slot sampling state; MoE
                    models decode through the drop-free dispatched
                    path (optionally shard_map expert-parallel over
                    ``ep_mesh``) with expert-load telemetry and a

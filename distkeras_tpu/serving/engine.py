@@ -183,6 +183,25 @@ class ServingEngine:
       ``num_slots * ceil(max_len / page_len)`` (worst-case capacity
       parity with the slab pool); size it DOWN to actual traffic and
       let cost-aware admission + preemption absorb the tail.
+    * ``host_kv_pages`` — the HOST page pool (offload tier, docs/
+      serving.md §Host KV offload). When > 0, preemption victims swap
+      their pages out D2H (resume = H2D copy + table restore, token-
+      identical, no re-prefill — an order of magnitude cheaper, which
+      is what makes sizing ``num_pages`` aggressively down safe) and
+      cold prefix-cache chains spill to host before LRU-evicting
+      outright (effective prefix capacity = device + host pages).
+      0 (default) disables; size it to spare host RAM — pages cost
+      ``2 * Hkv * page_len * Dh * dtype_bytes`` per layer.
+    * ``decode_kernel`` — the paged decode readout: ``"auto"``
+      (default) runs the Pallas paged-attention kernel on TPU (K/V
+      gathered HBM->VMEM through the page table IN-KERNEL — no
+      materialized logical view, docs/serving.md §Paged-attention
+      kernel) and the ``_gather_pages`` reference elsewhere;
+      ``"paged"`` forces the kernel (interpreter mode off-TPU — the
+      oracle hook tier-1 uses); ``"off"`` forces the gather path
+      (the A/B baseline). Pools whose ``page_len`` breaks the
+      kernel's tiling rule (% 8 float, % 32 int8) silently keep the
+      gather path.
     * ``prefix_cache`` — hash-cons identical prompt prefixes onto
       shared pages (on by default; sharing is exact up to
       chunked-prefill fp reassociation — see ``kv_pool.PrefixCache``).
@@ -275,6 +294,8 @@ class ServingEngine:
                  tracer=None, slo=None,
                  kv_layout: str = "paged", page_len: int = 16,
                  num_pages: Optional[int] = None,
+                 host_kv_pages: int = 0,
+                 decode_kernel: str = "auto",
                  prefix_cache: bool = True,
                  prefix_granularity: int = 1,
                  draft: Optional[DraftSource] = None, spec_k: int = 4,
@@ -339,10 +360,34 @@ class ServingEngine:
             raise ValueError(
                 f"kv_layout must be 'paged' or 'slab', got {kv_layout!r}")
         self.kv_layout = kv_layout
+        # paged-attention decode kernel (decode-kernel PR): "auto" =
+        # the Pallas page-table kernel on TPU, the _gather_pages
+        # reference elsewhere; "paged" forces the kernel (interpreter
+        # mode off-TPU — the oracle/test hook); "off" forces the
+        # gather path (the A/B baseline the bench rider prices)
+        if decode_kernel not in ("auto", "paged", "off"):
+            raise ValueError(
+                f"decode_kernel must be 'auto', 'paged' or 'off', "
+                f"got {decode_kernel!r}")
+        self.decode_kernel = decode_kernel
+        self._paged_kernel = {"auto": None, "paged": True,
+                              "off": False}[decode_kernel]
+        if kv_layout == "slab":
+            # loud-validation convention: paged-only options must not
+            # silently no-op on a slab engine
+            if host_kv_pages:
+                raise ValueError(
+                    "host_kv_pages needs kv_layout='paged' (the slab "
+                    "pool has no page-granular offload)")
+            if decode_kernel != "auto":
+                raise ValueError(
+                    "decode_kernel applies to the paged readout only; "
+                    "a slab engine always uses the einsum path")
         if kv_layout == "paged":
             self.pool = PagedKVPool(module, self.num_slots, self.max_len,
                                     page_len=page_len,
                                     num_pages=num_pages,
+                                    host_pages=host_kv_pages,
                                     dtype=cache_dtype)
             self.page_len = self.pool.page_len
             self.prefix = PrefixCache(self.pool) if prefix_cache else None
@@ -368,6 +413,9 @@ class ServingEngine:
         # and the occupant's decode writes position t before the mask
         # ever admits it
         self._staging = self.pool.make_request_cache()
+        #: host-offload odometer snapshot (pool counts cumulatively;
+        #: _flush_host_window publishes per-window deltas)
+        self._off_seen = (0, 0, 0)
         # bounded admission (load shedding): submits past max_queue
         # raise AdmissionRejected instead of growing the queue without
         # bound under overload; None keeps the open-queue behavior
@@ -798,6 +846,16 @@ class ServingEngine:
                 m.record_pages(self.pool.free_pages,
                                self.pool.shared_pages,
                                self._fragmentation())
+                # host-tier odometers: the pool counts cumulatively;
+                # the metrics WINDOW gets deltas so window swaps stay
+                # honest (the record_pages gauge discipline)
+                po, pr, ob = (self.pool.pages_offloaded,
+                              self.pool.pages_restored,
+                              self.pool.offload_bytes)
+                so, sr, sb = self._off_seen
+                if po > so or pr > sr:
+                    m.record_offload(po - so, pr - sr, ob - sb)
+                    self._off_seen = (po, pr, ob)
         if self._decode_buf:
             for n, dt, toks in self._decode_buf:
                 m.record_decode(n, dt, n_tokens=toks)
@@ -961,6 +1019,12 @@ class ServingEngine:
         decoding, prefilling = self._rec_cache[1]
         extra = ({"pages_free": self.pool.free_pages}
                  if self.kv_layout == "paged" else {})
+        if self.kv_layout == "paged" \
+                and self.pool.host_cache is not None:
+            # host-pool occupancy in the flight-recorder ring: a
+            # post-mortem distinguishes "swaps stopped because the
+            # host tier filled" from "preemptions stopped"
+            extra["host_pages_free"] = self.pool.host_free_pages
         self.recorder.record(
             "serving.iteration", engine=self.engine_id,
             iter=self._iters,
@@ -1087,12 +1151,13 @@ class ServingEngine:
                 moe_dispatched=self._moe_dispatched,
                 moe_stats=self.max_len if self._moe_stats_on else None)
             stats_on = self._moe_stats_on
+            pk = self._paged_kernel
 
             def step(params, state, cache, tok, t, tables):
                 if paged:
                     out = decode_step_slots_paged(
                         module, params, state, cache, tok, t, tables,
-                        page_len, **moe_kw)
+                        page_len, paged_kernel=pk, **moe_kw)
                 else:
                     out = decode_step_slots(
                         module, params, state, cache, tok, t, **moe_kw)
@@ -1157,7 +1222,8 @@ class ServingEngine:
             k = self.fuse_steps
             moe_kw = dict(
                 moe_dispatched=self._moe_dispatched,
-                moe_stats=self.max_len if self._moe_stats_on else None)
+                moe_stats=self.max_len if self._moe_stats_on else None,
+                paged_kernel=self._paged_kernel)
             stats_on = self._moe_stats_on
 
             if greedy_only:
@@ -1236,12 +1302,13 @@ class ServingEngine:
                 moe_dispatched=self._moe_dispatched,
                 moe_stats=self.max_len if self._moe_stats_on else None)
             stats_on = self._moe_stats_on
+            pk = self._paged_kernel
 
             def vstep(params, state, cache, toks, t, tables):
                 if paged:
                     out = verify_step_slots_paged(
                         module, params, state, cache, toks, t, tables,
-                        page_len, **moe_kw)
+                        page_len, paged_kernel=pk, **moe_kw)
                 else:
                     out = verify_step_slots(
                         module, params, state, cache, toks, t, **moe_kw)
@@ -1439,8 +1506,27 @@ class ServingEngine:
 
         Matched pages are incref'd HERE, before any reclaim — the
         reclaim sweep frees cache-only (ref == 1) pages and must never
-        eat the chain this very plan is about to use."""
+        eat the chain this very plan is about to use.
+
+        A preemption victim whose pages were SWAPPED OUT (offload PR)
+        is funded differently: it needs exactly its swapped page
+        count back (no prefix match, no +1 growth page — the snapshot
+        already covers the next write), and its resume is an H2D copy
+        instead of a re-prefill."""
         pool = self.pool
+        swap = getattr(req, "_swap", None)
+        if swap is not None:
+            n = len(swap["host"])
+            need = n + self._moe_admit_extra(req, n)
+            if pool.free_pages < need and self.prefix is not None:
+                deficit = need - pool.free_pages
+                if self.prefix.evictable_pages() >= deficit:
+                    self.prefix.reclaim(deficit)
+            if pool.free_pages < need:
+                return None
+            priv = [pool.alloc_page() for _ in range(n)]
+            return {"restore": True, "full": [], "priv": priv,
+                    "shared_len": 0, "donor": None}
         toks = req.context_tokens
         # context + 1: the first decode write (position len(toks))
         # must land on an allocated page
@@ -1526,6 +1612,18 @@ class ServingEngine:
     def _apply_page_plan(self, req: Request, plan: Dict) -> None:
         slot = req.slot
         pool = self.pool
+        if plan.get("restore"):
+            # swap resume: the fresh pages land on the SAME logical
+            # indices the snapshot captured — the table restore half
+            # of the swap-in (the H2D payload copy runs at the
+            # request's prefill turn, _advance_prefill)
+            for lp, pid in zip(req._swap["logical"], plan["priv"]):
+                pool.assign(slot, int(lp), pid)
+            req._shared_len = 0
+            req._n_shared_full = 0
+            req._load_pages = []
+            req._donor_ref = None
+            return
         for j, pid in enumerate(plan["full"]):
             pool.assign(slot, j, pid)    # ref taken in _page_plan
         for i, pid in enumerate(plan["priv"]):
@@ -1584,6 +1682,35 @@ class ServingEngine:
         slot = victim.slot
         if victim.state is RequestState.DECODING:
             victim.rng = np.array(self._keys[slot])
+        # host KV offload (offload PR): a DECODING victim's pages swap
+        # out D2H before release, so resume is an H2D page copy +
+        # table restore instead of a full context re-prefill — byte-
+        # identical (the pages move, nothing recomputes). Prefilling
+        # victims hold no written pool pages (prefill writes staging);
+        # they keep the re-prefill path. Falls through silently when
+        # the host tier is off or full — the swap is an accelerator,
+        # never a correctness dependency. The snapshot deliberately
+        # includes SHARED prefix pages (ref > 1): excluding them
+        # would make resume depend on the prefix cache still holding
+        # the chain (evictable meanwhile), i.e. a partial-restore +
+        # partial-re-prefill plan. The cost is a private duplicate of
+        # the shared head after resume (it dies with the request,
+        # like any privately recomputed prefix) and the extra host
+        # bytes — re-attaching via prefix.match at resume is the
+        # documented follow-up (docs/serving.md).
+        swapped = 0
+        if victim.state is RequestState.DECODING \
+                and self.kv_layout == "paged" \
+                and self.pool.host_cache is not None:
+            row = self.pool.tables[slot]
+            logical = np.where(row < self.pool.num_pages)[0]
+            hids = self.pool.offload_pages(row[logical].tolist())
+            if hids is not None:
+                victim._swap = {"host": hids,
+                                "logical": logical.tolist(),
+                                "t": int(self._t[slot])}
+                swapped = len(hids)
+                self.tracer.on_swap_out(victim.rid, swapped)
         self.scheduler.preempt(victim)
         self._comp_ver += 1
         self._chain_dirty[slot] = True
@@ -1606,7 +1733,8 @@ class ServingEngine:
                 "serving.preempted", engine=self.engine_id,
                 rid=victim.rid, slot=slot,
                 n_generated=len(victim.generated), pages_freed=freed,
-                pages_free=self.pool.free_pages)
+                pages_free=self.pool.free_pages,
+                pages_swapped=swapped)
 
     def _ensure_decode_pages(self, lookahead=None) -> None:
         """Before a decode step: every running slot whose next write
@@ -1877,6 +2005,16 @@ class ServingEngine:
             self._preempt(req)
             if req.state in TERMINAL_STATES:
                 return None          # the pipeline flush finished it
+        if getattr(req, "_swap", None) is not None:
+            # any swap record — from the preempt above OR from an
+            # earlier preemption while the request sat QUEUED — holds
+            # pages in THIS engine's host pool, which a foreign
+            # engine cannot read: free them so the handoff rides the
+            # re-prefill resume (page SHIPPING over a transport is
+            # the router follow-up this machinery is built for;
+            # docs/serving.md §Router)
+            self.pool.free_host(req._swap["host"])
+            req._swap = None
         if req.state is not RequestState.QUEUED:
             raise RuntimeError(
                 f"cannot transfer request {rid} in state "
@@ -1939,6 +2077,11 @@ class ServingEngine:
         req._n_shared_full = 0
         req._load_pages = []
         req._donor_ref = None
+        # a swap record refers to the SOURCE engine's host pool
+        # (transfer_out frees it; a router death-failover request may
+        # still carry one from its dead engine) — restoring it here
+        # would read THIS pool's unrelated host rows
+        req._swap = None
         if req.rng is None:
             req.rng = jax.random.PRNGKey(req.seed)
         try:
@@ -1980,6 +2123,11 @@ class ServingEngine:
             # before its prefill turn consumed it
             self.pool.decref(req._donor_ref)
             req._donor_ref = None
+        if getattr(req, "_swap", None) is not None:
+            # preempted-and-swapped but terminated (deadline, cancel)
+            # before the swap-in consumed the host copy
+            self.pool.free_host(req._swap["host"])
+            req._swap = None
         req.error = error
         self.tracer.on_terminal(req.rid, state.value,
                                 len(req.generated))
@@ -2043,7 +2191,13 @@ class ServingEngine:
                 "total": pool.num_pages, "free": pool.free_pages,
                 "shared": pool.shared_pages,
                 "page_len": pool.page_len,
-                "fragmentation": round(self._fragmentation(), 4)}
+                "fragmentation": round(self._fragmentation(), 4),
+                # host offload tier (additive key): None when off
+                "host": (None if pool.host_cache is None else {
+                    "total": pool.host_pages,
+                    "free": pool.host_free_pages,
+                    "offloaded": pool.pages_offloaded,
+                    "restored": pool.pages_restored})}
             out["prefix_cache"] = (
                 None if self.prefix is None else {
                     "nodes": len(self.prefix),
@@ -2058,11 +2212,49 @@ class ServingEngine:
         # slow-prefill scenario (queue grows, deadlines/shedding engage)
         faults.point("serving.prefill")
         paged = self.kv_layout == "paged"
+        swap = getattr(req, "_swap", None) if paged else None
+        if swap is not None:
+            # swap-in resume (offload PR): the preemption snapshot
+            # copies H2D into the pages _apply_page_plan already wired
+            # into the table — token-identical BY CONSTRUCTION (the
+            # exact cache bytes return; nothing is recomputed), where
+            # the re-prefill path below is token-identical by the
+            # chunked-prefill oracle. No prefill chunk ever runs: the
+            # whole resume is this one copy + the vector restores.
+            t0_ = self.metrics.clock()
+            row = self.pool.tables[req.slot]
+            dev = [int(row[int(lp)]) for lp in swap["logical"]]
+            self.pool.restore_pages(swap["host"], dev)
+            self.pool.free_host(swap["host"])
+            req._swap = None
+            s = req.slot
+            self.scheduler.to_decoding(req)
+            self._comp_ver += 1
+            self._tok[s] = req.generated[-1]
+            self._t[s] = swap["t"]
+            self._temp[s] = req.temperature
+            self._topk[s] = req.top_k
+            self._topp[s] = req.top_p
+            self._stop[s] = req.stop_token
+            self._keys[s] = np.array(req.rng)
+            self._chain_dirty[s] = True    # host owns the next input
+            self._begin_draft(req, req.context_tokens)
+            self.metrics.record_swap_resume(
+                self.metrics.clock() - t0_, len(req.context_tokens))
+            self.tracer.on_swap_in(req.rid, len(dev))
+            self.tracer.on_resume(req.rid)
+            return
         # paged context = prompt, or prompt + generated[:-1] after a
         # preemption (the resumable-prefill recompute path)
         toks = req.context_tokens if paged else req.prompt
         p_len = len(toks)
         resume = paged and bool(req.generated)
+        if resume and req.prefill_pos == 0 \
+                and getattr(req, "_resume_t0", None) is None:
+            # re-prefill resume clock: first recompute chunk ->
+            # rejoining the decode batch (the number the offload
+            # bench's resume-latency rider compares against swap-in)
+            req._resume_t0 = self.metrics.clock()
         if paged and req.prefill_pos == 0:
             if self.prefix is not None:
                 # pages registered since this request's admission plan
@@ -2134,6 +2326,12 @@ class ServingEngine:
             self._keys[s] = np.array(req.rng)
             self._chain_dirty[s] = True    # host owns the next input
             self._begin_draft(req, toks)
+            t0_ = getattr(req, "_resume_t0", None)
+            if t0_ is not None:
+                self.metrics.record_reprefill_resume(
+                    self.metrics.clock() - t0_,
+                    p_len - getattr(req, "_shared_len", 0))
+                req._resume_t0 = None
             self.tracer.on_resume(req.rid)
             return
         first, req.rng = self._sample_first_fn()(

@@ -32,6 +32,25 @@ allocation pressure needs them.
 Both pools compose with the int8 quantized cache (``dtype="int8"``):
 payload and per-token-per-head scale planes share the page tables and
 move together through every insert/load/gather program.
+
+HOST KV OFFLOAD TIER (decode-kernel/offload PR, ROADMAP item 3b):
+``PagedKVPool(host_pages=N)`` adds a host-memory page pool mirroring
+the device pool's per-layer planes. ``offload_pages`` copies physical
+device pages D2H (all layers' transfers enqueued async first, then
+fenced and copied into the pinned host rows — the checkpoint-snapshot
+discipline from docs/overlap.md) and ``restore_pages`` scatters them
+back into freshly allocated device pages byte-identically. Two
+consumers:
+
+  * the serving engine's PREEMPTION path — a victim's pages swap out
+    instead of being discarded, so resume is an H2D page copy + table
+    restore instead of a full context re-prefill (order-of-magnitude
+    cheaper eviction, which is what makes aggressive oversubscription
+    safe);
+  * ``PrefixCache`` eviction — a cold chain SPILLS its LRU leaves to
+    host before dropping them outright, so the effective prefix-cache
+    capacity multiplies: a later match restores the spilled page H2D
+    and the chain serves hits again.
 """
 
 from __future__ import annotations
@@ -141,6 +160,23 @@ def _write_pages(pool, staging, table):
 
 
 @jax.jit
+def _gather_rows(pool, ids):
+    """Gather physical pages ``ids`` out of every pool plane — the D2H
+    offload read. One compiled program per (structure, n) pair, the
+    same bounded cardinality as the per-``n_pos`` insert programs."""
+    return jax.tree_util.tree_map(lambda p: p[ids], pool)
+
+
+@jax.jit
+def _scatter_rows(pool, ids, vals):
+    """Scatter host page payloads ``vals`` into pool rows ``ids`` —
+    the H2D restore write (byte-identical: storage dtypes in, storage
+    dtypes out, no recompute anywhere)."""
+    return jax.tree_util.tree_map(
+        lambda p, v: p.at[ids].set(v.astype(p.dtype)), pool, vals)
+
+
+@jax.jit
 def _load_pages(staging, pool, table, valid):
     """Gather pool pages into the batch-1 staging cache: logical page
     ``p`` becomes ``pool[table[p]]`` where ``valid[p]``, else keeps the
@@ -177,7 +213,7 @@ class PagedKVPool:
 
     def __init__(self, module, num_slots: int, max_len: int,
                  page_len: int = 16, num_pages: Optional[int] = None,
-                 dtype=jnp.float32):
+                 host_pages: int = 0, dtype=jnp.float32):
         if num_slots < 1:
             raise ValueError(f"num_slots must be >= 1, got {num_slots}")
         if max_len < 1:
@@ -220,6 +256,29 @@ class PagedKVPool:
         # tests/traces, same convention as the slot allocator)
         self._free = list(range(self.num_pages))[::-1]
         self._tables_dev = None
+        # --- host offload tier (module doc): a host-memory mirror of
+        # the page planes, sized independently of the device pool —
+        # host RAM is an order of magnitude cheaper than HBM, so this
+        # is where preemption victims and cold prefix chains go
+        self.host_pages = int(host_pages)
+        if self.host_pages < 0:
+            raise ValueError(
+                f"host_pages must be >= 0, got {host_pages}")
+        self.host_cache = None
+        self._host_free: List[int] = []
+        if self.host_pages:
+            self.host_cache = [
+                None if kv is None else
+                {key: np.zeros((self.host_pages,) + tuple(a.shape[1:]),
+                               a.dtype)
+                 for key, a in kv.items()}
+                for kv in self.cache]
+            self._host_free = list(range(self.host_pages))[::-1]
+        #: offload odometers (cumulative since construction — the
+        #: engine publishes per-window deltas into ServingMetrics)
+        self.pages_offloaded = 0
+        self.pages_restored = 0
+        self.offload_bytes = 0
 
     # -- device views -------------------------------------------------------
 
@@ -314,6 +373,79 @@ class PagedKVPool:
         self._dirty()
         return int(pages.size)
 
+    # -- host offload tier --------------------------------------------------
+
+    @property
+    def host_free_pages(self) -> int:
+        return len(self._host_free)
+
+    def offload_pages(self, page_ids) -> Optional[List[int]]:
+        """Copy physical device pages D2H into free host pages;
+        returns the host page ids (the caller owns them until
+        ``free_host``), or None when the host tier is off or lacks
+        capacity — callers fall back to the discard/re-prefill path.
+        Every layer plane's D2H transfer is enqueued
+        (``copy_to_host_async``) before any is fenced, so the copies
+        overlap instead of paying one serial round trip per leaf; the
+        fenced views are immediately copied into the host pool rows
+        (a fancy-index store always copies), so no view of
+        runtime-owned device memory survives the call."""
+        n = len(page_ids)
+        if self.host_cache is None or n == 0 \
+                or len(self._host_free) < n:
+            return None
+        ids = jnp.asarray(np.asarray(page_ids, np.int32))
+        dev = _gather_rows(self.cache, ids)
+        for leaf in jax.tree_util.tree_leaves(dev):
+            try:
+                leaf.copy_to_host_async()
+            except Exception:  # lint: allow-swallow — a backend
+                pass           # without async D2H fetches at the fence
+        hids = [self._host_free.pop() for _ in range(n)]
+        hsel = np.asarray(hids, np.int64)
+        for kv_host, kv_dev in zip(self.host_cache, dev):
+            if kv_host is None:
+                continue
+            for key, host_arr in kv_host.items():
+                fetched = np.asarray(kv_dev[key])
+                host_arr[hsel] = fetched
+                self.offload_bytes += fetched.nbytes
+        self.pages_offloaded += n
+        return hids
+
+    def restore_pages(self, host_ids, dev_ids) -> None:
+        """H2D: host page payloads -> the given (already allocated)
+        device pages, byte-identical — the swap-in that replaces a
+        preemption victim's full context re-prefill. The host pages
+        are NOT freed here (``free_host`` is the owner's call)."""
+        if self.host_cache is None:
+            raise RuntimeError(
+                "no host page pool (construct with host_pages > 0)")
+        if len(host_ids) != len(dev_ids):
+            raise ValueError(
+                f"host/device page counts differ: {len(host_ids)} "
+                f"vs {len(dev_ids)}")
+        if not len(host_ids):
+            return
+        hsel = np.asarray(host_ids, np.int64)
+        vals = [None if kv is None else
+                {key: a[hsel] for key, a in kv.items()}
+                for kv in self.host_cache]
+        self.cache = _scatter_rows(
+            self.cache, jnp.asarray(np.asarray(dev_ids, np.int32)),
+            vals)
+        self.pages_restored += len(host_ids)
+
+    def free_host(self, host_ids) -> None:
+        """Return host pages to the free list. Double-free is a loud
+        error — two owners sharing one host page would corrupt both
+        (the device-side ``decref`` contract, host edition)."""
+        for h in host_ids:
+            h = int(h)
+            if h in self._host_free:
+                raise RuntimeError(f"host page {h} double-freed")
+            self._host_free.append(h)
+
     # -- staging transfers --------------------------------------------------
 
     def insert_pages(self, staging, slot: int, skip_pages: int,
@@ -350,12 +482,13 @@ class PagedKVPool:
 
 
 class _Node:
-    __slots__ = ("nid", "page", "parent", "key", "last_used")
+    __slots__ = ("nid", "page", "parent", "key", "last_used", "host")
 
     def __init__(self, nid, page, parent, key, last_used):
         self.nid = nid
-        self.page = page
-        self.parent = parent
+        self.page = page                 # device page id, or None when
+        self.host = None                 # spilled (``host`` holds the
+        self.parent = parent             # host page id instead)
         self.key = key
         self.last_used = last_used
 
@@ -382,7 +515,16 @@ class PrefixCache:
 
     Eviction is LRU over LEAF nodes whose page only the cache holds
     (``ref == 1``) — evicting a leaf exposes its parent for the next
-    round, so sustained pressure unwinds whole chains."""
+    round, so sustained pressure unwinds whole chains.
+
+    With a pool host tier (``PagedKVPool(host_pages=N)``) eviction
+    SPILLS before it drops: the LRU victim's page copies D2H and the
+    node stays in the trie host-resident (``match()`` restores it to
+    a fresh device page on the next hit — H2D copy, no recompute), so
+    the effective cache capacity is device + host pages. Only when
+    the host tier is full (or absent) does a victim drop outright;
+    sustained pressure then unwinds the OLDEST host-resident leaves
+    first, exposing their parents for spilling in turn."""
 
     def __init__(self, pool: PagedKVPool):
         self._pool = pool
@@ -449,6 +591,8 @@ class PrefixCache:
             node = self._children.get(parent, {}).get(key)
             if node is None:
                 break
+            if node.page is None and not self._restore_node(node):
+                break                    # host-resident, no device page
             node.last_used = tick
             if parent == 0:
                 # affinity hit counter: this chain's root page served
@@ -471,10 +615,28 @@ class PrefixCache:
                         .sum())
                 if m > best:
                     best, donor = m, node
+        if donor is not None and donor.page is None \
+                and not self._restore_node(donor):
+            donor = None                 # spilled donor, pool full
         if donor is not None:
             donor.last_used = tick
             return pages, pos + best, donor.page
         return pages, pos, None
+
+    def _restore_node(self, node: _Node) -> bool:
+        """Bring a host-resident (spilled) node back onto a fresh
+        device page — H2D copy, byte-identical, no prefill recompute.
+        False when no device page can be allocated (the chain walk
+        stops there; the node stays spilled for a later try)."""
+        pool = self._pool
+        pid = pool.alloc_page()          # ref = 1: the cache's hold
+        if pid is None:
+            return False
+        pool.restore_pages([node.host], [pid])
+        pool.free_host([node.host])
+        node.host = None
+        node.page = pid
+        return True
 
     def register(self, tokens, table_row) -> int:
         """Install every FULL prompt page of ``tokens`` (physical ids
@@ -493,6 +655,19 @@ class PrefixCache:
             key = toks[j * pl:(j + 1) * pl].tobytes()
             ch = self._children.setdefault(parent, {})
             node = ch.get(key)
+            if node is not None and node.page is None:
+                # the chain spilled (or its restore failed) between
+                # this request's match and its register — the request
+                # recomputed the page privately, so ADOPT that live
+                # device page and retire the host copy: sharing
+                # revives at zero copy cost (same fp-reassociation
+                # contract as any registered page)
+                pid = int(table_row[j])
+                if pid < pool.num_pages:
+                    node.page = pid
+                    pool.incref(pid)
+                    pool.free_host([node.host])
+                    node.host = None
             if node is None:
                 pid = int(table_row[j])
                 if pid >= pool.num_pages:
@@ -509,40 +684,87 @@ class PrefixCache:
             parent = node.nid
         return added
 
+    def _drop(self, node: _Node) -> None:
+        """Remove a node from the trie, releasing whichever page
+        (device or host) it holds."""
+        del self._children[node.parent][node.key]
+        del self._children[node.nid]
+        del self._nodes[node.nid]
+        if node.parent == 0:
+            self._hits.pop(node.key, None)
+        tok0 = int(np.frombuffer(node.key, np.int32)[0])
+        bucket = self._first.get(node.parent, {}).get(tok0, [])
+        if node in bucket:
+            bucket.remove(node)
+        if node.page is not None:
+            self._pool.decref(node.page)
+        else:
+            self._pool.free_host([node.host])
+
     def evict_one(self) -> bool:
-        """Drop the least-recently-used LEAF node whose page only the
-        cache holds, freeing its page. False when nothing is
-        evictable (every cached page is also live in some slot)."""
+        """Free ONE device page held only by the cache. With a pool
+        host tier, the LRU cache-only node SPILLS (page copied D2H,
+        node stays matchable — ``match()`` restores it in place, so
+        spilling ANY node, leaf or interior, leaves the trie intact);
+        without host space the LRU cache-only LEAF drops outright
+        (dropping must stay leaf-first or the chain below would
+        orphan), and when every droppable leaf is already
+        host-resident, the OLDEST spilled leaves drop first to free
+        host space and expose their parents. False when no device
+        page can be freed (every cached page is also live in some
+        slot)."""
         pool = self._pool
-        victim = None
-        for node in self._nodes.values():
-            if self._children.get(node.nid):
-                continue                          # interior: keep chain
-            if pool.ref[node.page] != 1:
-                continue                          # a slot still reads it
-            if victim is None or node.last_used < victim.last_used:
-                victim = node
-        if victim is None:
-            return False
-        del self._children[victim.parent][victim.key]
-        del self._children[victim.nid]
-        del self._nodes[victim.nid]
-        if victim.parent == 0:
-            self._hits.pop(victim.key, None)
-        tok0 = int(np.frombuffer(victim.key, np.int32)[0])
-        bucket = self._first.get(victim.parent, {}).get(tok0, [])
-        if victim in bucket:
-            bucket.remove(victim)
-        pool.decref(victim.page)
-        return True
+        while True:
+            spill = drop = host_leaf = None
+            for node in self._nodes.values():
+                leaf = not self._children.get(node.nid)
+                if node.page is None:
+                    if leaf and (host_leaf is None or
+                                 node.last_used < host_leaf.last_used):
+                        host_leaf = node
+                    continue
+                if pool.ref[node.page] != 1:
+                    continue                      # a slot still reads it
+                if spill is None or node.last_used < spill.last_used:
+                    spill = node
+                if leaf and (drop is None
+                             or node.last_used < drop.last_used):
+                    drop = node
+            if spill is not None and pool.host_free_pages > 0:
+                hids = pool.offload_pages([spill.page])
+                if hids is not None:
+                    pool.decref(spill.page)
+                    spill.page = None
+                    spill.host = hids[0]
+                    return True
+            if drop is not None:
+                self._drop(drop)
+                return True
+            if spill is None or host_leaf is None:
+                # no device page to free at all (spill is None: the
+                # host-resident remainder must NOT be drained for
+                # nothing), or nothing left to unwind
+                return False
+            # unwind: a device page exists but the host tier is full
+            # and it is not a droppable leaf — dropping the oldest
+            # spilled leaf frees host space (the next round can spill
+            # again) and may expose a device-resident parent
+            self._drop(host_leaf)
 
     def evictable_pages(self) -> int:
-        """Pages the cache could EVENTUALLY free under pressure: nodes
-        whose page only the cache holds and whose whole subtree is in
-        the same position (children must evict before their parent).
-        Callers check this BEFORE reclaiming toward a target — a
-        reclaim that cannot reach its goal would drain the whole
-        reusable cache for nothing."""
+        """DEVICE pages the cache could EVENTUALLY free under
+        pressure. Without a host tier: nodes whose page only the
+        cache holds and whose whole subtree is in the same position
+        (dropping is leaf-first, so children must be freeable before
+        their parent; a host-resident node blocks nothing and
+        contributes nothing). A cache-only node NOT drop-reachable
+        that way can still SPILL — but each such spill permanently
+        consumes a host page (a spilled interior node is not
+        unwindable while slot-pinned children keep it off the leaf
+        frontier), so the spill-only contribution is capped at the
+        host pool's free capacity. Callers check this BEFORE
+        reclaiming toward a target — a reclaim that cannot reach its
+        goal would drain the whole reusable cache for nothing."""
         memo: Dict[int, bool] = {}
 
         def ok(nid: int) -> bool:
@@ -551,12 +773,21 @@ class PrefixCache:
                 return got
             node = self._nodes[nid]
             memo[nid] = res = (
-                self._pool.ref[node.page] == 1
+                (node.page is None
+                 or self._pool.ref[node.page] == 1)
                 and all(ok(c.nid)
                         for c in self._children.get(nid, {}).values()))
             return res
 
-        return sum(1 for nid in self._nodes if ok(nid))
+        droppable = spill_only = 0
+        for node in self._nodes.values():
+            if node.page is None or self._pool.ref[node.page] != 1:
+                continue
+            if ok(node.nid):
+                droppable += 1
+            else:
+                spill_only += 1
+        return droppable + min(spill_only, self._pool.host_free_pages)
 
     def reclaim(self, n_pages: int) -> int:
         """Evict until ``n_pages`` pages were freed (or nothing more is

@@ -92,6 +92,25 @@ class ServingMetrics:
             "serving.prefix_lookup_tokens")
         self._preempted = self.registry.counter(
             "serving.requests_preempted")
+        # host KV offload tier (offload PR): pages swapped D2H on
+        # preemption / prefix spill, pages restored H2D, bytes moved;
+        # resume-latency histograms split by path (page swap-in vs
+        # context re-prefill — the bench's crossover measurement) and
+        # the re-prefill token tallies (recomputed vs avoided)
+        self._pages_offloaded = self.registry.counter(
+            "serving.pages_offloaded")
+        self._pages_restored = self.registry.counter(
+            "serving.pages_restored")
+        self._offload_bytes = self.registry.counter(
+            "serving.offload_bytes")
+        self._resume_swap = self.registry.histogram(
+            "serving.resume_swap_s")
+        self._resume_reprefill = self.registry.histogram(
+            "serving.resume_reprefill_s")
+        self._reprefill_toks = self.registry.counter(
+            "serving.reprefill_tokens")
+        self._reprefill_toks_avoided = self.registry.counter(
+            "serving.reprefill_tokens_avoided")
         # serving router (router PR): requests detached from this
         # engine for re-admission on another replica (prefill->decode
         # handoff, drain rebalancing) — NOT terminal, NOT preemptions
@@ -211,6 +230,33 @@ class ServingMetrics:
         self._pages_shared.set(int(shared))
         self._page_frag.set(float(fragmentation))
 
+    def record_offload(self, offloaded: int, restored: int,
+                       nbytes: int) -> None:
+        """Host-tier page movement since the last flush (the engine
+        publishes per-window DELTAS of the pool's cumulative
+        odometers)."""
+        self._pages_offloaded.inc(int(offloaded))
+        self._pages_restored.inc(int(restored))
+        self._offload_bytes.inc(int(nbytes))
+
+    def record_swap_resume(self, dur_s: float,
+                           tokens_avoided: int) -> None:
+        """One preemption resume served by a host-page SWAP-IN:
+        ``dur_s`` is the H2D copy + table restore wall;
+        ``tokens_avoided`` the context tokens a re-prefill resume
+        would have recomputed."""
+        self._resume_swap.observe(float(dur_s))
+        self._reprefill_toks_avoided.inc(int(tokens_avoided))
+
+    def record_reprefill_resume(self, dur_s: float,
+                                tokens: int) -> None:
+        """One preemption resume served by context RE-PREFILL:
+        ``dur_s`` spans first recompute chunk -> rejoining decode,
+        ``tokens`` the context positions recomputed (net of shared
+        prefix pages)."""
+        self._resume_reprefill.observe(float(dur_s))
+        self._reprefill_toks.inc(int(tokens))
+
     def record_spec_verify(self, proposed: int, accepted: int) -> None:
         """One slot's outcome in one speculative verify step:
         ``proposed`` drafts offered (the engine's fixed k), ``accepted``
@@ -300,6 +346,22 @@ class ServingMetrics:
     @property
     def requests_transferred(self) -> int:
         return int(self._transferred.value())
+
+    @property
+    def pages_offloaded(self) -> int:
+        return int(self._pages_offloaded.value())
+
+    @property
+    def pages_restored(self) -> int:
+        return int(self._pages_restored.value())
+
+    def resume_swap_samples(self) -> List[float]:
+        """Swap-in resume durations (histogram reservoir) — the
+        offload bench reduces these to p50/p99."""
+        return self._resume_swap.samples()
+
+    def resume_reprefill_samples(self) -> List[float]:
+        return self._resume_reprefill.samples()
 
     @property
     def spec_proposed(self) -> int:
@@ -405,6 +467,19 @@ class ServingMetrics:
                 "free": int(pages_free),
                 "shared": int(self._pages_shared.value() or 0),
                 "fragmentation": self._page_frag.value()}),
+            # host KV offload tier (keys ADDED by the offload PR):
+            # page-swap traffic and the per-path resume latencies —
+            # the swap-vs-re-prefill crossover, measured
+            "offload": {
+                "pages_offloaded": self.pages_offloaded,
+                "pages_restored": self.pages_restored,
+                "offload_bytes": int(self._offload_bytes.value()),
+                "reprefill_tokens": int(self._reprefill_toks.value()),
+                "reprefill_tokens_avoided": int(
+                    self._reprefill_toks_avoided.value()),
+                "resume_swap_s": self._pcts(self._resume_swap),
+                "resume_reprefill_s": self._pcts(
+                    self._resume_reprefill)},
             "prefix_cache": {
                 "lookups": int(self._prefix_lookups.value()),
                 "hits": int(self._prefix_hits.value()),

@@ -60,7 +60,16 @@ class Prefetcher:
             raise ValueError(f"depth must be >= 1, got {depth}")
         self._fn = fn
         self._place = place
-        self._items = list(items)
+        # LAZY: the iterable is consumed one item at a time ON THE
+        # PRODUCER THREAD (predictors PR — the predictors.py:210
+        # follow-up). The old ``list(items)`` materialized the whole
+        # stream up front, which silently broke unbounded sources
+        # (Kafka-style consumers, generators) and double-buffered
+        # nothing for them; epoch-chunk callers pass small finite
+        # iterables and are unaffected. A generator is therefore
+        # advanced off-thread: it must not be consumed elsewhere
+        # concurrently (none of the repo's sources are).
+        self._items = items
         self._q: "queue.Queue" = queue.Queue(maxsize=depth)
         self._stopped = threading.Event()
         # telemetry (obs registry): queue depth at each consume (a full
@@ -102,7 +111,18 @@ class Prefetcher:
 
     def _produce(self):
         from distkeras_tpu.resilience import faults
-        for item in self._items:
+        it = iter(self._items)
+        while True:
+            try:
+                item = next(it)
+            except StopIteration:
+                break
+            except Exception as e:
+                # a LAZY source failing mid-stream (this PR) takes the
+                # same consumer-side re-raise path as an fn error —
+                # the eager list() used to surface it in __init__
+                self._put((None, None, e))
+                return
             if self._stopped.is_set():
                 return
             try:

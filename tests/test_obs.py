@@ -574,7 +574,11 @@ def test_serving_summary_keys_are_backward_compatible():
         "moe",
         # live departures to another replica ADDED by the
         # serving-router PR (transfer_out handoffs/rebalances)
-        "requests_transferred"}
+        "requests_transferred",
+        # host KV offload tally ADDED by the offload PR (page-swap
+        # traffic + per-path resume latencies; zeros/None without a
+        # host tier)
+        "offload"}
 
 
 # --- integration: prefetch gauges -------------------------------------------

@@ -77,6 +77,49 @@ def test_device_stager_applies_requested_sharding():
     assert Xs.dtype == jnp.float32
 
 
+def test_prefetcher_lazy_iterable_not_materialized():
+    """The Prefetcher consumes its source LAZILY on the producer
+    thread (predictors PR): an UNBOUNDED generator works — the old
+    ``list(items)`` would hang forever — and backpressure bounds how
+    far the source is advanced past the consumer."""
+    pulled = []
+
+    def endless():
+        i = 0
+        while True:
+            pulled.append(i)
+            yield i
+            i += 1
+
+    p = Prefetcher(lambda i: i * 2, endless(), depth=2)
+    it = iter(p)
+    got = [next(it) for _ in range(5)]
+    assert got == [(i, 2 * i) for i in range(5)]
+    p.close()
+    time.sleep(0.1)
+    # depth (queued) + 1 (in hand) + 1 (pulled-but-not-yet-queued):
+    # the source was never drained past the backpressure bound
+    assert len(pulled) <= 5 + 2 + 2, pulled
+    assert not p._thread.is_alive()
+
+
+def test_prefetcher_lazy_source_error_reraises_consumer_side():
+    """A lazy source failing MID-STREAM re-raises at the consuming
+    next() with its original type (the eager list() surfaced it in
+    __init__; laziness must not turn it into a dead-producer
+    RuntimeError)."""
+    def bad():
+        yield 1
+        yield 2
+        raise KeyError("source broke")
+
+    got = []
+    with pytest.raises(KeyError, match="source broke"):
+        for item, value in Prefetcher(lambda i: i, bad()):
+            got.append(value)
+    assert got == [1, 2]
+
+
 def test_backpressure_bounds_producer_lead():
     """The producer may stage at most depth (queued) + 1 (in hand)
     chunks ahead of the consumer — the device-memory bound."""

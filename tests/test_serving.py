@@ -562,14 +562,18 @@ def test_prefix_partial_page_copy_on_write(memorized_lm):
         out_a2[ra2], generate(m, a[None], 5, temperature=0.0)[0])
 
 
-def test_preemption_resume_token_identity(memorized_lm):
+@pytest.mark.parametrize("host_pages", [0, 16])
+def test_preemption_resume_token_identity(memorized_lm, host_pages):
     """Two streams outgrow a deliberately small page pool: the younger
-    is preempted mid-decode, resumes via the recompute prefill, and
-    BOTH stay token-identical to standalone generate() — the
+    is preempted mid-decode, resumes — via the recompute prefill
+    (``host_pages=0``) or the host-page SWAP (offload PR: D2H at
+    eviction, H2D + table restore at re-admission, no re-prefill) —
+    and BOTH stay token-identical to standalone generate() — the
     acceptance bar for preemption correctness. Staggered arrivals."""
     m = memorized_lm
     eng = ServingEngine(m, num_slots=2, max_len=32, page_len=4,
-                        num_pages=8, prefix_cache=False)
+                        num_pages=8, prefix_cache=False,
+                        host_kv_pages=host_pages)
     r0 = eng.submit(PATTERN[:5], 16)
     eng.step()
     eng.step()
@@ -577,32 +581,176 @@ def test_preemption_resume_token_identity(memorized_lm):
     out = eng.run(max_steps=2000)
     assert eng.metrics.requests_preempted >= 1
     assert eng.metrics.summary()["requests_preempted"] >= 1
+    if host_pages:
+        # the victim's resume really was a page swap, not a re-prefill
+        assert eng.pool.pages_offloaded >= 1
+        assert eng.pool.pages_restored == eng.pool.pages_offloaded
+        off = eng.metrics.summary()["offload"]
+        assert off["pages_restored"] >= 1
+        assert off["resume_swap_s"] is not None
+        assert off["reprefill_tokens_avoided"] > 0
     np.testing.assert_array_equal(
         out[r0], generate(m, PATTERN[None, :5], 16, temperature=0.0)[0])
     np.testing.assert_array_equal(
         out[r1], generate(m, PATTERN[None, :6], 15, temperature=0.0)[0])
 
 
-def test_preempted_sampled_request_resumes_key_stream(memorized_lm):
+@pytest.mark.parametrize("host_pages", [0, 16])
+def test_preempted_sampled_request_resumes_key_stream(memorized_lm,
+                                                      host_pages):
     """A SAMPLED request preempted mid-decode must draw the same
     tokens as under an ample page budget: its per-slot PRNG key is
     snapshotted at eviction and restored at resume, so the draw
-    stream depends only on its own seed and step count."""
+    stream depends only on its own seed and step count. With the
+    host tier on, the swap resume must be BYTE-identical too — the
+    cache pages return bit-for-bit, so this also pins swap-resume ==
+    re-prefill-resume == uninterrupted run (the offload acceptance
+    criterion: the ample run IS the uninterrupted stream)."""
     m = memorized_lm
 
-    def run(num_pages):
+    def run(num_pages, host):
         eng = ServingEngine(m, num_slots=2, max_len=32, page_len=4,
-                            num_pages=num_pages, prefix_cache=False)
+                            num_pages=num_pages, prefix_cache=False,
+                            host_kv_pages=host)
         eng.submit(PATTERN[:5], 16)              # greedy page hog
         srid = eng.submit(PATTERN[:4], 14, temperature=0.9,
                           top_p=0.95, seed=7)
         out = eng.run(max_steps=3000)
-        return out[srid], eng.metrics.requests_preempted
+        return (out[srid], eng.metrics.requests_preempted,
+                eng.pool.pages_offloaded)
 
-    ample, p_ample = run(num_pages=16)
-    tight, p_tight = run(num_pages=8)
+    ample, p_ample, _ = run(num_pages=16, host=0)
+    tight, p_tight, offloaded = run(num_pages=8, host=host_pages)
     assert p_ample == 0 and p_tight >= 1
+    assert bool(offloaded) == bool(host_pages)
     np.testing.assert_array_equal(ample, tight)
+
+
+def test_offload_swap_events_and_recorder(memorized_lm):
+    """The swap lifecycle is observable: swap_out/swap_in timeline
+    events on the preempted request, the iteration ring carries the
+    host-pool occupancy, and health() exposes the host tier."""
+    from distkeras_tpu.obs.recorder import get_recorder, reset_recorder
+    m = memorized_lm
+    reset_recorder()
+    try:
+        eng = ServingEngine(m, num_slots=2, max_len=32, page_len=4,
+                            num_pages=8, prefix_cache=False,
+                            host_kv_pages=16)
+        eng.submit(PATTERN[:5], 16)
+        eng.step()
+        eng.step()
+        eng.submit(PATTERN[:6], 15)
+        eng.run(max_steps=2000)
+        assert eng.metrics.requests_preempted >= 1
+        kinds = [e["name"] for t in eng.tracer.timelines()
+                 for e in t.events]
+        assert "swap_out" in kinds and "swap_in" in kinds
+        recs = get_recorder().records()
+        pre = [r for r in recs if r["kind"] == "serving.preempted"]
+        assert pre and pre[0]["pages_swapped"] >= 1
+        iters = [r for r in recs if r["kind"] == "serving.iteration"]
+        assert any("host_pages_free" in r for r in iters)
+        h = eng.health()
+        assert h["pages"]["host"]["total"] == 16
+        assert h["pages"]["host"]["restored"] >= 1
+    finally:
+        reset_recorder()
+
+
+def test_prefix_cache_spills_to_host_and_restores(memorized_lm):
+    """Cold prefix chains spill D2H instead of dropping: after a full
+    reclaim, a same-template request still HITS the cache (the chain
+    restores H2D page by page) and stays token-identical."""
+    m = memorized_lm
+    eng = ServingEngine(m, num_slots=2, max_len=48, page_len=4,
+                        host_kv_pages=32)
+    prompt = np.tile(PATTERN, 2)[:12]            # 3 full cached pages
+    ra = eng.submit(prompt, 5)
+    out_a = eng.run(max_steps=300)
+    np.testing.assert_array_equal(
+        out_a[ra], generate(m, prompt[None], 5, temperature=0.0)[0])
+    n_nodes = len(eng.prefix)
+    assert n_nodes >= 3
+    # pressure: reclaim everything — with a host tier this SPILLS
+    # (nodes stay matchable) rather than dropping
+    freed = eng.prefix.reclaim(eng.pool.num_pages)
+    assert freed >= n_nodes
+    assert eng.pool.pages_offloaded >= n_nodes
+    assert len(eng.prefix) == n_nodes            # chain survived
+    restored_before = eng.pool.pages_restored
+    rb = eng.submit(prompt, 5)
+    out_b = eng.run(max_steps=300)
+    assert eng.pool.pages_restored > restored_before
+    assert eng.metrics.summary()["prefix_cache"]["hits"] >= 1
+    np.testing.assert_array_equal(
+        out_b[rb], generate(m, prompt[None], 5, temperature=0.0)[0])
+
+
+def test_transfer_of_swapped_queued_request_drops_swap(memorized_lm):
+    """Review fix: a QUEUED preempted-and-swapped request leaving via
+    transfer_out must release its host pages and shed the swap record
+    — the record names the SOURCE engine's host pool, which the
+    adopting engine cannot read (a stale one would restore garbage
+    or raise on a host-less target). The handoff then rides the
+    re-prefill resume, token-identical."""
+    m = memorized_lm
+    src = ServingEngine(m, num_slots=1, max_len=32, page_len=4,
+                        num_pages=8, prefix_cache=False,
+                        host_kv_pages=16)
+    rid = src.submit(PATTERN[:5], 10)
+    # bring it to DECODING, then preempt via a higher-priority arrival
+    while src.scheduler.running.get(0) is None \
+            or src.scheduler.running[0].rid != rid:
+        src.step()
+    for _ in range(2):
+        src.step()
+    req = src[rid]
+    src._preempt(req)
+    assert req._swap is not None and src.pool.host_free_pages < 16
+    out = src.transfer_out(rid)
+    assert out is req and req._swap is None
+    assert src.pool.host_free_pages == 16      # host pages released
+    dst = ServingEngine(m, num_slots=1, max_len=32, page_len=4)
+    new_rid = dst.transfer_in(req)
+    res = dst.run(max_steps=500)
+    np.testing.assert_array_equal(
+        res[new_rid],
+        generate(m, PATTERN[None, :5], 10, temperature=0.0)[0])
+
+
+def test_pool_offload_roundtrip_and_host_accounting(memorized_lm):
+    """PagedKVPool host-tier unit contract: D2H/H2D round trip is
+    byte-identical, capacity exhaustion returns None (callers fall
+    back to discard), and host double-free is loud."""
+    from distkeras_tpu.serving import PagedKVPool
+    m = memorized_lm
+    pool = PagedKVPool(m.module, num_slots=2, max_len=32, page_len=4,
+                       host_pages=3)
+    # write recognizable content into pages 0..2 via direct scatter
+    rs = np.random.RandomState(0)
+    pool.cache = jax.tree_util.tree_map(
+        lambda a: jnp.asarray(rs.randn(*a.shape).astype(a.dtype)),
+        pool.cache)
+    before = jax.tree_util.tree_map(np.asarray, pool.cache)
+    hids = pool.offload_pages([0, 2])
+    assert hids is not None and len(hids) == 2
+    assert pool.host_free_pages == 1
+    assert pool.offload_pages([0, 1]) is None    # capacity: only 1 left
+    # scramble the device pages, then restore onto different ids
+    pool.cache = jax.tree_util.tree_map(jnp.zeros_like, pool.cache)
+    pool.restore_pages(hids, [5, 7])
+    after = jax.tree_util.tree_map(np.asarray, pool.cache)
+    for b, a in zip(jax.tree_util.tree_leaves(before),
+                    jax.tree_util.tree_leaves(after)):
+        np.testing.assert_array_equal(b[0], a[5])
+        np.testing.assert_array_equal(b[2], a[7])
+    pool.free_host(hids)
+    assert pool.host_free_pages == 3
+    with pytest.raises(RuntimeError, match="double-freed"):
+        pool.free_host([hids[0]])
+    assert pool.pages_offloaded == 2 and pool.pages_restored == 2
+    assert pool.offload_bytes > 0
 
 
 def test_priority_scheduler_order_and_preempt():
