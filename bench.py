@@ -1104,9 +1104,13 @@ def bench_spec_decode(num_slots: int, prompt_len: int, new_tokens: int,
         num_layers=cfg["num_layers"], mlp_ratio=cfg["mlp_ratio"],
         use_rope=True, dtype="bfloat16"), (cfg["seq"],), seed=0)
     max_len = prompt_len + new_tokens
+    # ONE draft source for the whole family (bench hygiene, tree-spec
+    # PR): the proposer is engine-lifetime state, not per-pass state —
+    # rebuilding it per pass hid any warm-path cost it amortizes
+    draft = NgramDraft()
     eng = ServingEngine(model, num_slots=num_slots, max_len=max_len,
                         prefill_chunk=prefill_chunk,
-                        draft=NgramDraft(), spec_k=spec_k)
+                        draft=draft, spec_k=spec_k)
     rs = np.random.RandomState(0)
 
     def prompts_for(kind):
@@ -1132,26 +1136,36 @@ def bench_spec_decode(num_slots: int, prompt_len: int, new_tokens: int,
         eng.metrics = ServingMetrics()
         for p in prompts:
             eng.submit(p, new_tokens, speculate=speculate)
-        eng.run(max_steps=200_000)
+        finished = []
+        while eng.scheduler.pending:
+            finished.extend(eng.step())
         m = eng.metrics
         rate = m.decode_tokens_per_sec(min_occupancy=num_slots)
         if rate is None:
             rate = m.decode_tokens_per_sec()
-        return rate, m
+        return rate, m, finished
 
     out = {}
     for kind in ("repetitive", "random"):
         spec_rates, plain_rates, accepts = [], [], []
         rate_samples, disabled = [], 0
+        ema_trajectories = []
         for i in range(n_passes):
             prompts = prompts_for(kind)
-            r_spec, m_spec = drive(prompts, True)
-            r_plain, _ = drive(prompts, False)
+            r_spec, m_spec, done = drive(prompts, True)
+            r_plain, _, _ = drive(prompts, False)
             spec_rates.append(r_spec)
             plain_rates.append(r_plain)
             accepts.append(m_spec.acceptance_rate)
             disabled += int(m_spec.summary()["speculation"]
                             ["disabled_streams"])
+            # per-pass acceptance-EMA snapshot (tree-spec PR bench
+            # hygiene): each finished request's final acceptance EMA —
+            # across passes this is the trajectory the engine's
+            # demotion/adaptation logic actually saw
+            ema_trajectories.append(sorted(
+                round(float(r.spec_ema), 3)
+                for r in done if r.spec_ema is not None))
             # pooled across passes so the percentiles describe the same
             # data the median headline does, not just the last pass
             rate_samples.extend(m_spec.spec_accept_rates())
@@ -1182,6 +1196,161 @@ def bench_spec_decode(num_slots: int, prompt_len: int, new_tokens: int,
             "spec_passes": [round(r, 1) for r in spec_rates],
             "plain_passes": [round(r, 1) for r in plain_rates],
             "disabled_streams": disabled,
+            # per-pass per-request final acceptance EMAs (sorted): the
+            # demotion signal's trajectory across passes
+            "ema_trajectories": ema_trajectories,
+        }
+    return out
+
+
+def bench_spec_tree(num_slots: int, prompt_len: int, new_tokens: int,
+                    n_passes: int, spec_k: int, spec_width: int,
+                    prefill_chunk=None, d_model: int = 32,
+                    num_layers: int = 2, epochs: int = 60):
+    """Tree speculation (tree-speculation PR): marginal decode tok/s
+    of TREE drafts (``spec_tree=True``, per-divergence branching
+    ``NgramDraft``) vs LINEAR drafts vs PLAIN decode, at EQUAL chain
+    depth — both engines draft ``spec_k`` deep; the tree engine ADDS
+    ``spec_width``-way branching at every divergence point (window
+    ``1 + spec_k * spec_width`` vs the chain's ``spec_k + 1``). That
+    is the SpecInfer/Medusa bet: window WIDTH is nearly free wherever
+    decode is weight-read-bound (accelerators) or dispatch-bound (the
+    tiny model here), so covering the top-m continuations per
+    divergence point buys accepted-tokens-per-verify at marginal
+    cost.
+
+    THE WORKLOAD IS DELIBERATELY AMBIGUOUS (the serving_overlap
+    "deliberately tiny" discipline, applied to acceptance structure):
+    a small LM is TRAINED on streams of repeated 4-token blocks whose
+    final token is a coin flip between two tails — so every block
+    boundary is a genuine divergence point where the n-gram suffix
+    has TWO historical continuations. A single chain must gamble on
+    one (the most recent — right about half the time); the tree
+    covers both. A pure periodic motif degenerates to a tie (the
+    linear drafter is already perfect — measured), and an untrained
+    model either copies deterministically (tie) or accepts nothing
+    sampled — which is why this family trains for its trace; the
+    big-model raw-throughput speculation numbers stay in
+    ``serving_spec_decode``.
+
+    Trace kinds: ``repetitive`` — random-tail block streams (the
+    headline: divergences are real but drafting works); ``random`` —
+    i.i.d. prompts (both drafters miss, the EMA demotes tree streams
+    through the adaptive controller's narrowing first; records what
+    tree windows cost when drafting fails).
+
+    One trained model, one hoisted draft source (``NgramDraft`` is
+    stateless — safe to share across engines), two warmed engines
+    reused across every pass. Returns ``{kind: {tree_tok_s,
+    linear_tok_s, plain_tok_s, tree_vs_linear, tree_vs_plain,
+    linear_vs_plain, tree_acceptance, linear_acceptance,
+    tree_width_percentiles, path_len_percentiles, ...}}``."""
+    from distkeras_tpu.models import Model, zoo
+    from distkeras_tpu.serving import (NgramDraft, ServingEngine,
+                                       ServingMetrics)
+
+    vocab = 29
+    head = np.array([11, 7, 19])
+    tails = (2, 8)
+    block = len(head) + 1
+
+    def make_stream(n_blocks, rng):
+        return np.concatenate(
+            [np.concatenate([head, [tails[rng.randint(2)]]])
+             for _ in range(n_blocks)]).astype(np.int32)
+
+    seq = 32
+    rs = np.random.RandomState(0)
+    X = np.stack([make_stream(-(-(seq + 1) // block), rs)[:seq + 1]
+                  for _ in range(256)])
+    model = Model.build(
+        zoo.transformer_lm(vocab, d_model=d_model, num_heads=4,
+                           num_layers=num_layers, mlp_ratio=2,
+                           use_rope=True), (seq,), seed=2)
+    model.fit(X[:, :-1], X[:, 1:], optimizer="adam", learning_rate=5e-3,
+              batch_size=64, epochs=epochs,
+              loss="sparse_categorical_crossentropy_from_logits")
+    max_len = prompt_len + new_tokens
+    draft = NgramDraft()                 # hoisted: stateless, shared
+    kw = dict(num_slots=num_slots, max_len=max_len,
+              prefill_chunk=prefill_chunk, draft=draft)
+    eng_tree = ServingEngine(model, spec_k=spec_k, spec_tree=True,
+                             spec_width=spec_width, **kw)
+    eng_lin = ServingEngine(model, spec_k=spec_k, **kw)
+
+    def prompts_for(kind):
+        out = []
+        for _ in range(num_slots):
+            if kind == "repetitive":
+                p = make_stream(-(-prompt_len // block),
+                                rs)[:prompt_len]
+            else:
+                p = rs.randint(0, vocab, (prompt_len,)).astype(np.int32)
+            out.append(p)
+        return out
+
+    # warm-up: compile each engine's prefill/verify/plain programs
+    warm = prompts_for("repetitive")[0]
+    for eng in (eng_tree, eng_lin):
+        eng.submit(warm, new_tokens, speculate=True)
+        eng.run(max_steps=100_000)
+        eng.submit(warm, new_tokens, speculate=False)
+        eng.run(max_steps=100_000)
+
+    def drive(eng, prompts, speculate):
+        eng.metrics = ServingMetrics()
+        for p in prompts:
+            eng.submit(p, new_tokens, speculate=speculate)
+        eng.run(max_steps=200_000)
+        m = eng.metrics
+        rate = m.decode_tokens_per_sec(min_occupancy=num_slots)
+        if rate is None:
+            rate = m.decode_tokens_per_sec()
+        return rate, m
+
+    out = {}
+    for kind in ("repetitive", "random"):
+        tree_rates, lin_rates, plain_rates = [], [], []
+        tree_acc, lin_acc = [], []
+        tree_summ = None
+        for i in range(n_passes):
+            prompts = prompts_for(kind)
+            r_tree, m_tree = drive(eng_tree, prompts, True)
+            r_lin, m_lin = drive(eng_lin, prompts, True)
+            r_plain, _ = drive(eng_lin, prompts, False)
+            tree_rates.append(r_tree)
+            lin_rates.append(r_lin)
+            plain_rates.append(r_plain)
+            tree_acc.append(m_tree.acceptance_rate)
+            lin_acc.append(m_lin.acceptance_rate)
+            tree_summ = m_tree.summary()["speculation"]
+            print(f"spec_tree {kind} pass {i}: tree {r_tree:.1f} / "
+                  f"linear {r_lin:.1f} / plain {r_plain:.1f} tok/s "
+                  f"(tree {r_tree / r_lin:.2f}x linear, "
+                  f"{r_tree / r_plain:.2f}x plain)",
+                  file=sys.stderr, flush=True)
+        tree_med = statistics.median(tree_rates)
+        lin_med = statistics.median(lin_rates)
+        plain_med = statistics.median(plain_rates)
+
+        def _acc(v):
+            vals = [a for a in v if a is not None]
+            return round(statistics.median(vals), 3) if vals else None
+
+        out[kind] = {
+            "tree_tok_s": round(tree_med, 1),
+            "linear_tok_s": round(lin_med, 1),
+            "plain_tok_s": round(plain_med, 1),
+            "tree_vs_linear": round(tree_med / lin_med, 3),
+            "tree_vs_plain": round(tree_med / plain_med, 3),
+            "linear_vs_plain": round(lin_med / plain_med, 3),
+            "tree_acceptance": _acc(tree_acc),
+            "linear_acceptance": _acc(lin_acc),
+            "tree_width_percentiles": tree_summ["tree_width"],
+            "path_len_percentiles": tree_summ["accepted_path_len"],
+            "tree_passes": [round(r, 1) for r in tree_rates],
+            "linear_passes": [round(r, 1) for r in lin_rates],
+            "plain_passes": [round(r, 1) for r in plain_rates],
         }
     return out
 
@@ -2078,6 +2247,7 @@ def main():
     ap.add_argument("--model", choices=["all", "resnet50", "lm", "lm_big",
                                         "generate", "generate_long",
                                         "serving", "spec_decode",
+                                        "spec_tree",
                                         "serving_overlap",
                                         "serving_router",
                                         "serving_moe", "moe",
@@ -2087,6 +2257,7 @@ def main():
                     "generate_long (P=2048/8192 serving grid) + serving "
                     "(continuous-batching engine, open-loop trace) + "
                     "spec_decode (speculative decoding on/off) + "
+                    "spec_tree (tree vs linear vs plain speculation) + "
                     "serving_overlap (zero-bubble loop vs synchronous "
                     "A/B on a tiny host-bound model) + "
                     "serving_router (prefix-affinity router over 2 "
@@ -2154,8 +2325,8 @@ def main():
         records = []
         for mode in ("resnet50", "lm", "overlap", "generate",
                      "generate_long", "serving", "spec_decode",
-                     "serving_overlap", "serving_router", "serving_moe",
-                     "moe", "lm_big"):
+                     "spec_tree", "serving_overlap", "serving_router",
+                     "serving_moe", "moe", "lm_big"):
             if base_profile:
                 args.profile = f"{base_profile.rstrip('/')}/{mode}"
             try:
@@ -2689,7 +2860,59 @@ def _run_mode(mode, args, on_accel, peak, device_kind):
                     "the repetitive trace; vs_baseline = value / "
                     "spec-off rate of the same engine; "
                     "accept_rate_percentiles = per-slot per-iteration "
-                    "draft acceptance distribution",
+                    "draft acceptance distribution; ema_trajectories = "
+                    "per-pass sorted per-request final acceptance EMAs",
+            "device_kind": device_kind,
+        }
+        return _emit(rec)
+
+    if mode == "spec_tree":
+        if on_accel:
+            num_slots, prompt_len, new_tokens = 8, 40, 64
+            n_passes, spec_k, spec_width, chunk = 3, 6, 2, None
+        else:
+            num_slots, prompt_len, new_tokens = 4, 20, 24
+            n_passes, spec_k, spec_width, chunk = 2, 6, 2, None
+        out = bench_spec_tree(num_slots, prompt_len, new_tokens,
+                              n_passes, spec_k, spec_width,
+                              prefill_chunk=chunk)
+        rep, rnd = out["repetitive"], out["random"]
+        rec = {
+            "metric": "serving_spec_tree_tokens_per_sec_per_chip",
+            "value": rep["tree_tok_s"],
+            "unit": "tokens/sec",
+            # the acceptance ratio: tree vs LINEAR speculation at equal
+            # chain depth on the repetitive-motif (noisy) trace —
+            # >= 1.0 CPU-smoke criterion, >= 1.3x documented
+            # accelerator target; the below-anchor tripwire flags < 0.9
+            "vs_baseline": rep["tree_vs_linear"],
+            "repetitive": rep,
+            "random": rnd,
+            "spec_k": spec_k,
+            "spec_width": spec_width,
+            "window": 1 + spec_k * spec_width,
+            "draft_source": "ngram tree (per-divergence branching)",
+            "num_slots": num_slots,
+            "prompt_len": prompt_len,
+            "new_tokens": new_tokens,
+            "prefill_chunk": chunk,
+            "criterion": ">= 1.0x tree-vs-linear marginal decode "
+                         "tok/s at equal chain depth on the "
+                         "repetitive-motif (random-tail block) CPU "
+                         "smoke trace (>= 1.3x documented accelerator "
+                         "target, where window width rides the "
+                         "weight-read bound for free); the random "
+                         "trace documents tree-window cost when "
+                         "drafting fails",
+            "note": "closed-loop full-occupancy drives on a small LM "
+                    "TRAINED on random-tail block streams (every "
+                    "block boundary a genuine divergence point — see "
+                    "bench_spec_tree docstring); value = tree-spec "
+                    "decode tokens/s on the repetitive trace; "
+                    "vs_baseline = value / linear-spec rate of a "
+                    "same-depth chain engine (the tree adds "
+                    "spec_width-way branching on top); both engines "
+                    "share one hoisted NgramDraft and are warmed once",
             "device_kind": device_kind,
         }
         return _emit(rec)

@@ -692,7 +692,52 @@ def _cache_write_slots(kv, k, v, t):
             "v": jnp.where(hit4, vh.astype(kv["v"].dtype), kv["v"])}
 
 
-def _slot_attn_readout(attn: MultiHeadAttention, p, q, kv, t, dt):
+def _window_positions(t, w_len: int, tree):
+    """Per-window-query cache positions: ``t + j`` for the causal chain
+    (window query j sits j steps past the slot's start), or
+    ``t + depth[j]`` for a token TREE (tree-speculation PR — each node's
+    position is its depth on its own root path, so siblings share a
+    position while occupying distinct window columns)."""
+    if tree is None:
+        return t[:, None] + jnp.arange(w_len)            # [S, W]
+    return t[:, None] + tree["depth"]                    # [S, W]
+
+
+def _window_valid_mask(t, w_len: int, L: int, tree, window):
+    """[S, W, L] attention validity for the windowed readout.
+
+    Chain (``tree`` None): window query j admits cache positions
+    ``<= t + j`` — the established window-causal mask.
+
+    Tree: node j was WRITTEN at cache position ``t + j`` (its window
+    column), so query i admits (a) the committed prefix ``< t`` and
+    (b) window column j's position ``t + j`` iff j is an ancestor of i
+    (self included) per ``tree["anc"]`` — rejected/sibling branches
+    stay invisible exactly like the chain's future positions. Sentinel
+    slots (t out of range) admit garbage either way; their logits are
+    discarded by contract. ``window`` adds the SWA band around each
+    query's own position (``t + depth``)."""
+    ar = jnp.arange(L)[None, None, :]                    # [1, 1, L]
+    if tree is None:
+        pos = t[:, None] + jnp.arange(w_len)             # [S, W]
+        valid = ar <= pos[:, :, None]
+    else:
+        anc = tree["anc"]                                # [S, W, W] bool
+        s_n = anc.shape[0]
+        rel = jnp.arange(L)[None, :] - t[:, None]        # [S, L]
+        within = (rel >= 0) & (rel < w_len)
+        anc_g = anc[jnp.arange(s_n)[:, None, None],
+                    jnp.arange(w_len)[None, :, None],
+                    jnp.clip(rel, 0, w_len - 1)[:, None, :]]
+        valid = (rel < 0)[:, None, :] | (within[:, None, :] & anc_g)
+        pos = t[:, None] + tree["depth"]
+    if window is not None:
+        valid &= ar > (pos - window)[:, :, None]
+    return valid
+
+
+def _slot_attn_readout(attn: MultiHeadAttention, p, q, kv, t, dt,
+                       tree=None):
     """Masked per-slot attention of the projected decode queries against
     a logically contiguous ``[S, H, L, D]`` kv view — a slab pool or a
     page gather in logical-position order — plus the output projection.
@@ -705,7 +750,10 @@ def _slot_attn_readout(attn: MultiHeadAttention, p, q, kv, t, dt):
     positions ``<= t[s] + j`` — causal WITHIN the window too, so the
     drafts just written at ``t+1 .. t+j`` are visible to later window
     positions while rejected-tail garbage stays masked for every query
-    that must not see it."""
+    that must not see it. ``tree`` (tree-speculation PR: ``{"depth":
+    [S, W], "anc": [S, W, W]}``) generalizes the window to a token
+    tree — see ``_window_valid_mask``; a chain-shaped tree produces the
+    exact mask above, bit for bit."""
     scale = (attn.head_dim or q.shape[-1]) ** -0.5
     b = q.shape[0]
     w_len = q.shape[1]
@@ -716,11 +764,7 @@ def _slot_attn_readout(attn: MultiHeadAttention, p, q, kv, t, dt):
     qg = (q.astype(jnp.float32) * scale).reshape(
         b, w_len, hkv, g, dh)                        # [S, W, Hkv, G, D]
     s = _decode_scores(qg, kv)                       # [S, Hkv, G, W, L]
-    pos = t[:, None] + jnp.arange(w_len)             # [S, W]
-    valid = jnp.arange(L)[None, None, :] <= pos[:, :, None]   # [S, W, L]
-    if attn.attn_window is not None:
-        valid &= jnp.arange(L)[None, None, :] \
-            > (pos - attn.attn_window)[:, :, None]
+    valid = _window_valid_mask(t, w_len, L, tree, attn.attn_window)
     s = jnp.where(valid[:, None, None, :, :], s, NEG_INF)
     w = jax.nn.softmax(s, axis=-1)
     out = _decode_mix(w, kv).astype(dt)              # [S, W, Hkv, G, D]
@@ -871,15 +915,19 @@ def _use_paged_kernel(kv, page_len: int, paged_kernel) -> bool:
 
 
 def _paged_attn_readout(attn: MultiHeadAttention, p, q, kv, t, table,
-                        page_len: int, dt, paged_kernel):
+                        page_len: int, dt, paged_kernel, tree=None):
     """Readout for the paged decode/verify paths: the Pallas
     paged-attention kernel (K/V gathered HBM -> VMEM through the page
     table inside the kernel — no materialized [S, H, L, D] view) when
     enabled, else ``_gather_pages`` + the shared slab readout (the
-    off-TPU/interpret fallback and the kernel's oracle)."""
+    off-TPU/interpret fallback and the kernel's oracle). ``tree``
+    forwards the ancestor-mask window (tree-speculation PR) — the
+    kernel takes the ``[S, W, W]`` mask as an operand; the gather path
+    threads it into the shared mask builder."""
     if not _use_paged_kernel(kv, page_len, paged_kernel):
         return _slot_attn_readout(attn, p, q,
-                                  _gather_pages(kv, table), t, dt)
+                                  _gather_pages(kv, table), t, dt,
+                                  tree=tree)
     from distkeras_tpu.ops.paged_attention import paged_decode_attention
     b, w_len, nh, dh = q.shape
     hkv = attn.kv_heads
@@ -892,6 +940,7 @@ def _paged_attn_readout(attn: MultiHeadAttention, p, q, kv, t, table,
     o = paged_decode_attention(
         qg, kv["k"], kv["v"], t, table, scale=scale,
         window=attn.attn_window,
+        anc=None if tree is None else tree["anc"],
         interpret=None if backend_is_tpu() else True, **sc)
     out = o.reshape(b, w_len, nh, dh).astype(dt)
     return jnp.einsum("bshe,hed->bsd", out, p["wo"].astype(dt))
@@ -987,12 +1036,21 @@ def decode_step_slots_paged(module: Sequential, params, state, cache,
 def _decode_block_slots_window(block: TransformerBlock, p, s, kv, x, t,
                                table=None, page_len: int = 0,
                                moe_dispatched=True, routing=None,
-                               paged_kernel=None):
+                               paged_kernel=None, tree=None,
+                               kv_out=None):
     """One TransformerBlock over a [S, W, d] window at per-slot
     positions ``t .. t+W-1``: project the window's q/k/v, write ALL W
     positions into the cache (slab one-hot writes, or page-table
     scatters when ``table`` is given), then run the shared windowed
-    readout."""
+    readout.
+
+    ``tree`` (tree-speculation PR): rope each node at its ROOT-PATH
+    position ``t + depth[j]`` (that is where it lands if accepted —
+    siblings share a rope position while writing distinct window
+    columns ``t + j``) and attend through the ancestor mask. The
+    per-layer roped k/v land in ``kv_out`` (a list the caller owns) so
+    the post-acceptance ``commit_tree_path`` can re-write the accepted
+    path at its contiguous final positions."""
     attn = block.attn
     h, _ = block.norm1.apply(p["norm1"], s["norm1"], x)
     dt = jnp.dtype(attn.dtype)
@@ -1000,9 +1058,11 @@ def _decode_block_slots_window(block: TransformerBlock, p, s, kv, x, t,
     q, k, v = _project_qkv(attn, p["attn"], xc)          # [S, W, H, D]
     w_len = q.shape[1]
     if attn.use_rope:
-        pos = t[:, None] + jnp.arange(w_len)             # [S, W]
+        pos = _window_positions(t, w_len, tree)          # [S, W]
         q = apply_rope(q, pos, scale=attn.rope_scale)
         k = apply_rope(k, pos, scale=attn.rope_scale)
+    if kv_out is not None:
+        kv_out.append((k, v))
     for j in range(w_len):
         if table is None:
             kv = _cache_write_slots(kv, k[:, j:j + 1], v[:, j:j + 1],
@@ -1011,10 +1071,10 @@ def _decode_block_slots_window(block: TransformerBlock, p, s, kv, x, t,
             kv = _cache_write_pages(kv, k[:, j:j + 1], v[:, j:j + 1],
                                     t + j, table, page_len)
     if table is None:
-        y = _slot_attn_readout(attn, p["attn"], q, kv, t, dt)
+        y = _slot_attn_readout(attn, p["attn"], q, kv, t, dt, tree=tree)
     else:
         y = _paged_attn_readout(attn, p["attn"], q, kv, t, table,
-                                page_len, dt, paged_kernel)
+                                page_len, dt, paged_kernel, tree=tree)
     x = x + y.astype(x.dtype)
     h, _ = block.norm2.apply(p["norm2"], s["norm2"], x)
     m = _apply_mlp_decode(block.mlp, p["mlp"], s["mlp"], h,
@@ -1024,38 +1084,55 @@ def _decode_block_slots_window(block: TransformerBlock, p, s, kv, x, t,
 
 def _verify_window(module: Sequential, params, state, cache, toks, t,
                    table, page_len: int, moe_dispatched: bool = True,
-                   moe_stats=None, paged_kernel=None):
+                   moe_stats=None, paged_kernel=None, tree=None):
     """Shared body of the verify steps: [S, W] window tokens through the
     whole stack at per-slot positions; returns ([S, W, V] logits,
     cache). MoE blocks see the [S, W] window as ONE slot-token batch
     through the dispatched decode path (capacity = S*W: drop-free even
-    when every window position routes to one expert)."""
+    when every window position routes to one expert).
+
+    ``tree`` (``{"depth": [S, W], "anc": [S, W, W]}``) switches the
+    window from a causal chain to a token TREE: every node still
+    writes its own window column ``t + j``, but positions (rope +
+    positional embedding) come from the node's root-path depth and the
+    ancestor mask decides visibility. The return gains a third value —
+    the per-layer roped window k/v (None for non-attention layers) —
+    which ``commit_tree_path`` consumes after acceptance."""
     x = toks                                             # [S, W] int
     w_len = toks.shape[1]
     new_cache = list(cache)
     routing = [] if moe_stats is not None else None
+    kv_win = [] if tree is not None else None
     for i, layer in enumerate(module.layers):
         p, s, kv = params[i], state[i], cache[i]
         block = _decode_block_of(layer)
         if block is not None:
             x, new_cache[i] = _decode_block_slots_window(
                 block, p, s, kv, x, t, table, page_len,
-                moe_dispatched, routing, paged_kernel)
+                moe_dispatched, routing, paged_kernel, tree, kv_win)
         elif isinstance(layer, PositionalEmbedding):
-            pos = t[:, None] + jnp.arange(w_len)         # [S, W]
+            pos = _window_positions(t, w_len, tree)      # [S, W]
             x = x + p["embeddings"][pos].astype(x.dtype)
         elif isinstance(layer, Dropout):
             pass                                         # eval: identity
         else:
             x, _ = layer.apply(p, s, x, training=False)
+    if kv_win is not None:
+        # index-align the collected (k, v) pairs with the CACHE list
+        # (blocks appended in layer order; everything else is None)
+        it = iter(kv_win)
+        kv_win = [next(it) if _decode_block_of(layer) is not None
+                  else None for layer in module.layers]
+    out = (x, new_cache) if tree is None else (x, new_cache, kv_win)
     if moe_stats is not None:
-        return x, new_cache, _moe_route_stats(
-            routing, t, w_len, int(moe_stats))
-    return x, new_cache                                  # [S, W, V]
+        return out + (_moe_route_stats(routing, t, w_len,
+                                       int(moe_stats)),)
+    return out                                           # [S, W, V], ..
 
 
 def verify_step_slots(module: Sequential, params, state, cache, toks, t,
-                      *, moe_dispatched: bool = True, moe_stats=None):
+                      *, moe_dispatched: bool = True, moe_stats=None,
+                      tree=None):
     """Batched speculative VERIFY against the slab pool: toks [S, W]
     int (window token 0 is the slot's pending decode input, tokens
     1..W-1 its drafts), t [S] int per-slot window start positions;
@@ -1064,15 +1141,27 @@ def verify_step_slots(module: Sequential, params, state, cache, toks, t,
     greedy accept rule is ``argmax(logits[:, j-1]) == toks[:, j]``.
     Sentinel slots (t out of range) write nothing and produce garbage
     logits, exactly like ``decode_step_slots`` — whose
-    ``moe_dispatched``/``moe_stats`` MoE contract also applies."""
+    ``moe_dispatched``/``moe_stats`` MoE contract also applies.
+
+    ``tree`` (tree-speculation PR: ``{"depth": [S, W] int, "anc":
+    [S, W, W] bool}``) generalizes the chain window to a token TREE —
+    window column j holds tree node j (node 0 the pending input/root),
+    roped and position-embedded at its root-path depth, visible only
+    to its descendants via the ancestor mask. With ``tree`` the return
+    gains a third value: the per-layer roped window k/v that
+    :func:`commit_tree_path` writes back along the accepted path. A
+    chain-shaped tree (``depth[j] = j``, lower-triangular ``anc``)
+    reproduces the plain window BIT FOR BIT."""
     return _verify_window(module, params, state, cache, toks, t,
-                          None, 0, moe_dispatched, moe_stats)
+                          None, 0, moe_dispatched, moe_stats,
+                          tree=tree)
 
 
 def verify_step_slots_paged(module: Sequential, params, state, cache,
                             toks, t, table, page_len: int,
                             *, moe_dispatched: bool = True,
-                            moe_stats=None, paged_kernel=None):
+                            moe_stats=None, paged_kernel=None,
+                            tree=None):
     """The paged mirror of :func:`verify_step_slots`: window writes
     scatter through the [S, P] page tables (unallocated logical pages
     drop their writes — the engine pre-allocates pages for every
@@ -1080,10 +1169,122 @@ def verify_step_slots_paged(module: Sequential, params, state, cache,
     the rejected tail). ``paged_kernel`` selects the readout exactly
     as in :func:`decode_step_slots_paged` — the kernel's ``[S, W]``
     window-causal mask generalization is what lets the speculative
-    verify ride it too."""
+    verify ride it too; the tree mask (``tree=``, see
+    :func:`verify_step_slots`) rides the kernel as an ``[S, W, W]``
+    ancestor-mask operand."""
     return _verify_window(module, params, state, cache, toks, t,
                           table, page_len, moe_dispatched, moe_stats,
-                          paged_kernel)
+                          paged_kernel, tree=tree)
+
+
+def tree_walk(logits, toks, parents, *, temperature=None, top_k=None,
+              top_p=None, keys=None):
+    """In-program acceptance over a verified token tree: greedily walk
+    the longest accepted root-path.
+
+    ``logits`` [S, W, V] is the verify forward's output (row j = the
+    target's next-token distribution AFTER consuming node j's root
+    path); ``toks`` [S, W] the window tokens (node 0 = the pending
+    input); ``parents`` [S, W] the parent-index vectors (node 0 and
+    unused nodes carry -1 — an unused node can never be entered
+    because no walk position equals -1).
+
+    The walk starts at the root and repeats: draw the target's choice
+    ``x`` at the current node (argmax when ``temperature`` is None,
+    else one PRNG split + ``_sample_vec`` — EXACTLY the per-emitted-
+    token key discipline of plain decode, so sampled streams stay
+    byte-identical); emit ``x``; descend into the lowest-index child
+    whose draft token equals ``x``, or stop. Every emitted token is
+    either an accepted draft (the child's token) or the final bonus —
+    between 1 and W emissions. For a point-mass (deterministic) draft
+    this IS the exact multi-draft rejection-sampling rule: each
+    candidate child is a distinct point mass, and sampling from the
+    target then accepting on equality preserves the plain-decode
+    output distribution token for token.
+
+    Returns ``(emitted [S, W], n_emit [S], path [S, W], new_keys)``:
+    ``emitted[:, :n_emit]`` are the tokens to append, ``path[:, d]``
+    the accepted node at depth d (valid for ``d < n_emit``; the commit
+    uses it to place K/V), ``new_keys`` the post-walk per-slot keys
+    (None for greedy) — advanced by exactly ``n_emit`` splits, as
+    ``n_emit`` plain decode iterations would have."""
+    s_n, w_len, _ = logits.shape
+    greedy = temperature is None
+    rows = jnp.arange(s_n)
+    cur = jnp.zeros((s_n,), jnp.int32)
+    walking = jnp.ones((s_n,), bool)
+    n_emit = jnp.zeros((s_n,), jnp.int32)
+    ks = keys
+    emitted = []
+    path = [cur]
+    for _ in range(w_len):
+        lg = logits[rows, cur]                           # [S, V]
+        if greedy:
+            x = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+        else:
+            split = jax.vmap(jax.random.split)(ks)
+            x = _sample_vec(lg, temperature, top_k, top_p,
+                            split[:, 1]).astype(jnp.int32)
+            # the key advances only on steps that actually emit — a
+            # finished walk must not consume entropy plain decode
+            # would not have
+            ks = jnp.where(walking[:, None], split[:, 0], ks)
+        emitted.append(jnp.where(walking, x, -1))
+        n_emit = n_emit + walking.astype(jnp.int32)
+        is_child = (parents == cur[:, None]) & (toks == x[:, None]) \
+            & walking[:, None]                           # [S, W]
+        # node 0's parent is -1 and cur >= 0, so the root can never be
+        # re-entered; ties (two children with one token) resolve
+        # lowest-index — the subtrees are interchangeable up to here
+        has = is_child.any(axis=1)
+        child = jnp.argmax(is_child, axis=1).astype(jnp.int32)
+        walking = walking & has
+        cur = jnp.where(walking, child, cur)
+        path.append(cur)
+    return (jnp.stack(emitted, axis=1),
+            n_emit,
+            jnp.stack(path[:w_len], axis=1),
+            None if greedy else ks)
+
+
+def commit_tree_path(cache, kv_win, path, t, n_emit, table=None,
+                     page_len: int = 0):
+    """Post-acceptance cache commit for tree speculation: write the
+    accepted root-path's K/V at its CONTIGUOUS final positions.
+
+    The verify forward wrote node j at window column ``t + j``; the
+    accepted node at depth d belongs at ``t + d`` (and was roped
+    there — ``depth[path[d]] == d`` by construction). This pass
+    gathers each layer's window k/v along ``path`` and re-writes
+    depths ``0 .. n_emit-1`` through the established slot/page
+    writers; depths past the accepted path route to an out-of-range
+    position, where the one-hot write misses and the page scatter
+    drops — rejected branches stay exactly the stale-tail garbage the
+    masks already cover, healed by the stream's own later writes.
+    Chain-shaped trees re-write identical bytes (the accepted node AT
+    depth d IS window column d), so a width-1 tree's cache equals the
+    linear verify's bit for bit."""
+    w_len = path.shape[1]
+    new_cache = list(cache)
+    drop = jnp.int32(2 ** 30)        # past any capacity: writers skip
+    for i, kvw in enumerate(kv_win):
+        if kvw is None:
+            continue
+        k, v = kvw                                       # [S, W, H, D]
+        kc = jnp.take_along_axis(k, path[:, :, None, None], axis=1)
+        vc = jnp.take_along_axis(v, path[:, :, None, None], axis=1)
+        kv = new_cache[i]
+        for d in range(w_len):
+            pos = jnp.where(d < n_emit, t + d, drop)
+            if table is None:
+                kv = _cache_write_slots(kv, kc[:, d:d + 1],
+                                        vc[:, d:d + 1], pos)
+            else:
+                kv = _cache_write_pages(kv, kc[:, d:d + 1],
+                                        vc[:, d:d + 1], pos, table,
+                                        page_len)
+        new_cache[i] = kv
+    return new_cache
 
 
 # --- fused multi-step decode (zero-bubble serving PR) -----------------------

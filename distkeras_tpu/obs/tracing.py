@@ -102,7 +102,8 @@ class RequestTimeline:
                  "n_tokens", "events", "dropped_events", "_agg_count",
                  "_agg_t0", "n_preempted", "prefix_hit_tokens",
                  "spec_proposed", "spec_accepted", "_spec_agg_proposed",
-                 "_spec_agg_accepted", "_moe_agg_n", "_moe_agg_entropy",
+                 "_spec_agg_accepted", "_spec_agg_width",
+                 "_spec_agg_path", "_moe_agg_n", "_moe_agg_entropy",
                  "_moe_agg_top")
 
     def __init__(self, rid: int):
@@ -128,6 +129,8 @@ class RequestTimeline:
         self.spec_accepted = 0       # drafts the target accepted
         self._spec_agg_proposed = 0  # since last spec_verify flush
         self._spec_agg_accepted = 0
+        self._spec_agg_width = 0     # max tree width in the window
+        self._spec_agg_path = 0      # max accepted root-path length
         self._moe_agg_n = 0          # MoE iters since last flush
         self._moe_agg_entropy = 0.0  # summed router entropy (nats)
         self._moe_agg_top = 0.0      # max top-expert share seen
@@ -151,11 +154,19 @@ class RequestTimeline:
             self._agg_count = 0
             self._agg_t0 = None
         if self._spec_agg_proposed:
+            extra = {}
+            if self._spec_agg_width:
+                # tree speculation (tree-speculation PR): the widest
+                # tree and longest accepted root path in the window
+                extra = {"tree_width": self._spec_agg_width,
+                         "accepted_path_len": self._spec_agg_path}
             self.add_event("spec_verify", t, max_events,
                            proposed=self._spec_agg_proposed,
-                           accepted=self._spec_agg_accepted)
+                           accepted=self._spec_agg_accepted, **extra)
             self._spec_agg_proposed = 0
             self._spec_agg_accepted = 0
+            self._spec_agg_width = 0
+            self._spec_agg_path = 0
         if self._moe_agg_n:
             self.add_event(
                 "moe_route", t, max_events,
@@ -459,11 +470,15 @@ class RequestTracer:
 
     def on_spec_verify(self, items) -> None:
         """One speculative verify step's per-request outcomes:
-        ``items`` is an iterable of ``(rid, proposed, accepted)``.
-        Aggregated onto the decode-event cadence (flushed together), so
-        speculation adds no per-iteration event volume."""
+        ``items`` is an iterable of ``(rid, proposed, accepted)`` —
+        or, for TREE verifies (tree-speculation PR), ``(rid, proposed,
+        accepted, tree_width, accepted_path_len)``. Aggregated onto
+        the decode-event cadence (flushed together), so speculation
+        adds no per-iteration event volume; the tree fields aggregate
+        as window maxima."""
         with self._lock:
-            for rid, proposed, accepted in items:
+            for item in items:
+                rid, proposed, accepted = item[0], item[1], item[2]
                 tl = self._live.get(rid)
                 if tl is None:
                     continue
@@ -471,6 +486,11 @@ class RequestTracer:
                 tl.spec_accepted += int(accepted)
                 tl._spec_agg_proposed += int(proposed)
                 tl._spec_agg_accepted += int(accepted)
+                if len(item) > 3:
+                    tl._spec_agg_width = max(tl._spec_agg_width,
+                                             int(item[3]))
+                    tl._spec_agg_path = max(tl._spec_agg_path,
+                                            int(item[4]))
 
     def on_moe_route(self, rids, entropy: float,
                      top_share: float) -> None:

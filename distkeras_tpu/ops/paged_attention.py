@@ -85,7 +85,11 @@ def page_aligned(page_len: int, quantized: bool) -> bool:
 
 def _kernel(t_ref, tb_ref, *refs, scale: float, page_len: int,
             g: int, w_len: int, hkv: int, window, quantized: bool,
-            n_pages: int):
+            n_pages: int, tree: bool):
+    if tree:
+        anc_ref, refs = refs[0], refs[1:]
+    else:
+        anc_ref = None
     if quantized:
         (q_ref, k_ref, v_ref, ks_ref, vs_ref, o_ref,
          m_ref, l_ref, acc_ref) = refs
@@ -107,6 +111,7 @@ def _kernel(t_ref, tb_ref, *refs, scale: float, page_len: int,
     start = pi * page_len
     # a page participates iff it holds any position some window query
     # admits: the union of the per-query ranges is (t - window, t+W-1]
+    # (tree windows too: every node's column lies in [t, t+W-1])
     run = jnp.logical_and(start <= t + (w_len - 1),
                           tb_ref[si, pi] < n_pages)
     if window is not None:
@@ -120,9 +125,29 @@ def _kernel(t_ref, tb_ref, *refs, scale: float, page_len: int,
         j_idx = lax.broadcasted_iota(jnp.int32, (rows, page_len), 0) // g
         pos = start + lax.broadcasted_iota(
             jnp.int32, (rows, page_len), 1)
-        valid = pos <= t + j_idx
-        if window is not None:
-            valid = jnp.logical_and(valid, pos > t + j_idx - window)
+        if anc_ref is None:
+            valid = pos <= t + j_idx
+            if window is not None:
+                valid = jnp.logical_and(valid, pos > t + j_idx - window)
+        else:
+            # tree window (tree-speculation PR): the committed prefix
+            # (< t) plus, for window column w2 at position t + w2, the
+            # per-ROW ancestor bit — the equality-OR form keeps the
+            # gather static (W is small and compile-time)
+            anc_blk = anc_ref[0]               # [rows, Wpad] int32
+            valid = pos < t
+            for w2 in range(w_len):
+                valid = jnp.logical_or(
+                    valid,
+                    jnp.logical_and(anc_blk[:, w2:w2 + 1] != 0,
+                                    pos == t + w2))
+            if window is not None:
+                # each query's own position is t + depth; depth = its
+                # ancestor count (self included) minus one
+                depth = jnp.sum((anc_blk[:, :w_len] != 0)
+                                .astype(jnp.int32),
+                                axis=1, keepdims=True) - 1
+                valid = jnp.logical_and(valid, pos > t + depth - window)
         # unrolled per-KV-head loop: each h is one independent
         # online-softmax update (static Python unroll, hkv copies —
         # the bh_block amortization of ops.decode_attention)
@@ -162,7 +187,7 @@ def _kernel(t_ref, tb_ref, *refs, scale: float, page_len: int,
 def paged_decode_attention(q, k_pages, v_pages, t, table, *,
                            scale: Optional[float] = None,
                            window: Optional[int] = None,
-                           k_scale=None, v_scale=None,
+                           k_scale=None, v_scale=None, anc=None,
                            interpret: Optional[bool] = None):
     """Window decode attention straight off the page pool.
 
@@ -173,7 +198,16 @@ def paged_decode_attention(q, k_pages, v_pages, t, table, *,
     ``[S, P]`` int32 page tables (entries >= N are the unallocated
     sentinel — skipped). Returns ``[S, W, Hkv, G, D]`` f32, the
     masked-softmax attention of each window query over its slot's
-    cache positions (``window`` adds the SWA band)."""
+    cache positions (``window`` adds the SWA band).
+
+    ``anc`` (tree speculation, ``[S, W, W]`` bool): switch the
+    window-causal mask to a per-slot token-TREE mask — window query i
+    admits the committed prefix (``< t``) plus window column j's
+    position ``t + j`` iff ``anc[s, i, j]`` (node j is i or one of its
+    ancestors; the engine derives the mask from the draft's
+    parent-index vectors). SWA models derive each node's own position
+    from its ancestor count (``t + depth``). A lower-triangular ``anc``
+    reproduces the plain window-causal mask exactly."""
     s, w_len, hkv, g, d = q.shape
     n_pages, _, page_len, _ = k_pages.shape
     n_logical = table.shape[1]
@@ -185,6 +219,10 @@ def paged_decode_attention(q, k_pages, v_pages, t, table, *,
             "use models.decoding._gather_pages instead")
     if scale is None:
         scale = d ** -0.5
+    if anc is not None and w_len > 128:
+        raise ValueError(
+            f"tree window {w_len} exceeds the kernel's one-tile "
+            "ancestor-mask lane budget (128 nodes)")
     if interpret is None:
         interpret = not backend_is_tpu()
     if pltpu is None:  # pragma: no cover — no Pallas TPU support
@@ -210,12 +248,27 @@ def paged_decode_attention(q, k_pages, v_pages, t, table, *,
     def sc_map(si, pi, t_ref, tb_ref):
         return (jnp.minimum(tb_ref[si, pi], n_pages - 1), 0, 0)
 
-    in_specs = [
+    def anc_map(si, pi, t_ref, tb_ref):
+        return (si, 0, 0)
+
+    in_specs = []
+    operands = []
+    if anc is not None:
+        # the ancestor mask as a per-slot [rows, W] int32 plane: each
+        # query row repeats its window node's mask (G query heads share
+        # one node), rows padded with the q padding, the node axis
+        # padded to the 128-lane tile
+        anc_rows = jnp.repeat(jnp.asarray(anc, jnp.int32), g, axis=1)
+        anc_rows = jnp.pad(anc_rows,
+                           ((0, 0), (0, pad), (0, 128 - w_len)))
+        in_specs.append(pl.BlockSpec((1, rows_p, 128), anc_map))
+        operands.append(anc_rows)
+    in_specs += [
         pl.BlockSpec((1, hkv, rows_p, d), q_map),
         pl.BlockSpec((1, hkv, page_len, d), kv_map),
         pl.BlockSpec((1, hkv, page_len, d), kv_map),
     ]
-    operands = [qr, k_pages, v_pages]
+    operands += [qr, k_pages, v_pages]
     if quantized:
         in_specs += [pl.BlockSpec((1, hkv, page_len), sc_map),
                      pl.BlockSpec((1, hkv, page_len), sc_map)]
@@ -223,7 +276,8 @@ def paged_decode_attention(q, k_pages, v_pages, t, table, *,
     kernel = functools.partial(
         _kernel, scale=float(scale), page_len=int(page_len), g=int(g),
         w_len=int(w_len), hkv=int(hkv), window=window,
-        quantized=quantized, n_pages=int(n_pages))
+        quantized=quantized, n_pages=int(n_pages),
+        tree=anc is not None)
     kwargs = {}
     if not interpret:
         kwargs["compiler_params"] = pltpu.CompilerParams(

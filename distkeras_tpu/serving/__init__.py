@@ -40,7 +40,10 @@ per-step decode kernels and an actual serving workload:
                    self-drafting, zero extra weights) and
                    ``DraftModel`` (a small LM with its own paged KV) —
                    verified k-at-a-time by one batched target pass
-                   (``models.decoding.verify_step_slots[_paged]``)
+                   (``models.decoding.verify_step_slots[_paged]``),
+                   linearly or as per-slot token TREES
+                   (``propose_tree`` + the ancestor-mask window,
+                   ``ServingEngine(spec_tree=)``)
     metrics.py     TTFT, TPOT, request latency, queue depth, slot
                    occupancy, tokens/s, page-budget gauges and
                    prefix-cache hit rates — the numbers ``bench.py

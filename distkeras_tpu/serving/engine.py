@@ -92,17 +92,20 @@ from distkeras_tpu.models.decoding import (_attn_compute_dtype,
                                            _decode_block_of,
                                            _resolve_head_dims,
                                            _sample_vec, _serving_params,
+                                           commit_tree_path,
                                            decode_fused_slots,
                                            decode_step_slots,
                                            decode_step_slots_paged,
                                            prefill, prefill_chunk_step,
+                                           tree_walk,
                                            verify_step_slots,
                                            verify_step_slots_paged)
 from distkeras_tpu.models.moe import MoE
 from distkeras_tpu.resilience import faults
 from distkeras_tpu.serving.kv_pool import (KVPool, PagedKVPool,
                                            PrefixCache)
-from distkeras_tpu.serving.speculation import DraftSource
+from distkeras_tpu.serving.speculation import (DraftSource,
+                                               tree_ancestors)
 from distkeras_tpu.serving.metrics import ServingMetrics
 from distkeras_tpu.serving.scheduler import (AdmissionRejected,
                                              FIFOScheduler,
@@ -229,6 +232,22 @@ class ServingEngine:
       acceptance is below the floor stops speculating (the verify
       window costs a (k+1)-wide forward; on a never-accepting stream
       that is pure overhead). Sticky per request.
+    * ``spec_tree`` / ``spec_width`` — TREE speculation (docs/
+      serving.md §Tree speculation): drafts arrive as a per-slot token
+      TREE (``DraftSource.propose_tree`` — branching n-gram
+      continuations or a beam-style draft-model tree) and ONE
+      tree-masked verify window scores every branch; the in-program
+      walk accepts the longest root path (exact multi-draft rejection
+      sampling for sampled streams — byte-identical to plain decode)
+      and the cache commits only the accepted path. The window is
+      ``1 + spec_k * spec_width`` columns (STATIC); an adaptive
+      per-stream controller sizes each request's actual depth/width
+      inside it from the acceptance EMA (hot streams widen toward the
+      caps, cold streams narrow toward a plain chain and ultimately
+      the existing EMA kill switch). ``spec_tree=False`` (default)
+      keeps the landed linear verify path byte-for-byte; with
+      ``spec_width=1`` the tree path IS the linear chain (oracle
+      tests pin the identity).
 
     Zero-bubble knobs (docs/serving.md §Zero-bubble loop):
 
@@ -301,6 +320,7 @@ class ServingEngine:
                  draft: Optional[DraftSource] = None, spec_k: int = 4,
                  spec_disable_below: float = 0.1,
                  spec_warmup: int = 8,
+                 spec_tree: bool = False, spec_width: int = 1,
                  moe_decode: str = "dispatched",
                  ep_mesh=None,
                  overlap: bool = True, fuse_steps: int = 0,
@@ -545,6 +565,27 @@ class ServingEngine:
         self.spec_disable_below = float(spec_disable_below)
         self.spec_warmup = int(spec_warmup)
         self._spec_fns = {}                  # greedy_only -> jit verify
+        # tree speculation (tree-speculation PR): the verify window
+        # widens to 1 + spec_k * spec_width TREE nodes; per-stream
+        # depth/width adapt inside the static window
+        self.spec_tree = bool(spec_tree)
+        self.spec_width = int(spec_width)
+        if self.spec_width < 1:
+            raise ValueError(
+                f"spec_width must be >= 1, got {spec_width}")
+        if self.spec_width > 1 and not self.spec_tree:
+            raise ValueError(
+                "spec_width > 1 needs spec_tree=True (the linear "
+                "verify window has no branch columns)")
+        if self.spec_tree and draft is None:
+            raise ValueError(
+                "spec_tree=True needs a draft source "
+                "(ServingEngine(draft=...))")
+        #: verify-window width: tree windows hold the full node budget
+        self.spec_window = (1 + self.spec_k * self.spec_width
+                            if self.spec_tree else self.spec_k + 1)
+        self._tree_fns = {}                  # greedy_only -> jit tree fn
+        self._spec_tree_buf: List = []       # (tree_width, path_len)
         if draft is not None:
             draft.bind(self)
 
@@ -864,6 +905,10 @@ class ServingEngine:
             for k, acc in self._spec_buf:
                 m.record_spec_verify(k, acc)
             self._spec_buf.clear()
+        if self._spec_tree_buf:
+            for width, path_len in self._spec_tree_buf:
+                m.record_spec_tree(width, path_len)
+            self._spec_tree_buf.clear()
         if self._trace_decode:
             if self.tracer.enabled:
                 self.tracer.on_decode_batch(self._trace_decode,
@@ -872,8 +917,10 @@ class ServingEngine:
             self._trace_decode_t0 = None
         if self._trace_spec:
             if self.tracer.enabled:
+                # linear entries are [proposed, accepted]; tree entries
+                # append [tree_width, accepted_path_len]
                 self.tracer.on_spec_verify(
-                    [(rid, pa[0], pa[1])
+                    [(rid, *pa)
                      for rid, pa in self._trace_spec.items()])
             self._trace_spec = {}
 
@@ -1376,6 +1423,98 @@ class ServingEngine:
                 else "serving.verify_sampled", fn)
         return fn
 
+    def _verify_tree_fn(self, greedy_only: bool):
+        """The TREE counterparts of ``_verify_fn``'s two variants: one
+        program runs the tree-masked verify forward
+        (``verify_step_slots[_paged]`` with the ancestor mask), the
+        in-program acceptance walk (``tree_walk`` — greedy argmax
+        descent, or the exact point-mass rejection-sampling walk with
+        one PRNG split per emitted token), and the accepted-path cache
+        commit (``commit_tree_path``) — returning ``(emitted, n_emit,
+        cache[, keys], moe)``. Slots whose tree has no draft nodes
+        (opted out, EMA-disabled, clamped to depth 0) walk exactly one
+        root step — a plain decode step — so mixed batches share the
+        program, the linear path's ``active`` contract re-expressed as
+        tree shape."""
+        fn = self._tree_fns.get(greedy_only)
+        if fn is None:
+            module = self.module
+            paged = self.kv_layout == "paged"
+            page_len = self.page_len
+            moe_kw = dict(
+                moe_dispatched=self._moe_dispatched,
+                moe_stats=self.max_len if self._moe_stats_on else None)
+            stats_on = self._moe_stats_on
+            pk = self._paged_kernel
+
+            def vstep(params, state, cache, toks, t, depth, anc,
+                      tables):
+                tree = {"depth": depth, "anc": anc}
+                if paged:
+                    out = verify_step_slots_paged(
+                        module, params, state, cache, toks, t, tables,
+                        page_len, tree=tree, paged_kernel=pk, **moe_kw)
+                else:
+                    out = verify_step_slots(
+                        module, params, state, cache, toks, t,
+                        tree=tree, **moe_kw)
+                if stats_on:
+                    logits, cache, kvw, moe = out
+                else:
+                    (logits, cache, kvw), moe = out, None
+                return logits, cache, kvw, moe
+
+            if greedy_only:
+                def body(params, state, cache, toks, t, parents, depth,
+                         anc, tables):
+                    logits, cache, kvw, moe = vstep(
+                        params, state, cache, toks, t, depth, anc,
+                        tables)
+                    emitted, n_emit, path, _ = tree_walk(
+                        logits, toks, parents)
+                    cache = commit_tree_path(
+                        cache, kvw, path, t, n_emit, table=tables,
+                        page_len=page_len or 0)
+                    return emitted, n_emit, cache, moe
+
+                if paged:
+                    fn, n_args = body, 9
+                else:
+                    def fn(params, state, cache, toks, t, parents,
+                           depth, anc):
+                        return body(params, state, cache, toks, t,
+                                    parents, depth, anc, None)
+                    n_args = 8
+            else:
+                def body(params, state, cache, toks, t, parents, depth,
+                         anc, temp, topk, topp, keys, tables):
+                    logits, cache, kvw, moe = vstep(
+                        params, state, cache, toks, t, depth, anc,
+                        tables)
+                    emitted, n_emit, path, new_keys = tree_walk(
+                        logits, toks, parents, temperature=temp,
+                        top_k=topk, top_p=topp, keys=keys)
+                    cache = commit_tree_path(
+                        cache, kvw, path, t, n_emit, table=tables,
+                        page_len=page_len or 0)
+                    return emitted, n_emit, cache, new_keys, moe
+
+                if paged:
+                    fn, n_args = body, 13
+                else:
+                    def fn(params, state, cache, toks, t, parents,
+                           depth, anc, temp, topk, topp, keys):
+                        return body(params, state, cache, toks, t,
+                                    parents, depth, anc, temp, topk,
+                                    topp, keys, None)
+                    n_args = 12
+            fn = self._jit_serving(fn, n_args)
+            self._tree_fns[greedy_only] = fn
+            self._recompile.watch(
+                "serving.verify_tree_greedy" if greedy_only
+                else "serving.verify_tree_sampled", fn)
+        return fn
+
     # --- speculation bookkeeping ------------------------------------------
 
     def _spec_eligible(self, req: Request) -> bool:
@@ -1412,6 +1551,52 @@ class ServingEngine:
 
     #: EMA smoothing for per-request draft acceptance
     _SPEC_EMA_ALPHA = 0.25
+    #: adaptive tree controller (spec_tree): EMA at-or-above widens a
+    #: stream toward (spec_k, spec_width); below the demote line it
+    #: narrows toward a depth-1 chain — full demotion to plain decode
+    #: stays the existing spec_disable_below kill switch's job
+    _TREE_PROMOTE_EMA = 0.6
+    _TREE_DEMOTE_EMA = 0.25
+
+    def _tree_shape(self, req: Request):
+        """This request's tree shape for the NEXT verify: the adaptive
+        controller's (depth, width), depth clamped so no accepted path
+        can outrun the remaining token budget (``remaining - 1`` — the
+        final emitted token is always the free bonus). Depth < 1 means
+        the stream rides the window as a plain decode step this
+        iteration."""
+        if req.tree_depth is None:
+            req.tree_depth = self.spec_k
+            req.tree_width = self.spec_width
+        remaining = req.max_new_tokens - len(req.generated)
+        return min(req.tree_depth, remaining - 1), req.tree_width
+
+    def _adapt_tree(self, req: Request) -> None:
+        """Resize a stream's tree from its acceptance EMA: hot streams
+        (EMA >= ``_TREE_PROMOTE_EMA``) deepen first, then widen — depth
+        compounds on a well-predicted stream, width only pays at
+        divergence points; cold streams (< ``_TREE_DEMOTE_EMA``) shed
+        width first (side branches are the cheapest columns to stop
+        wasting), then depth, demoting toward a 1-deep chain — the
+        sticky EMA floor (``_observe_acceptance``) handles the final
+        drop to plain decode. Gated on the SAME ``spec_warmup`` as the
+        kill switch: a fresh stream's first verifies routinely miss
+        (its n-gram history is still forming), and resizing off that
+        transient collapsed trees the steady state would have kept
+        wide."""
+        ema = req.spec_ema
+        if ema is None or req.spec_checks < self.spec_warmup:
+            return
+        if ema >= self._TREE_PROMOTE_EMA:
+            if req.tree_depth < self.spec_k:
+                req.tree_depth += 1
+            elif req.tree_width < self.spec_width:
+                req.tree_width += 1
+        elif ema < self._TREE_DEMOTE_EMA:
+            if req.tree_width > 1:
+                req.tree_width -= 1
+            elif req.tree_depth > 1:
+                req.tree_depth -= 1
 
     #: prefill-program cache cap: every DISTINCT (q_len, t0, final)
     #: triple is its own XLA program (the final chunk's key differs for
@@ -1616,9 +1801,14 @@ class ServingEngine:
             # swap resume: the fresh pages land on the SAME logical
             # indices the snapshot captured — the table restore half
             # of the swap-in (the H2D payload copy runs at the
-            # request's prefill turn, _advance_prefill)
+            # request's prefill turn, _advance_prefill). Prefix-
+            # resident pages re-link in place: the snapshot's refcount
+            # hold becomes the slot's table hold (released like any
+            # slot page at the next release_slot)
             for lp, pid in zip(req._swap["logical"], plan["priv"]):
                 pool.assign(slot, int(lp), pid)
+            for lp, pid in req._swap.get("shared", ()):
+                pool.assign(slot, int(lp), int(pid))
             req._shared_len = 0
             req._n_shared_full = 0
             req._load_pages = []
@@ -1689,25 +1879,36 @@ class ServingEngine:
         # victims hold no written pool pages (prefill writes staging);
         # they keep the re-prefill path. Falls through silently when
         # the host tier is off or full — the swap is an accelerator,
-        # never a correctness dependency. The snapshot deliberately
-        # includes SHARED prefix pages (ref > 1): excluding them
-        # would make resume depend on the prefix cache still holding
-        # the chain (evictable meanwhile), i.e. a partial-restore +
-        # partial-re-prefill plan. The cost is a private duplicate of
-        # the shared head after resume (it dies with the request,
-        # like any privately recomputed prefix) and the extra host
-        # bytes — re-attaching via prefix.match at resume is the
-        # documented follow-up (docs/serving.md).
+        # never a correctness dependency.
+        #
+        # PREFIX-AWARE snapshot (tree-speculation PR satellite,
+        # closing the PR-17 trade-off): pages still RESIDENT in the
+        # prefix cache are not copied at all — the snapshot takes a
+        # refcount hold instead (pinning them against spill/drop: both
+        # need ref == 1) and resume re-links them into the table, the
+        # hold becoming the slot's. Only the private remainder moves
+        # D2H, so a shared-prefix-heavy victim swaps a fraction of its
+        # context and duplicates nothing on resume.
         swapped = 0
         if victim.state is RequestState.DECODING \
                 and self.kv_layout == "paged" \
                 and self.pool.host_cache is not None:
             row = self.pool.tables[slot]
             logical = np.where(row < self.pool.num_pages)[0]
-            hids = self.pool.offload_pages(row[logical].tolist())
+            shared, priv = [], []
+            for lp in logical.tolist():
+                pid = int(row[lp])
+                if self.prefix is not None and self.prefix.resident(pid):
+                    shared.append((lp, pid))
+                else:
+                    priv.append(lp)
+            hids = (self.pool.offload_pages(row[priv].tolist())
+                    if priv else [])
             if hids is not None:
-                victim._swap = {"host": hids,
-                                "logical": logical.tolist(),
+                for _lp, pid in shared:
+                    self.pool.incref(pid)       # the snapshot's hold
+                victim._swap = {"host": hids, "logical": priv,
+                                "shared": shared,
                                 "t": int(self._t[slot])}
                 swapped = len(hids)
                 self.tracer.on_swap_out(victim.rid, swapped)
@@ -2005,16 +2206,14 @@ class ServingEngine:
             self._preempt(req)
             if req.state in TERMINAL_STATES:
                 return None          # the pipeline flush finished it
-        if getattr(req, "_swap", None) is not None:
-            # any swap record — from the preempt above OR from an
-            # earlier preemption while the request sat QUEUED — holds
-            # pages in THIS engine's host pool, which a foreign
-            # engine cannot read: free them so the handoff rides the
-            # re-prefill resume (page SHIPPING over a transport is
-            # the router follow-up this machinery is built for;
-            # docs/serving.md §Router)
-            self.pool.free_host(req._swap["host"])
-            req._swap = None
+        # any swap record — from the preempt above OR from an
+        # earlier preemption while the request sat QUEUED — holds
+        # pages in THIS engine's host pool (and refcount holds on
+        # prefix-resident pages), which a foreign engine cannot use:
+        # drop them so the handoff rides the re-prefill resume (page
+        # SHIPPING over a transport is the router follow-up this
+        # machinery is built for; docs/serving.md §Router)
+        self._drop_swap(req)
         if req.state is not RequestState.QUEUED:
             raise RuntimeError(
                 f"cannot transfer request {rid} in state "
@@ -2123,11 +2322,9 @@ class ServingEngine:
             # before its prefill turn consumed it
             self.pool.decref(req._donor_ref)
             req._donor_ref = None
-        if getattr(req, "_swap", None) is not None:
-            # preempted-and-swapped but terminated (deadline, cancel)
-            # before the swap-in consumed the host copy
-            self.pool.free_host(req._swap["host"])
-            req._swap = None
+        # preempted-and-swapped but terminated (deadline, cancel)
+        # before the swap-in consumed the host copy / shared holds
+        self._drop_swap(req)
         req.error = error
         self.tracer.on_terminal(req.rid, state.value,
                                 len(req.generated))
@@ -2384,6 +2581,12 @@ class ServingEngine:
             if not self.scheduler.running:
                 return                  # the flush drained the batch
         fuse = 0 if spec else self._fuse_window()
+        if spec and self.spec_tree:
+            # tree speculation: the page lookahead depends on the
+            # PROPOSED node span, so proposal must precede page growth
+            # — the whole iteration lives in _spec_tree_step
+            self._spec_tree_step(finished)
+            return
         if paged:
             # page growth happens BEFORE the step (a write with no page
             # would silently drop); may preempt streams out of
@@ -2478,14 +2681,43 @@ class ServingEngine:
             self._warmed.add(name)
             self._recompile.mark_warm(name)
         self._note_moe_route(moe)
+
+        def note(slot, req, trace_on):
+            m = int(n_acc[slot])
+            self._spec_buf.append((k, m))
+            # the EMA updates INLINE (not on the host-window
+            # cadence): a spec iteration is already synchronous —
+            # the verify fetch above paid the sync — and the
+            # warm-up/kill-switch contract (spec_warmup checks,
+            # then disable) is exact-count, not windowed
+            self._observe_acceptance(req, m / k)
+            if trace_on:
+                pa = self._trace_spec.setdefault(req.rid, [0, 0])
+                pa[0] += k
+                pa[1] += m
+
+        self._consume_spec(running, cand, n_acc + 1, active, note,
+                           finished, t0)
+
+    def _consume_spec(self, running, emitted, n_emit, active, note,
+                      finished: List[Request], t0: float) -> None:
+        """Shared host-consume loop of the linear and tree spec steps:
+        append ``emitted[slot, :n_emit[slot]]`` until each request's
+        stop/budget, advance the ``_tok``/``_t`` mirrors, batch the
+        trace-decode ticks, run ``note(slot, req, trace_on)`` for each
+        ACTIVE slot's speculation bookkeeping, and flush deferred host
+        work BEFORE any terminal transition (on_terminal retires the
+        timeline, and the final verify's outcome belongs on it). One
+        copy of these contracts — the two call sites diverge only in
+        their ``note`` closures."""
         now_ = self._metrics.clock()
         trace_on = self.tracer.enabled
         n_emitted = 0
         done_reqs = []
         for slot, req in list(running.items()):
-            m = int(n_acc[slot])
+            ne = int(n_emit[slot])
             appended = 0
-            for token in cand[slot, :m + 1]:
+            for token in emitted[slot, :ne]:
                 req.generated.append(int(token))
                 appended += 1
                 if req.done:
@@ -2499,27 +2731,127 @@ class ServingEngine:
                 if self._trace_decode_t0 is None:
                     self._trace_decode_t0 = now_
             if active[slot]:
-                self._spec_buf.append((k, m))
-                # the EMA updates INLINE (not on the host-window
-                # cadence): a spec iteration is already synchronous —
-                # the verify fetch above paid the sync — and the
-                # warm-up/kill-switch contract (spec_warmup checks,
-                # then disable) is exact-count, not windowed
-                self._observe_acceptance(req, m / k)
-                if trace_on:
-                    pa = self._trace_spec.setdefault(req.rid, [0, 0])
-                    pa[0] += k
-                    pa[1] += m
+                note(slot, req, trace_on)
             if req.done:
                 done_reqs.append(req)
         self._decode_buf.append((len(running), now_ - t0, n_emitted))
         if done_reqs:
-            # spec events / decode ticks BEFORE terminal transitions:
-            # on_terminal retires the timeline, and the final verify's
-            # outcome belongs on it
             self._flush_host_window()
             for req in done_reqs:
                 self._finish(req, finished)
+
+    def _spec_tree_step(self, finished: List[Request]) -> None:
+        """One TREE draft-and-verify iteration (tree-speculation PR).
+
+        Order matters: (1) build each eligible stream's tree via
+        ``DraftSource.propose_tree`` under its adaptive (depth, width)
+        and a node budget capped by slot capacity; (2) derive
+        depth/ancestor arrays and grow pages for the PROPOSED node
+        span — the verify forward writes window columns ``t ..
+        t + n_nodes - 1`` and an accepted node's missing page would
+        silently corrupt its KV, so the lookahead is the worst-case
+        tree width, not the chain depth; (3) one compiled
+        verify-walk-commit program; (4) host consume: append
+        ``emitted[:n_emit]``, update the acceptance EMA (on the
+        longest-chain basis ``path_len / depth`` so the kill switch
+        threshold means the same thing as the linear path's), resize
+        the stream's tree (``_adapt_tree``). Streams whose tree ends
+        up empty ride the program as plain decode steps."""
+        W = self.spec_window
+        paged = self.kv_layout == "paged"
+        running = self.scheduler.running
+        s_n = self.num_slots
+        toks = np.zeros((s_n, W), np.int32)
+        toks[:, 0] = self._tok
+        parents = np.full((s_n, W), -1, np.int32)
+        active = np.zeros(s_n, bool)
+        depth_v = np.zeros(s_n, np.int32)
+        width_v = np.ones(s_n, np.int32)
+        budget_v = np.zeros(s_n, np.int32)
+        for slot, r in running.items():
+            if not self._spec_eligible(r):
+                continue
+            d, w = self._tree_shape(r)
+            if d < 1:
+                continue
+            active[slot] = True
+            depth_v[slot] = d
+            width_v[slot] = w
+            # every node writes its own window column: the span must
+            # fit the slot's capacity (>= d always — a chain fits)
+            budget_v[slot] = min(d * w,
+                                 self.max_len - 1 - int(self._t[slot]))
+        if active.any():
+            self._draft.propose_tree(dict(running), self._tok, self._t,
+                                     toks, parents, active, depth_v,
+                                     width_v, budget_v)
+        depth, anc, n_nodes = tree_ancestors(parents)
+        if paged:
+            look = np.where(active, n_nodes - 1, 0).astype(np.int64)
+            self._ensure_decode_pages(look)
+            if not self.scheduler.running:
+                return
+        t0 = self.metrics.clock()
+        running = self.scheduler.running
+        greedy_only = all(r.temperature <= 0.0
+                          for r in running.values())
+        tables = (self.pool.device_tables(),) if paged else ()
+        targs = (toks, self._t, parents, depth, anc)
+        if greedy_only:
+            emitted, n_emit, self.pool.cache, moe = \
+                self._verify_tree_fn(True)(
+                    self._params, self._state, self.pool.cache, *targs,
+                    *tables)
+            emitted, n_emit = self._fetch(emitted, n_emit)
+        else:
+            (emitted, n_emit, self.pool.cache, keys, moe) = \
+                self._verify_tree_fn(False)(
+                    self._params, self._state, self.pool.cache, *targs,
+                    self._temp, self._topk, self._topp, self._keys,
+                    *tables)
+            emitted, n_emit, new_keys = self._fetch(emitted, n_emit,
+                                                    keys)
+            self._keys = new_keys.copy()
+        name = ("serving.verify_tree_greedy" if greedy_only
+                else "serving.verify_tree_sampled")
+        if name not in self._warmed:
+            self._warmed.add(name)
+            self._recompile.mark_warm(name)
+        self._note_moe_route(moe)
+
+        def note(slot, req, trace_on):
+            nd = int(n_nodes[slot]) - 1         # draft nodes offered
+            m = int(n_emit[slot]) - 1           # accepted path length
+            self._spec_buf.append((nd, m))
+            self._spec_tree_buf.append((int(width_v[slot]), m))
+            # EMA on the longest-chain basis: m / depth means the
+            # same thing the linear path's m / k did, so the
+            # warm-up/kill-switch thresholds carry over unchanged
+            self._observe_acceptance(
+                req, m / max(1, int(depth_v[slot])))
+            self._adapt_tree(req)
+            if trace_on:
+                pa = self._trace_spec.setdefault(req.rid, [0, 0, 0, 0])
+                pa[0] += nd
+                pa[1] += m
+                pa[2] = max(pa[2], int(width_v[slot]))
+                pa[3] = max(pa[3], m)
+
+        self._consume_spec(running, emitted, n_emit, active, note,
+                           finished, t0)
+
+    def _drop_swap(self, req: Request) -> None:
+        """Release an orphaned swap snapshot: free its host pages
+        (pending async batches fully covered just drop — never read,
+        never fenced) and release the refcount holds on the prefix-
+        resident pages the snapshot pinned instead of copying."""
+        swap = getattr(req, "_swap", None)
+        if swap is None:
+            return
+        self.pool.free_host(swap["host"])
+        for _lp, pid in swap.get("shared", ()):
+            self.pool.decref(int(pid))
+        req._swap = None
 
     def _finish(self, req: Request, finished: List[Request]):
         slot = req.slot

@@ -279,6 +279,15 @@ class PagedKVPool:
         self.pages_offloaded = 0
         self.pages_restored = 0
         self.offload_bytes = 0
+        #: async swap-out (tree-speculation PR satellite): offload
+        #: batches whose D2H copies are enqueued but not yet fenced
+        #: into the host rows — each entry {"hids": [...], "dev":
+        #: gathered device pages}. The gather is a jitted snapshot, so
+        #: holding it is safe against later cache mutation; it pins
+        #: device memory until the fence, bounded by outstanding swaps.
+        self._pending_host: List[Dict] = []
+        #: lazy-fence odometer (tests pin laziness through it)
+        self.host_fences = 0
 
     # -- device views -------------------------------------------------------
 
@@ -380,16 +389,21 @@ class PagedKVPool:
         return len(self._host_free)
 
     def offload_pages(self, page_ids) -> Optional[List[int]]:
-        """Copy physical device pages D2H into free host pages;
-        returns the host page ids (the caller owns them until
+        """Enqueue physical device pages for D2H copy into free host
+        pages; returns the host page ids (the caller owns them until
         ``free_host``), or None when the host tier is off or lacks
         capacity — callers fall back to the discard/re-prefill path.
-        Every layer plane's D2H transfer is enqueued
-        (``copy_to_host_async``) before any is fenced, so the copies
-        overlap instead of paying one serial round trip per leaf; the
-        fenced views are immediately copied into the host pool rows
-        (a fancy-index store always copies), so no view of
-        runtime-owned device memory survives the call."""
+
+        ASYNC (tree-speculation PR satellite): the call only gathers
+        the pages into a device-side snapshot (a jitted copy — later
+        cache mutation cannot touch it) and enqueues the D2H
+        transfers (``copy_to_host_async``); nothing blocks. The fence
+        into the pinned host rows runs LAZILY at the first
+        ``restore_pages``/``free_host`` touch of these host pages —
+        the preempt-heavy serving path no longer stalls its iteration
+        on a D2H round trip that only the (much later, often never)
+        resume actually needs. A batch freed before any restore is
+        dropped without ever fencing."""
         n = len(page_ids)
         if self.host_cache is None or n == 0 \
                 or len(self._host_free) < n:
@@ -397,26 +411,49 @@ class PagedKVPool:
         ids = jnp.asarray(np.asarray(page_ids, np.int32))
         dev = _gather_rows(self.cache, ids)
         for leaf in jax.tree_util.tree_leaves(dev):
+            self.offload_bytes += leaf.nbytes
             try:
                 leaf.copy_to_host_async()
             except Exception:  # lint: allow-swallow — a backend
                 pass           # without async D2H fetches at the fence
         hids = [self._host_free.pop() for _ in range(n)]
-        hsel = np.asarray(hids, np.int64)
-        for kv_host, kv_dev in zip(self.host_cache, dev):
-            if kv_host is None:
-                continue
-            for key, host_arr in kv_host.items():
-                fetched = np.asarray(kv_dev[key])
-                host_arr[hsel] = fetched
-                self.offload_bytes += fetched.nbytes
+        self._pending_host.append({"hids": list(hids), "dev": dev})
         self.pages_offloaded += n
         return hids
+
+    @property
+    def host_swap_pending(self) -> int:
+        """Host pages whose D2H payload is enqueued but not yet
+        fenced (the async swap-out's backlog; tests pin laziness)."""
+        return sum(len(p["hids"]) for p in self._pending_host)
+
+    def _fence_host(self, host_ids) -> None:
+        """Materialize every pending D2H batch that covers any of
+        ``host_ids`` into the host pool rows (whole batches — the
+        gather was batch-granular). The fancy-index store always
+        copies, so no view of runtime-owned device memory survives."""
+        need = {int(h) for h in host_ids}
+        if not need or not self._pending_host:
+            return
+        keep = []
+        for pend in self._pending_host:
+            if need.isdisjoint(pend["hids"]):
+                keep.append(pend)
+                continue
+            self.host_fences += 1
+            hsel = np.asarray(pend["hids"], np.int64)
+            for kv_host, kv_dev in zip(self.host_cache, pend["dev"]):
+                if kv_host is None:
+                    continue
+                for key, host_arr in kv_host.items():
+                    host_arr[hsel] = np.asarray(kv_dev[key])
+        self._pending_host = keep
 
     def restore_pages(self, host_ids, dev_ids) -> None:
         """H2D: host page payloads -> the given (already allocated)
         device pages, byte-identical — the swap-in that replaces a
-        preemption victim's full context re-prefill. The host pages
+        preemption victim's full context re-prefill. Fences any
+        pending async swap-out of these pages first. The host pages
         are NOT freed here (``free_host`` is the owner's call)."""
         if self.host_cache is None:
             raise RuntimeError(
@@ -427,6 +464,7 @@ class PagedKVPool:
                 f"vs {len(dev_ids)}")
         if not len(host_ids):
             return
+        self._fence_host(host_ids)
         hsel = np.asarray(host_ids, np.int64)
         vals = [None if kv is None else
                 {key: a[hsel] for key, a in kv.items()}
@@ -437,9 +475,22 @@ class PagedKVPool:
         self.pages_restored += len(host_ids)
 
     def free_host(self, host_ids) -> None:
-        """Return host pages to the free list. Double-free is a loud
-        error — two owners sharing one host page would corrupt both
-        (the device-side ``decref`` contract, host edition)."""
+        """Return host pages to the free list. A pending async batch
+        fully covered by the free is DROPPED without fencing (its
+        payload has no reader left); partially freed batches fence
+        first so the surviving pages' data lands. Double-free is a
+        loud error — two owners sharing one host page would corrupt
+        both (the device-side ``decref`` contract, host edition)."""
+        need = {int(h) for h in host_ids}
+        if need and self._pending_host:
+            keep = []
+            for pend in self._pending_host:
+                hs = set(pend["hids"])
+                if hs and hs <= need:
+                    continue             # fully freed: never fence
+                keep.append(pend)
+            self._pending_host = keep
+            self._fence_host(need)
         for h in host_ids:
             h = int(h)
             if h in self._host_free:
@@ -542,11 +593,20 @@ class PrefixCache:
         #: dict is bounded by the root's live children (entries die
         #: with their node in ``evict_one``)
         self._hits: Dict[bytes, int] = {}
+        #: device page id -> owning node: the O(1) residency probe the
+        #: engine's prefix-aware swap snapshot consults (tree-spec PR
+        #: satellite) — a resident page need not be copied to host, it
+        #: just needs a refcount hold until resume re-links it
+        self._by_page: Dict[int, _Node] = {}
         self._nid = itertools.count(1)
         self._tick = itertools.count()
 
     def __len__(self) -> int:
         return len(self._nodes)
+
+    def resident(self, pid: int) -> bool:
+        """Is device page ``pid`` held by a cache node right now?"""
+        return int(pid) in self._by_page
 
     # -- router affinity signal ---------------------------------------------
 
@@ -636,6 +696,7 @@ class PrefixCache:
         pool.free_host([node.host])
         node.host = None
         node.page = pid
+        self._by_page[pid] = node
         return True
 
     def register(self, tokens, table_row) -> int:
@@ -666,6 +727,7 @@ class PrefixCache:
                 if pid < pool.num_pages:
                     node.page = pid
                     pool.incref(pid)
+                    self._by_page[pid] = node
                     pool.free_host([node.host])
                     node.host = None
             if node is None:
@@ -679,6 +741,7 @@ class PrefixCache:
                 self._first.setdefault(parent, {}).setdefault(
                     int(toks[j * pl]), []).append(node)
                 pool.incref(pid)
+                self._by_page[pid] = node
                 added += 1
             node.last_used = tick
             parent = node.nid
@@ -697,6 +760,7 @@ class PrefixCache:
         if node in bucket:
             bucket.remove(node)
         if node.page is not None:
+            self._by_page.pop(node.page, None)
             self._pool.decref(node.page)
         else:
             self._pool.free_host([node.host])
@@ -733,6 +797,7 @@ class PrefixCache:
             if spill is not None and pool.host_free_pages > 0:
                 hids = pool.offload_pages([spill.page])
                 if hids is not None:
+                    self._by_page.pop(spill.page, None)
                     pool.decref(spill.page)
                     spill.page = None
                     spill.host = hids[0]

@@ -127,6 +127,13 @@ class ServingMetrics:
             "serving.spec_accept_rate")
         self._spec_disabled = self.registry.counter(
             "serving.spec_disabled")
+        # tree speculation (tree-speculation PR): the per-verify tree
+        # width a stream ran at and the accepted root-path length —
+        # the adaptive controller's observable trajectory
+        self._spec_tree_width = self.registry.histogram(
+            "serving.spec_tree_width")
+        self._spec_path_len = self.registry.histogram(
+            "serving.spec_path_len")
         # MoE serving (MoE-serving PR): per-expert routing load (one
         # gauge series per expert id — BOUNDED by the model's expert
         # count), the router-entropy gauge, and the concentration the
@@ -270,6 +277,14 @@ class ServingMetrics:
     def record_spec_disabled(self) -> None:
         """The acceptance EMA kicked one stream back to plain decode."""
         self._spec_disabled.inc()
+
+    def record_spec_tree(self, tree_width: int,
+                         accepted_path_len: int) -> None:
+        """One slot's outcome in one TREE verify (tree-speculation PR):
+        the branch width the stream's adaptive tree ran at and the
+        accepted root-path length (0 = only the bonus token emitted)."""
+        self._spec_tree_width.observe(float(tree_width))
+        self._spec_path_len.observe(float(accepted_path_len))
 
     def record_moe_route(self, expert_load, entropy: float,
                          concentration: float) -> None:
@@ -499,7 +514,11 @@ class ServingMetrics:
                 "proposed": self.spec_proposed,
                 "accepted": self.spec_accepted,
                 "disabled_streams": int(self._spec_disabled.value()),
-                "accept_rate": self._pcts(self._spec_rate)},
+                "accept_rate": self._pcts(self._spec_rate),
+                # tree keys (ADDED by the tree-speculation PR): None
+                # until a tree verify ran
+                "tree_width": self._pcts(self._spec_tree_width),
+                "accepted_path_len": self._pcts(self._spec_path_len)},
             "tokens_generated": tokens,
             # request-level throughput: all generated tokens over the
             # first-submit -> last-finish span (includes queueing +

@@ -42,6 +42,29 @@ draft" — which keeps sampled streams byte-identical to plain decode
 (same per-request key stream, one split per emitted token) instead of
 merely distribution-equivalent. See docs/serving.md §Speculative
 decoding for the acceptance math.
+
+TREE SPECULATION (tree-speculation PR): the engine can also drive
+``propose_tree`` — a per-slot token TREE (SpecInfer/Medusa-style
+multi-chain drafts) verified through ONE tree-masked window
+(``models.decoding.verify_step_slots[_tree kwarg]``). A tree raises
+expected accepted-tokens-per-verify over a single chain exactly when
+the chain's next token is AMBIGUOUS: several plausible continuations
+exist and the linear draft can only bet on one. ``NgramDraft`` trees
+branch on distinct historical continuations of the matched suffix
+(top-m continuations hash-consed into a trie — one node per divergence
+point); ``DraftModel`` trees are beam-style (the greedy chain plus the
+per-step top-``width`` runner-up tokens as single-node side branches).
+Every ``DraftSource`` gets trees for free via the default
+``propose_tree`` (its linear chain laid out as a width-1 tree — the
+engine's ``spec_tree`` A/B and the byte-identity oracle hook).
+
+Host-sync discipline: ``propose``/``propose_tree`` and the tree
+helpers below run INSIDE the serving iteration (a speculative
+iteration is synchronous by design — the verify fetch is its
+sanctioned sync), so they are a ``tools/lint_host_sync.py`` zone: no
+``jax.device_get``/``block_until_ready``/``float(<traced>)``. The
+draft-model step's per-step ``np.asarray`` fetch is the sources'
+sanctioned medium (drafting is host-driven by design).
 """
 
 from __future__ import annotations
@@ -51,7 +74,64 @@ from typing import Dict, Optional
 import jax
 import numpy as np
 
-__all__ = ["DraftSource", "NgramDraft", "DraftModel"]
+__all__ = ["DraftSource", "NgramDraft", "DraftModel", "tree_ancestors",
+           "build_token_tree"]
+
+
+def tree_ancestors(parents: np.ndarray):
+    """Host-side tree derivation: parent-index vectors ``[S, W]``
+    (node 0 = root; ``parents[s, 0] = -1``; unused nodes carry -1) ->
+    ``(depth [S, W] int32, anc [S, W, W] bool, n_nodes [S] int64)``.
+    ``anc[s, i, j]`` is True iff node j is i or an ancestor of i —
+    the verify window's visibility mask; ``depth`` is each node's
+    root-path position offset; ``n_nodes`` counts root + used nodes
+    (the page-lookahead span: the forward writes window columns
+    ``t .. t + n_nodes - 1``). Parents must be topologically ordered
+    (``parents[s, j] < j``) — the tree builders guarantee it."""
+    parents = np.asarray(parents, np.int64)
+    s_n, w_len = parents.shape
+    depth = np.zeros((s_n, w_len), np.int32)
+    anc = np.zeros((s_n, w_len, w_len), bool)
+    anc[:, 0, 0] = True
+    rows = np.arange(s_n)
+    for j in range(1, w_len):
+        p = parents[:, j]
+        used = p >= 0
+        pc = np.where(used, p, 0)
+        anc[:, j] = np.where(used[:, None], anc[rows, pc], False)
+        anc[rows, j, j] = used
+        depth[:, j] = np.where(used, depth[rows, pc] + 1, 0)
+    n_nodes = (parents >= 0).sum(axis=1) + 1
+    return depth, anc, n_nodes
+
+
+def build_token_tree(chains, toks_row: np.ndarray,
+                     parents_row: np.ndarray, max_nodes: int) -> int:
+    """Merge candidate continuation ``chains`` (iterable of int token
+    sequences, best first) into one slot's tree arrays: shared
+    prefixes hash-cons onto one node — the trie of continuations, one
+    branch per divergence point — under a ``max_nodes`` draft-node
+    budget (later chains truncate first: insertion order is priority
+    order). ``toks_row[0]`` (the pending input/root) is the caller's;
+    returns the number of draft nodes used."""
+    index = {}
+    nxt = 1
+    cap = min(int(max_nodes), len(toks_row) - 1)
+    for chain in chains:
+        par = 0
+        for tokv in chain:
+            key = (par, int(tokv))
+            nid = index.get(key)
+            if nid is None:
+                if nxt > cap:
+                    break
+                nid = nxt
+                nxt += 1
+                index[key] = nid
+                toks_row[nid] = int(tokv)
+                parents_row[nid] = par
+            par = nid
+    return nxt - 1
 
 
 class DraftSource:
@@ -90,6 +170,33 @@ class DraftSource:
         force-rejected in the verify program."""
         raise NotImplementedError
 
+    def propose_tree(self, requests: Dict[int, object], tok: np.ndarray,
+                     t: np.ndarray, toks: np.ndarray,
+                     parents: np.ndarray, active: np.ndarray,
+                     depth: np.ndarray, width: np.ndarray,
+                     max_nodes: np.ndarray) -> None:
+        """Fill per-slot token TREES for a tree-masked verify window.
+        ``toks``/``parents`` are ``[S, W]``; node 0 (the root) already
+        holds the pending input with parent -1, and every unused node
+        must keep parent -1. For each active slot the source may use
+        up to ``max_nodes[slot]`` draft nodes shaped by the engine's
+        adaptive per-stream ``depth[slot]`` (longest chain) and
+        ``width[slot]`` (branches per divergence point) — parents must
+        stay topologically ordered (``parents[s, j] < j``).
+
+        The default lays the source's LINEAR proposal out as a width-1
+        root path, so every ``DraftSource`` speculates through the
+        tree window unchanged (the engine's byte-identity oracle
+        hook); branching sources override."""
+        k = toks.shape[1] - 1
+        buf = np.zeros((toks.shape[0], k), np.int32)
+        self.propose(requests, tok, t, buf, active)
+        cols = np.arange(k)
+        use = active[:, None] & (
+            cols[None, :] < np.minimum(depth, max_nodes)[:, None])
+        toks[:, 1:] = np.where(use, buf, 0)
+        parents[:, 1:] = np.where(use, cols[None, :], -1)
+
 
 class NgramDraft(DraftSource):
     """Prompt-lookup self-drafting: suffix-match over each stream's own
@@ -117,21 +224,25 @@ class NgramDraft(DraftSource):
         self.min_ngram = int(min_ngram)
         self.max_context = int(max_context)
 
+    def _context(self, req) -> np.ndarray:
+        """The capped lookup context (prompt + generated, most recent
+        ``max_context`` tokens). Slices BEFORE concatenating: the cap
+        must bound the per-iteration host copy too, not just the scan
+        — at long prompts the full-history concat was the hot-loop
+        cost. Shared by the linear and tree proposals so the bound
+        stays in one place."""
+        cap = self.max_context
+        gen = req.generated[-cap:]
+        head = req.prompt[-max(0, cap - len(gen)):] \
+            if len(gen) < cap else req.prompt[:0]
+        return np.concatenate([head, np.asarray(gen, np.int32)])
+
     def propose(self, requests, tok, t, out, active):
         k = out.shape[1]
-        cap = self.max_context
         for slot, req in requests.items():
             if not active[slot]:
                 continue
-            # slice BEFORE concatenating: the cap must bound the
-            # per-iteration host copy too, not just the scan — at long
-            # prompts the full-history concat was the hot-loop cost
-            gen = req.generated[-cap:]
-            head = req.prompt[-max(0, cap - len(gen)):] \
-                if len(gen) < cap else req.prompt[:0]
-            ctx = np.concatenate(
-                [head, np.asarray(gen, np.int32)])
-            out[slot] = self.lookup(ctx, k)
+            out[slot] = self.lookup(self._context(req), k)
 
     def lookup(self, ctx: np.ndarray, k: int) -> np.ndarray:
         """The k-token proposal continuing ``ctx`` (which ends with the
@@ -159,6 +270,100 @@ class NgramDraft(DraftSource):
                 buf[len(cont):] = cont[-1]       # pad; tail likely rejects
             return buf
         return buf
+
+    def continuations(self, ctx: np.ndarray, m: int):
+        """The ``m`` most recent DISTINCT next tokens following the
+        current suffix of ``ctx`` — the single-step branching
+        primitive of the tree proposal: where :meth:`lookup` bets on
+        ONE occurrence's whole continuation, this surfaces every way
+        the matched suffix has historically continued (most recent
+        first). Suffix lengths ``max_ngram`` down to ``min_ngram``;
+        empty when nothing re-occurs."""
+        if m < 1:
+            return []
+        n_hi = min(self.max_ngram, len(ctx) - 1)
+        for n in range(n_hi, self.min_ngram - 1, -1):
+            suffix = ctx[-n:]
+            win = np.lib.stride_tricks.sliding_window_view(ctx[:-1], n)
+            hits = np.flatnonzero((win == suffix).all(axis=1))
+            if not hits.size:
+                continue
+            out = []
+            for h in hits[::-1]:                 # most recent first
+                tv = int(ctx[h + n])
+                if tv not in out:
+                    out.append(tv)
+                    if len(out) >= m:
+                        break
+            return out
+        return []
+
+    def propose_tree(self, requests, tok, t, toks, parents, active,
+                     depth, width, max_nodes):
+        """Branching prompt-lookup: grow each stream's tree node by
+        node, branching into the top-``width`` distinct historical
+        continuations AT EVERY DIVERGENCE POINT — a node whose
+        (context + root path) suffix has only ever continued one way
+        gets one child; a suffix with disagreeing historical
+        continuations gets up to ``width``. Depth-first along the
+        most-recent continuation (the linear draft's exact chain is
+        the tree's primary path), so a tight node budget spends
+        itself on the primary chain before the alternates."""
+        for slot, req in requests.items():
+            if not active[slot]:
+                continue
+            self._grow(self._context(req), toks[slot], parents[slot],
+                       int(depth[slot]), int(width[slot]),
+                       int(max_nodes[slot]))
+
+    def _grow(self, ctx, toks_row, parents_row, depth: int, width: int,
+              max_nodes: int) -> int:
+        """Tree growth over historical continuations; returns the
+        number of draft nodes placed. Budget order: (1) the PRIMARY
+        chain — the most-recent continuation at every node, i.e. the
+        linear draft's exact bet — to full depth; (2) alternates
+        SHALLOW-FIRST (a divergence near the root truncates the whole
+        window when missed, so its coverage is worth the most), each
+        alternate immediately extended by its own primary chain (the
+        branch's aftermath is usually unambiguous — a bare one-token
+        branch would waste the depth behind it). Each expansion
+        re-runs the suffix scan on ``ctx`` extended by the node's
+        root path, so deeper nodes condition on the branch taken;
+        scans are bounded by ``max_nodes`` (≤ depth * width)."""
+        from collections import deque
+        cap = min(int(max_nodes), len(toks_row) - 1)
+        if cap < 1 or depth < 1:
+            return 0
+        used = 0
+        alternates = deque()
+
+        def chain(par: int, path, depth_left: int):
+            nonlocal used
+            while depth_left > 0 and used < cap:
+                ctx_ext = (np.concatenate(
+                    [ctx, np.asarray(path, np.int32)]) if path else ctx)
+                conts = self.continuations(ctx_ext, width)
+                if not conts:
+                    return
+                for tv in conts[1:]:
+                    alternates.append((par, list(path), tv, depth_left))
+                used += 1
+                nid = used
+                toks_row[nid] = conts[0]
+                parents_row[nid] = par
+                par = nid
+                path = path + [conts[0]]
+                depth_left -= 1
+
+        chain(0, [], depth)
+        while alternates and used < cap:
+            par, path, tv, depth_left = alternates.popleft()
+            used += 1
+            nid = used
+            toks_row[nid] = tv
+            parents_row[nid] = par
+            chain(nid, path + [tv], depth_left - 1)
+        return used
 
 
 class DraftModel(DraftSource):
@@ -211,8 +416,16 @@ class DraftModel(DraftSource):
         self.pool = None                     # built at bind()
         self._staging = None
         self._prefill_fns = {}               # length-keyed LRU, engine cap
-        self._step_fn = None
+        self._step_fns = {}                  # width -> jit draft step
         self._active = set()                 # slots with live draft KV
+        #: slot -> (t0, [tokens]) — what the last draft round WROTE
+        #: into the draft KV at positions t0.. (the greedy chain). The
+        #: heal pass rewrites positions where the stream actually
+        #: committed a DIFFERENT token (an accepted tree side branch);
+        #: without it the draft cache silently diverges after the
+        #: first non-primary acceptance and every later draft attends
+        #: wrong-token KV (code-review finding, this PR).
+        self._written = {}
 
     #: same LRU bound the engine uses for its ragged prefill programs
     MAX_PREFILL_PROGRAMS = 64
@@ -255,6 +468,7 @@ class DraftModel(DraftSource):
         if self.pool is not None and slot in self._active:
             self.pool.release_slot(slot)
             self._active.discard(slot)
+        self._written.pop(slot, None)
 
     def _prefill_fn(self, n: int):
         """Head-less whole-context chunk prefill at batch 1 (the draft
@@ -277,11 +491,18 @@ class DraftModel(DraftSource):
             self._prefill_fns.pop(next(iter(self._prefill_fns)))
         return fn
 
-    def _decode_fn(self):
-        if self._step_fn is None:
+    def _decode_fn(self, width: int = 1):
+        """Jitted draft step: argmax ids (``width`` 1) or the
+        ``lax.top_k`` id matrix ``[S, width]`` (beam-style trees —
+        column 0 is the argmax the greedy chain follows). One program
+        per distinct width (the engine's per-request widths share the
+        engine-level cap, so the set is tiny)."""
+        fn = self._step_fns.get(width)
+        if fn is None:
             from distkeras_tpu.models.decoding import \
                 decode_step_slots_paged
             import jax.numpy as jnp
+            from jax import lax
             module = self.module
             page_len = self.pool.page_len
 
@@ -290,28 +511,126 @@ class DraftModel(DraftSource):
                 logits, cache = decode_step_slots_paged(
                     module, params, state, cache, tok, t, tables,
                     page_len)
-                return jnp.argmax(logits, axis=-1), cache
+                if width == 1:
+                    return jnp.argmax(logits, axis=-1), cache
+                return lax.top_k(logits, width)[1], cache
 
-            self._step_fn = fn
-        return self._step_fn
+            self._step_fns[width] = fn
+        return fn
 
-    def propose(self, requests, tok, t, out, active):
+    def _heal(self, requests, tok, t) -> None:
+        """Rewrite draft-KV positions where the stream committed a
+        token OTHER than the one the last draft round wrote there —
+        the accepted side branch of a tree verify. The linear path is
+        immune by construction (the accepted prefix IS the draft's
+        own chain), so this almost always no-ops; after a non-primary
+        acceptance it replays the actual accepted tokens through the
+        ordinary draft step (correct rope, correct KV), bounded by
+        the previous round's chain length. Runs batched over slots
+        like ``_draft_steps``, inert slots at the sentinel."""
         import jax.numpy as jnp
-        if not self._active:
+        s_n = len(t)
+        start = np.full(s_n, -1, np.int64)
+        stop = np.zeros(s_n, np.int64)
+        actual = {}
+        for slot, req in requests.items():
+            rec = self._written.get(slot)
+            if slot not in self._active or rec is None:
+                continue
+            t0, chain = rec
+            ctx = np.concatenate(
+                [req.prompt, np.asarray(req.generated, np.int32)])
+            hi = min(int(t[slot]), t0 + len(chain), len(ctx))
+            d = t0
+            while d < hi and chain[d - t0] == int(ctx[d]):
+                d += 1
+            if d < hi:
+                start[slot] = d
+                stop[slot] = hi
+                actual[slot] = ctx
+        if (start < 0).all():
             return
-        k = out.shape[1]
-        fn = self._decode_fn()
+        fn = self._decode_fn(1)
         tables = self.pool.device_tables()
-        # slots without live draft KV (speculation disabled, or the
-        # draft pool was dry at begin) run at the inert sentinel so
-        # their writes drop and their garbage proposals stay inactive
+        n_heal = int((stop - np.maximum(start, 0)).max())
+        for j in range(n_heal):
+            pos = start + j
+            live = (start >= 0) & (pos < stop)
+            tt = np.where(live, pos, self.pool.max_len).astype(np.int32)
+            cur = np.zeros(s_n, np.int32)
+            for slot in actual:
+                if live[slot]:
+                    cur[slot] = int(actual[slot][pos[slot]])
+            _, self.pool.cache = fn(self._params, self._state,
+                                    self.pool.cache, jnp.asarray(cur),
+                                    jnp.asarray(tt), tables)
+
+    def _draft_steps(self, requests, tok, t, k: int, width: int):
+        """Run ``k`` greedy draft steps feeding the argmax forward;
+        returns the per-step ``[S, width]`` top-id matrices. Slots
+        without live draft KV run at the inert sentinel so their
+        writes drop and their garbage proposals stay inactive. Heals
+        side-branch divergence from the previous round first, and
+        records what this round writes for the next heal."""
+        import jax.numpy as jnp
+        self._heal(requests, tok, t)
+        fn = self._decode_fn(width)
+        tables = self.pool.device_tables()
         tt = np.where([s in self._active for s in range(len(t))],
                       t, self.pool.max_len).astype(np.int32)
         cur = np.asarray(tok, np.int32).copy()
-        for j in range(k):
+        tops = []
+        for _ in range(k):
             nxt, self.pool.cache = fn(self._params, self._state,
                                       self.pool.cache, jnp.asarray(cur),
                                       jnp.asarray(tt), tables)
-            cur = np.asarray(nxt).astype(np.int32)
-            out[:, j] = cur
+            ids = np.asarray(nxt, np.int32)
+            if ids.ndim == 1:
+                ids = ids[:, None]
+            tops.append(ids)
+            cur = ids[:, 0].copy()
             tt = tt + 1
+        for slot in self._active:
+            self._written[slot] = (
+                int(t[slot]),
+                [int(tok[slot])] + [int(ids[slot, 0])
+                                    for ids in tops[:-1]])
+        return tops
+
+    def propose(self, requests, tok, t, out, active):
+        if not self._active:
+            return
+        tops = self._draft_steps(requests, tok, t, out.shape[1], 1)
+        for j, ids in enumerate(tops):
+            out[:, j] = ids[:, 0]
+
+    def propose_tree(self, requests, tok, t, toks, parents, active,
+                     depth, width, max_nodes):
+        """Beam-style draft tree: the greedy chain carries the depth,
+        and at every chain position the draft's top-``width`` runner-up
+        tokens hang off as single-node side branches — the target gets
+        ``width`` chances per divergence point at one extra verify
+        column each, without the draft paying extra sequential
+        steps."""
+        if not self._active:
+            return
+        k = int(depth.max()) if depth.size else 0
+        w = int(width.max()) if width.size else 1
+        if k < 1:
+            return
+        tops = self._draft_steps(requests, tok, t, k, max(1, w))
+        for slot in range(toks.shape[0]):
+            if not active[slot] or slot not in self._active:
+                continue
+            d = int(depth[slot])
+            wd = int(width[slot])
+            greedy_chain = np.asarray(
+                [tops[j][slot, 0] for j in range(d)], np.int32)
+            chains = [greedy_chain]
+            for j in range(d):
+                for r in range(1, min(wd, tops[j].shape[1])):
+                    chains.append(np.concatenate(
+                        [greedy_chain[:j],
+                         tops[j][slot, r:r + 1]]).astype(np.int32))
+            build_token_tree(chains, toks[slot], parents[slot],
+                             int(max_nodes[slot]))

@@ -124,3 +124,46 @@ def _check_virtual_mesh():
     assert jax.default_backend() == "cpu" and len(jax.devices()) == 8, (
         "tests expect 8 virtual CPU devices; got "
         f"{jax.default_backend()}: {jax.devices()}")
+
+
+@pytest.fixture(scope="session")
+def pattern_lm():
+    """THE shared memorized LM of the serving/decoding suites: a tiny
+    transformer overfit on one repeating sequence (huge greedy argmax
+    margins => token-identity assertions robust to fp reassociation
+    across batch shapes). Eight modules used to train byte-identical
+    copies of this model (~30 s each) — session scope trains ONCE and
+    shares the jitted-program caches too (tree-speculation PR tier-1
+    budget reclaim). Tests must not mutate it (none do: engines and
+    generate() only read params)."""
+    import numpy as np
+    from distkeras_tpu.models import Model, zoo
+    pattern = np.array([3, 1, 4, 1, 5, 9, 2, 6, 5, 3, 5, 8])
+    X = np.tile(pattern, (256, 1))
+    m = Model.build(
+        zoo.transformer_lm(29, d_model=32, num_heads=4, num_layers=2,
+                           mlp_ratio=2, use_rope=True), (12,), seed=2)
+    m.fit(X[:, :-1], X[:, 1:], optimizer="adam", learning_rate=5e-3,
+          batch_size=64, epochs=30,
+          loss="sparse_categorical_crossentropy_from_logits")
+    return m
+
+
+@pytest.fixture(scope="session")
+def pattern_moe_lm():
+    """All-MoE sibling of ``pattern_lm`` (2-layer, 8 experts, dense
+    dispatch — the generate() oracle semantics), shared by the
+    MoE-serving and zero-bubble suites for the same tier-1 budget
+    reclaim."""
+    import numpy as np
+    from distkeras_tpu.models import Model, zoo
+    pattern = np.array([3, 1, 4, 1, 5, 9, 2, 6, 5, 3, 5, 8])
+    X = np.tile(pattern, (256, 1))
+    m = Model.build(
+        zoo.transformer_lm(29, d_model=32, num_heads=4, num_layers=2,
+                           mlp_ratio=2, use_rope=True, moe_every=1,
+                           num_experts=8), (12,), seed=2)
+    m.fit(X[:, :-1], X[:, 1:], optimizer="adam", learning_rate=5e-3,
+          batch_size=64, epochs=25,
+          loss="sparse_categorical_crossentropy_from_logits")
+    return m

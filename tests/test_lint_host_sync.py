@@ -33,6 +33,33 @@ def test_serving_scope_covers_the_decode_path():
     assert SERVING_LOOP_MODULE.endswith("serving/engine.py")
 
 
+def test_serving_scope_covers_the_tree_spec_path():
+    # the tree draft/accept call graph (tree-speculation PR) is in the
+    # engine zone, and the draft-source module is the third zone
+    from tools.lint_host_sync import (SPECULATION_LOOP_FUNCS,
+                                      SPECULATION_MODULE)
+    for fn in ("_spec_tree_step", "_tree_shape", "_adapt_tree"):
+        assert fn in SERVING_LOOP_FUNCS
+    for fn in ("propose", "propose_tree", "continuations",
+               "build_token_tree", "tree_ancestors"):
+        assert fn in SPECULATION_LOOP_FUNCS
+    assert SPECULATION_MODULE.endswith("serving/speculation.py")
+
+
+def test_speculation_zone_flags_base_rules_but_allows_np_fetch():
+    from tools.lint_host_sync import SPECULATION_LOOP_FUNCS
+    src = ("import jax\n"
+           "import numpy as np\n"
+           "def propose_tree(self, requests):\n"
+           "    x = np.asarray(t)\n"            # allowed medium here
+           "    y = jax.device_get(t)\n"        # base rule: flagged
+           "def elsewhere(self):\n"
+           "    z = jax.device_get(t)\n")       # out of scope
+    findings = check_source(src, "s.py",
+                            only_funcs=SPECULATION_LOOP_FUNCS)
+    assert [ln for _, ln, _ in findings] == [5]
+
+
 def test_serving_loop_has_exactly_one_marked_lagged_fetch():
     src = (REPO / SERVING_LOOP_MODULE).read_text()
     import ast

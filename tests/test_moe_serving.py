@@ -36,17 +36,10 @@ def _moe_lm(expert_axis=None, seed=2):
 
 
 @pytest.fixture(scope="module")
-def memorized_moe_lm():
-    """Overfit on one repeating sequence (the test_serving fixture
-    idiom): greedy argmax margins are huge everywhere, so
-    token-identity assertions survive the fp-reassociation difference
-    between the dispatched and dense expert contractions."""
-    X = np.tile(PATTERN, (256, 1))
-    m = _moe_lm()
-    m.fit(X[:, :-1], X[:, 1:], optimizer="adam", learning_rate=5e-3,
-          batch_size=64, epochs=25,
-          loss="sparse_categorical_crossentropy_from_logits")
-    return m
+def memorized_moe_lm(pattern_moe_lm):
+    """The shared session-scoped all-MoE overfit-PATTERN LM
+    (conftest pattern_moe_lm); trained once per session."""
+    return pattern_moe_lm
 
 
 # --- MoE.decode_apply unit contract -----------------------------------------

@@ -25,18 +25,9 @@ PATTERN = np.array([3, 1, 4, 1, 5, 9, 2, 6, 5, 3, 5, 8])
 
 
 @pytest.fixture(scope="module")
-def memorized_lm():
-    """Same overfit fixture as test_serving: huge greedy argmax margins
-    make token-identity assertions robust to fp reassociation across
-    batch shapes and replicas."""
-    X = np.tile(PATTERN, (256, 1))
-    m = Model.build(
-        zoo.transformer_lm(V, d_model=32, num_heads=4, num_layers=2,
-                           mlp_ratio=2, use_rope=True), (S,), seed=2)
-    m.fit(X[:, :-1], X[:, 1:], optimizer="adam", learning_rate=5e-3,
-          batch_size=64, epochs=30,
-          loss="sparse_categorical_crossentropy_from_logits")
-    return m
+def memorized_lm(pattern_lm):
+    """The shared session-scoped overfit-PATTERN LM (conftest pattern_lm): huge greedy argmax margins keep token-identity assertions robust; trained once per test session."""
+    return pattern_lm
 
 
 def _engine(m, eid, **kw):

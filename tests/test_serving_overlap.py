@@ -24,33 +24,16 @@ PATTERN = np.array([3, 1, 4, 1, 5, 9, 2, 6, 5, 3, 5, 8])
 
 
 @pytest.fixture(scope="module")
-def memorized_lm():
-    """Overfit on one repeating sequence (the test_serving fixture
-    idiom): greedy argmax margins are huge everywhere, so
-    token-identity assertions are robust across batch shapes."""
-    X = np.tile(PATTERN, (256, 1))
-    m = Model.build(
-        zoo.transformer_lm(V, d_model=32, num_heads=4, num_layers=2,
-                           mlp_ratio=2, use_rope=True), (S,), seed=2)
-    m.fit(X[:, :-1], X[:, 1:], optimizer="adam", learning_rate=5e-3,
-          batch_size=64, epochs=30,
-          loss="sparse_categorical_crossentropy_from_logits")
-    return m
+def memorized_lm(pattern_lm):
+    """The shared session-scoped overfit-PATTERN LM (conftest pattern_lm): huge greedy argmax margins keep token-identity assertions robust; trained once per test session."""
+    return pattern_lm
 
 
 @pytest.fixture(scope="module")
-def memorized_moe_lm():
-    """All-MoE sibling (the test_moe_serving fixture idiom) for the
-    dispatched-decode x zero-bubble oracle."""
-    X = np.tile(PATTERN, (256, 1))
-    m = Model.build(
-        zoo.transformer_lm(V, d_model=32, num_heads=4, num_layers=2,
-                           mlp_ratio=2, use_rope=True, moe_every=1,
-                           num_experts=8), (S,), seed=2)
-    m.fit(X[:, :-1], X[:, 1:], optimizer="adam", learning_rate=5e-3,
-          batch_size=64, epochs=25,
-          loss="sparse_categorical_crossentropy_from_logits")
-    return m
+def memorized_moe_lm(pattern_moe_lm):
+    """The shared session-scoped all-MoE overfit-PATTERN LM
+    (conftest pattern_moe_lm); trained once per session."""
+    return pattern_moe_lm
 
 
 def _drive(eng, subs, stagger=0):

@@ -23,20 +23,9 @@ PATTERN = np.array([3, 1, 4, 1, 5, 9, 2, 6, 5, 3, 5, 8])
 
 
 @pytest.fixture(scope="module")
-def memorized_lm():
-    """Overfit on one repeating sequence (the test_serving fixture
-    idiom): greedy decode has huge argmax margins, so token-identity
-    assertions are robust to fp-reassociation between the (k+1)-wide
-    verify window and the 1-wide plain step — and the continuation
-    REPEATS, so n-gram self-drafting actually accepts."""
-    X = np.tile(PATTERN, (256, 1))
-    m = Model.build(
-        zoo.transformer_lm(V, d_model=32, num_heads=4, num_layers=2,
-                           mlp_ratio=2, use_rope=True), (S,), seed=2)
-    m.fit(X[:, :-1], X[:, 1:], optimizer="adam", learning_rate=5e-3,
-          batch_size=64, epochs=30,
-          loss="sparse_categorical_crossentropy_from_logits")
-    return m
+def memorized_lm(pattern_lm):
+    """The shared session-scoped overfit-PATTERN LM (conftest pattern_lm): huge greedy argmax margins keep token-identity assertions robust; trained once per test session."""
+    return pattern_lm
 
 
 class WrongDraft(DraftSource):
@@ -45,6 +34,14 @@ class WrongDraft(DraftSource):
 
     def propose(self, requests, tok, t, out, active):
         out[:] = 0
+
+
+def _tree(spec_tree):
+    """Engine kwargs for the spec_tree parametrization: tree width 1
+    must be byte-identical to the landed linear path (the tree-masked
+    verify walk degenerates to the chain — tree-speculation PR)."""
+    return {"spec_tree": True, "spec_width": 1} if spec_tree else {}
+
 
 
 # --- verify-step unit: one window pass == W sequential decode steps ---------
@@ -138,14 +135,15 @@ def test_ngram_lookup_proposes_continuation():
 # --- the oracle: greedy speculation == generate(), per request --------------
 
 
-def test_greedy_ngram_spec_matches_generate_paged(memorized_lm):
+@pytest.mark.parametrize("spec_tree", [False, True])
+def test_greedy_ngram_spec_matches_generate_paged(memorized_lm, spec_tree):
     """N-gram self-drafting on the paged engine: staggered arrivals,
     mixed lengths/budgets, more requests than slots. Every request's
     greedy tokens equal standalone generate(), and speculation really
     fired (drafts were accepted)."""
     m = memorized_lm
     eng = ServingEngine(m, num_slots=3, max_len=48, page_len=4,
-                        draft=NgramDraft(), spec_k=3)
+                        draft=NgramDraft(), spec_k=3, **_tree(spec_tree))
     prompts = [np.tile(PATTERN, 2)[:10], np.tile(PATTERN, 2)[:14],
                PATTERN[:6], np.tile(PATTERN, 2)[:13]]
     budgets = [12, 9, 14, 10]
@@ -182,12 +180,13 @@ def test_greedy_draft_model_spec_matches_generate(memorized_lm):
     assert eng.metrics.summary()["acceptance_rate"] > 0.8
 
 
-def test_greedy_spec_slab_layout_matches_generate(memorized_lm):
+@pytest.mark.parametrize("spec_tree", [False, True])
+def test_greedy_spec_slab_layout_matches_generate(memorized_lm, spec_tree):
     """The slab pool speculates too (verify_step_slots, one-hot window
     writes): token identity + acceptance on the legacy layout."""
     m = memorized_lm
     eng = ServingEngine(m, num_slots=2, max_len=48, kv_layout="slab",
-                        draft=NgramDraft(), spec_k=3)
+                        draft=NgramDraft(), spec_k=3, **_tree(spec_tree))
     r0 = eng.submit(np.tile(PATTERN, 2)[:10], 12)
     r1 = eng.submit(np.tile(PATTERN, 2)[:14], 8)
     out = eng.run(max_steps=800)
@@ -202,13 +201,14 @@ def test_greedy_spec_slab_layout_matches_generate(memorized_lm):
     assert eng.metrics.summary()["speculation"]["accepted"] > 0
 
 
-def test_greedy_spec_int8_cache_matches_generate(memorized_lm):
+@pytest.mark.parametrize("spec_tree", [False, True])
+def test_greedy_spec_int8_cache_matches_generate(memorized_lm, spec_tree):
     """Speculation composes with the int8 quantized cache: window
     writes quantize per position, scale planes ride the same tables."""
     m = memorized_lm
     eng = ServingEngine(m, num_slots=2, max_len=48, page_len=4,
                         cache_dtype="int8", draft=NgramDraft(),
-                        spec_k=3)
+                        spec_k=3, **_tree(spec_tree))
     prompt = np.tile(PATTERN, 2)[:13]
     rid = eng.submit(prompt, 9)
     out = eng.run(max_steps=800)
@@ -272,7 +272,8 @@ def test_sampled_spec_stream_byte_identical_to_plain(memorized_lm):
 # --- preemption interaction -------------------------------------------------
 
 
-def test_spec_preempt_resume_token_identity(memorized_lm):
+@pytest.mark.parametrize("spec_tree", [False, True])
+def test_spec_preempt_resume_token_identity(memorized_lm, spec_tree):
     """Streams speculating in a deliberately tiny page pool: the
     younger is preempted mid-speculation, resumes via the recompute
     prefill (draft KV re-ingested), and BOTH stay token-identical to
@@ -280,7 +281,7 @@ def test_spec_preempt_resume_token_identity(memorized_lm):
     m = memorized_lm
     eng = ServingEngine(m, num_slots=2, max_len=32, page_len=4,
                         num_pages=8, prefix_cache=False,
-                        draft=NgramDraft(), spec_k=3)
+                        draft=NgramDraft(), spec_k=3, **_tree(spec_tree))
     r0 = eng.submit(np.tile(PATTERN, 2)[:5], 16)
     eng.step()
     eng.step()

@@ -1,0 +1,255 @@
+"""Host-KV offload hardening (tree-speculation PR satellites): the
+ASYNC swap-out (D2H copies enqueue at preempt time and fence lazily at
+the first restore/free touch — the preempt path no longer blocks the
+serving iteration on a D2H round trip) and the PREFIX-AWARE swap
+snapshot (pages still resident in the prefix cache are pinned by
+refcount instead of duplicated to host, re-linked in place on resume —
+closing the PR-17 private-duplicate trade-off)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from distkeras_tpu.models import Model, zoo
+from distkeras_tpu.models.decoding import generate
+from distkeras_tpu.serving import NgramDraft, PagedKVPool, ServingEngine
+
+V, S = 29, 12
+PATTERN = np.array([3, 1, 4, 1, 5, 9, 2, 6, 5, 3, 5, 8])
+
+
+@pytest.fixture(scope="module")
+def memorized_lm(pattern_lm):
+    """The shared session-scoped overfit-PATTERN LM (conftest pattern_lm): huge greedy argmax margins keep token-identity assertions robust; trained once per test session."""
+    return pattern_lm
+
+
+@pytest.fixture()
+def pool(memorized_lm):
+    from distkeras_tpu.models.decoding import _resolve_head_dims
+    _resolve_head_dims(memorized_lm.module, memorized_lm.params)
+    return PagedKVPool(memorized_lm.module, num_slots=2, max_len=32,
+                       page_len=4, host_pages=6)
+
+
+def _fill_page(pool, pid, seed):
+    """Deterministic nonzero content in one physical page."""
+    rs = np.random.RandomState(seed)
+    new = []
+    for kv in pool.cache:
+        if kv is None:
+            new.append(kv)
+            continue
+        out = {}
+        for key, arr in kv.items():
+            row = rs.randn(*arr.shape[1:]).astype(np.float32)
+            out[key] = arr.at[pid].set(jnp.asarray(row, arr.dtype))
+        new.append(out)
+    pool.cache = new
+
+
+def _page_bytes(pool, pid):
+    return [{k: np.asarray(v[pid]) for k, v in kv.items()}
+            for kv in pool.cache if kv is not None]
+
+
+# --- async swap-out (pool level) --------------------------------------------
+
+
+def test_offload_is_lazy_and_restore_fences_byte_identically(pool):
+    p0 = pool.alloc_page()
+    p1 = pool.alloc_page()
+    _fill_page(pool, p0, 0)
+    _fill_page(pool, p1, 1)
+    want0, want1 = _page_bytes(pool, p0), _page_bytes(pool, p1)
+    hids = pool.offload_pages([p0, p1])
+    # nothing fenced yet: the D2H is enqueued, not consumed
+    assert pool.host_swap_pending == 2
+    assert pool.host_fences == 0
+    assert pool.pages_offloaded == 2
+    # ... even if the source pages are overwritten afterwards (the
+    # gather snapshotted them)
+    _fill_page(pool, p0, 7)
+    d0, d1 = pool.alloc_page(), pool.alloc_page()
+    pool.restore_pages(hids, [d0, d1])
+    assert pool.host_fences == 1
+    assert pool.host_swap_pending == 0
+    for got, want in zip(_page_bytes(pool, d0), want0):
+        for k in want:
+            np.testing.assert_array_equal(got[k], want[k])
+    for got, want in zip(_page_bytes(pool, d1), want1):
+        for k in want:
+            np.testing.assert_array_equal(got[k], want[k])
+    pool.free_host(hids)
+
+
+def test_free_host_drops_unread_batch_without_fencing(pool):
+    p0 = pool.alloc_page()
+    _fill_page(pool, p0, 3)
+    hids = pool.offload_pages([p0])
+    assert pool.host_swap_pending == 1
+    pool.free_host(hids)                 # never restored: just drop
+    assert pool.host_fences == 0
+    assert pool.host_swap_pending == 0
+    assert pool.host_free_pages == pool.host_pages
+    # double-free still loud
+    with pytest.raises(RuntimeError, match="double-freed"):
+        pool.free_host(hids)
+
+
+def test_partially_freed_batch_fences_surviving_pages(pool):
+    p0, p1 = pool.alloc_page(), pool.alloc_page()
+    _fill_page(pool, p0, 4)
+    _fill_page(pool, p1, 5)
+    want1 = _page_bytes(pool, p1)
+    hids = pool.offload_pages([p0, p1])
+    pool.free_host(hids[:1])             # partial free: must fence
+    assert pool.host_fences == 1
+    assert pool.host_swap_pending == 0
+    d1 = pool.alloc_page()
+    pool.restore_pages(hids[1:], [d1])
+    for got, want in zip(_page_bytes(pool, d1), want1):
+        for k in want:
+            np.testing.assert_array_equal(got[k], want[k])
+    pool.free_host(hids[1:])
+
+
+# --- async swap-out (engine level) ------------------------------------------
+
+
+def test_preempt_heavy_loop_defers_the_fence(memorized_lm):
+    """A preempt-heavy drive with the host tier: swap-outs enqueue
+    without fencing inside the iteration (pending backlog observed
+    while victims sit queued), every fence is paid by a resume, and
+    outputs stay token-identical to generate()."""
+    m = memorized_lm
+    eng = ServingEngine(m, num_slots=2, max_len=32, page_len=4,
+                        num_pages=8, prefix_cache=False,
+                        host_kv_pages=16)
+    r0 = eng.submit(np.tile(PATTERN, 2)[:5], 16)
+    eng.step()
+    eng.step()
+    r1 = eng.submit(np.tile(PATTERN, 2)[:6], 15)
+    max_pending = 0
+    done = {}
+    while eng.scheduler.pending:
+        for r in eng.step():
+            done[r.rid] = r
+        max_pending = max(max_pending, eng.pool.host_swap_pending)
+    assert eng.metrics.requests_preempted >= 1
+    assert eng.pool.pages_offloaded > 0
+    # the lazy contract: some iteration ran with an unfenced backlog,
+    # and fences never exceed one per offload batch consumed
+    assert max_pending > 0
+    assert eng.pool.host_fences <= eng.metrics.requests_preempted
+    np.testing.assert_array_equal(
+        done[r0].tokens, generate(m, np.tile(PATTERN, 2)[None, :5], 16,
+                                  temperature=0.0)[0])
+    np.testing.assert_array_equal(
+        done[r1].tokens, generate(m, np.tile(PATTERN, 2)[None, :6], 15,
+                                  temperature=0.0)[0])
+
+
+# --- prefix-aware swap snapshot ---------------------------------------------
+
+
+def test_prefix_resident_pages_relink_instead_of_swapping(memorized_lm):
+    """A victim whose context shares prefix-cache pages swaps only the
+    PRIVATE remainder D2H; the shared pages take a refcount hold and
+    re-link on resume, with refcounts returning to cache-only after
+    the request drains."""
+    m = memorized_lm
+    eng = ServingEngine(m, num_slots=2, max_len=32, page_len=4,
+                        host_kv_pages=16)
+    prompt = np.tile(PATTERN, 2)[:12]            # 3 full shared pages
+    rA = eng.submit(prompt, 4)
+    outA = eng.run(max_steps=400)                # registers the prefix
+    np.testing.assert_array_equal(
+        outA[rA], generate(m, prompt[None], 4, temperature=0.0)[0])
+    assert len(eng.prefix) >= 3
+    rB = eng.submit(prompt, 12)
+    while eng[rB].state.value != "decoding":
+        eng.step()
+    eng.step()
+    before_off = eng.pool.pages_offloaded
+    req = eng[rB]
+    eng._preempt(req)
+    swap = req._swap
+    assert swap is not None
+    # prefix matches cap at len(prompt) - 1, so the final prompt page
+    # is always private: 2 of the 3 full pages share
+    assert len(swap["shared"]) >= 2
+    shared_pids = [pid for _lp, pid in swap["shared"]]
+    # shared pages pinned (cache ref + snapshot hold), not offloaded
+    for pid in shared_pids:
+        assert eng.pool.ref[pid] >= 2
+        assert eng.prefix.resident(pid)
+    assert eng.pool.pages_offloaded - before_off == len(swap["host"])
+    assert len(swap["host"]) < len(shared_pids) + len(swap["host"]) \
+        or not swap["host"]
+    out = eng.run(max_steps=800)
+    np.testing.assert_array_equal(
+        out[rB], generate(m, prompt[None], 12, temperature=0.0)[0])
+    # refcount regression: after the drain the shared pages are held
+    # by the cache alone again
+    for pid in shared_pids:
+        assert eng.pool.ref[pid] == 1
+    assert eng.pool.host_free_pages == eng.pool.host_pages
+
+
+def test_host_full_fallback_rolls_back_shared_holds(memorized_lm):
+    """When the host tier cannot take the PRIVATE remainder, the swap
+    falls through to the re-prefill path — and the shared pages'
+    snapshot holds are rolled back (no refcount leak), still
+    token-identical."""
+    m = memorized_lm
+    eng = ServingEngine(m, num_slots=2, max_len=32, page_len=4,
+                        host_kv_pages=1)         # < private page count
+    prompt = np.tile(PATTERN, 2)[:12]
+    rA = eng.submit(prompt, 4)
+    eng.run(max_steps=400)
+    shared_before = {int(p): int(eng.pool.ref[p])
+                     for p in list(eng.prefix._by_page)}
+    rB = eng.submit(prompt, 12)
+    while eng[rB].state.value != "decoding":
+        eng.step()
+    eng.step()
+    req = eng[rB]
+    eng._preempt(req)
+    assert getattr(req, "_swap", None) is None   # host tier too small
+    # no leaked snapshot holds: resident pages carry the cache ref
+    # plus (at most) live slot refs — after the drain, cache-only
+    out = eng.run(max_steps=800)
+    np.testing.assert_array_equal(
+        out[rB], generate(m, prompt[None], 12, temperature=0.0)[0])
+    for pid in shared_before:
+        if eng.prefix.resident(pid):
+            assert eng.pool.ref[pid] == 1
+
+
+def test_terminated_swap_releases_shared_holds(memorized_lm):
+    """Cancelling a swapped-out victim drops the snapshot's refcount
+    holds (shared pages fall back to cache-only) and frees its host
+    pages without fencing them."""
+    m = memorized_lm
+    eng = ServingEngine(m, num_slots=2, max_len=32, page_len=4,
+                        host_kv_pages=16)
+    prompt = np.tile(PATTERN, 2)[:12]
+    rA = eng.submit(prompt, 4)
+    eng.run(max_steps=400)
+    rB = eng.submit(prompt, 12)
+    while eng[rB].state.value != "decoding":
+        eng.step()
+    eng.step()
+    req = eng[rB]
+    eng._preempt(req)
+    swap = req._swap
+    assert swap is not None
+    shared_pids = [pid for _lp, pid in swap["shared"]]
+    fences = eng.pool.host_fences
+    eng.cancel(rB)
+    for pid in shared_pids:
+        assert eng.pool.ref[pid] == 1            # cache-only again
+    assert eng.pool.host_free_pages == eng.pool.host_pages
+    assert eng.pool.host_fences == fences        # dropped, not fenced

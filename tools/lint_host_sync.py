@@ -40,6 +40,14 @@ marked site is sanctioned: the lagged fetch in ``_fetch()``; zero
 marks (someone deleted the contract) or a second mark (someone snuck a
 new sync past review) are both findings.
 
+THE SPECULATION PATH (tree-speculation PR) is the third zone: the
+draft propose/accept call graph of ``serving/speculation.py``
+(``SPECULATION_LOOP_FUNCS`` — ``propose``/``propose_tree``, the
+n-gram lookups, the tree builders) runs inside the synchronous
+speculative iteration, so the three base rules apply there too;
+``np.asarray`` stays allowed (the draft-model step's per-step fetch
+is the sources' sanctioned medium).
+
 Exit status 1 when findings exist (wired into tier-1 as
 ``tests/test_lint_host_sync.py``).
 """
@@ -76,11 +84,29 @@ SERVING_LOOP_FUNCS = frozenset({
     "_fuse_window", "_inflight", "_merge_keys", "_ensure_decode_pages",
     "_fragmentation", "_record_iteration", "_finish", "_admit",
     "_expire_deadlines",
+    # tree speculation (tree-speculation PR): the tree draft/accept
+    # call graph runs inside the iteration too
+    "_spec_tree_step", "_tree_shape", "_adapt_tree", "_drop_swap",
+    "_consume_spec",
 })
 
 #: how many ``# lint: allow-host-sync`` marks the serving loop may
 #: carry: exactly one — the lagged fetch in ``_fetch()``
 SERVING_ALLOWED_MARKS = 1
+
+#: the draft-source module (tree-speculation PR): proposal and the
+#: tree helpers run INSIDE the (synchronous) speculative iteration, so
+#: the three base rules apply — a stray ``jax.device_get`` /
+#: ``block_until_ready`` / ``float(<traced>)`` in the propose path is
+#: a per-iteration sync regression. ``np.asarray`` stays ALLOWED here
+#: (unlike the engine zone): the draft-model step's per-step fetch is
+#: the sources' sanctioned medium — drafting is host-driven by design.
+SPECULATION_MODULE = "distkeras_tpu/serving/speculation.py"
+SPECULATION_LOOP_FUNCS = frozenset({
+    "propose", "propose_tree", "lookup", "continuations", "_grow",
+    "build_token_tree", "tree_ancestors", "_draft_steps", "_heal",
+    "_context",
+})
 
 Finding = Tuple[str, int, str]
 
@@ -232,6 +258,11 @@ def check_tree(root: Path) -> List[Finding]:
             p.read_text(), SERVING_LOOP_MODULE,
             only_funcs=SERVING_LOOP_FUNCS, ban_np_fetch=True,
             allowed_marks=SERVING_ALLOWED_MARKS))
+    p = root / SPECULATION_MODULE
+    if p.exists():
+        findings.extend(check_source(
+            p.read_text(), SPECULATION_MODULE,
+            only_funcs=SPECULATION_LOOP_FUNCS))
     return findings
 
 
