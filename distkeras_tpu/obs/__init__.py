@@ -52,6 +52,7 @@ from distkeras_tpu.obs.tracing import (  # noqa: F401
     NULL_TRACER, RequestTracer, resolve_tracer)
 from distkeras_tpu.obs.recorder import (  # noqa: F401
     NULL_RECORDER, FlightRecorder, get_recorder, resolve_recorder)
+from distkeras_tpu.obs.timeseries import Ring, TimeSeries  # noqa: F401
 from distkeras_tpu.obs.slo import Objective, SLOEngine  # noqa: F401
 
 _enabled = [os.environ.get("DKT_TELEMETRY", "1") not in ("0", "false")]

@@ -328,6 +328,15 @@ class MetricsRegistry:
                          reservoir_size=reservoir_size
                          or self.reservoir_size)
 
+    def instruments(self) -> Dict[str, _Metric]:
+        """Live ``{name: instrument}`` map (a shallow copy). The
+        time-series scraper (``obs.timeseries``) and the report-series
+        lint walk this to see which series exist and, for histograms,
+        to diff reservoirs between scrapes — read-only access; mutate
+        through the instruments themselves."""
+        with self._lock:
+            return dict(self._metrics)
+
     def snapshot(self) -> Dict:
         """``{"counters": {name: {labels: v}}, "gauges": ...,
         "histograms": {name: {labels: stats}}}`` — the one shape every

@@ -40,10 +40,10 @@ reports objective status in ``health()`` and
 from __future__ import annotations
 
 import threading
-from collections import deque
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence
 
+from distkeras_tpu.obs.timeseries import Ring
 from distkeras_tpu.utils.profiling import now, percentiles
 
 __all__ = ["Objective", "SLOEngine", "availability", "latency_objective",
@@ -122,7 +122,8 @@ class SLOEngine:
     should match the metrics window's clock."""
 
     def __init__(self, objectives: Sequence[Objective],
-                 window_s: float = 300.0, clock=now, registry=None):
+                 window_s: float = 300.0, clock=now, registry=None,
+                 history_capacity: int = 1024):
         objectives = list(objectives)
         if not objectives:
             raise ValueError("SLOEngine needs at least one objective")
@@ -137,7 +138,11 @@ class SLOEngine:
         self.clock = clock
         self.registry = registry
         self._lock = threading.Lock()
-        self._history: deque = deque()     # (t, {name: status})
+        #: timestamped evaluation history — the ONE burn trajectory.
+        #: ``status()``/``health()`` window-max and ``obs.report``'s
+        #: per-phase max-burn both read this ring (capacity-bounded;
+        #: ``window_s`` filtering happens at read time).
+        self.history = Ring(history_capacity)  # (t, {name: status})
         self._breached: Dict[str, bool] = {}
         self._g_frac = registry.gauge("slo.good_fraction")
         self._g_burn = registry.gauge("slo.burn_rate")
@@ -191,11 +196,8 @@ class SLOEngine:
                     for o in self.objectives}
         if not record:
             return statuses
+        self.history.append(t, statuses)
         with self._lock:
-            self._history.append((t, statuses))
-            cutoff = t - self.window_s
-            while self._history and self._history[0][0] < cutoff:
-                self._history.popleft()
             transitions = []
             for name, st in statuses.items():
                 was = self._breached.get(name, False)
@@ -216,20 +218,32 @@ class SLOEngine:
         with self._lock:
             return [n for n, b in self._breached.items() if b]
 
+    def burn_history(self, t0: Optional[float] = None,
+                     t1: Optional[float] = None
+                     ) -> List[tuple]:
+        """Timestamped burn trajectory ``[(t, {objective: burn}), ...]``
+        over ``[t0, t1]`` (either bound optional) — the join surface
+        ``obs.report`` slices per trace phase. Same ring ``status()``
+        computes its window-max from, so reports and ``health()`` can
+        never disagree."""
+        return [(t, {name: st["burn_rate"] for name, st in sts.items()})
+                for t, sts in self.history.window(t0, t1)]
+
     def status(self) -> Optional[Dict]:
         """The latest evaluation, each objective annotated with its
-        window-max burn rate (the rolling-window view); None before
-        the first ``evaluate()``."""
-        with self._lock:
-            if not self._history:
-                return None
-            latest = self._history[-1][1]
-            window_max: Dict[str, float] = {}
-            for _, statuses in self._history:
-                for name, st in statuses.items():
-                    window_max[name] = max(window_max.get(name, 0.0),
-                                           st["burn_rate"])
-            out = {name: dict(st) for name, st in latest.items()}
+        window-max burn rate (the rolling-window view, computed over
+        the ``history`` ring entries within ``window_s`` of the latest
+        evaluation); None before the first ``evaluate()``."""
+        last = self.history.last()
+        if last is None:
+            return None
+        t_latest, latest = last
+        window_max: Dict[str, float] = {}
+        for _, statuses in self.history.window(t_latest - self.window_s):
+            for name, st in statuses.items():
+                window_max[name] = max(window_max.get(name, 0.0),
+                                       st["burn_rate"])
+        out = {name: dict(st) for name, st in latest.items()}
         for name, st in out.items():
             st["window_max_burn_rate"] = window_max.get(name, 0.0)
         return {"window_s": self.window_s, "objectives": out,

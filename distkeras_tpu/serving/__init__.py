@@ -51,6 +51,13 @@ per-step decode kernels and an actual serving workload:
                    the flight-recorder ring and declarative SLOs live
                    in ``distkeras_tpu.obs`` (tracing/recorder/slo) and
                    are wired through the engine
+    loadgen.py     production-shaped traffic: seeded phased arrivals
+                   (diurnal ramps, bursts, flash crowds), heavy-tail
+                   lengths, template/tenant mixes — synthesized into a
+                   replayable JSONL ``Trace`` and driven open-loop
+                   through an engine or router fleet on the iteration
+                   clock (deterministic; ``obs.report`` turns the
+                   result into the per-phase scenario SLO report)
     router/        the horizontal tier: N engine replicas behind a
                    prefix-affinity/least-loaded ``Router`` with
                    lifecycle-managed ``EngineReplica``s, disaggregated
@@ -65,6 +72,13 @@ the scheduling policy and the router tier.
 
 from distkeras_tpu.serving.engine import (DegradedRequest,  # noqa: F401
                                           ServingEngine)
+from distkeras_tpu.serving.loadgen import (IterationClock,  # noqa: F401
+                                           PhaseSpec, PhaseResult,
+                                           ReplayResult, TenantSpec,
+                                           Trace, TraceRequest,
+                                           WorkloadSpec,
+                                           diurnal_burst_scenario,
+                                           replay, synthesize)
 from distkeras_tpu.serving.kv_pool import (KVPool,  # noqa: F401
                                            PagedKVPool, PrefixCache)
 from distkeras_tpu.serving.metrics import ServingMetrics  # noqa: F401

@@ -77,6 +77,8 @@ pre-casting but not int8; prompts longer than
 from __future__ import annotations
 
 import itertools
+import weakref
+import zlib
 from typing import Dict, List, Optional
 
 import jax
@@ -86,6 +88,7 @@ import numpy as np
 from distkeras_tpu import obs
 from distkeras_tpu.obs.recorder import resolve_recorder
 from distkeras_tpu.obs.slo import SLOEngine
+from distkeras_tpu.obs.timeseries import TimeSeries
 from distkeras_tpu.obs.tracing import resolve_tracer
 from distkeras_tpu.models.core import Model, Sequential
 from distkeras_tpu.models.decoding import (_attn_compute_dtype,
@@ -320,7 +323,9 @@ class ServingEngine:
                  draft: Optional[DraftSource] = None, spec_k: int = 4,
                  spec_disable_below: float = 0.1,
                  spec_warmup: int = 8,
+                 spec_reprobe: Optional[int] = None,
                  spec_tree: bool = False, spec_width: int = 1,
+                 timeseries=None,
                  moe_decode: str = "dispatched",
                  ep_mesh=None,
                  overlap: bool = True, fuse_steps: int = 0,
@@ -521,6 +526,29 @@ class ServingEngine:
             self.slo = slo
         else:
             self.slo = SLOEngine(list(slo), clock=self.metrics.clock)
+        # windowed time-series telemetry (obs.timeseries): scraped on
+        # the existing deferred host-window cadence in step() — pure
+        # host-side Python over the live registry, zero new device
+        # syncs. ``timeseries=None`` (default) builds a scraper that
+        # follows the CURRENT metrics window across per-interval swaps
+        # (the weakref provider — the scraper must not keep the engine
+        # alive); ``False`` disables; a ``TimeSeries`` instance is used
+        # as-is (the replay harness installs one on a virtual clock).
+        if timeseries is False:
+            self.timeseries = None
+        elif isinstance(timeseries, TimeSeries):
+            self.timeseries = timeseries
+        else:
+            _wref = weakref.ref(self)
+
+            def _live_registry():
+                eng = _wref()
+                return None if eng is None else eng._metrics.registry
+
+            self.timeseries = TimeSeries(
+                _live_registry, clock=self.metrics.clock,
+                interval_s=0.0 if timeseries is None else float(timeseries),
+                tags={"engine": self.engine_id})
         self._requests: Dict[int, Request] = {}
         self._rid = itertools.count()
 
@@ -564,6 +592,17 @@ class ServingEngine:
                 f"got {spec_disable_below}")
         self.spec_disable_below = float(spec_disable_below)
         self.spec_warmup = int(spec_warmup)
+        # adaptive re-enable: the EMA kill switch above is sticky by
+        # default (the adversarial-stream contract several tests pin);
+        # with ``spec_reprobe=N`` a demoted stream gets a probabilistic
+        # re-probe after generating N more tokens, so a workload shift
+        # (the draft starts predicting again) can win speculation back
+        if spec_reprobe is not None:
+            spec_reprobe = int(spec_reprobe)
+            if spec_reprobe < 1:
+                raise ValueError(
+                    f"spec_reprobe must be >= 1, got {spec_reprobe}")
+        self.spec_reprobe = spec_reprobe
         self._spec_fns = {}                  # greedy_only -> jit verify
         # tree speculation (tree-speculation PR): the verify window
         # widens to 1 + spec_k * spec_width TREE nodes; per-stream
@@ -766,6 +805,8 @@ class ServingEngine:
             snap["requests"] = self.tracer.summaries()
         if self.slo is not None:
             snap["slo"] = self.slo.status()
+        if self.timeseries is not None:
+            snap["timeseries"] = self.timeseries.summary()
         return snap
 
     # --- zero-bubble loop: pipelined dispatch + deferred host work --------
@@ -1524,17 +1565,59 @@ class ServingEngine:
                 and not req.spec_disabled)
 
     def _spec_slots(self):
-        """Decoding slots that speculate THIS iteration."""
-        return [slot for slot, r in self.scheduler.running.items()
-                if self._spec_eligible(r)]
+        """Decoding slots that speculate THIS iteration. Demoted
+        streams get their re-probe chance here (``spec_reprobe``) —
+        the one place every decode iteration already inspects them."""
+        out = []
+        for slot, r in self.scheduler.running.items():
+            if r.spec_disabled and self.spec_reprobe is not None:
+                self._maybe_reprobe(r)
+            if self._spec_eligible(r):
+                out.append(slot)
+        return out
 
     def _spec_disable(self, req: Request) -> None:
-        """Sticky per-request kill switch (adversarial-stream escape
-        hatch): the stream decodes plainly from here on."""
+        """Per-request kill switch (adversarial-stream escape hatch):
+        the stream decodes plainly from here on — sticky unless the
+        engine was built with ``spec_reprobe``."""
         req.spec_disabled = True
+        req.spec_disabled_at = len(req.generated)
         self.metrics.record_spec_disabled()
         if self._draft is not None and req.slot is not None:
             self._draft.end_slot(req.slot)
+
+    #: re-probe coin odds: one in this many eligible positions fires
+    #: (deterministic — a crc32 of (seed, rid, position), not an RNG
+    #: draw, so replays reproduce the exact re-enable points)
+    _SPEC_REPROBE_ONE_IN = 8
+
+    def _maybe_reprobe(self, req: Request) -> None:
+        """Probabilistic speculation re-enable (``spec_reprobe``): once
+        a demoted stream has generated ``spec_reprobe`` further tokens,
+        each position flips a deterministic coin; on success the stream
+        rejoins speculation with a FRESH warm-up (EMA and check count
+        reset — the kill switch gets a clean window to re-judge). If
+        the draft cannot re-adopt the slot the stream re-demotes and
+        the cooldown restarts. Token identity is untouched either way:
+        verify accepts only target-matching tokens."""
+        if (self._draft is None or not req.speculate
+                or req.slot is None):
+            return
+        since = len(req.generated) - (req.spec_disabled_at or 0)
+        if since < self.spec_reprobe:
+            return
+        coin = zlib.crc32(
+            f"{req.seed}:{req.rid}:{len(req.generated)}".encode())
+        if coin % self._SPEC_REPROBE_ONE_IN:
+            return
+        req.spec_disabled = False
+        req.spec_disabled_at = None
+        req.spec_ema = None
+        req.spec_checks = 0
+        if self._draft.begin_slot(req.slot, req.context_tokens):
+            self.metrics.record_spec_reenabled()
+        else:
+            self._spec_disable(req)
 
     def _observe_acceptance(self, req: Request, rate: float) -> None:
         """Update the per-request acceptance EMA; below the floor after
@@ -2083,6 +2166,10 @@ class ServingEngine:
         if self._iters % self._host_window == 0 \
                 or not self.scheduler.pending:
             self._flush_host_window()
+            if self.timeseries is not None:
+                # piggybacks on the flush cadence just paid: pure
+                # host-side registry reads, zero added device syncs
+                self.timeseries.maybe_sample(iteration=self._iters)
         if self._iters % self._RECOMPILE_CHECK_EVERY == 0:
             self._recompile.check()
         if self.slo is not None \

@@ -1,0 +1,596 @@
+"""Production-shaped workload generator + deterministic replayer.
+
+``bench.py``'s hand-rolled open-loop traces exercise one arrival
+process (exponential inter-arrivals at a fixed rate) with fixed-length
+prompts — nothing like production traffic, whose defining features are
+exactly what stress a serving fleet: *phased* load (diurnal ramps, step
+bursts, flash crowds), *heavy-tailed* prompt/output lengths, and
+*structured* prompt populations (shared templates that exercise the
+prefix cache, tenants with different priorities). This module
+synthesizes such traffic as a replayable artifact and drives it through
+an engine or a router fleet deterministically:
+
+* :func:`synthesize` expands a :class:`WorkloadSpec` (phases + length
+  distributions + template/tenant mixes) into a :class:`Trace` — every
+  request materialized with explicit arrival iteration, prompt tokens,
+  output budget, tenant and phase tag — from one numpy seed. Same spec
+  + same seed = bit-identical trace, on any host.
+* ``Trace.to_jsonl`` / ``Trace.from_jsonl`` round-trip the trace
+  through the ``obs.exporters`` JSONL conventions (typed lines under
+  the ``SCHEMA_VERSION`` forward-compat contract: the new ``"phase"``
+  and ``"request"`` record types are additive — old readers skip
+  them, no version bump).
+* :func:`replay` drives the trace open-loop on the **engine's own
+  iteration clock**: arrivals are indexed by iteration, not wall time,
+  and an :class:`IterationClock` (``t = iteration * dt``) is installed
+  as the metrics/SLO/time-series clock — no sleeps, no wall-clock
+  reads in any recorded number, so a CPU tier-1 test can assert two
+  replays produce *identical* per-phase report numbers. Each trace
+  phase gets its own ``ServingMetrics`` window (swapped at the
+  boundary — the engine drains its pipeline into the old window
+  first), so per-phase percentiles and SLO attainment are exact, not
+  approximations over a shared reservoir.
+
+The produced :class:`ReplayResult` is the input to ``obs.report``,
+which joins phase annotations against the time series into the
+scenario SLO report.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import zlib
+from dataclasses import asdict, dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from distkeras_tpu.obs.exporters import SCHEMA_VERSION
+from distkeras_tpu.obs.slo import Objective, SLOEngine
+from distkeras_tpu.obs.timeseries import TimeSeries
+from distkeras_tpu.serving.metrics import ServingMetrics
+from distkeras_tpu.serving.scheduler import AdmissionRejected
+
+__all__ = ["IterationClock", "PhaseSpec", "PhaseResult", "ReplayResult",
+           "TenantSpec", "Trace", "TraceRequest", "WorkloadSpec",
+           "diurnal_burst_scenario", "replay", "synthesize"]
+
+
+# --- workload specification -------------------------------------------------
+
+
+@dataclass(frozen=True)
+class PhaseSpec:
+    """One arrival-process phase, ``duration`` engine iterations long.
+
+    ``rate`` is the mean arrivals per iteration at the phase's end;
+    ``shape="flat"`` holds it constant (a step burst / flash crowd is
+    just a short flat phase at a high rate), ``shape="ramp"``
+    interpolates linearly from ``rate0`` to ``rate`` (a diurnal ramp
+    up, or down when ``rate0 > rate``)."""
+
+    name: str
+    duration: int
+    rate: float
+    shape: str = "flat"
+    rate0: float = 0.0
+
+    def __post_init__(self):
+        if self.duration < 1:
+            raise ValueError(f"phase {self.name!r}: duration must be "
+                             f">= 1, got {self.duration}")
+        if self.shape not in ("flat", "ramp"):
+            raise ValueError(f"phase {self.name!r}: shape must be "
+                             f"'flat' or 'ramp', got {self.shape!r}")
+        if self.rate < 0 or self.rate0 < 0:
+            raise ValueError(f"phase {self.name!r}: rates must be >= 0")
+
+    def rate_at(self, i: int) -> float:
+        """Arrival rate at iteration ``i`` of the phase (0-based)."""
+        if self.shape == "flat" or self.duration <= 1:
+            return self.rate
+        frac = i / (self.duration - 1)
+        return self.rate0 + (self.rate - self.rate0) * frac
+
+
+@dataclass(frozen=True)
+class TenantSpec:
+    """One tenant class in the mix: sampled by ``weight``, submitted at
+    ``priority`` (the PriorityScheduler classes)."""
+
+    name: str
+    weight: float = 1.0
+    priority: int = 1
+
+
+@dataclass(frozen=True)
+class WorkloadSpec:
+    """The full workload shape :func:`synthesize` expands.
+
+    Lengths are heavy-tailed lognormals (median/sigma), clipped to
+    ``[1, *_max]``; prompt lengths additionally round UP to multiples
+    of ``length_quantum`` — production deployments bucket prompt
+    lengths to bound prefill-program compiles (see
+    ``ServingEngine.MAX_PREFILL_PROGRAMS``), and the generator models
+    that. A ``template_frac`` fraction of prompts start with one of
+    ``n_templates`` shared ``template_len``-token prefixes (the
+    prefix-cache exercise); the rest are fully random."""
+
+    vocab: int
+    phases: Tuple[PhaseSpec, ...]
+    prompt_median: float = 12.0
+    prompt_sigma: float = 0.6
+    prompt_max: int = 32
+    output_median: float = 8.0
+    output_sigma: float = 0.6
+    output_max: int = 24
+    length_quantum: int = 4
+    n_templates: int = 4
+    template_len: int = 8
+    template_frac: float = 0.5
+    tenants: Tuple[TenantSpec, ...] = (TenantSpec("standard"),)
+
+    def __post_init__(self):
+        if self.vocab < 3:
+            raise ValueError(f"vocab must be >= 3, got {self.vocab}")
+        if not self.phases:
+            raise ValueError("WorkloadSpec needs at least one phase")
+        if self.length_quantum < 1:
+            raise ValueError("length_quantum must be >= 1")
+        if self.template_len >= self.prompt_max:
+            raise ValueError(
+                f"template_len ({self.template_len}) must be < "
+                f"prompt_max ({self.prompt_max})")
+        if not self.tenants:
+            raise ValueError("WorkloadSpec needs at least one tenant")
+        if not 0.0 <= self.template_frac <= 1.0:
+            raise ValueError("template_frac must be in [0, 1]")
+
+    @property
+    def total_iterations(self) -> int:
+        return sum(p.duration for p in self.phases)
+
+
+# --- the trace --------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class TraceRequest:
+    """One materialized request: everything replay needs, explicit."""
+
+    arrival: int                  # engine iteration it becomes visible
+    prompt: Tuple[int, ...]
+    max_new_tokens: int
+    tenant: str = "standard"
+    priority: int = 1
+    phase: str = ""
+    template: Optional[int] = None
+
+
+@dataclass(frozen=True)
+class PhaseSpan:
+    """Iteration span ``[start, end)`` a phase covered in the trace."""
+
+    name: str
+    start: int
+    end: int
+
+
+@dataclass(frozen=True)
+class Trace:
+    """A replayable workload: requests + phase spans + provenance."""
+
+    requests: Tuple[TraceRequest, ...]
+    phases: Tuple[PhaseSpan, ...]
+    meta: Dict = field(default_factory=dict, compare=True)
+
+    def __len__(self) -> int:
+        return len(self.requests)
+
+    # -- JSONL round trip (exporter conventions) ---------------------
+
+    def to_jsonl(self, path: str) -> None:
+        """Typed JSONL lines: one ``meta`` header (carries
+        ``schema_version`` + provenance), one ``phase`` line per span,
+        one ``request`` line per request. Additive record types under
+        the exporter forward-compat contract."""
+        with open(path, "w") as f:
+            f.write(json.dumps(
+                {"type": "meta", "seq": 0,
+                 "schema_version": SCHEMA_VERSION,
+                 "kind": "loadgen_trace", "n_requests": len(self.requests),
+                 **self.meta}) + "\n")
+            for p in self.phases:
+                f.write(json.dumps(
+                    {"type": "phase", "seq": 0, "name": p.name,
+                     "start": p.start, "end": p.end}) + "\n")
+            for i, r in enumerate(self.requests):
+                f.write(json.dumps(
+                    {"type": "request", "seq": 0, "i": i,
+                     "arrival": r.arrival, "prompt": list(r.prompt),
+                     "max_new_tokens": r.max_new_tokens,
+                     "tenant": r.tenant, "priority": r.priority,
+                     "phase": r.phase, "template": r.template}) + "\n")
+
+    @classmethod
+    def from_jsonl(cls, path: str) -> "Trace":
+        """Inverse of :meth:`to_jsonl`; skips record types it does not
+        know (the same forward-compat stance as
+        ``exporters.read_jsonl``)."""
+        meta: Dict = {}
+        phases: List[PhaseSpan] = []
+        reqs: List[Tuple[int, TraceRequest]] = []
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                rec = json.loads(line)
+                t = rec.get("type")
+                if t == "meta" and rec.get("kind") == "loadgen_trace":
+                    meta = {k: v for k, v in rec.items()
+                            if k not in ("type", "seq", "schema_version",
+                                         "kind", "n_requests")}
+                elif t == "phase":
+                    phases.append(PhaseSpan(rec["name"], rec["start"],
+                                            rec["end"]))
+                elif t == "request":
+                    reqs.append((rec["i"], TraceRequest(
+                        arrival=rec["arrival"],
+                        prompt=tuple(rec["prompt"]),
+                        max_new_tokens=rec["max_new_tokens"],
+                        tenant=rec.get("tenant", "standard"),
+                        priority=rec.get("priority", 1),
+                        phase=rec.get("phase", ""),
+                        template=rec.get("template"))))
+        reqs.sort(key=lambda p: p[0])
+        return cls(requests=tuple(r for _, r in reqs),
+                   phases=tuple(phases), meta=meta)
+
+
+def synthesize(spec: WorkloadSpec, seed: int = 0) -> Trace:
+    """Expand a :class:`WorkloadSpec` into a :class:`Trace` — one
+    ``numpy.random.RandomState(seed)`` drives every draw (arrival
+    counts, lengths, tenant/template picks, token values), so the
+    trace is bit-identical across hosts and runs."""
+    rs = np.random.RandomState(seed)
+    templates = [rs.randint(1, spec.vocab, size=spec.template_len)
+                 .tolist() for _ in range(spec.n_templates)]
+    weights = np.asarray([t.weight for t in spec.tenants], np.float64)
+    cum = np.cumsum(weights / weights.sum())
+    q = spec.length_quantum
+
+    def _length(median: float, sigma: float, lo: int, hi: int,
+                quantize: bool) -> int:
+        n = int(np.round(rs.lognormal(mean=math.log(median),
+                                      sigma=sigma)))
+        if quantize:
+            n = int(math.ceil(max(n, 1) / q) * q)
+        return int(np.clip(n, lo, hi))
+
+    requests: List[TraceRequest] = []
+    phases: List[PhaseSpan] = []
+    it0 = 0
+    for ph in spec.phases:
+        for i in range(ph.duration):
+            for _ in range(int(rs.poisson(ph.rate_at(i)))):
+                tenant = spec.tenants[int(np.searchsorted(
+                    cum, rs.random_sample()))]
+                tid = None
+                total = _length(spec.prompt_median, spec.prompt_sigma,
+                                q, spec.prompt_max, quantize=True)
+                if spec.n_templates and rs.random_sample() \
+                        < spec.template_frac:
+                    tid = int(rs.randint(spec.n_templates))
+                    if total <= spec.template_len:
+                        total = min(spec.prompt_max,
+                                    spec.template_len + q)
+                    prompt = templates[tid] + rs.randint(
+                        1, spec.vocab,
+                        size=total - spec.template_len).tolist()
+                else:
+                    prompt = rs.randint(1, spec.vocab,
+                                        size=total).tolist()
+                out_len = _length(spec.output_median, spec.output_sigma,
+                                  1, spec.output_max, quantize=False)
+                requests.append(TraceRequest(
+                    arrival=it0 + i, prompt=tuple(prompt),
+                    max_new_tokens=out_len, tenant=tenant.name,
+                    priority=tenant.priority, phase=ph.name,
+                    template=tid))
+        phases.append(PhaseSpan(ph.name, it0, it0 + ph.duration))
+        it0 += ph.duration
+    meta = {"seed": int(seed), "vocab": spec.vocab,
+            "total_iterations": spec.total_iterations,
+            "spec": {**asdict(spec),
+                     "phases": [asdict(p) for p in spec.phases],
+                     "tenants": [asdict(t) for t in spec.tenants]}}
+    return Trace(requests=tuple(requests), phases=tuple(phases),
+                 meta=meta)
+
+
+def diurnal_burst_scenario(vocab: int, *, scale: float = 1.0,
+                           prompt_max: int = 24, output_max: int = 12,
+                           length_quantum: int = 8,
+                           tenants: Optional[Sequence[TenantSpec]] = None
+                           ) -> WorkloadSpec:
+    """THE fixed reference scenario (bench + tests): a diurnal ramp to
+    steady state, a 4x step burst, recovery, a short flash crowd, and
+    a ramp-down — ~200 iterations end to end. ``scale`` multiplies
+    every arrival rate (0.25 for quick tier-1 runs)."""
+    s = float(scale)
+    return WorkloadSpec(
+        vocab=vocab,
+        phases=(
+            PhaseSpec("ramp_up", 40, rate=0.30 * s, shape="ramp",
+                      rate0=0.02 * s),
+            PhaseSpec("steady", 50, rate=0.30 * s),
+            PhaseSpec("burst", 25, rate=1.20 * s),
+            PhaseSpec("recovery", 40, rate=0.25 * s),
+            PhaseSpec("flash", 10, rate=2.50 * s),
+            PhaseSpec("cooldown", 40, rate=0.05 * s, shape="ramp",
+                      rate0=0.25 * s),
+        ),
+        prompt_median=10.0, prompt_sigma=0.5, prompt_max=prompt_max,
+        output_median=6.0, output_sigma=0.5, output_max=output_max,
+        length_quantum=length_quantum,
+        n_templates=3, template_len=min(8, prompt_max - length_quantum),
+        template_frac=0.5,
+        tenants=tuple(tenants) if tenants is not None else (
+            TenantSpec("interactive", weight=3.0, priority=0),
+            TenantSpec("standard", weight=6.0, priority=1),
+            TenantSpec("batch", weight=1.0, priority=2)))
+
+
+# --- deterministic replay ---------------------------------------------------
+
+
+class IterationClock:
+    """A virtual clock ticking ``dt`` seconds per engine iteration.
+    Installed as the metrics/SLO/time-series clock during replay, it
+    makes every recorded timestamp, latency and rate a pure function
+    of iteration count — deterministic on any host, no sleeps."""
+
+    def __init__(self, dt: float = 1e-3, t0: float = 0.0):
+        if dt <= 0:
+            raise ValueError(f"dt must be > 0, got {dt}")
+        self.dt = float(dt)
+        self._t = float(t0)
+
+    def __call__(self) -> float:
+        return self._t
+
+    def advance(self, n: int = 1) -> float:
+        self._t += n * self.dt
+        return self._t
+
+
+@dataclass
+class PhaseResult:
+    """One phase's outcome: per-engine metrics-window summaries and
+    SLO statuses (single-engine replays are a fleet of one), plus the
+    submit/shed counts of arrivals that fell inside the phase."""
+
+    name: str
+    start: int                    # iteration span [start, end)
+    end: int
+    t0: float                     # virtual-clock span
+    t1: float
+    submitted: int = 0
+    shed: int = 0
+    summaries: Dict[str, Dict] = field(default_factory=dict)
+    slo: Dict[str, Dict] = field(default_factory=dict)
+
+
+@dataclass
+class ReplayResult:
+    """Everything :func:`obs.report.build_report` joins: the trace,
+    per-phase results, per-request outcomes, and the live handles
+    (time series per engine, SLO engines) for timeline slicing."""
+
+    trace: Trace
+    phases: List[PhaseResult]
+    outcomes: List[Dict]
+    iterations: int
+    dt: float
+    fleet: bool
+    engine_ids: List[str]
+    timeseries: Dict[str, TimeSeries]
+    slo: Dict[str, Optional[SLOEngine]]
+
+    @property
+    def totals(self) -> Dict[str, int]:
+        counts: Dict[str, int] = {}
+        for o in self.outcomes:
+            counts[o["state"]] = counts.get(o["state"], 0) + 1
+        counts["total"] = len(self.outcomes)
+        return counts
+
+
+def _token_crc(tokens) -> int:
+    """Cheap deterministic fingerprint of a request's full token
+    sequence — two replays are token-identical iff these match."""
+    return zlib.crc32(np.ascontiguousarray(
+        np.asarray(tokens, np.int64)).tobytes())
+
+
+def replay(trace: Trace, target, *,
+           objectives: Optional[Sequence[Objective]] = None,
+           dt: float = 1e-3, max_steps: Optional[int] = None,
+           timeseries_capacity: int = 2048) -> ReplayResult:
+    """Drive ``trace`` open-loop through ``target`` (a ``ServingEngine``
+    or a ``Router`` fleet) on a virtual iteration clock.
+
+    Per engine, the replay installs: a fresh ``ServingMetrics`` window
+    on the shared :class:`IterationClock` (swapped again at every
+    phase boundary, draining the pipeline first — per-phase windows),
+    a clock-matched ``TimeSeries`` scraper following the live window,
+    and — when ``objectives`` is given — a per-engine ``SLOEngine``
+    evaluated by the engine's own step cadence plus once at each phase
+    boundary (router replays: the per-objective registry gauges
+    collide across replicas, but each engine's burn-history ring stays
+    separate, and that ring is what the report reads).
+
+    Arrivals submit when the iteration clock reaches their trace
+    iteration; an ``AdmissionRejected`` records the request as shed.
+    Idle gaps fast-forward (no empty stepping). After the last phase
+    the fleet drains, reported as the synthetic ``(drain)`` phase."""
+    fleet = hasattr(target, "replicas")
+    # report keys must be identical across two replays of the same
+    # scenario, but the obs component registry appends an object-id
+    # disambiguator to reused names ("serving[0x..]", "r0#0x.."). Strip
+    # it — unless that would collide within THIS run, in which case the
+    # unique (nondeterministic) form is the lesser evil.
+    def _stable(name: str) -> str:
+        return name.split("[", 1)[0].split("#", 1)[0]
+
+    engines: Dict[str, "object"] = {}
+    pairs = ([(r.name, r.engine) for r in target.replicas] if fleet
+             else [(target.engine_id, target)])
+    for name, eng in pairs:
+        key = _stable(name)
+        engines[name if key in engines else key] = eng
+    clock = IterationClock(dt)
+    tseries: Dict[str, TimeSeries] = {}
+    slos: Dict[str, Optional[SLOEngine]] = {}
+    for eid, eng in engines.items():
+        eng.metrics = ServingMetrics(clock=clock)
+        ts = TimeSeries(
+            (lambda e=eng: e.metrics.registry),
+            capacity=timeseries_capacity, clock=clock,
+            tags={"engine": eid})
+        eng.timeseries = ts
+        tseries[eid] = ts
+        slo = (SLOEngine(list(objectives), clock=clock)
+               if objectives else None)
+        eng.slo = slo
+        slos[eid] = slo
+
+    def _busy() -> bool:
+        if fleet:
+            return target.pending
+        if target.scheduler.pending or target._finish_buf:
+            return True
+        if target._pending is not None:
+            # dangling pipelined step: it was launched before the
+            # flush that finished the batch's last request, so every
+            # stream it covers has retired and step() (which only
+            # consumes in-flight work from the decode path) would spin
+            # forever. Consume it directly — run()'s drain loop does
+            # exactly this; a retired-covered step drops wholesale,
+            # anything live lands in _finish_buf
+            target._flush_pending()
+            return bool(target._finish_buf)
+        return False
+
+    reqs = sorted(enumerate(trace.requests), key=lambda p: p[1].arrival)
+    outcomes: List[Dict] = [
+        {"i": i, "phase": r.phase, "tenant": r.tenant,
+         "state": "unsubmitted", "n_tokens": 0}
+        for i, r in sorted(
+            ((i, r) for i, r in enumerate(trace.requests)))]
+    rid_to_idx: Dict[int, int] = {}
+
+    def _submit(idx: int, tr: TraceRequest) -> None:
+        prompt = np.asarray(tr.prompt, np.int32)
+        try:
+            rid = target.submit(prompt, tr.max_new_tokens,
+                                priority=tr.priority, seed=idx)
+        except AdmissionRejected:
+            outcomes[idx]["state"] = "shed"
+            return
+        rid_to_idx[rid] = idx
+        outcomes[idx]["state"] = "submitted"
+
+    def _consume(terminals) -> None:
+        items = (terminals.items() if isinstance(terminals, dict)
+                 else ((r.rid, r) for r in terminals))
+        for rid, req in items:
+            idx = rid_to_idx.pop(rid, None)
+            if idx is None:
+                continue
+            o = outcomes[idx]
+            o["state"] = req.state.name.lower()
+            o["n_tokens"] = len(req.generated)
+            o["tokens_crc"] = _token_crc(req.tokens)
+
+    def _close_phase(name: str, start: int, end: int,
+                     t0: float, submitted_slice) -> PhaseResult:
+        res = PhaseResult(name=name, start=start, end=end,
+                          t0=t0, t1=clock())
+        for eid, eng in engines.items():
+            eng._flush_pending()
+            eng._flush_host_window()
+            if eng.timeseries is not None:
+                eng.timeseries.sample(iteration=end)
+            win = eng.metrics
+            if slos[eid] is not None:
+                res.slo[eid] = slos[eid].evaluate(win)
+            res.summaries[eid] = win.summary()
+            # fresh per-phase window; tell the scraper its counter
+            # baselines are void (the reset clamp alone cannot detect a
+            # swap whose new values coincidentally match the old ones)
+            eng.metrics = ServingMetrics(clock=clock)
+            if eng.timeseries is not None:
+                eng.timeseries.reset_baseline()
+        for o in submitted_slice:
+            if o["state"] == "shed":
+                res.shed += 1
+            else:
+                res.submitted += 1
+        return res
+
+    phase_results: List[PhaseResult] = []
+    next_i = 0                      # cursor into arrival-sorted reqs
+    it = 0
+    budget = (max_steps if max_steps is not None
+              else trace.meta.get("total_iterations", 0) * 50 + 20000)
+    steps = 0
+    for span in trace.phases:
+        t0 = clock()
+        lo_i = next_i
+        while it < span.end:
+            while next_i < len(reqs) and \
+                    reqs[next_i][1].arrival <= it:
+                idx, tr = reqs[next_i]
+                _submit(idx, tr)
+                next_i += 1
+            if _busy():
+                _consume(target.step())
+                steps += 1
+                if steps > budget:
+                    raise RuntimeError(
+                        f"replay exceeded {budget} steps (phase "
+                        f"{span.name!r}, iteration {it}) — engine "
+                        "not draining?")
+                clock.advance()
+                it += 1
+            else:
+                # idle fast-forward to the next arrival (or phase end)
+                nxt = (reqs[next_i][1].arrival
+                       if next_i < len(reqs) else span.end)
+                jump = max(1, min(nxt, span.end) - it)
+                clock.advance(jump)
+                it += jump
+        phase_results.append(_close_phase(
+            span.name, span.start, span.end, t0,
+            [outcomes[i] for i, _ in reqs[lo_i:next_i]]))
+    # drain tail: everything still in flight finishes here
+    t0 = clock()
+    start = it
+    while _busy():
+        _consume(target.step())
+        steps += 1
+        if steps > budget:
+            raise RuntimeError(
+                f"replay drain exceeded {budget} steps — engine "
+                "not draining?")
+        clock.advance()
+        it += 1
+    if it > start or any(o["state"] == "submitted" for o in outcomes):
+        phase_results.append(_close_phase("(drain)", start, it, t0, []))
+    return ReplayResult(
+        trace=trace, phases=phase_results, outcomes=outcomes,
+        iterations=it, dt=dt, fleet=fleet,
+        engine_ids=list(engines), timeseries=tseries, slo=slos)

@@ -127,6 +127,10 @@ class ServingMetrics:
             "serving.spec_accept_rate")
         self._spec_disabled = self.registry.counter(
             "serving.spec_disabled")
+        # adaptive re-enable (ServingEngine(spec_reprobe=...)): demoted
+        # streams the cooldown re-probe won back to speculation
+        self._spec_reenabled = self.registry.counter(
+            "serving.spec_reenabled")
         # tree speculation (tree-speculation PR): the per-verify tree
         # width a stream ran at and the accepted root-path length —
         # the adaptive controller's observable trajectory
@@ -277,6 +281,10 @@ class ServingMetrics:
     def record_spec_disabled(self) -> None:
         """The acceptance EMA kicked one stream back to plain decode."""
         self._spec_disabled.inc()
+
+    def record_spec_reenabled(self) -> None:
+        """A demoted stream's cooldown re-probe won speculation back."""
+        self._spec_reenabled.inc()
 
     def record_spec_tree(self, tree_width: int,
                          accepted_path_len: int) -> None:
@@ -514,6 +522,8 @@ class ServingMetrics:
                 "proposed": self.spec_proposed,
                 "accepted": self.spec_accepted,
                 "disabled_streams": int(self._spec_disabled.value()),
+                # key ADDED by the loadgen/timeseries PR: re-probe wins
+                "reenabled_streams": int(self._spec_reenabled.value()),
                 "accept_rate": self._pcts(self._spec_rate),
                 # tree keys (ADDED by the tree-speculation PR): None
                 # until a tree verify ran

@@ -47,6 +47,7 @@ import numpy as np
 
 from distkeras_tpu import obs
 from distkeras_tpu.obs.recorder import resolve_recorder
+from distkeras_tpu.obs.timeseries import TimeSeries
 from distkeras_tpu.resilience import faults
 from distkeras_tpu.serving.engine import DegradedRequest, ServingEngine
 from distkeras_tpu.serving.router.policies import resolve_policy
@@ -102,7 +103,7 @@ class Router:
     _CTL_EVERY = 16
 
     def __init__(self, replicas, *, policy="prefix_affinity",
-                 start: bool = True):
+                 start: bool = True, timeseries=None):
         reps: List[EngineReplica] = []
         for r in replicas:
             if isinstance(r, ServingEngine):
@@ -148,6 +149,20 @@ class Router:
         self._n: Dict[str, int] = {
             "dispatched": 0, "handoffs": 0, "failovers": 0,
             "rebalanced": 0, "rejected": 0}
+        # fleet-level time series (obs.timeseries): scrapes the GLOBAL
+        # registry (router.* counters, slo gauges, device watermarks)
+        # on the controller cadence; per-replica serving series live on
+        # each engine's OWN scraper (engine-id-tagged). ``None`` =
+        # default scraper, ``False`` = off, instance = used as-is.
+        if timeseries is False:
+            self.timeseries = None
+        elif isinstance(timeseries, TimeSeries):
+            self.timeseries = timeseries
+        else:
+            self.timeseries = TimeSeries(
+                obs.get_registry(),
+                interval_s=0.0 if timeseries is None else float(timeseries),
+                tags={"component": "router"})
         if start:
             for r in reps:
                 if r.state is ReplicaState.STARTING:
@@ -252,6 +267,11 @@ class Router:
         if self.controller is not None \
                 and self._steps % self._CTL_EVERY == 0:
             self.controller.tick()
+        if self.timeseries is not None \
+                and self._steps % self._CTL_EVERY == 0:
+            # fleet scrape on the controller cadence — host-side
+            # registry reads only, no device syncs
+            self.timeseries.maybe_sample(step=self._steps)
         for grid, req in self._finish_buf:
             finished[grid] = req       # produced by handoff/cancel races
         self._finish_buf.clear()
@@ -483,6 +503,8 @@ class Router:
         agg = obs.aggregate_serving()
         agg["router"] = self.counters()
         agg["states"] = {r.name: r.state.value for r in self.replicas}
+        if self.timeseries is not None:
+            agg["timeseries"] = self.timeseries.summary()
         return agg
 
 

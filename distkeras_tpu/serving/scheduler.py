@@ -116,6 +116,8 @@ class Request:
     spec_disabled: bool = False
     spec_ema: Optional[float] = None     # EMA of per-verify accept rate
     spec_checks: int = 0                 # verify steps observed
+    spec_disabled_at: Optional[int] = None  # generated-count at demotion
+    #                                      (re-probe cooldown anchor)
     # tree speculation (tree-speculation PR): the adaptive controller's
     # per-stream tree shape (None until the engine seeds them from its
     # spec_k/spec_width caps at first use; survives preempt/resume)

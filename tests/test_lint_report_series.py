@@ -1,0 +1,29 @@
+"""tools/lint_report_series.py wired into tier-1: every registry series
+name the scenario report (``obs.report.REPORT_SERIES``) reads must
+exist in a live instrument surface — a metric rename fails HERE instead
+of silently flatlining a report panel."""
+
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO / "tools"))
+
+from lint_report_series import check, live_series, main  # noqa: E402
+
+
+def test_every_report_series_exists_live():
+    findings = check()
+    assert not findings, "\n".join(msg for _, msg in findings)
+    assert main() == 0
+
+
+def test_live_surface_covers_serving_and_slo():
+    live = live_series()
+    assert "serving.ttft_s" in live
+    assert "slo.burn_rate" in live
+
+
+def test_renamed_metric_is_flagged():
+    findings = check(["serving.ttft_s", "serving.does_not_exist_s"])
+    assert [name for name, _ in findings] == ["serving.does_not_exist_s"]

@@ -1,0 +1,196 @@
+"""Production-shaped traffic simulator (``serving.loadgen``) and the
+scenario SLO report (``obs.report``): seeded synthesis is bit-identical
+and JSONL round-trips; a replay through the engine is deterministic —
+two replays of the same trace on identically-configured fresh engines
+produce identical outcomes, token CRCs, and per-phase report numbers;
+overload sheds deterministically and the report detects the onset; the
+same contract holds through a Router fleet."""
+
+import json
+
+import numpy as np
+import pytest
+
+from distkeras_tpu.obs import report as scenario_report
+from distkeras_tpu.obs.slo import availability, ttft_p99
+from distkeras_tpu.serving import (PhaseSpec, ServingEngine, TenantSpec,
+                                   Trace, WorkloadSpec,
+                                   diurnal_burst_scenario, replay,
+                                   synthesize)
+
+PATTERN = np.array([3, 1, 4, 1, 5, 9, 2, 6, 5, 3, 5, 8])
+
+
+def _spec(**kw):
+    kw.setdefault("vocab", 29)
+    kw.setdefault("scale", 0.3)
+    kw.setdefault("prompt_max", 16)
+    kw.setdefault("output_max", 8)
+    return diurnal_burst_scenario(**kw)
+
+
+# --- synthesis --------------------------------------------------------------
+
+
+def test_synthesize_is_seed_deterministic_and_shaped():
+    spec = _spec()
+    t1, t2 = synthesize(spec, seed=7), synthesize(spec, seed=7)
+    assert t1 == t2
+    assert t1 != synthesize(spec, seed=8)
+    assert len(t1.requests) > 5
+    names = [p.name for p in t1.phases]
+    assert names == ["ramp_up", "steady", "burst", "recovery", "flash",
+                     "cooldown"]
+    q = spec.length_quantum
+    for r in t1.requests:
+        assert 1 <= len(r.prompt) <= spec.prompt_max
+        assert len(r.prompt) % q == 0         # quantized prompt lengths
+        assert 1 <= r.max_new_tokens <= spec.output_max
+        assert all(1 <= tok < spec.vocab for tok in r.prompt)
+        assert r.tenant in ("interactive", "standard", "batch")
+    # arrivals are ordered and inside the phase spans
+    spans = {p.name: (p.start, p.end) for p in t1.phases}
+    for r in t1.requests:
+        lo, hi = spans[r.phase]
+        assert lo <= r.arrival < hi
+    # the burst phase offers a higher rate than steady
+    by_phase = {n: 0 for n in names}
+    for r in t1.requests:
+        by_phase[r.phase] += 1
+    per_it = {p.name: by_phase[p.name] / (p.end - p.start)
+              for p in t1.phases}
+    assert per_it["burst"] > per_it["steady"]
+
+
+def test_templates_exercise_shared_prefixes():
+    spec = _spec(scale=1.0)
+    tr = synthesize(spec, seed=3)
+    templated = [r for r in tr.requests if r.template is not None]
+    assert templated
+    by_template = {}
+    for r in templated:
+        by_template.setdefault(r.template, set()).add(
+            r.prompt[:spec.template_len])
+    # every request tagged with template i shares that exact prefix
+    assert all(len(prefixes) == 1 for prefixes in by_template.values())
+
+
+def test_workload_spec_validation():
+    ph = (PhaseSpec("p", 10, 0.1),)
+    with pytest.raises(ValueError, match="vocab"):
+        WorkloadSpec(vocab=2, phases=ph)
+    with pytest.raises(ValueError, match="phase"):
+        WorkloadSpec(vocab=29, phases=())
+    with pytest.raises(ValueError, match="template_len"):
+        WorkloadSpec(vocab=29, phases=ph, template_len=32,
+                     prompt_max=32)
+    with pytest.raises(ValueError, match="shape"):
+        PhaseSpec("p", 10, 0.1, shape="square")
+    with pytest.raises(ValueError, match="duration"):
+        PhaseSpec("p", 0, 0.1)
+
+
+def test_trace_jsonl_roundtrip(tmp_path):
+    tr = synthesize(_spec(), seed=5)
+    path = tmp_path / "trace.jsonl"
+    tr.to_jsonl(str(path))
+    back = Trace.from_jsonl(str(path))
+    assert back.requests == tr.requests
+    assert back.phases == tr.phases
+    assert back.meta["seed"] == 5
+    # forward-compat: unknown record types are skipped, not fatal
+    with open(path, "a") as f:
+        f.write(json.dumps({"type": "from_the_future", "x": 1}) + "\n")
+    assert Trace.from_jsonl(str(path)).requests == tr.requests
+
+
+# --- replay determinism (the acceptance gate) -------------------------------
+
+
+def _mk_engine(pattern_lm, **kw):
+    kw.setdefault("num_slots", 2)
+    kw.setdefault("max_len", 32)
+    kw.setdefault("max_queue", 6)
+    return ServingEngine(pattern_lm, **kw)
+
+
+def test_replay_twice_identical_outcomes_and_reports(pattern_lm):
+    """The tentpole contract: same seeded scenario through the same
+    engine config twice => identical traces, outcomes (including token
+    CRCs), and per-phase report numbers."""
+    spec = _spec(prompt_max=16, output_max=8)
+    tr = synthesize(spec, seed=7)
+    objs = [ttft_p99(0.25), availability(0.5)]
+    r1 = replay(tr, _mk_engine(pattern_lm), objectives=objs, dt=1e-3)
+    r2 = replay(tr, _mk_engine(pattern_lm), objectives=objs, dt=1e-3)
+    assert r1.iterations == r2.iterations
+    assert r1.outcomes == r2.outcomes          # states + token CRCs
+    assert any("tokens_crc" in o for o in r1.outcomes)
+    rep1 = scenario_report.build_report(r1)
+    rep2 = scenario_report.build_report(r2)
+    assert scenario_report.to_json(rep1) == scenario_report.to_json(rep2)
+    # every request reached a terminal state and the report says so
+    assert r1.totals.get("finished", 0) + r1.totals.get("shed", 0) \
+        == len(tr.requests)
+    assert {ph["name"] for ph in rep1["phases"]} \
+        >= {p.name for p in tr.phases}
+    h = rep1["headline"]
+    assert 0.0 <= h["min_attainment"] <= 1.0
+
+
+def test_overload_sheds_and_report_detects_onset(pattern_lm):
+    """A tiny admission queue under the flash crowd: sheds happen,
+    deterministically, and the report's saturation join finds the
+    shed onset inside an overloaded phase."""
+    spec = _spec(scale=1.5, prompt_max=8, output_max=6)
+    tr = synthesize(spec, seed=13)
+    objs = [availability(0.9)]
+    r = replay(tr, _mk_engine(pattern_lm, max_queue=2),
+               objectives=objs, dt=1e-3)
+    assert r.totals.get("shed", 0) > 0
+    rep = scenario_report.build_report(r)
+    shed_phases = [ph for ph in rep["phases"] if ph["shed"] > 0]
+    assert shed_phases
+    assert any(
+        s.get("shed_onset_t") is not None
+        for ph in shed_phases for s in ph["saturation"].values())
+    # attainment dips below 1 in at least one overloaded phase
+    assert rep["headline"]["min_attainment"] < 1.0
+    md = scenario_report.to_markdown(rep)
+    html = scenario_report.to_html(rep)
+    assert "Scenario report" in md and "<svg" in html
+
+
+def test_replay_through_router_fleet_is_deterministic(pattern_lm):
+    spec = _spec(scale=0.5, prompt_max=16, output_max=8)
+    tr = synthesize(spec, seed=11)
+
+    def mk():
+        from distkeras_tpu.serving import Router
+        return Router([
+            _mk_engine(pattern_lm, engine_id="lg0"),
+            _mk_engine(pattern_lm, engine_id="lg1")])
+
+    objs = [ttft_p99(0.25), availability(0.5)]
+    r1 = replay(tr, mk(), objectives=objs, dt=1e-3)
+    r2 = replay(tr, mk(), objectives=objs, dt=1e-3)
+    assert r1.fleet and sorted(r1.engine_ids) == ["lg0", "lg1"]
+    assert r1.outcomes == r2.outcomes
+    rep1 = scenario_report.build_report(r1)
+    assert scenario_report.to_json(rep1) \
+        == scenario_report.to_json(scenario_report.build_report(r2))
+    # fleet rows carry per-replica divergence
+    assert any("divergence" in ph for ph in rep1["phases"])
+
+
+def test_report_artifacts_save_and_parse(tmp_path, pattern_lm):
+    spec = _spec(scale=0.4, prompt_max=8, output_max=6)
+    tr = synthesize(spec, seed=2)
+    r = replay(tr, _mk_engine(pattern_lm),
+               objectives=[availability(0.5)], dt=1e-3)
+    rep = scenario_report.build_report(r)
+    paths = scenario_report.save_report(rep, str(tmp_path))
+    assert set(paths) == {"json", "md", "html"}
+    parsed = json.loads(open(paths["json"]).read())
+    assert parsed["kind"] == "scenario_report"
+    assert parsed["schema_version"] == rep["schema_version"]

@@ -190,6 +190,36 @@ def test_status_reports_rolling_window_max_burn():
         "window_max_burn_rate"] == 0.0
 
 
+def test_history_is_ring_backed_and_burn_history_slices():
+    """Satellite (loadgen/timeseries PR): ``status()``'s rolling-window
+    max burn is computed over the SAME bounded ``Ring`` that
+    ``burn_history()`` slices for scenario reports — one trajectory,
+    no duplicate bookkeeping."""
+    from distkeras_tpu.obs.timeseries import Ring
+    clk = FakeClock()
+    slo = SLOEngine([ttft_p99(1.0)], window_s=100.0, clock=clk,
+                    registry=MetricsRegistry(), history_capacity=3)
+    assert isinstance(slo.history, Ring)
+    assert slo.burn_history() == []
+    slo.evaluate(_metrics_with_ttfts(clk, [5.0] * 4))     # burn 100x
+    t_first = slo.history.last()[0]
+    clk.advance(10.0)
+    slo.evaluate(_metrics_with_ttfts(FakeClock(), [0.1] * 4))
+    hist = slo.burn_history()
+    assert [b["ttft_p99"] for _, b in hist] \
+        == [pytest.approx(100.0), 0.0]
+    # slicing by the span only returns evaluations inside it
+    assert [b["ttft_p99"] for _, b in slo.burn_history(t_first + 1.0)] \
+        == [0.0]
+    # the ring is bounded: old entries fall off AND leave the window max
+    for _ in range(3):
+        clk.advance(1.0)
+        slo.evaluate(_metrics_with_ttfts(FakeClock(), [0.1] * 4))
+    assert len(slo.history) == 3
+    assert slo.status()["objectives"]["ttft_p99"][
+        "window_max_burn_rate"] == 0.0
+
+
 # --- engine integration -----------------------------------------------------
 
 V, S = 29, 12

@@ -392,6 +392,77 @@ def test_draft_pool_starvation_disables_not_blocks(memorized_lm):
         req.tokens, generate(m, prompt[None], 8, temperature=0.0)[0])
 
 
+class FlippingDraft(DraftSource):
+    """Adversarial-then-helpful draft: garbage (token 0) for the first
+    ``bad_calls`` propose() calls, then delegates to prompt-lookup —
+    the transient-degradation shape the re-probe knob exists for."""
+
+    def __init__(self, bad_calls):
+        self.inner = NgramDraft()
+        self.bad = bad_calls
+        self.calls = 0
+
+    def begin_slot(self, slot, context):
+        return self.inner.begin_slot(slot, context)
+
+    def end_slot(self, slot):
+        return self.inner.end_slot(slot)
+
+    def propose(self, requests, tok, t, out, active):
+        self.calls += 1
+        if self.calls <= self.bad:
+            out[:] = 0
+        else:
+            self.inner.propose(requests, tok, t, out, active)
+
+
+def test_spec_reprobe_reenables_after_cooldown(memorized_lm):
+    """``spec_reprobe=N``: a stream demoted by the acceptance EMA gets
+    deterministic re-probe coins after an N-token cooldown; once the
+    draft recovers, speculation re-enables (counter moves, EMA warm-up
+    restarts) and the output stays token-identical to the oracle."""
+    m = memorized_lm
+    eng = ServingEngine(m, num_slots=1, max_len=64,
+                        draft=FlippingDraft(6), spec_k=2, spec_warmup=4,
+                        spec_reprobe=4)
+    prompt = np.tile(PATTERN, 4)[:8]
+    rid = eng.submit(prompt, 40)
+    done = {}
+    steps = 0
+    while eng.scheduler.pending:
+        for r in eng.step():
+            done[r.rid] = r
+        steps += 1
+        assert steps < 2000
+    req = done[rid]
+    s = eng.metrics.summary()["speculation"]
+    assert s["disabled_streams"] >= 1        # the EMA demotion fired
+    assert s["reenabled_streams"] >= 1       # ...and the re-probe took
+    assert not req.spec_disabled             # speculating again at end
+    assert s["accepted"] > 0                 # recovered draft accepted
+    np.testing.assert_array_equal(
+        req.tokens, generate(m, prompt[None], 40, temperature=0.0)[0])
+
+
+def test_spec_reprobe_default_is_sticky(memorized_lm):
+    """Without the knob the EMA demotion stays sticky — the pinned
+    pre-existing contract — even when the draft recovers."""
+    m = memorized_lm
+    eng = ServingEngine(m, num_slots=1, max_len=64,
+                        draft=FlippingDraft(6), spec_k=2, spec_warmup=4)
+    rid = eng.submit(np.tile(PATTERN, 4)[:8], 40)
+    done = {}
+    while eng.scheduler.pending:
+        for r in eng.step():
+            done[r.rid] = r
+    assert done[rid].spec_disabled
+    s = eng.metrics.summary()["speculation"]
+    assert s["reenabled_streams"] == 0
+    with pytest.raises(ValueError, match="spec_reprobe"):
+        ServingEngine(m, num_slots=1, max_len=64, draft=NgramDraft(),
+                      spec_k=2, spec_reprobe=0)
+
+
 # --- observability ----------------------------------------------------------
 
 

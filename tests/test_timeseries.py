@@ -1,0 +1,279 @@
+"""Windowed time-series telemetry (``obs.timeseries``): the bounded
+``Ring``, counter rates with the reset clamp, gauge levels, per-window
+histogram percentiles from reservoir deltas, the interval gate, the
+JSONL/Prometheus exports — and the serving integration: engine scrapes
+ride the deferred host-window flush cadence (no new host syncs), and a
+Router fleet's interleaved per-engine scrapes keep engine tags separate
+while ``obs.aggregate_serving()`` totals match the per-replica sums."""
+
+import json
+
+import numpy as np
+import pytest
+
+from distkeras_tpu import obs
+from distkeras_tpu.obs.registry import MetricsRegistry
+from distkeras_tpu.obs.timeseries import Ring, TimeSeries
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def advance(self, dt):
+        self.t += dt
+
+    def __call__(self):
+        return self.t
+
+
+# --- Ring -------------------------------------------------------------------
+
+
+def test_ring_bounds_window_and_span():
+    r = Ring(4)
+    assert r.last() is None and len(r) == 0 and r.span_s() == 0.0
+    for i in range(6):
+        r.append(float(i), {"i": i})
+    assert len(r) == 4                       # capacity-bounded
+    assert [t for t, _ in r] == [2.0, 3.0, 4.0, 5.0]
+    assert r.last()[1] == {"i": 5}
+    assert [t for t, _ in r.window(3.0, 4.0)] == [3.0, 4.0]  # inclusive
+    assert [t for t, _ in r.window(4.5)] == [5.0]
+    assert r.span_s() == 3.0
+    with pytest.raises(ValueError):
+        Ring(0)
+
+
+# --- scrape semantics -------------------------------------------------------
+
+
+def test_counter_scrape_value_delta_rate():
+    clk = FakeClock()
+    reg = MetricsRegistry()
+    c = reg.counter("t.count")
+    ts = TimeSeries(reg, clock=clk)
+    c.inc(5)
+    e = ts.sample()["counters"]["t.count"][""]
+    assert e == {"value": 5.0, "delta": 5.0, "rate": None}  # first scrape
+    clk.advance(2.0)
+    c.inc(4)
+    e = ts.sample()["counters"]["t.count"][""]
+    assert e["delta"] == 4.0 and e["rate"] == pytest.approx(2.0)
+
+
+def test_counter_reset_clamp_on_registry_swap():
+    """A shrinking counter means the backing registry was swapped (the
+    engine's per-phase metrics windows): the clamp records the fresh
+    level as the delta instead of a negative rate."""
+    clk = FakeClock()
+    box = [MetricsRegistry()]
+    box[0].counter("t.count").inc(10)
+    ts = TimeSeries(lambda: box[0], clock=clk)
+    ts.sample()
+    clk.advance(1.0)
+    box[0] = MetricsRegistry()               # swap: counter back to 0
+    box[0].counter("t.count").inc(3)
+    e = ts.sample()["counters"]["t.count"][""]
+    assert e == {"value": 3.0, "delta": 3.0, "rate": pytest.approx(3.0)}
+
+
+def test_reset_baseline_after_deliberate_swap():
+    """The clamp alone cannot see a swap whose new value coincidentally
+    equals the old one — callers that swap the registry on purpose (the
+    trace replayer's per-phase windows) call reset_baseline() so the
+    next scrape starts from zero."""
+    clk = FakeClock()
+    box = [MetricsRegistry()]
+    box[0].counter("t.count").inc(3)
+    ts = TimeSeries(lambda: box[0], clock=clk)
+    ts.sample()
+    clk.advance(1.0)
+    box[0] = MetricsRegistry()               # swap: same value reached
+    box[0].counter("t.count").inc(3)
+    ts.reset_baseline()
+    e = ts.sample()["counters"]["t.count"][""]
+    assert e == {"value": 3.0, "delta": 3.0, "rate": pytest.approx(3.0)}
+
+
+def test_gauge_scrape_is_level():
+    reg = MetricsRegistry()
+    g = reg.gauge("t.depth")
+    ts = TimeSeries(reg, clock=FakeClock())
+    g.set(7.0)
+    assert ts.sample()["gauges"]["t.depth"][""] == {"value": 7.0}
+    g.set(2.0)
+    assert ts.sample()["gauges"]["t.depth"][""] == {"value": 2.0}
+
+
+def test_histogram_windowed_percentiles_from_reservoir_deltas():
+    """Each scrape's histogram stats cover ONLY the observations since
+    the previous scrape — not the cumulative distribution — with the
+    exact window count from the streaming counter."""
+    clk = FakeClock()
+    reg = MetricsRegistry()
+    h = reg.histogram("t.lat")
+    ts = TimeSeries(reg, clock=clk)
+    for v in (1.0, 2.0, 3.0):
+        h.observe(v)
+    e = ts.sample()["histograms"]["t.lat"][""]
+    assert e["count"] == 3 and e["p50"] == pytest.approx(2.0)
+    clk.advance(1.0)
+    for v in (10.0, 20.0):                   # a much slower window
+        h.observe(v)
+    e = ts.sample()["histograms"]["t.lat"][""]
+    assert e["count"] == 2
+    assert e["p50"] == pytest.approx(15.0)   # window values only
+    assert e["min"] == 10.0 and e["max"] == 20.0
+    clk.advance(1.0)
+    assert "t.lat" not in ts.sample()["histograms"]  # empty window
+
+
+def test_interval_gate_and_extras():
+    clk = FakeClock()
+    reg = MetricsRegistry()
+    reg.counter("t.c").inc()
+    ts = TimeSeries(reg, clock=clk, interval_s=1.0)
+    assert ts.maybe_sample(iteration=1) is not None
+    clk.advance(0.5)
+    assert ts.maybe_sample(iteration=2) is None       # too soon
+    clk.advance(0.6)
+    s = ts.maybe_sample(iteration=3)
+    assert s is not None and s["iteration"] == 3
+    assert len(ts.ring) == 2
+    assert ts.series("t.c", field="value") == [(0.0, 1.0), (1.1, 1.0)]
+    with pytest.raises(ValueError):
+        TimeSeries(reg, interval_s=-1.0)
+
+
+def test_summary_is_compact_and_json_safe():
+    clk = FakeClock()
+    reg = MetricsRegistry()
+    reg.counter("t.c").inc()
+    ts = TimeSeries(reg, clock=clk, interval_s=0.0, tags={"engine": "e0"})
+    ts.sample(iteration=4)
+    s = ts.summary()
+    assert s["n_samples"] == 1 and s["tags"] == {"engine": "e0"}
+    assert s["last_iteration"] == 4
+    json.dumps(s)
+
+
+# --- exports ----------------------------------------------------------------
+
+
+def test_jsonl_export_is_forward_compatible(tmp_path):
+    """New ``timeseries`` record types under the existing
+    SCHEMA_VERSION: typed lines old readers skip, no version bump."""
+    from distkeras_tpu.obs.exporters import SCHEMA_VERSION
+    clk = FakeClock()
+    reg = MetricsRegistry()
+    reg.counter("t.c").inc(2)
+    reg.gauge("t.g").set(1.5)
+    ts = TimeSeries(reg, clock=clk)
+    ts.sample()
+    path = tmp_path / "ts.jsonl"
+    ts.export_jsonl(str(path))
+    lines = [json.loads(ln) for ln in path.read_text().splitlines()]
+    assert lines[0]["type"] == "meta"
+    assert lines[0]["schema_version"] == SCHEMA_VERSION
+    kinds = {ln["type"] for ln in lines[1:]}
+    assert kinds == {"timeseries"}
+    names = {ln["name"] for ln in lines[1:]}
+    assert names == {"t.c", "t.g"}
+
+
+def test_prometheus_text_is_timestamped():
+    clk = FakeClock(5.0)
+    reg = MetricsRegistry()
+    reg.counter("t.c").inc(3)
+    reg.histogram("t.lat").observe(0.5)
+    ts = TimeSeries(reg, clock=clk)
+    ts.sample()
+    text = ts.prometheus_text()
+    assert "distkeras_t_c" in text
+    assert "_window_count" in text
+    for line in text.splitlines():
+        if line.startswith("#") or not line.strip():
+            continue
+        # exposition format: "name{labels} value timestamp_ms"
+        assert line.split()[-1].lstrip("-").isdigit(), line
+
+
+# --- serving integration ----------------------------------------------------
+
+
+def test_engine_scrapes_on_host_window_cadence(pattern_lm):
+    """The engine's TimeSeries samples land on the deferred host-window
+    flush (and the final drain) — zero scrapes are taken anywhere else,
+    and the telemetry snapshot carries the summary."""
+    from distkeras_tpu.serving import ServingEngine
+    eng = ServingEngine(pattern_lm, num_slots=2, max_len=32,
+                        engine_id="ts-cadence")
+    pattern = np.array([3, 1, 4, 1, 5, 9, 2, 6, 5, 3, 5, 8])
+    eng.submit(pattern[:8], 8)
+    eng.run(max_steps=400)
+    assert len(eng.timeseries.ring) >= 1
+    finished = eng.timeseries.series("serving.requests_finished",
+                                     field="value")
+    assert finished[-1][1] == 1.0
+    snap = obs.telemetry_snapshot()
+    # match by engine_id: other tests' engines may still be attached
+    # to the global component registry
+    comp = next(v for k, v in snap["components"].items()
+                if "ts-cadence" in k)
+    assert comp["timeseries"]["n_samples"] == len(eng.timeseries.ring)
+
+
+def test_engine_timeseries_opt_out_and_injection(pattern_lm):
+    from distkeras_tpu.serving import ServingEngine
+    eng = ServingEngine(pattern_lm, num_slots=1, max_len=32,
+                        timeseries=False)
+    assert eng.timeseries is None
+    own = TimeSeries(MetricsRegistry(), clock=FakeClock())
+    eng2 = ServingEngine(pattern_lm, num_slots=1, max_len=32,
+                         timeseries=own)
+    assert eng2.timeseries is own
+
+
+def test_fleet_scrapes_separate_by_engine_and_sum_to_aggregate(pattern_lm):
+    """Satellite: interleaved per-engine scrapes under a Router fleet.
+    Each engine's samples carry its own tag and counters; the
+    ``obs.aggregate_serving()`` fleet totals equal the sum of the
+    per-replica counter values at the same point."""
+    from distkeras_tpu.serving import Router, ServingEngine
+    pattern = np.array([3, 1, 4, 1, 5, 9, 2, 6, 5, 3, 5, 8])
+    engines = [ServingEngine(pattern_lm, engine_id=f"tse{i}",
+                             num_slots=2, max_len=32)
+               for i in range(2)]
+    router = Router(engines)
+    for i in range(6):
+        router.submit(np.tile(pattern, 2)[:8 + (i % 2) * 4], 6)
+    steps = 0
+    while router.pending:
+        router.step()
+        steps += 1
+        assert steps < 500
+    # drain both engines' deferred windows, then scrape once more so
+    # the final counters are visible in each ring
+    for eng in engines:
+        eng._flush_pending()
+        eng._flush_host_window()
+        eng.timeseries.sample()
+    per_engine = {}
+    for eng in engines:
+        tag = eng.timeseries.tags["engine"]
+        assert tag == eng.engine_id            # tags separate cleanly
+        finished = eng.timeseries.series("serving.requests_finished",
+                                         field="value")
+        per_engine[tag] = finished[-1][1]
+    assert set(per_engine) == {e.engine_id for e in engines}
+    # aggregate over exactly this fleet's components (other tests may
+    # have live engines attached to the global snapshot)
+    snap = obs.telemetry_snapshot()
+    mine = {k: v for k, v in snap["components"].items()
+            if any(e.engine_id in k for e in engines)}
+    assert len(mine) == 2
+    agg = obs.aggregate_serving({"components": mine})
+    assert agg["totals"]["requests_finished"] == \
+        pytest.approx(sum(per_engine.values()))
+    assert sum(per_engine.values()) == 6.0

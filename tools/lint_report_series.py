@@ -1,0 +1,78 @@
+#!/usr/bin/env python
+"""Live check: every series name the scenario report reads exists.
+
+``obs/report.py`` and the ``bench.py --model loadgen`` family read
+registry series BY NAME out of time-series scrapes
+(``REPORT_SERIES``). A metric rename in ``serving/metrics.py`` or
+``obs/slo.py`` would not break any import — the report's joins just
+come back empty and a dashboard panel silently flatlines. That is the
+worst kind of observability regression: the system looks healthy
+because the instrument reporting on it vanished.
+
+This linter closes the loop dynamically (its siblings —
+``lint_metric_names.py`` etc. — are static AST walks; name existence
+is a runtime property, so this one runs a smoke scenario instead):
+
+  1. instantiate the live instrument surface the report reads —
+     a ``ServingMetrics`` window (the engine's per-request metric
+     family) and an ``SLOEngine`` evaluation (the ``slo.*`` gauges
+     + breach counter) — exactly as a replay would;
+  2. assert every ``REPORT_SERIES`` name is registered in one of
+     those live registries.
+
+A renamed (or dropped) metric fails tier-1 via
+``tests/test_lint_report_series.py``. Pure-CPU, no model build, no
+JAX arrays — milliseconds, not seconds.
+
+Exit status 1 when findings exist.
+"""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+from typing import List, Tuple
+
+Finding = Tuple[str, str]     # (series name, message)
+
+
+def live_series() -> set:
+    """Every series name registered by the instrument surfaces the
+    scenario report reads: a fresh ``ServingMetrics`` window plus one
+    ``SLOEngine`` evaluation against it."""
+    from distkeras_tpu.obs.slo import SLOEngine, availability, ttft_p99
+    from distkeras_tpu.serving.metrics import ServingMetrics
+
+    metrics = ServingMetrics()
+    slo = SLOEngine([ttft_p99(0.5), availability(0.9)],
+                    registry=metrics.registry)
+    slo.evaluate(metrics)
+    return set(metrics.registry.instruments())
+
+
+def check(names=None) -> List[Finding]:
+    """Findings for the given series names (default: the report's
+    ``REPORT_SERIES`` contract surface)."""
+    if names is None:
+        from distkeras_tpu.obs.report import REPORT_SERIES
+        names = REPORT_SERIES
+    live = live_series()
+    return [(n, f"series {n!r} read by obs/report.py is not registered "
+                f"by any live instrument surface (renamed or dropped?)")
+            for n in names if n not in live]
+
+
+def main(argv=None) -> int:
+    sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+    findings = check()
+    for name, msg in findings:
+        print(f"lint_report_series: {msg}", file=sys.stderr)
+    if findings:
+        print(f"lint_report_series: {len(findings)} finding(s)",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
