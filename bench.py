@@ -1547,7 +1547,8 @@ def bench_serving_router(num_slots: int, prompt_len: int,
     Returns ``{router_req_s, single_req_s, ratio, per-pass lists,
     affinity_hit_rate, handoffs, disagg}``."""
     from distkeras_tpu.models import Model, zoo
-    from distkeras_tpu.serving import (EngineReplica, Router,
+    from distkeras_tpu.serving import (AutoscaleController,
+                                       EngineReplica, Router,
                                        ServingEngine, ServingMetrics)
 
     cfg = cfg or LM_CFG
@@ -1659,6 +1660,49 @@ def bench_serving_router(num_slots: int, prompt_len: int,
         disagg.submit(prompts[j], new_tokens)
     disagg.run(max_steps=500_000)
     dis_dt = time.perf_counter() - t0
+
+    # elastic rider: 1 seed replica + an AutoscaleController allowed to
+    # grow to 2, driven closed-loop until drained — records the
+    # fleet-size timeline and decision counts (the flapping tripwire:
+    # a controller regression shows up as a decision-count blow-up at
+    # equal attainment, or a timeline that never returns to baseline).
+    # The seed replica's admission queue is bounded so the burst SHEDS
+    # — shed onset is the controller's overload signal, so the rider
+    # exercises the whole loop: shed -> scale_up -> drain -> idle ->
+    # scale_down back to the floor
+    def build_elastic(eid):
+        return ServingEngine(model, num_slots=num_slots,
+                             max_len=max_len, page_len=page_len,
+                             num_pages=num_pages,
+                             prefix_granularity=page_len,
+                             prefill_chunk=prefill_chunk,
+                             max_queue=2 * num_slots, engine_id=eid)
+
+    elastic = Router([EngineReplica(build_elastic("ea"))])
+
+    def _factory():
+        return EngineReplica(build_elastic(f"e{len(elastic.replicas)}"))
+
+    ctl = AutoscaleController(elastic, _factory, min_serving=1,
+                              max_replicas=2, up_sustain=1,
+                              idle_sustain=2, cooldown=2)
+    elastic.attach_controller(ctl)
+    n_el = min(n_requests, 6 * num_slots)
+    for j in range(n_el):
+        try:
+            elastic.submit(prompts[j % len(prompts)], new_tokens)
+        except Exception:
+            pass                     # shed: the overload signal itself
+    elastic.run(max_steps=500_000)
+    # retired replicas only leave the fleet on a router step; give the
+    # controller a few idle ticks so scale-down can land in the record
+    for _ in range(ctl.idle_sustain * elastic._CTL_EVERY * 4):
+        if not elastic.pending and len(elastic.replicas) <= 1:
+            break
+        elastic.step()
+    fleet_timeline = [{"step": s, "event": ev, "replica": name}
+                      for s, ev, name in elastic.fleet_events]
+
     router_med = statistics.median(router_rates)
     single_med = statistics.median(single_rates)
     return {
@@ -1679,7 +1723,135 @@ def bench_serving_router(num_slots: int, prompt_len: int,
             "requests": n_dis,
             "handoffs": disagg.counters()["handoffs"],
         },
+        "fleet_timeline": fleet_timeline,
+        "autoscale_decisions": ctl.counts(),
+        "elastic_requests": n_el,
+        "elastic_counters": elastic.counters(),
     }
+
+
+def bench_autoscale(scale: float, num_slots: int, max_len: int,
+                    prompt_max: int, output_max: int, max_queue: int,
+                    max_replicas: int = 3, dt: float = 1e-3,
+                    out_dir=None, cfg=None):
+    """Closed-loop fleet resilience (fleet-autoscale PR): the seeded
+    flash-crowd + scripted-replica-kill chaos scenario
+    (``loadgen.flash_crowd_chaos_scenario``) replayed through a
+    2-replica router fleet with the ``AutoscaleController`` ON vs OFF.
+    The headline is the SLO-attainment delta (controller on minus
+    off) with per-incident MTTR from the burn-history ring riding
+    along — and the whole record is GATED by the double-replay
+    determinism check: the controller-on replay runs TWICE through
+    fresh fleets and must be byte-identical (outcomes, incidents,
+    fleet timeline, autoscale decisions, report JSON) before the
+    numbers mean anything. Everything derives from the virtual
+    iteration clock — nothing here is wall-clock timed.
+
+    Returns (record_dict, artifact_paths, deterministic)."""
+    import copy
+    import gc
+    import tempfile
+
+    from distkeras_tpu.models import Model, zoo
+    from distkeras_tpu.obs import report as scenario_report
+    from distkeras_tpu.obs.slo import availability, tpot_p99, ttft_p99
+    from distkeras_tpu.serving import (AutoscaleController,
+                                       EngineReplica, Router,
+                                       ServingEngine, Trace,
+                                       flash_crowd_chaos_scenario,
+                                       replay, synthesize)
+
+    cfg = cfg or LM_CFG
+    model = Model.build(zoo.transformer_lm(
+        cfg["vocab"], d_model=cfg["d_model"], num_heads=cfg["num_heads"],
+        num_layers=cfg["num_layers"], mlp_ratio=cfg["mlp_ratio"],
+        use_rope=True), (min(cfg["seq"], max_len),), seed=0)
+    spec = flash_crowd_chaos_scenario(
+        vocab=cfg["vocab"], scale=scale, prompt_max=prompt_max,
+        output_max=output_max,
+        length_quantum=min(8, max(1, prompt_max // 2)))
+    trace = synthesize(spec, seed=23)
+    deterministic = synthesize(spec, seed=23) == trace
+
+    out_dir = out_dir or tempfile.mkdtemp(prefix="bench_autoscale_")
+    trace_path = os.path.join(out_dir, "trace.jsonl")
+    trace.to_jsonl(trace_path)
+    rt = Trace.from_jsonl(trace_path)
+    deterministic &= (rt.requests == trace.requests
+                      and rt.chaos == trace.chaos)
+
+    objectives = [ttft_p99(250 * dt), tpot_p99(50 * dt),
+                  availability(0.9)]
+
+    def _run(controller_on):
+        # fresh fleet per replay; comparables are snapshotted and the
+        # fleet freed before the next run so engine ids can re-register
+        # in the process-global obs component registry
+        def mk(eid):
+            return ServingEngine(model, num_slots=num_slots,
+                                 max_len=max_len, max_queue=max_queue,
+                                 engine_id=eid)
+        router = Router([EngineReplica(mk("f0")),
+                         EngineReplica(mk("f1"))])
+        ctl = None
+        if controller_on:
+            minted = [0]
+
+            def factory():
+                minted[0] += 1
+                return EngineReplica(mk(f"fs{minted[0]}"))
+
+            ctl = AutoscaleController(router, factory, min_serving=1,
+                                      max_replicas=max_replicas,
+                                      up_sustain=1, idle_sustain=4,
+                                      cooldown=2)
+            router.attach_controller(ctl)
+        res = replay(trace, router, objectives=objectives, dt=dt)
+        rep = scenario_report.build_report(res)
+        return {
+            "outcomes": copy.deepcopy(res.outcomes),
+            "incidents": copy.deepcopy(res.incidents),
+            "fleet_timeline": copy.deepcopy(res.fleet_timeline),
+            "autoscale_events": copy.deepcopy(res.autoscale_events),
+            "decisions": ctl.counts() if ctl else {},
+            "report": rep,
+            "json": scenario_report.to_json(rep),
+        }
+
+    on1 = _run(True)
+    gc.collect()
+    on2 = _run(True)
+    gc.collect()
+    # the determinism gate: byte-identical double replay ACROSS the
+    # kill + scale events, or the attainment/MTTR numbers don't count
+    for key in ("outcomes", "incidents", "fleet_timeline",
+                "autoscale_events", "decisions", "json"):
+        deterministic &= (on1[key] == on2[key])
+    off = _run(False)
+    gc.collect()
+
+    rep_on, rep_off = on1["report"], off["report"]
+    att_on = rep_on.get("headline", {}).get("min_attainment", 0.0)
+    att_off = rep_off.get("headline", {}).get("min_attainment", 0.0)
+    rec_on = rep_on.get("recovery") or {}
+    paths = scenario_report.save_report(rep_on, out_dir)
+    record = {
+        "attainment_on": round(att_on, 4),
+        "attainment_off": round(att_off, 4),
+        "attainment_delta": round(att_on - att_off, 4),
+        "mttr": rec_on.get("max_mttr"),
+        "incidents": rec_on.get("incidents"),
+        "requests_on": rec_on.get("requests"),
+        "fleet_size": rec_on.get("fleet_size"),
+        "autoscale_decisions": on1["decisions"],
+        "fleet_timeline": on1["fleet_timeline"],
+        "shed_on": sum(1 for o in on1["outcomes"]
+                       if o["state"] == "shed"),
+        "shed_off": sum(1 for o in off["outcomes"]
+                        if o["state"] == "shed"),
+        "artifacts": {**paths, "trace": trace_path},
+    }
+    return record, paths, deterministic
 
 
 #: the serving_moe bench's MoE LM shape (accelerator tier): every block
@@ -2318,7 +2490,7 @@ def main():
                                         "serving_overlap",
                                         "serving_router",
                                         "serving_moe", "moe",
-                                        "loadgen",
+                                        "loadgen", "autoscale",
                                         "overlap"],
                     default="all",
                     help="'all' (default) runs resnet50 + lm + generate + "
@@ -2333,6 +2505,8 @@ def main():
                     "serving_moe (dispatched vs dense-routing MoE "
                     "decode) + loadgen (diurnal+burst scenario replay, "
                     "per-phase SLO attainment + determinism contract) "
+                    "+ autoscale (flash-crowd + replica-kill chaos "
+                    "replay, controller on vs off, recovery SLOs) "
                     "+ moe + lm_big, one JSON line each (ResNet "
                     "headline first, cumulative summary line last)")
     ap.add_argument("--profile", default=None,
@@ -2396,7 +2570,8 @@ def main():
         for mode in ("resnet50", "lm", "overlap", "generate",
                      "generate_long", "serving", "spec_decode",
                      "spec_tree", "serving_overlap", "serving_router",
-                     "serving_moe", "loadgen", "moe", "lm_big"):
+                     "serving_moe", "loadgen", "autoscale", "moe",
+                     "lm_big"):
             if base_profile:
                 args.profile = f"{base_profile.rstrip('/')}/{mode}"
             try:
@@ -2774,6 +2949,56 @@ def _run_mode(mode, args, on_accel, peak, device_kind):
         }
         return _emit(rec)
 
+    if mode == "autoscale":
+        if on_accel:
+            kw = dict(scale=1.0, num_slots=8, max_len=320,
+                      prompt_max=192, output_max=96, max_queue=16,
+                      max_replicas=4)
+        else:
+            # CPU tier: the same flash-crowd + scripted-kill structure
+            # at smoke scale (loadgen's tiny-LM discipline)
+            kw = dict(scale=0.6, num_slots=2, max_len=48,
+                      prompt_max=16, output_max=8, max_queue=6,
+                      max_replicas=3,
+                      cfg=dict(vocab=256, d_model=64, num_heads=4,
+                               num_layers=2, mlp_ratio=2, seq=48))
+        out, paths, deterministic = bench_autoscale(**kw)
+        rec = {
+            # headline: controller-on minus controller-off worst-phase
+            # SLO attainment on the SAME chaos trace — the closed loop
+            # must at least not hurt (>= 0 floor); MTTR rides along
+            "metric": "autoscale_slo_attainment_delta",
+            "value": out["attainment_delta"],
+            "unit": "fraction",
+            # vs_baseline = on/off attainment ratio: >= 1.0 is the
+            # acceptance bar, the below-anchor tripwire flags < 0.9
+            "vs_baseline": (round(out["attainment_on"]
+                                  / out["attainment_off"], 4)
+                            if out["attainment_off"] else 1.0),
+            "attainment_on": out["attainment_on"],
+            "attainment_off": out["attainment_off"],
+            "mttr": out["mttr"],
+            "incidents": out["incidents"],
+            "requests": out["requests_on"],
+            "shed_on": out["shed_on"],
+            "shed_off": out["shed_off"],
+            "fleet_size": out["fleet_size"],
+            "autoscale_decisions": out["autoscale_decisions"],
+            "fleet_timeline": out["fleet_timeline"],
+            "deterministic": deterministic,
+            "artifacts": out["artifacts"],
+            "criterion": "flash-crowd + scripted replica-kill chaos "
+                         "trace: controller-on attainment >= "
+                         "controller-off, per-incident MTTR recorded "
+                         "from the burn ring — gated by the "
+                         "double-replay determinism check "
+                         "(deterministic=true means the controller-on "
+                         "replay was byte-identical twice across the "
+                         "kill + scale events)",
+            "device_kind": device_kind,
+        }
+        return _emit(rec)
+
     if mode == "serving":
         if on_accel:
             num_slots, prompt_len, new_tokens = 8, 128, 128
@@ -3119,6 +3344,13 @@ def _run_mode(mode, args, on_accel, peak, device_kind):
             "affinity_hit_rate": out["affinity_hit_rate"],
             "handoffs": out["handoffs"],
             "disagg": out["disagg"],
+            # elastic rider: fleet-size timeline + decision counts —
+            # the flapping tripwire (a controller regression = decision
+            # blow-up at equal attainment, or a timeline stuck high)
+            "fleet_timeline": out["fleet_timeline"],
+            "autoscale_decisions": out["autoscale_decisions"],
+            "elastic_requests": out["elastic_requests"],
+            "elastic_counters": out["elastic_counters"],
             "num_slots_per_replica": kw["num_slots"],
             "prompt_len": kw["prompt_len"],
             "new_tokens": kw["new_tokens"],

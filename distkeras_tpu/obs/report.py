@@ -139,6 +139,95 @@ def _phase_timeline(samples) -> Dict[str, List]:
     return out
 
 
+def _merged_burn_history(result) -> List[Tuple[float, float]]:
+    """Fleet-wide burn trajectory: (t, max burn across engines and
+    objectives), merged from every engine's burn-history ring. All
+    engines scrape on the same virtual clock, so samples group by t."""
+    by_t: Dict[float, float] = {}
+    for eid, slo in (result.slo or {}).items():
+        if slo is None:
+            continue
+        for t, burns in slo.burn_history():
+            if burns:
+                by_t[t] = max(by_t.get(t, 0.0), max(burns.values()))
+    return sorted(by_t.items())
+
+
+def _recovery(result) -> Optional[Dict]:
+    """Per-incident recovery SLOs for chaos replays.
+
+    For each fault trigger recorded by the replayer:
+
+    * **time_to_first_action** — virtual seconds from the trigger to
+      the first non-blocked autoscale decision (scale_up/scale_down/gc)
+      at or after it; None when no controller acted.
+    * **mttr** — mean-time-to-recovery from the SLO burn-history
+      rings: the first post-incident sample where the fleet-max burn
+      rate exceeds 1.0 (the budget-neutral line) marks the outage;
+      recovery is the first later sample back at <= 1.0. ``mttr`` is
+      recovery-t minus incident-t; None while still burning at the end
+      of the replay, and absent entirely if the incident never pushed
+      burn past 1.0.
+
+    Request accounting splits terminal outcomes into **lost**
+    (timed out / cancelled), **replayed** (finished after replica
+    failover — tokens re-derived from the seed ledger), and
+    **degraded** (finished after a prefill->decode handoff only).
+    """
+    incidents = getattr(result, "incidents", None) or []
+    timeline = getattr(result, "fleet_timeline", None) or []
+    events = getattr(result, "autoscale_events", None) or []
+    if not incidents and not timeline and not events:
+        return None
+    burn = _merged_burn_history(result)
+    rows: List[Dict] = []
+    for inc in incidents:
+        t_inc = inc["t"]
+        row = dict(inc)
+        act = next((e for e in events
+                    if e["t"] >= t_inc and e.get("action") != "blocked"),
+                   None)
+        row["time_to_first_action"] = (
+            None if act is None else round(act["t"] - t_inc, 9))
+        breach_t = next((t for t, b in burn if t >= t_inc and b > 1.0),
+                        None)
+        if breach_t is not None:
+            rec_t = next((t for t, b in burn
+                          if t > breach_t and b <= 1.0), None)
+            row["breach_t"] = round(breach_t, 9)
+            row["mttr"] = (None if rec_t is None
+                           else round(rec_t - t_inc, 9))
+        rows.append(row)
+    lost = replayed = degraded = 0
+    for o in result.outcomes:
+        st = o.get("state")
+        if st in ("timed_out", "cancelled"):
+            lost += 1
+        elif st == "finished" and o.get("failovers", 0) > 0:
+            replayed += 1
+        elif st == "finished" and o.get("handoffs", 0) > 0:
+            degraded += 1
+    sizes = [e.get("total", 0) for e in timeline]
+    actions: Dict[str, int] = {}
+    for e in events:
+        a = e.get("action", "?")
+        actions[a] = actions.get(a, 0) + 1
+    out: Dict = {
+        "incidents": rows,
+        "requests": {"lost": lost, "replayed": replayed,
+                     "degraded": degraded},
+        "fleet_timeline": timeline,
+        "autoscale_actions": actions,
+    }
+    if sizes:
+        out["fleet_size"] = {"min": min(sizes), "max": max(sizes),
+                             "final": sizes[-1]}
+    mttrs = [r["mttr"] for r in rows if r.get("mttr") is not None]
+    if mttrs:
+        out["max_mttr"] = max(mttrs)
+    return out
+
+
 def build_report(result) -> Dict:
     """Join a ``loadgen.ReplayResult`` into the scenario report dict
     (JSON-serializable; see the renderers for markdown/HTML forms)."""
@@ -238,7 +327,7 @@ def build_report(result) -> Dict:
         for n in objs:
             burn_tl[eid][n] = [burns.get(n) for _, burns in hist]
 
-    return {
+    out = {
         "schema_version": SCHEMA_VERSION,
         "kind": "scenario_report",
         "scenario": {
@@ -256,6 +345,14 @@ def build_report(result) -> Dict:
         "phases": phases_out,
         "burn": burn_tl,
     }
+    # recovery SLOs — only for chaos/autoscale replays (additive key:
+    # readers of plain scenario reports see no change)
+    rec = _recovery(result)
+    if rec is not None:
+        out["recovery"] = rec
+        if "max_mttr" in rec:
+            headline["max_mttr"] = rec["max_mttr"]
+    return out
 
 
 # --- renderers --------------------------------------------------------------
@@ -314,6 +411,31 @@ def to_markdown(report: Dict) -> str:
                     f"{k}: {_fmt(v['spread'], 0)}"
                     for k, v in sorted(div.items()))
                 lines.append(f"- {ph['name']}: {spread}")
+    rec = report.get("recovery")
+    if rec:
+        lines += ["", "## Recovery", ""]
+        reqs = rec.get("requests", {})
+        lines.append(
+            f"Requests: **{reqs.get('lost', 0)} lost**, "
+            f"{reqs.get('replayed', 0)} replayed (failover), "
+            f"{reqs.get('degraded', 0)} degraded (handoff).")
+        fs = rec.get("fleet_size")
+        if fs:
+            lines.append(
+                f"Fleet size: {fs['min']}-{fs['max']} "
+                f"(final {fs['final']}). Autoscale actions: "
+                + (" ".join(f"{k}={v}" for k, v in
+                            sorted(rec.get("autoscale_actions",
+                                           {}).items())) or "none")
+                + ".")
+        if rec.get("incidents"):
+            lines += ["", "| incident | t | first action | MTTR |",
+                      "|---|---:|---:|---:|"]
+            for inc in rec["incidents"]:
+                lines.append(
+                    f"| {inc.get('point', '?')} | {_fmt(inc.get('t'))} "
+                    f"| {_fmt(inc.get('time_to_first_action'))} "
+                    f"| {_fmt(inc.get('mttr')) if 'breach_t' in inc else 'no breach'} |")
     return "\n".join(lines) + "\n"
 
 
@@ -434,6 +556,31 @@ def to_html(report: Dict) -> str:
             f"SLO burn rate — {eid}",
             [(o, [(t, v) for t, v in zip(tl["t"], tl[o])
                   if v is not None]) for o in objs], phases))
+    rec = report.get("recovery")
+    if rec and rec.get("fleet_timeline"):
+        # step-function fleet-size series: repeat each size until the
+        # next mutation so the chart reads as levels, not ramps
+        tl = rec["fleet_timeline"]
+        series = []
+        for key in ("total", "serving", "dead"):
+            pts: List[Tuple[float, float]] = []
+            for i, e in enumerate(tl):
+                if i > 0:
+                    pts.append((e["t"], tl[i - 1].get(key, 0)))
+                pts.append((e["t"], e.get(key, 0)))
+            series.append((key, pts))
+        charts.append("<h3>fleet</h3>")
+        charts.append(_svg_chart("fleet size", series, phases))
+        inc_s = " ".join(
+            f"{_html.escape(str(i.get('point')))}@t={_fmt(i.get('t'))}"
+            f" (first action {_fmt(i.get('time_to_first_action'))}, "
+            f"MTTR {_fmt(i.get('mttr')) if 'breach_t' in i else 'no breach'})"
+            for i in rec.get("incidents", []))
+        reqs = rec.get("requests", {})
+        charts.append(
+            f"<p>incidents: {inc_s or 'none'}<br>requests: "
+            f"{reqs.get('lost', 0)} lost, {reqs.get('replayed', 0)} "
+            f"replayed, {reqs.get('degraded', 0)} degraded</p>")
     return (
         "<!doctype html><html><head><meta charset='utf-8'>"
         "<title>scenario report</title></head>"

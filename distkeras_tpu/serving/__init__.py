@@ -63,8 +63,10 @@ per-step decode kernels and an actual serving workload:
                    lifecycle-managed ``EngineReplica``s, disaggregated
                    prefill/decode pools (handoff = the engine's
                    ``transfer_out``/``transfer_in`` re-entry path),
-                   replica-death mass failover and an
-                   ``SLOBurnController`` drain loop
+                   replica-death mass failover, the elastic
+                   ``add_replica``/``remove_replica`` surface, an
+                   ``SLOBurnController`` drain loop and the
+                   ``AutoscaleController`` closed-loop fleet sizer
 
 See ``docs/serving.md`` for the architecture, the paged-KV design,
 the scheduling policy and the router tier.
@@ -72,12 +74,14 @@ the scheduling policy and the router tier.
 
 from distkeras_tpu.serving.engine import (DegradedRequest,  # noqa: F401
                                           ServingEngine)
-from distkeras_tpu.serving.loadgen import (IterationClock,  # noqa: F401
+from distkeras_tpu.serving.loadgen import (ChaosSpec,  # noqa: F401
+                                           IterationClock,
                                            PhaseSpec, PhaseResult,
                                            ReplayResult, TenantSpec,
                                            Trace, TraceRequest,
                                            WorkloadSpec,
                                            diurnal_burst_scenario,
+                                           flash_crowd_chaos_scenario,
                                            replay, synthesize)
 from distkeras_tpu.serving.kv_pool import (KVPool,  # noqa: F401
                                            PagedKVPool, PrefixCache)
@@ -88,7 +92,9 @@ from distkeras_tpu.serving.scheduler import (AdmissionRejected,  # noqa: F401
                                              RequestState, TERMINAL_STATES)
 from distkeras_tpu.serving.speculation import (DraftModel,  # noqa: F401
                                                DraftSource, NgramDraft)
-from distkeras_tpu.serving.router import (EngineReplica,  # noqa: F401
+from distkeras_tpu.serving.router import (AutoscaleController,  # noqa: F401
+                                          ControllerChain,
+                                          EngineReplica,
                                           LeastLoaded, PlacementPolicy,
                                           PrefixAffinity, ReplicaDead,
                                           ReplicaState,

@@ -41,7 +41,7 @@ from __future__ import annotations
 import json
 import math
 import zlib
-from dataclasses import asdict, dataclass, field
+from dataclasses import asdict, dataclass, field, fields
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -49,12 +49,14 @@ import numpy as np
 from distkeras_tpu.obs.exporters import SCHEMA_VERSION
 from distkeras_tpu.obs.slo import Objective, SLOEngine
 from distkeras_tpu.obs.timeseries import TimeSeries
+from distkeras_tpu.resilience import faults
 from distkeras_tpu.serving.metrics import ServingMetrics
 from distkeras_tpu.serving.scheduler import AdmissionRejected
 
-__all__ = ["IterationClock", "PhaseSpec", "PhaseResult", "ReplayResult",
-           "TenantSpec", "Trace", "TraceRequest", "WorkloadSpec",
-           "diurnal_burst_scenario", "replay", "synthesize"]
+__all__ = ["ChaosSpec", "IterationClock", "PhaseSpec", "PhaseResult",
+           "ReplayResult", "TenantSpec", "Trace", "TraceRequest",
+           "WorkloadSpec", "diurnal_burst_scenario",
+           "flash_crowd_chaos_scenario", "replay", "synthesize"]
 
 
 # --- workload specification -------------------------------------------------
@@ -105,6 +107,62 @@ class TenantSpec:
 
 
 @dataclass(frozen=True)
+class ChaosSpec:
+    """One phase-anchored fault script entry: arm a
+    ``resilience.faults`` injection point when the replay's iteration
+    cursor reaches ``at``, optionally disarm it at ``clear_at``.
+
+    The trigger knobs mirror ``faults.inject`` — ``nth`` (fire on the
+    N-th pass after arming; default 1 when no trigger is given),
+    ``every`` (a sustained fault storm), ``prob`` + ``seed`` (seeded
+    stochastic faults — still deterministic, the fault point keeps its
+    own ``RandomState``), ``action`` (``"raise"``/``"stall"``/
+    ``"nan"``), ``stall_s`` and ``transient``. Scripts serialize into
+    the trace JSONL as additive ``"chaos"`` records, so a chaos
+    scenario is a replayable artifact exactly like its traffic:
+    same trace + same fleet = byte-identical outcome, twice."""
+
+    point: str
+    at: int
+    clear_at: Optional[int] = None
+    nth: Optional[int] = None
+    every: Optional[int] = None
+    prob: Optional[float] = None
+    seed: int = 0
+    action: Optional[str] = None     # faults.inject default: raise
+    stall_s: Optional[float] = None
+    transient: bool = False
+
+    def __post_init__(self):
+        if not self.point:
+            raise ValueError("ChaosSpec needs an injection point name")
+        if self.at < 0:
+            raise ValueError(f"chaos {self.point!r}: at must be >= 0")
+        if self.clear_at is not None and self.clear_at <= self.at:
+            raise ValueError(
+                f"chaos {self.point!r}: clear_at ({self.clear_at}) "
+                f"must be > at ({self.at})")
+
+    def inject_kwargs(self) -> Dict:
+        """The ``faults.inject`` keyword set this entry arms (defaults
+        to ``nth=1`` when no trigger knob is given)."""
+        kw: Dict = {"seed": self.seed, "transient": self.transient}
+        if self.action is not None:
+            kw["action"] = self.action
+        if self.stall_s is not None:
+            kw["stall_s"] = self.stall_s
+        if self.nth is not None:
+            kw["nth"] = self.nth
+        if self.every is not None:
+            kw["every"] = self.every
+        if self.prob is not None:
+            kw["prob"] = self.prob
+        if self.nth is None and self.every is None and self.prob is None:
+            kw["nth"] = 1
+        return kw
+
+
+@dataclass(frozen=True)
 class WorkloadSpec:
     """The full workload shape :func:`synthesize` expands.
 
@@ -115,7 +173,16 @@ class WorkloadSpec:
     ``ServingEngine.MAX_PREFILL_PROGRAMS``), and the generator models
     that. A ``template_frac`` fraction of prompts start with one of
     ``n_templates`` shared ``template_len``-token prefixes (the
-    prefix-cache exercise); the rest are fully random."""
+    prefix-cache exercise); the rest are fully random.
+
+    A ``sampled_frac`` fraction of requests decode stochastically
+    (``temperature``/``top_p`` — the byte-identity acceptance for
+    chaos scenarios needs sampled streams, greedy ones cannot expose a
+    broken failover key replay); a ``deadline_frac`` fraction carry a
+    ``deadline_iters``-iteration submit→finish budget (a deadline
+    flood = a phase worth of arrivals with tight budgets). ``chaos``
+    is the phase-anchored fault script (:class:`ChaosSpec`), carried
+    into the trace and armed live by :func:`replay`."""
 
     vocab: int
     phases: Tuple[PhaseSpec, ...]
@@ -130,8 +197,21 @@ class WorkloadSpec:
     template_len: int = 8
     template_frac: float = 0.5
     tenants: Tuple[TenantSpec, ...] = (TenantSpec("standard"),)
+    sampled_frac: float = 0.0
+    temperature: float = 0.9
+    top_p: float = 0.95
+    deadline_frac: float = 0.0
+    deadline_iters: int = 0
+    chaos: Tuple[ChaosSpec, ...] = ()
 
     def __post_init__(self):
+        if not 0.0 <= self.sampled_frac <= 1.0:
+            raise ValueError("sampled_frac must be in [0, 1]")
+        if not 0.0 <= self.deadline_frac <= 1.0:
+            raise ValueError("deadline_frac must be in [0, 1]")
+        if self.deadline_frac > 0 and self.deadline_iters < 1:
+            raise ValueError(
+                "deadline_frac > 0 needs deadline_iters >= 1")
         if self.vocab < 3:
             raise ValueError(f"vocab must be >= 3, got {self.vocab}")
         if not self.phases:
@@ -157,7 +237,10 @@ class WorkloadSpec:
 
 @dataclass(frozen=True)
 class TraceRequest:
-    """One materialized request: everything replay needs, explicit."""
+    """One materialized request: everything replay needs, explicit.
+    ``deadline`` is an ITERATION budget (converted to seconds with the
+    replay's ``dt``); ``temperature``/``top_p`` make the stream
+    stochastic (seeded per-request at replay — index = seed)."""
 
     arrival: int                  # engine iteration it becomes visible
     prompt: Tuple[int, ...]
@@ -166,6 +249,9 @@ class TraceRequest:
     priority: int = 1
     phase: str = ""
     template: Optional[int] = None
+    deadline: Optional[int] = None
+    temperature: float = 0.0
+    top_p: float = 1.0
 
 
 @dataclass(frozen=True)
@@ -179,11 +265,15 @@ class PhaseSpan:
 
 @dataclass(frozen=True)
 class Trace:
-    """A replayable workload: requests + phase spans + provenance."""
+    """A replayable workload: requests + phase spans + the chaos
+    script + provenance. The chaos entries ride in the same JSONL
+    artifact as the traffic (additive ``"chaos"`` record type), so a
+    stored chaos scenario is one self-contained file."""
 
     requests: Tuple[TraceRequest, ...]
     phases: Tuple[PhaseSpan, ...]
     meta: Dict = field(default_factory=dict, compare=True)
+    chaos: Tuple[ChaosSpec, ...] = ()
 
     def __len__(self) -> int:
         return len(self.requests)
@@ -193,8 +283,9 @@ class Trace:
     def to_jsonl(self, path: str) -> None:
         """Typed JSONL lines: one ``meta`` header (carries
         ``schema_version`` + provenance), one ``phase`` line per span,
-        one ``request`` line per request. Additive record types under
-        the exporter forward-compat contract."""
+        one ``chaos`` line per fault-script entry, one ``request`` line
+        per request. Additive record types under the exporter
+        forward-compat contract."""
         with open(path, "w") as f:
             f.write(json.dumps(
                 {"type": "meta", "seq": 0,
@@ -205,13 +296,23 @@ class Trace:
                 f.write(json.dumps(
                     {"type": "phase", "seq": 0, "name": p.name,
                      "start": p.start, "end": p.end}) + "\n")
-            for i, r in enumerate(self.requests):
+            for c in self.chaos:
                 f.write(json.dumps(
-                    {"type": "request", "seq": 0, "i": i,
-                     "arrival": r.arrival, "prompt": list(r.prompt),
-                     "max_new_tokens": r.max_new_tokens,
-                     "tenant": r.tenant, "priority": r.priority,
-                     "phase": r.phase, "template": r.template}) + "\n")
+                    {"type": "chaos", "seq": 0, **asdict(c)}) + "\n")
+            for i, r in enumerate(self.requests):
+                rec = {"type": "request", "seq": 0, "i": i,
+                       "arrival": r.arrival, "prompt": list(r.prompt),
+                       "max_new_tokens": r.max_new_tokens,
+                       "tenant": r.tenant, "priority": r.priority,
+                       "phase": r.phase, "template": r.template}
+                # additive keys, written only when non-default so old
+                # traces byte-compare against re-serialized ones
+                if r.deadline is not None:
+                    rec["deadline"] = r.deadline
+                if r.temperature:
+                    rec["temperature"] = r.temperature
+                    rec["top_p"] = r.top_p
+                f.write(json.dumps(rec) + "\n")
 
     @classmethod
     def from_jsonl(cls, path: str) -> "Trace":
@@ -220,6 +321,7 @@ class Trace:
         ``exporters.read_jsonl``)."""
         meta: Dict = {}
         phases: List[PhaseSpan] = []
+        chaos: List[ChaosSpec] = []
         reqs: List[Tuple[int, TraceRequest]] = []
         with open(path) as f:
             for line in f:
@@ -235,6 +337,12 @@ class Trace:
                 elif t == "phase":
                     phases.append(PhaseSpan(rec["name"], rec["start"],
                                             rec["end"]))
+                elif t == "chaos":
+                    # unknown keys skipped: additive chaos-record
+                    # fields must not break old readers
+                    known = {f.name for f in fields(ChaosSpec)}
+                    chaos.append(ChaosSpec(**{
+                        k: v for k, v in rec.items() if k in known}))
                 elif t == "request":
                     reqs.append((rec["i"], TraceRequest(
                         arrival=rec["arrival"],
@@ -243,10 +351,14 @@ class Trace:
                         tenant=rec.get("tenant", "standard"),
                         priority=rec.get("priority", 1),
                         phase=rec.get("phase", ""),
-                        template=rec.get("template"))))
+                        template=rec.get("template"),
+                        deadline=rec.get("deadline"),
+                        temperature=rec.get("temperature", 0.0),
+                        top_p=rec.get("top_p", 1.0))))
         reqs.sort(key=lambda p: p[0])
         return cls(requests=tuple(r for _, r in reqs),
-                   phases=tuple(phases), meta=meta)
+                   phases=tuple(phases), meta=meta,
+                   chaos=tuple(chaos))
 
 
 def synthesize(spec: WorkloadSpec, seed: int = 0) -> Trace:
@@ -294,20 +406,34 @@ def synthesize(spec: WorkloadSpec, seed: int = 0) -> Trace:
                                         size=total).tolist()
                 out_len = _length(spec.output_median, spec.output_sigma,
                                   1, spec.output_max, quantize=False)
+                # conditional draws: with the fractions at their 0.0
+                # defaults the RandomState stream is untouched, so
+                # pre-existing (spec, seed) pairs keep their traces
+                temp, top_p = 0.0, 1.0
+                if spec.sampled_frac > 0 and \
+                        rs.random_sample() < spec.sampled_frac:
+                    temp, top_p = spec.temperature, spec.top_p
+                deadline = None
+                if spec.deadline_frac > 0 and \
+                        rs.random_sample() < spec.deadline_frac:
+                    deadline = spec.deadline_iters
                 requests.append(TraceRequest(
                     arrival=it0 + i, prompt=tuple(prompt),
                     max_new_tokens=out_len, tenant=tenant.name,
                     priority=tenant.priority, phase=ph.name,
-                    template=tid))
+                    template=tid, deadline=deadline,
+                    temperature=temp, top_p=top_p))
         phases.append(PhaseSpan(ph.name, it0, it0 + ph.duration))
         it0 += ph.duration
     meta = {"seed": int(seed), "vocab": spec.vocab,
             "total_iterations": spec.total_iterations,
             "spec": {**asdict(spec),
                      "phases": [asdict(p) for p in spec.phases],
-                     "tenants": [asdict(t) for t in spec.tenants]}}
+                     "tenants": [asdict(t) for t in spec.tenants],
+                     "chaos": [asdict(c) for c in spec.chaos]}}
     return Trace(requests=tuple(requests), phases=tuple(phases),
-                 meta=meta)
+                 meta=meta, chaos=tuple(sorted(
+                     spec.chaos, key=lambda c: (c.at, c.point))))
 
 
 def diurnal_burst_scenario(vocab: int, *, scale: float = 1.0,
@@ -341,6 +467,44 @@ def diurnal_burst_scenario(vocab: int, *, scale: float = 1.0,
             TenantSpec("interactive", weight=3.0, priority=0),
             TenantSpec("standard", weight=6.0, priority=1),
             TenantSpec("batch", weight=1.0, priority=2)))
+
+
+def flash_crowd_chaos_scenario(vocab: int, *, scale: float = 1.0,
+                               prompt_max: int = 24, output_max: int = 12,
+                               length_quantum: int = 8,
+                               kill_at: Optional[int] = None,
+                               sampled_frac: float = 0.5
+                               ) -> WorkloadSpec:
+    """THE fixed chaos reference scenario (``bench.py --model
+    autoscale`` + tier-1): warm-up to steady state, a flash crowd with
+    a scripted ``replica.die`` mid-crowd (``kill_at`` defaults to the
+    crowd's first third), then recovery and cooldown — the overload
+    and the capacity loss land TOGETHER, which is exactly when an
+    autoscaler must not flap. Half the streams sample stochastically
+    so failover byte-identity is actually exercised."""
+    s = float(scale)
+    warm, steady, crowd = 30, 30, 30
+    if kill_at is None:
+        kill_at = warm + steady + crowd // 3
+    return WorkloadSpec(
+        vocab=vocab,
+        phases=(
+            PhaseSpec("warmup", warm, rate=0.20 * s, shape="ramp",
+                      rate0=0.02 * s),
+            PhaseSpec("steady", steady, rate=0.25 * s),
+            PhaseSpec("flash", crowd, rate=2.00 * s),
+            PhaseSpec("recovery", 40, rate=0.20 * s),
+            PhaseSpec("cooldown", 30, rate=0.04 * s, shape="ramp",
+                      rate0=0.20 * s),
+        ),
+        prompt_median=10.0, prompt_sigma=0.5, prompt_max=prompt_max,
+        output_median=6.0, output_sigma=0.5, output_max=output_max,
+        length_quantum=length_quantum,
+        n_templates=2, template_len=min(8, prompt_max - length_quantum),
+        template_frac=0.5, sampled_frac=sampled_frac,
+        tenants=(TenantSpec("interactive", weight=3.0, priority=0),
+                 TenantSpec("standard", weight=6.0, priority=1)),
+        chaos=(ChaosSpec("replica.die", at=int(kill_at)),))
 
 
 # --- deterministic replay ---------------------------------------------------
@@ -398,6 +562,14 @@ class ReplayResult:
     engine_ids: List[str]
     timeseries: Dict[str, TimeSeries]
     slo: Dict[str, Optional[SLOEngine]]
+    #: chaos triggers observed live: {"t", "iteration", "point"} per
+    #: firing (the recovery report's incident anchors)
+    incidents: List[Dict] = field(default_factory=list)
+    #: fleet-size census at t=0 and after every fleet mutation:
+    #: {"t", "iteration", "total", "serving", ...} (router targets)
+    fleet_timeline: List[Dict] = field(default_factory=list)
+    #: autoscale decisions stamped with virtual time as they appeared
+    autoscale_events: List[Dict] = field(default_factory=list)
 
     @property
     def totals(self) -> Dict[str, int]:
@@ -434,8 +606,21 @@ def replay(trace: Trace, target, *,
 
     Arrivals submit when the iteration clock reaches their trace
     iteration; an ``AdmissionRejected`` records the request as shed.
-    Idle gaps fast-forward (no empty stepping). After the last phase
-    the fleet drains, reported as the synthetic ``(drain)`` phase."""
+    Idle gaps fast-forward (no empty stepping — but never past a
+    scripted chaos iteration). After the last phase the fleet drains,
+    reported as the synthetic ``(drain)`` phase.
+
+    Chaos scenarios: the trace's :class:`ChaosSpec` entries arm their
+    ``resilience.faults`` points when the iteration cursor reaches
+    ``at`` (disarmed at ``clear_at`` / on exit), every trigger firing
+    is recorded as an incident ``{"t", "iteration", "point"}``, and —
+    fleet targets — the replay follows mutations the fleet makes to
+    itself: replicas an ``AutoscaleController`` adds mid-replay are
+    put on the same virtual clock the seed fleet records on, dead
+    replicas stop being flushed, the fleet-size census lands in
+    ``fleet_timeline`` and controller decisions in
+    ``autoscale_events``. Everything is anchored to the iteration
+    cursor, so a chaos scenario replays byte-identically twice."""
     fleet = hasattr(target, "replicas")
     # report keys must be identical across two replays of the same
     # scenario, but the obs component registry appends an object-id
@@ -445,16 +630,18 @@ def replay(trace: Trace, target, *,
     def _stable(name: str) -> str:
         return name.split("[", 1)[0].split("#", 1)[0]
 
-    engines: Dict[str, "object"] = {}
-    pairs = ([(r.name, r.engine) for r in target.replicas] if fleet
-             else [(target.engine_id, target)])
-    for name, eng in pairs:
-        key = _stable(name)
-        engines[name if key in engines else key] = eng
     clock = IterationClock(dt)
+    engines: Dict[str, "object"] = {}
     tseries: Dict[str, TimeSeries] = {}
     slos: Dict[str, Optional[SLOEngine]] = {}
-    for eid, eng in engines.items():
+    known_ids: set = set()
+
+    def _install(eid: str, eng) -> None:
+        """Put one engine on the virtual clock: fresh metrics window,
+        clock-matched scraper, per-engine SLO engine. Also runs for
+        replicas a controller adds MID-replay, so an autoscaled-up
+        engine records on the same deterministic clock as the seed
+        fleet."""
         eng.metrics = ServingMetrics(clock=clock)
         ts = TimeSeries(
             (lambda e=eng: e.metrics.registry),
@@ -466,6 +653,14 @@ def replay(trace: Trace, target, *,
                if objectives else None)
         eng.slo = slo
         slos[eid] = slo
+        engines[eid] = eng
+        known_ids.add(id(eng))
+
+    pairs = ([(r.name, r.engine) for r in target.replicas] if fleet
+             else [(target.engine_id, target)])
+    for name, eng in pairs:
+        key = _stable(name)
+        _install(name if key in engines else key, eng)
 
     def _busy() -> bool:
         if fleet:
@@ -494,9 +689,17 @@ def replay(trace: Trace, target, *,
 
     def _submit(idx: int, tr: TraceRequest) -> None:
         prompt = np.asarray(tr.prompt, np.int32)
+        kw: Dict = {}
+        if tr.deadline is not None:
+            # iteration budget -> virtual seconds; the router carries
+            # the REMAINING budget across any mid-flight moves
+            kw["deadline_s"] = tr.deadline * dt
+        if tr.temperature:
+            kw["temperature"] = tr.temperature
+            kw["top_p"] = tr.top_p
         try:
             rid = target.submit(prompt, tr.max_new_tokens,
-                                priority=tr.priority, seed=idx)
+                                priority=tr.priority, seed=idx, **kw)
         except AdmissionRejected:
             outcomes[idx]["state"] = "shed"
             return
@@ -514,14 +717,20 @@ def replay(trace: Trace, target, *,
             o["state"] = req.state.name.lower()
             o["n_tokens"] = len(req.generated)
             o["tokens_crc"] = _token_crc(req.tokens)
+            o["failovers"] = getattr(req, "n_failovers", 0)
+            o["handoffs"] = getattr(req, "n_handoffs", 0)
 
     def _close_phase(name: str, start: int, end: int,
                      t0: float, submitted_slice) -> PhaseResult:
         res = PhaseResult(name=name, start=start, end=end,
                           t0=t0, t1=clock())
         for eid, eng in engines.items():
-            eng._flush_pending()
-            eng._flush_host_window()
+            if id(eng) not in dead_ids:
+                # a chaos-killed engine is never flushed (its pipeline
+                # died mid-step); its last-scraped window still
+                # summarizes below
+                eng._flush_pending()
+                eng._flush_host_window()
             if eng.timeseries is not None:
                 eng.timeseries.sample(iteration=end)
             win = eng.metrics
@@ -541,56 +750,164 @@ def replay(trace: Trace, target, *,
                 res.submitted += 1
         return res
 
+    # -- chaos script + recovery bookkeeping -----------------------------
+    if fleet:
+        from distkeras_tpu.serving.router.replica import ReplicaState
+    dead_ids: set = set()
+    incidents: List[Dict] = []
+    fleet_timeline: List[Dict] = []
+    autoscale_events: List[Dict] = []
+    chaos = sorted(trace.chaos, key=lambda c: (c.at, c.point))
+    armed: List[ChaosSpec] = []
+    pending_clears: List[ChaosSpec] = []
+    chaos_i = 0
+    cur_it = [0]                    # listener needs the live cursor
+
+    def _on_trigger(point: str) -> None:
+        incidents.append({"t": clock(), "iteration": cur_it[0],
+                          "point": point})
+
+    def _chaos_tick(i: int) -> None:
+        """Arm every script entry whose iteration has arrived; disarm
+        expired storms. Arming is anchored to the ITERATION CURSOR —
+        pure virtual time — so two replays arm identically."""
+        nonlocal chaos_i
+        while chaos_i < len(chaos) and chaos[chaos_i].at <= i:
+            c = chaos[chaos_i]
+            faults.inject(c.point, **c.inject_kwargs())
+            armed.append(c)
+            if c.clear_at is not None:
+                pending_clears.append(c)
+            chaos_i += 1
+        for c in list(pending_clears):
+            if c.clear_at <= i:
+                faults.clear(c.point)
+                pending_clears.remove(c)
+
+    def _next_chaos_event(after: int) -> Optional[int]:
+        cands = ([chaos[chaos_i].at] if chaos_i < len(chaos) else []) \
+            + [c.clear_at for c in pending_clears]
+        return min((x for x in cands if x > after), default=None)
+
+    def _find_decisions(t):
+        ctl = getattr(t, "controller", None)
+        if ctl is None:
+            return None
+        if hasattr(ctl, "decisions"):
+            return ctl.decisions
+        for c in getattr(ctl, "controllers", ()):
+            if hasattr(c, "decisions"):
+                return c.decisions
+        return None
+
+    ctl_decisions = _find_decisions(target) if fleet else None
+    decisions_seen = len(ctl_decisions) if ctl_decisions else 0
+    fleet_ver = [getattr(target, "_fleet_version", 0)] if fleet else [0]
+    if fleet:
+        fleet_timeline.append({"t": clock(), "iteration": 0,
+                               **target.fleet_counts()})
+
+    def _post_step(i: int) -> None:
+        """After every fleet step: mark newly-dead engines (they are
+        never flushed again), install virtual-clock instrumentation on
+        replicas a controller just added, extend the fleet-size
+        timeline, and timestamp fresh autoscale decisions."""
+        nonlocal decisions_seen
+        if not fleet:
+            return
+        for r in target.replicas:
+            if r.state is ReplicaState.DEAD:
+                dead_ids.add(id(r.engine))
+        if target._fleet_version != fleet_ver[0]:
+            fleet_ver[0] = target._fleet_version
+            for r in target.replicas:
+                if id(r.engine) in known_ids:
+                    continue
+                key = _stable(r.name)
+                _install(r.name if key in engines else key, r.engine)
+            fleet_timeline.append({"t": clock(), "iteration": i,
+                                   **target.fleet_counts()})
+        if ctl_decisions is not None:
+            while decisions_seen < len(ctl_decisions):
+                d = dict(ctl_decisions[decisions_seen])
+                d["t"] = clock()
+                d["iteration"] = i
+                autoscale_events.append(d)
+                decisions_seen += 1
+
     phase_results: List[PhaseResult] = []
     next_i = 0                      # cursor into arrival-sorted reqs
     it = 0
     budget = (max_steps if max_steps is not None
               else trace.meta.get("total_iterations", 0) * 50 + 20000)
     steps = 0
-    for span in trace.phases:
+    faults.add_trigger_listener(_on_trigger)
+    try:
+        for span in trace.phases:
+            t0 = clock()
+            lo_i = next_i
+            while it < span.end:
+                cur_it[0] = it
+                _chaos_tick(it)
+                while next_i < len(reqs) and \
+                        reqs[next_i][1].arrival <= it:
+                    idx, tr = reqs[next_i]
+                    _submit(idx, tr)
+                    next_i += 1
+                if _busy():
+                    _consume(target.step())
+                    _post_step(it)
+                    steps += 1
+                    if steps > budget:
+                        raise RuntimeError(
+                            f"replay exceeded {budget} steps (phase "
+                            f"{span.name!r}, iteration {it}) — engine "
+                            "not draining?")
+                    clock.advance()
+                    it += 1
+                else:
+                    # idle fast-forward to the next arrival, chaos
+                    # event or phase end — a jump must never skip a
+                    # scripted arming iteration
+                    nxt = (reqs[next_i][1].arrival
+                           if next_i < len(reqs) else span.end)
+                    ce = _next_chaos_event(it)
+                    if ce is not None:
+                        nxt = min(nxt, ce)
+                    jump = max(1, min(nxt, span.end) - it)
+                    clock.advance(jump)
+                    it += jump
+            phase_results.append(_close_phase(
+                span.name, span.start, span.end, t0,
+                [outcomes[i] for i, _ in reqs[lo_i:next_i]]))
+        # drain tail: everything still in flight finishes here
         t0 = clock()
-        lo_i = next_i
-        while it < span.end:
-            while next_i < len(reqs) and \
-                    reqs[next_i][1].arrival <= it:
-                idx, tr = reqs[next_i]
-                _submit(idx, tr)
-                next_i += 1
-            if _busy():
-                _consume(target.step())
-                steps += 1
-                if steps > budget:
-                    raise RuntimeError(
-                        f"replay exceeded {budget} steps (phase "
-                        f"{span.name!r}, iteration {it}) — engine "
-                        "not draining?")
-                clock.advance()
-                it += 1
-            else:
-                # idle fast-forward to the next arrival (or phase end)
-                nxt = (reqs[next_i][1].arrival
-                       if next_i < len(reqs) else span.end)
-                jump = max(1, min(nxt, span.end) - it)
-                clock.advance(jump)
-                it += jump
-        phase_results.append(_close_phase(
-            span.name, span.start, span.end, t0,
-            [outcomes[i] for i, _ in reqs[lo_i:next_i]]))
-    # drain tail: everything still in flight finishes here
-    t0 = clock()
-    start = it
-    while _busy():
-        _consume(target.step())
-        steps += 1
-        if steps > budget:
-            raise RuntimeError(
-                f"replay drain exceeded {budget} steps — engine "
-                "not draining?")
-        clock.advance()
-        it += 1
-    if it > start or any(o["state"] == "submitted" for o in outcomes):
-        phase_results.append(_close_phase("(drain)", start, it, t0, []))
+        start = it
+        while _busy():
+            cur_it[0] = it
+            _chaos_tick(it)
+            _consume(target.step())
+            _post_step(it)
+            steps += 1
+            if steps > budget:
+                raise RuntimeError(
+                    f"replay drain exceeded {budget} steps — engine "
+                    "not draining?")
+            clock.advance()
+            it += 1
+        if it > start or any(o["state"] == "submitted"
+                             for o in outcomes):
+            phase_results.append(
+                _close_phase("(drain)", start, it, t0, []))
+    finally:
+        # leave no script entry armed past the replay (the process
+        # global fault table outlives this function)
+        for c in armed:
+            faults.clear(c.point)
+        faults.remove_trigger_listener(_on_trigger)
     return ReplayResult(
         trace=trace, phases=phase_results, outcomes=outcomes,
         iterations=it, dt=dt, fleet=fleet,
-        engine_ids=list(engines), timeseries=tseries, slo=slos)
+        engine_ids=list(engines), timeseries=tseries, slo=slos,
+        incidents=incidents, fleet_timeline=fleet_timeline,
+        autoscale_events=autoscale_events)

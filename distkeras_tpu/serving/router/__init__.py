@@ -12,17 +12,22 @@ SLO-burn-driven drain (ROADMAP item 2; docs/serving.md §Router).
                    surface over the fleet, prefill→decode handoff via
                    the engine's ``transfer_out``/``transfer_in``
                    re-entry path, replica-death mass failover with
-                   seed-replayed sampling keys
+                   seed-replayed sampling keys, and the elastic
+                   surface (``add_replica``/``remove_replica`` with
+                   drain→rebalance→retire semantics)
     controller.py  ``SLOBurnController`` — drain replicas burning
                    their SLO error budget, rebalance their queues,
-                   resume on recovery
+                   resume on recovery; ``AutoscaleController`` — grow
+                   the fleet on sustained burn/queue-growth/shed,
+                   shrink it on sustained idleness (hysteresis +
+                   cool-downs); ``ControllerChain`` composes them
 
 Everything the router does preserves the oracle contract: tokens are
 identical (byte-identical sampled) to a single engine / ``generate()``.
 """
 
 from distkeras_tpu.serving.router.controller import (  # noqa: F401
-    SLOBurnController)
+    AutoscaleController, ControllerChain, SLOBurnController)
 from distkeras_tpu.serving.router.policies import (  # noqa: F401
     LeastLoaded, PlacementPolicy, PrefixAffinity)
 from distkeras_tpu.serving.router.replica import (  # noqa: F401

@@ -1,33 +1,62 @@
-"""SLO-burn drain controller: the pressure loop that turns per-replica
-SLO burn rates (``obs.slo``) into fleet actions.
+"""Fleet controllers: the pressure loops that turn live telemetry into
+fleet actions.
 
 The single-engine degradation story ends at ``health() ==
-"degraded"`` — a probe's hint. With a fleet there is a real action to
-take: a replica burning its error budget faster than
-``drain_above`` stops taking traffic (``drain()`` — in-flight streams
-finish, queued work is rebalanced onto the rest of the fleet through
-the token-identical transfer path) and returns to service once its
-burn has recovered below ``resume_below`` (hysteresis, so a replica
-hovering at the threshold does not flap). ``min_serving`` replicas are
-always left serving — draining the whole fleet is worse than serving
-degraded.
+"degraded"`` — a probe's hint. With a fleet there are real actions to
+take, and this module holds both loops:
 
-Wire it with ``router.attach_controller(ctl)`` (ticked every
-``Router._CTL_EVERY`` steps) or call ``tick()`` on your own cadence.
-Burn rates come from each replica's own ``SLOEngine``
+* ``SLOBurnController`` — *quality* pressure: a replica burning its
+  error budget faster than ``drain_above`` stops taking traffic
+  (``drain()`` — in-flight streams finish, queued work is rebalanced
+  onto the rest of the fleet through the token-identical transfer
+  path) and returns to service once its burn has recovered below
+  ``resume_below`` (hysteresis, so a replica hovering at the threshold
+  does not flap). ``min_serving`` replicas are always left serving —
+  draining the whole fleet is worse than serving degraded.
+
+* ``AutoscaleController`` — *capacity* pressure: sustained SLO burn,
+  monotone queue growth or shed onset grows the fleet
+  (``Router.add_replica``); sustained whole-fleet idleness shrinks it
+  (``remove_replica`` → drain → retire). Cool-downs and sustain
+  windows keep it from flapping; every decision is counted and
+  ring-recorded. See the class docstring for the state machine.
+
+Wire one with ``router.attach_controller(ctl)`` (ticked every
+``Router._CTL_EVERY`` steps), both with ``ControllerChain`` (burn
+first — drain-for-burn beats scale-down), or call ``tick()`` on your
+own cadence. Burn rates come from each replica's own ``SLOEngine``
 (``ServingEngine(slo=[...])``); replicas without objectives are left
 alone.
 """
 
 from __future__ import annotations
 
-from typing import Dict, Optional
+from typing import Dict, List, Optional
 
 from distkeras_tpu import obs
 from distkeras_tpu.obs.recorder import resolve_recorder
+from distkeras_tpu.obs.report import _detect_growth
 from distkeras_tpu.serving.router.replica import ReplicaState
 
-__all__ = ["SLOBurnController"]
+__all__ = ["AutoscaleController", "ControllerChain", "SLOBurnController"]
+
+
+class ControllerChain:
+    """Drive several controllers from the router's single
+    ``attach_controller`` slot, in construction order. Put the
+    ``SLOBurnController`` before the ``AutoscaleController``: its
+    drains land first, and the autoscaler's same-tick ``draining``
+    guard then defers scale-down — drain-for-burn beats scale-down by
+    construction."""
+
+    def __init__(self, *controllers):
+        self.controllers = list(controllers)
+
+    def tick(self) -> Dict[str, str]:
+        out: Dict[str, str] = {}
+        for c in self.controllers:
+            out.update(c.tick() or {})
+        return out
 
 
 class SLOBurnController:
@@ -98,7 +127,11 @@ class SLOBurnController:
                 self.router.rebalance_queued(r)
         for r in self.router.replicas:
             if r.state is not ReplicaState.DRAINING \
-                    or not self._drained.get(r.name):
+                    or not self._drained.get(r.name) \
+                    or r.retiring:
+                # a retiring replica is leaving the fleet (scale-down /
+                # remove_replica): resuming it would race the retire
+                # sweep — one replica cannot be both drained and retired
                 continue
             burn = self._recovered_burn(r)
             if burn is not None and burn > self.resume_below:
@@ -122,3 +155,241 @@ class SLOBurnController:
         breach samples age out of a swapped window or the burn math
         recovers."""
         return replica.slo_burn()
+
+
+class AutoscaleController:
+    """Closed-loop fleet sizing: live saturation signals in,
+    ``Router.add_replica``/``remove_replica`` out.
+
+    One ``tick()`` (wire with ``router.attach_controller`` or compose
+    under a multiplexer with ``SLOBurnController``) evaluates three
+    scale-up signals over the SERVING, non-retiring fleet —
+
+    * **SLO burn**: any replica's live max burn rate (side-effect-free
+      ``slo_burn()``) above ``scale_up_burn``;
+    * **queue growth**: the fleet-total queue depth sampled every tick
+      shows a sustained monotone rise (the exact
+      ``obs.report._detect_growth`` predicate the post-hoc saturation
+      panel uses, evaluated live over the controller's own window);
+    * **shed onset**: the router rejected a request since the last tick
+      (fleet-wide shed — every replica refused).
+
+    A signal must persist for ``up_sustain`` consecutive ticks before a
+    scale-up fires (``factory()`` → ``add_replica``); a whole-fleet
+    idle reading (zero queued, zero occupied) must persist for
+    ``idle_sustain`` ticks before a scale-down retires one replica,
+    preferring the replicas this controller added (LIFO) so the fleet
+    relaxes back to its seed shape. After any action the controller
+    holds for ``cooldown`` ticks. ``min_serving``/``max_replicas``
+    bound the fleet; an action wanted but denied (bounds, cooldown, or
+    a drain-for-burn in progress — drain beats scale-down, one replica
+    is never both drained and retired) is counted and ring-recorded as
+    ``blocked``. DEAD replicas are garbage-collected through
+    ``remove_replica`` every tick.
+
+    Determinism: decisions depend only on tick-ordered fleet state —
+    no wall clock — and each one is appended to ``decisions`` stamped
+    with the router step, so a seeded replay reproduces the decision
+    log byte-identically. Counters: ``autoscale.scale_up`` /
+    ``autoscale.scale_down`` / ``autoscale.blocked``.
+    """
+
+    #: queue-depth samples kept for the growth predicate
+    _QWINDOW = 16
+
+    def __init__(self, router, factory, *, min_serving: int = 1,
+                 max_replicas: int = 4, scale_up_burn: float = 2.0,
+                 up_sustain: int = 2, idle_sustain: int = 4,
+                 cooldown: int = 4, growth_min_run: int = 3,
+                 growth_min_rise: float = 1.0,
+                 burn_controller: Optional[SLOBurnController] = None,
+                 gc_dead: bool = True):
+        if min_serving < 1:
+            raise ValueError(
+                f"min_serving must be >= 1, got {min_serving}")
+        if max_replicas < min_serving:
+            raise ValueError(
+                f"max_replicas ({max_replicas}) must be >= "
+                f"min_serving ({min_serving})")
+        if up_sustain < 1 or idle_sustain < 1:
+            raise ValueError("sustain windows must be >= 1")
+        self.router = router
+        self.factory = factory
+        self.min_serving = int(min_serving)
+        self.max_replicas = int(max_replicas)
+        self.scale_up_burn = float(scale_up_burn)
+        self.up_sustain = int(up_sustain)
+        self.idle_sustain = int(idle_sustain)
+        self.cooldown = int(cooldown)
+        self.growth_min_run = int(growth_min_run)
+        self.growth_min_rise = float(growth_min_rise)
+        self.burn_controller = burn_controller
+        self.gc_dead = bool(gc_dead)
+        self.recorder = resolve_recorder()
+        reg = obs.get_registry()
+        self._c_up = reg.counter("autoscale.scale_up")
+        self._c_down = reg.counter("autoscale.scale_down")
+        self._c_blocked = reg.counter("autoscale.blocked")
+        #: decision log: dicts with step/action/replica/reason —
+        #: deterministic under the virtual clock (replay's oracle)
+        self.decisions: List[Dict] = []
+        self._qhist: List[float] = []
+        self._ticks = 0
+        self._cool_until = 0
+        self._up_streak = 0
+        self._idle_streak = 0
+        self._last_shed = router.counters().get("rejected", 0)
+        #: names this controller added, LIFO scale-down preference
+        self._added: List[str] = []
+
+    # -- signal plumbing ---------------------------------------------------
+
+    def _serving(self):
+        return [r for r in self.router.replicas
+                if r.state is ReplicaState.SERVING and not r.retiring]
+
+    def _live_size(self) -> int:
+        """Replicas that count against ``max_replicas``: everything
+        not dead and not on its way out."""
+        return sum(1 for r in self.router.replicas
+                   if r.state is not ReplicaState.DEAD
+                   and not r.retiring)
+
+    def signals(self) -> Dict:
+        """The live saturation read (also handy for dashboards): burn,
+        queue-growth and shed-onset inputs plus the raw numbers they
+        came from. Pure observation — no fleet mutation."""
+        serving = self._serving()
+        burns = [b for b in (r.slo_burn() for r in serving)
+                 if b is not None]
+        burn = max(burns, default=None)
+        qd = float(sum(r.queue_depth for r in serving))
+        occ = sum(r.occupied for r in serving)
+        shed_now = self.router.counters().get("rejected", 0)
+        shed_delta = shed_now - self._last_shed
+        growth = _detect_growth(self._qhist + [qd],
+                                min_run=self.growth_min_run,
+                                min_rise=self.growth_min_rise)
+        return {
+            "burn": burn, "queue_depth": qd, "occupied": occ,
+            "shed_delta": shed_delta, "queue_growth": growth,
+            "overload": ((burn is not None and burn > self.scale_up_burn)
+                         or shed_delta > 0 or growth),
+            "idle": qd == 0 and occ == 0,
+        }
+
+    # -- the control pass --------------------------------------------------
+
+    def tick(self) -> Dict[str, str]:
+        """One control pass; returns ``{replica name: action}`` for
+        fleet mutations made (``"add"`` / ``"remove"`` / ``"gc"``)."""
+        actions: Dict[str, str] = {}
+        router = self.router
+        if self.gc_dead:
+            for rep in list(router.replicas):
+                if rep.state is ReplicaState.DEAD and not rep.retiring:
+                    router.remove_replica(rep.name)
+                    self._decide("gc", rep.name, "dead")
+                    actions[rep.name] = "gc"
+        sig = self.signals()
+        self._last_shed = router.counters().get("rejected", 0)
+        self._qhist.append(sig["queue_depth"])
+        if len(self._qhist) > self._QWINDOW:
+            del self._qhist[:len(self._qhist) - self._QWINDOW]
+        self._up_streak = self._up_streak + 1 if sig["overload"] else 0
+        self._idle_streak = self._idle_streak + 1 if sig["idle"] else 0
+        self._ticks += 1
+        if self._up_streak >= self.up_sustain:
+            self._scale_up(sig, actions)
+        elif self._idle_streak >= self.idle_sustain:
+            self._scale_down(sig, actions)
+        return actions
+
+    def _reason(self, sig: Dict) -> str:
+        if sig["burn"] is not None and sig["burn"] > self.scale_up_burn:
+            return f"burn:{sig['burn']:.2f}"
+        if sig["shed_delta"] > 0:
+            return f"shed:{sig['shed_delta']}"
+        if sig["queue_growth"]:
+            return "queue_growth"
+        return "idle"
+
+    def _decide(self, action: str, replica: Optional[str],
+                reason: str) -> None:
+        self.decisions.append({
+            "step": self.router._steps, "tick": self._ticks,
+            "action": action, "replica": replica, "reason": reason})
+        if self.recorder.enabled:
+            self.recorder.record(
+                "autoscale.decision", action=action, replica=replica,
+                reason=reason, fleet=len(self.router.replicas))
+
+    def _blocked(self, wanted: str, reason: str) -> None:
+        self._c_blocked.inc()
+        self._decide("blocked", None, f"{wanted}:{reason}")
+        # re-arm: the sustain window must refill before the next
+        # attempt, so a standing blocker yields a bounded decision log
+        # instead of one blocked entry per tick
+        self._up_streak = 0
+        self._idle_streak = 0
+
+    def _scale_up(self, sig: Dict, actions: Dict[str, str]) -> None:
+        reason = self._reason(sig)
+        if self._ticks < self._cool_until:
+            self._blocked("scale_up", "cooldown")
+            return
+        if self._live_size() >= self.max_replicas:
+            self._blocked("scale_up", "max_replicas")
+            return
+        rep = self.router.add_replica(self.factory)
+        self._added.append(rep.name)
+        self._c_up.inc(replica=rep.name)
+        self._decide("scale_up", rep.name, reason)
+        actions[rep.name] = "add"
+        self._up_streak = 0
+        self._idle_streak = 0
+        self._cool_until = self._ticks + self.cooldown
+
+    def _scale_down(self, sig: Dict, actions: Dict[str, str]) -> None:
+        if self._ticks < self._cool_until:
+            self._blocked("scale_down", "cooldown")
+            return
+        serving = self._serving()
+        if len(serving) <= self.min_serving:
+            self._blocked("scale_down", "min_serving")
+            return
+        if any(r.state is ReplicaState.DRAINING and not r.retiring
+               for r in self.router.replicas):
+            # drain-for-burn in progress: the burn controller owns that
+            # replica's fate (resume or operator removal) — shrinking
+            # the serving pool underneath it double-counts the same
+            # pressure relief
+            self._blocked("scale_down", "draining")
+            return
+        victim = None
+        names = {r.name: r for r in serving}
+        for name in reversed(self._added):        # LIFO: newest first
+            if name in names:
+                victim = names[name]
+                break
+        if victim is None:
+            # no controller-added replica left: deterministic fallback,
+            # lexicographically last name (stable across replays)
+            victim = max(serving, key=lambda r: r.name)
+        self.router.remove_replica(victim.name)
+        if victim.name in self._added:
+            self._added.remove(victim.name)
+        self._c_down.inc(replica=victim.name)
+        self._decide("scale_down", victim.name, "idle")
+        actions[victim.name] = "remove"
+        self._up_streak = 0
+        self._idle_streak = 0
+        self._cool_until = self._ticks + self.cooldown
+
+    def counts(self) -> Dict[str, int]:
+        """Plain decision totals for bench JSON (the registry carries
+        the same series for exporters)."""
+        out = {"scale_up": 0, "scale_down": 0, "blocked": 0, "gc": 0}
+        for d in self.decisions:
+            out[d["action"]] = out.get(d["action"], 0) + 1
+        return out

@@ -102,6 +102,12 @@ class EngineReplica:
         self.error: Optional[BaseException] = None
         #: fleet steps this replica has taken (telemetry)
         self.steps = 0
+        #: marked by ``Router.remove_replica``: the retire sweep pops
+        #: this replica from the fleet once it drains empty. Controllers
+        #: must treat a retiring replica as leaving — never resume it
+        #: (``SLOBurnController`` skips it) and never count it toward
+        #: serving capacity (``AutoscaleController`` does not).
+        self.retiring = False
 
     def __repr__(self):
         return (f"EngineReplica({self.name!r}, role={self.role!r}, "
@@ -112,10 +118,13 @@ class EngineReplica:
     def start(self) -> None:
         """STARTING/DRAINING → SERVING (idempotent; dead replicas stay
         dead — build a new replica instead of resurrecting state the
-        failover already re-homed)."""
+        failover already re-homed). An explicit ``start()`` also
+        cancels a pending retirement — the operator's resume beats the
+        router's scheduled removal."""
         if self.state is ReplicaState.DEAD:
             raise ReplicaDead(self.name, self.error)
         self.state = ReplicaState.SERVING
+        self.retiring = False
 
     def drain(self) -> None:
         """Close admission; in-flight streams keep stepping to

@@ -31,6 +31,18 @@ of ``EngineReplica``s, adding the three fleet-only behaviors:
   finish; the ``SLOBurnController`` drives drains from SLO burn rates
   and rebalances queued work off draining replicas.
 
+* **Elasticity** (``add_replica``/``remove_replica``): the fleet grows
+  and shrinks mid-flight. Removal is drain → rebalance queued → retire
+  once empty (the end-of-step sweep), DEAD replicas garbage-collect
+  through the same retiring path, and every mutation lands in
+  ``fleet_events`` + the ``router.fleet_size`` gauge so the recovery
+  report can draw the fleet-size timeline. ``AutoscaleController``
+  closes the loop: live burn/queue-growth/shed signals in,
+  ``add_replica``/``remove_replica`` out, with hysteresis and
+  cool-downs. Deadlines survive every move: the REMAINING budget (not
+  the original value) follows a stream across handoff, rebalance and
+  failover — a transferred request can never get its clock reset.
+
 Token-identity contract (the oracle tests pin it): every request
 routed, handed off, failed over or drained through the router produces
 the same tokens — byte-identical for sampled streams — as a single
@@ -52,6 +64,7 @@ from distkeras_tpu.resilience import faults
 from distkeras_tpu.serving.engine import DegradedRequest, ServingEngine
 from distkeras_tpu.serving.router.policies import resolve_policy
 from distkeras_tpu.serving.router.replica import (EngineReplica,
+                                                  ReplicaDead,
                                                   ReplicaState)
 from distkeras_tpu.serving.scheduler import (AdmissionRejected, Request,
                                              RequestState,
@@ -146,9 +159,22 @@ class Router:
         self._c_failover = reg.counter("router.failovers")
         self._c_rebalance = reg.counter("router.rebalanced")
         self._c_shed = reg.counter("router.rejected")
+        self._c_added = reg.counter("router.replicas_added")
+        self._c_removed = reg.counter("router.replicas_removed")
+        self._c_deadline = reg.counter("router.deadline_expired")
+        self._g_fleet = reg.gauge("router.fleet_size")
         self._n: Dict[str, int] = {
             "dispatched": 0, "handoffs": 0, "failovers": 0,
-            "rebalanced": 0, "rejected": 0}
+            "rebalanced": 0, "rejected": 0, "deadline_expired": 0,
+            "replicas_added": 0, "replicas_removed": 0}
+        #: bumped on every fleet mutation (add/remove/death) — harness
+        #: code (loadgen.replay) keys per-engine instrumentation sync
+        #: off this instead of diffing the replica list
+        self._fleet_version = 0
+        #: (router step, event, replica name) for add/remove/dead —
+        #: the fleet-size timeline's raw material
+        self.fleet_events: List[Tuple[int, str, str]] = []
+        self._g_fleet.set(len(reps))
         # fleet-level time series (obs.timeseries): scrapes the GLOBAL
         # registry (router.* counters, slo gauges, device watermarks)
         # on the controller cadence; per-replica serving series live on
@@ -192,6 +218,109 @@ class Router:
         """Tick ``controller`` every ``_CTL_EVERY`` router steps (the
         SLO-burn drain controller's cadence)."""
         self.controller = controller
+
+    # -- fleet elasticity --------------------------------------------------
+
+    def add_replica(self, replica, *, start: bool = True) -> EngineReplica:
+        """Grow the fleet mid-flight. ``replica`` is an
+        ``EngineReplica``, a bare paged ``ServingEngine`` (auto-wrapped
+        ``role="both"``) or a zero-arg factory returning either — the
+        factory form is what ``AutoscaleController`` holds, so engine
+        construction cost is only paid when a scale-up actually fires.
+        The new replica joins the placement pools immediately (next
+        ``submit``/``_place`` sees it); queued work already on other
+        replicas moves only through an explicit ``rebalance_queued``
+        or the normal shed-retry paths. Returns the added replica."""
+        if not isinstance(replica, (EngineReplica, ServingEngine)) \
+                and callable(replica):
+            replica = replica()
+        if isinstance(replica, ServingEngine):
+            replica = EngineReplica(replica)
+        if any(r.name == replica.name for r in self.replicas):
+            raise ValueError(
+                f"duplicate replica name: {replica.name!r}")
+        self.replicas.append(replica)
+        if replica.role != "both":
+            self.disaggregated = True
+        self._fleet_version += 1
+        self._c_added.inc(replica=replica.name)
+        self._n["replicas_added"] += 1
+        self.fleet_events.append((self._steps, "add", replica.name))
+        self._g_fleet.set(len(self.replicas))
+        if self.recorder.enabled:
+            self.recorder.record(
+                "router.replica_added", replica=replica.name,
+                role=replica.role, fleet=len(self.replicas))
+        if start and replica.state is ReplicaState.STARTING:
+            replica.start()
+        return replica
+
+    def remove_replica(self, name: str) -> EngineReplica:
+        """Shrink the fleet: drain ``name`` (admission closes, in-flight
+        streams finish in place through the normal drain contract),
+        rebalance its queued work onto the rest of the fleet, and mark
+        it retiring — the end-of-step sweep pops it from the fleet once
+        it is empty. A DEAD replica is garbage-collected through the
+        same path (its in-flight work was already failed over), so dead
+        weight and planned retirement share one bookkeeping funnel.
+        Raises when removing the last live admission-capable (or, in a
+        disaggregated fleet, decode-capable) replica."""
+        rep = self.replica(name)
+        if rep.state is not ReplicaState.DEAD:
+            survivors = [r for r in self.replicas
+                         if r is not rep and not r.retiring
+                         and r.state is not ReplicaState.DEAD]
+            if not any(r.role in ("both", "prefill") for r in survivors) \
+                    or (self.disaggregated and not any(
+                        r.role in ("both", "decode") for r in survivors)):
+                raise ValueError(
+                    f"cannot remove {name!r}: the fleet would have no "
+                    "live admission/decode-capable replica left")
+            if rep.state is not ReplicaState.DRAINING:
+                rep.drain()
+            rep.retiring = True
+            self.rebalance_queued(rep)
+        else:
+            rep.retiring = True
+        self._retire_pass()
+        return rep
+
+    def _retire_pass(self) -> None:
+        """Pop retiring replicas that have gone empty (and retiring
+        DEAD replicas outright — after re-homing any stragglers a
+        death outside ``step()`` left behind)."""
+        for r in list(self.replicas):
+            if not r.retiring:
+                continue
+            if r.state is ReplicaState.DEAD:
+                if any(tr.replica is r
+                       for tr in self._requests.values()):
+                    # died outside step() (operator mark_dead): the
+                    # failover sweep never ran for it — run it now so
+                    # retirement cannot strand tracked requests
+                    self._on_replica_death(
+                        r, r.error or ReplicaDead(r.name))
+            elif r.pending:
+                continue
+            self.replicas.remove(r)
+            self._fleet_version += 1
+            self._c_removed.inc(replica=r.name)
+            self._n["replicas_removed"] += 1
+            self.fleet_events.append((self._steps, "remove", r.name))
+            self._g_fleet.set(len(self.replicas))
+            if self.recorder.enabled:
+                self.recorder.record(
+                    "router.replica_removed", replica=r.name,
+                    state=r.state.value, fleet=len(self.replicas))
+
+    def fleet_counts(self) -> Dict[str, int]:
+        """Replica-lifecycle census: total plus per-state counts (the
+        fleet-size timeline samples this)."""
+        out = {"total": len(self.replicas), "serving": 0,
+               "starting": 0, "draining": 0, "dead": 0}
+        for r in self.replicas:
+            out[r.state.value] += 1
+        return out
 
     # -- client surface ----------------------------------------------------
 
@@ -257,12 +386,15 @@ class Router:
                 grid = self._local.pop((id(r), req.rid), None)
                 if grid is None:
                     continue           # not router-placed (direct use)
-                self._requests.pop(grid, None)
+                tr = self._requests.pop(grid, None)
+                if tr is not None:
+                    self._stamp(tr)
                 finished[grid] = req
         if self.disaggregated:
             self._handoff_pass()
         if self._orphans:
             self._retry_orphans()
+        self._retire_pass()
         self._steps += 1
         if self.controller is not None \
                 and self._steps % self._CTL_EVERY == 0:
@@ -330,6 +462,7 @@ class Router:
     def cancel(self, grid: int) -> Request:
         """Cancel a routed request wherever it currently lives."""
         tr = self._requests.pop(grid)
+        self._stamp(tr)
         if tr.replica is None:                    # orphaned: no engine
             self._orphans = [o for o in self._orphans if o is not tr]
             tr.req.state = RequestState.CANCELLED
@@ -338,6 +471,42 @@ class Router:
         return tr.replica.engine.cancel(tr.req.rid)
 
     # -- migration ---------------------------------------------------------
+
+    def _stamp(self, tr: _Tracked) -> None:
+        """Copy the router-side movement counts onto the request before
+        it is delivered: terminal requests carry how many times they
+        moved (handoff/rebalance) and how many replica deaths they
+        survived — the recovery accounting's per-request ground truth."""
+        tr.req.n_handoffs = tr.handoffs
+        tr.req.n_failovers = tr.failovers
+
+    def _shrink_deadline(self, tr: _Tracked, req: Request,
+                         src: EngineReplica) -> bool:
+        """Carry the REMAINING deadline budget across a replica move.
+        ``transfer_in`` restarts ``submit_t`` on the adopting engine's
+        clock, so without this adjustment every migration would silently
+        re-arm the full original budget. Returns False when the budget
+        is already spent — the request is terminated TIMED_OUT at the
+        router (it never reaches a new replica) and surfaced through
+        the finish buffer."""
+        if req.deadline_s is None:
+            return True
+        elapsed = max(0.0, src.engine.metrics.clock() - req.submit_t)
+        remaining = req.deadline_s - elapsed
+        if remaining <= 0:
+            req.state = RequestState.TIMED_OUT
+            self._requests.pop(tr.grid, None)
+            self._stamp(tr)
+            self._finish_buf.append((tr.grid, req))
+            self._c_deadline.inc(src=src.name)
+            self._n["deadline_expired"] += 1
+            if self.recorder.enabled:
+                self.recorder.record(
+                    "router.deadline_expired", grid=tr.grid,
+                    src=src.name, n_generated=len(req.generated))
+            return False
+        req.deadline_s = remaining
+        return True
 
     def _targets_for(self, req: Request) -> List[EngineReplica]:
         pool = (self._decode_pool() if req.generated
@@ -379,6 +548,8 @@ class Router:
         if req is None:
             return False       # finished mid-drain; src delivers it
         self._local.pop(old_key, None)
+        if not self._shrink_deadline(tr, req, src):
+            return False       # budget spent mid-move: TIMED_OUT here
         target = self._place(tr, req, exclude=src)
         if target is None:
             return False
@@ -426,6 +597,7 @@ class Router:
             if tr.req.state is RequestState.QUEUED:
                 if self._migrate(tr, self._c_rebalance, "rebalance",
                                  "rebalanced"):
+                    tr.handoffs += 1
                     moved += 1
         return moved
 
@@ -440,6 +612,8 @@ class Router:
         the dead engine (device state, pipeline, KV pages) is
         trusted."""
         replica.mark_dead(error)
+        self._fleet_version += 1
+        self.fleet_events.append((self._steps, "dead", replica.name))
         failed_over = 0
         for tr in list(self._requests.values()):
             if tr.replica is not replica:
@@ -450,8 +624,11 @@ class Router:
                 # terminal but undelivered (the dying step's finished
                 # list was lost with the exception): surface it now
                 self._requests.pop(tr.grid, None)
+                self._stamp(tr)
                 self._finish_buf.append((tr.grid, req))
                 continue
+            if not self._shrink_deadline(tr, req, replica):
+                continue       # budget spent before the re-admit
             # discard everything engine-local: the in-flight pipeline
             # step (recomputed identically), page/prefix bookkeeping,
             # and the slot key — replayed from the seed instead
@@ -503,6 +680,7 @@ class Router:
         agg = obs.aggregate_serving()
         agg["router"] = self.counters()
         agg["states"] = {r.name: r.state.value for r in self.replicas}
+        agg["fleet"] = self.fleet_counts()
         if self.timeseries is not None:
             agg["timeseries"] = self.timeseries.summary()
         return agg

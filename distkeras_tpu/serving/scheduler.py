@@ -108,6 +108,12 @@ class Request:
     submit_t: float = 0.0                # engine-clock submit timestamp
     error: Optional[BaseException] = None  # why CANCELLED (isolation)
     n_preempted: int = 0                 # times evicted back to queue
+    # fleet bookkeeping (router PRs): how many times this stream moved
+    # between replicas — stamped by the router when it delivers the
+    # terminal request, so replay outcomes can count lost vs replayed
+    # vs degraded work per incident
+    n_handoffs: int = 0                  # planned moves (disagg/rebalance)
+    n_failovers: int = 0                 # replica-death re-admissions
     # speculative decoding (spec-decode PR): whether this request
     # participates in draft-and-verify iterations, the acceptance EMA
     # that decides it keeps paying off, and the sticky kill switch the

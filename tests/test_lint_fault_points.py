@@ -1,0 +1,60 @@
+"""tools/lint_fault_points.py wired into tier-1: every
+``faults.point``/``faults.corrupt`` name in library code must appear
+in the docs/resilience.md catalog table and vice versa — a renamed
+injection site fails HERE instead of letting chaos schedules silently
+no-op against the old name."""
+
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO / "tools"))
+
+from lint_fault_points import (check, code_points,  # noqa: E402
+                               doc_points, main)
+
+
+def test_code_and_catalog_agree():
+    findings = check()
+    assert not findings, "\n".join(msg for _, msg in findings)
+    assert main() == 0
+
+
+def test_walk_finds_known_sites():
+    pts = code_points(REPO / "distkeras_tpu")
+    # the serving-chaos surface this PR scripts against
+    for name in ("replica.die", "serving.prefill", "serving.decode",
+                 "router.dispatch", "ckpt.write", "train.loss"):
+        assert name in pts, name
+    # every site is a file:line anchor
+    assert all(":" in site for sites in pts.values() for site in sites)
+
+
+def test_catalog_parser_reads_table_rows():
+    doc = (REPO / "docs" / "resilience.md").read_text()
+    names = doc_points(doc)
+    assert "replica.die" in names
+    assert "ckpt.d2h" in names
+    # prose backticks and non-dotted cells are not catalog rows
+    assert "faults" not in names
+
+
+def test_undocumented_point_is_flagged(tmp_path):
+    # negative injection: a point declared in code but missing from
+    # the catalog must produce a finding naming its site
+    src = tmp_path / "pkg"
+    src.mkdir()
+    (src / "mod.py").write_text(
+        "from distkeras_tpu.resilience import faults\n"
+        "def f():\n"
+        "    faults.point('serving.prefill')\n"
+        "    faults.point('totally.undocumented')\n")
+    doc = ("| `serving.prefill`  | site | models |\n"
+           "| `serving.vanished` | site | models |\n")
+    findings = check(root=src, doc_text=doc)
+    names = [n for n, _ in findings]
+    assert "totally.undocumented" in names       # code, not catalog
+    assert "serving.vanished" in names           # catalog, not code
+    assert "serving.prefill" not in names
+    undoc = next(m for n, m in findings if n == "totally.undocumented")
+    assert "mod.py:4" in undoc

@@ -1,0 +1,455 @@
+"""Fleet elasticity (fleet-autoscale PR): mid-flight
+``add_replica``/``remove_replica`` mutations, the AutoscaleController's
+hysteresis loop, remaining-deadline propagation across migrations and
+failover, and replica death inside a fused decode window or a tree
+speculation — all under the router's token-identity oracle (every
+surviving stream byte-identical to ``generate()`` / a single engine)."""
+
+import numpy as np
+import pytest
+
+from distkeras_tpu import obs
+from distkeras_tpu.models.decoding import generate
+from distkeras_tpu.resilience import faults
+from distkeras_tpu.serving import (AdmissionRejected,
+                                   AutoscaleController, ControllerChain,
+                                   EngineReplica, NgramDraft,
+                                   ReplicaState, RequestState, Router,
+                                   ServingEngine, ServingMetrics,
+                                   SLOBurnController)
+
+PATTERN = np.array([3, 1, 4, 1, 5, 9, 2, 6, 5, 3, 5, 8])
+
+
+@pytest.fixture(scope="module")
+def memorized_lm(pattern_lm):
+    return pattern_lm
+
+
+def _engine(m, eid, **kw):
+    kw.setdefault("num_slots", 2)
+    kw.setdefault("max_len", 32)
+    return ServingEngine(m, engine_id=eid, **kw)
+
+
+def _steps(router, n, out=None):
+    out = {} if out is None else out
+    for _ in range(n):
+        for g, req in router.step().items():
+            out[g] = req
+    return out
+
+
+def _drive(router, warm_steps=0):
+    out = _steps(router, warm_steps)
+    while router.pending:
+        for g, req in router.step().items():
+            out[g] = req
+    return out
+
+
+PROMPTS = [PATTERN[:4], PATTERN[:6], PATTERN[:3], PATTERN[:5],
+           PATTERN[:7], PATTERN[:5]]
+BUDGETS = [7, 5, 9, 6, 4, 8]
+
+
+def _refs(m):
+    return [generate(m, PROMPTS[i][None], max_new_tokens=BUDGETS[i],
+                     temperature=0.0)[0] for i in range(len(PROMPTS))]
+
+
+def _sampled_ref(m, prompt, budget, seed, **kw):
+    eng = ServingEngine(m, num_slots=1, max_len=32, **kw)
+    rid = eng.submit(prompt, budget, temperature=0.9, top_p=0.95,
+                     seed=seed)
+    return eng.run(max_steps=500)[rid]
+
+
+# --- add/remove mid-flight ---------------------------------------------------
+
+
+def test_add_replica_mid_flight_serves_queued_backlog(memorized_lm):
+    """Work queued behind a loaded 1-slot replica moves to a replica
+    added MID-FLIGHT (factory form) and every stream stays
+    byte-identical; the fleet views and counters track the mutation."""
+    m = memorized_lm
+    r = Router([EngineReplica(_engine(m, "af0", num_slots=1))])
+    grids = [r.submit(PROMPTS[i], BUDGETS[i]) for i in range(4)]
+    out = _steps(r, 2)
+    assert any(r._requests[g].req.state is RequestState.QUEUED
+               for g in grids if g in r._requests)
+    rep = r.add_replica(lambda: EngineReplica(_engine(m, "af1")))
+    assert rep.name == "af1" and rep.state is ReplicaState.SERVING
+    assert r.fleet_counts()["serving"] == 2
+    assert r.counters()["replicas_added"] == 1
+    moved = r.rebalance_queued(r.replica("af0"))
+    assert moved >= 1
+    out.update(_drive(r))
+    refs = _refs(m)
+    for i, g in enumerate(grids):
+        np.testing.assert_array_equal(out[g].tokens, refs[i])
+    # the new replica actually served rebalanced work
+    assert rep.engine.metrics.requests_finished >= 1
+    assert [e for _, e, n in r.fleet_events if n == "af1"] == ["add"]
+
+
+def test_add_replica_rejects_duplicates_and_accepts_instance(memorized_lm):
+    m = memorized_lm
+    r = Router([EngineReplica(_engine(m, "ai0"))])
+    rep = r.add_replica(EngineReplica(_engine(m, "ai1")))
+    assert rep.state is ReplicaState.SERVING
+    with pytest.raises(ValueError, match="duplicate"):
+        r.add_replica(EngineReplica(_engine(m, "x"), name="ai1"))
+    g = r.submit(PROMPTS[0], BUDGETS[0])
+    out = r.run(max_steps=500)
+    np.testing.assert_array_equal(out[g], _refs(m)[0])
+
+
+def test_remove_affinity_hottest_replica_token_identical(memorized_lm):
+    """Remove the replica whose PrefixCache is hottest (both templates'
+    home) while its streams are mid-decode and more sit queued:
+    drain -> rebalance -> retire-when-empty, every request finishing
+    byte-identically on the survivor, and the retired replica leaves
+    the fleet views."""
+    m = memorized_lm
+    r = Router([EngineReplica(_engine(m, "rh0", num_slots=1,
+                                      page_len=4)),
+                EngineReplica(_engine(m, "rh1", num_slots=1,
+                                      page_len=4))],
+               policy="prefix_affinity")
+    grids = [r.submit(PROMPTS[i], BUDGETS[i]) for i in range(4)]
+    out = _steps(r, 3)
+    by_rep = {}
+    for g in grids:
+        if g in r._requests:
+            by_rep.setdefault(r._requests[g].replica.name, []).append(g)
+    hottest = max(by_rep, key=lambda n: len(by_rep[n]))
+    r.remove_replica(hottest)
+    victim = next(x for x in r.replicas if x.name == hottest)
+    assert victim.retiring
+    assert victim.state is ReplicaState.DRAINING
+    out.update(_drive(r))
+    refs = _refs(m)
+    for i, g in enumerate(grids):
+        np.testing.assert_array_equal(out[g].tokens, refs[i])
+    # retired: gone from the fleet, views consistent
+    assert hottest not in {x.name for x in r.replicas}
+    assert r.fleet_counts() == {"total": 1, "serving": 1, "starting": 0,
+                                "draining": 0, "dead": 0}
+    assert r.counters()["replicas_removed"] == 1
+    assert any(e == "remove" and n == hottest
+               for _, e, n in r.fleet_events)
+    # aggregate_serving still sums the SURVIVING fleet
+    agg = obs.aggregate_serving()
+    assert agg["totals"]["requests_finished"] >= 1
+
+
+def test_remove_replica_guards_last_survivor(memorized_lm):
+    m = memorized_lm
+    r = Router([EngineReplica(_engine(m, "lg0"))])
+    with pytest.raises(ValueError):
+        r.remove_replica("lg0")          # no admission-capable survivor
+    with pytest.raises(KeyError):
+        r.remove_replica("no-such-replica")
+    # disaggregated: the only decode replica is also irremovable
+    rd = Router([EngineReplica(_engine(m, "lgp"), role="prefill"),
+                 EngineReplica(_engine(m, "lgd"), role="decode")])
+    with pytest.raises(ValueError):
+        rd.remove_replica("lgd")
+
+
+def test_dead_replica_gc_via_remove_path(memorized_lm):
+    """A DEAD replica is garbage-collected through the same
+    remove/retire funnel: its in-flight work is already failed over,
+    remove_replica() marks it retiring and the next step pops it."""
+    m = memorized_lm
+    try:
+        r = Router([EngineReplica(_engine(m, "gc0")),
+                    EngineReplica(_engine(m, "gc1"))])
+        grids = [r.submit(PROMPTS[i], BUDGETS[i]) for i in range(4)]
+        _steps(r, 2)
+        faults.inject("replica.die", nth=1)
+        out = _drive(r)
+        dead = next(x for x in r.replicas
+                    if x.state is ReplicaState.DEAD)
+        r.remove_replica(dead.name)
+        r.step()
+        assert dead.name not in {x.name for x in r.replicas}
+        assert r.fleet_counts()["dead"] == 0
+        refs = _refs(m)
+        for i, g in enumerate(grids):
+            np.testing.assert_array_equal(out[g].tokens, refs[i])
+    finally:
+        faults.reset()
+
+
+# --- deadline budget across migrations/failover ------------------------------
+
+
+def _virtual_fleet(m, names, t, **kw):
+    """Replicas sharing one controllable virtual clock (the replay
+    discipline: deadlines and elapsed time derive from metrics.clock)."""
+    reps = []
+    for n in names:
+        e = _engine(m, n, **kw)
+        e.metrics = ServingMetrics(clock=lambda: t[0])
+        reps.append(EngineReplica(e))
+    return reps
+
+
+def test_deadline_expires_mid_handoff_not_reset(memorized_lm):
+    """The regression: a queued request whose deadline budget is
+    already spent when it is HANDED OFF (rebalanced off a draining
+    replica) must come back TIMED_OUT — before this PR the transfer
+    re-stamped submit_t on the adopting engine, silently granting the
+    stream a fresh deadline."""
+    m = memorized_lm
+    t = [0.0]
+    r = Router(_virtual_fleet(m, ["dh0", "dh1"], t, num_slots=1),
+               policy="least_loaded")
+    g0 = r.submit(PROMPTS[0], BUDGETS[0])
+    g1 = r.submit(PROMPTS[1], BUDGETS[1])
+    _steps(r, 1)                     # both streams into their slots
+    gq = r.submit(PROMPTS[2], BUDGETS[2], deadline_s=0.5)
+    src = r._requests[gq].replica
+    assert r._requests[gq].req.state is RequestState.QUEUED
+    t[0] = 1.0                       # budget spent while queued
+    src.drain()
+    r.rebalance_queued(src)
+    out = _drive(r)
+    assert out[gq].state is RequestState.TIMED_OUT
+    assert r.counters()["deadline_expired"] >= 1
+    refs = _refs(m)
+    np.testing.assert_array_equal(out[g0].tokens, refs[0])
+    np.testing.assert_array_equal(out[g1].tokens, refs[1])
+
+
+def test_failover_carries_remaining_deadline_budget(memorized_lm):
+    """Replica death: the re-placed stream gets its REMAINING budget
+    (original minus elapsed on the dead replica), not the original."""
+    m = memorized_lm
+    t = [0.0]
+    try:
+        r = Router(_virtual_fleet(m, ["db0", "db1"], t))
+        g = r.submit(PROMPTS[0], BUDGETS[0], deadline_s=10.0)
+        home = r._requests[g].replica
+        _steps(r, 2)
+        t[0] = 3.0
+        # the fleet steps in list order, so arm the nth trigger to hit
+        # the HOME replica specifically
+        faults.inject("replica.die", nth=r.replicas.index(home) + 1)
+        while r._requests.get(g) is not None \
+                and r._requests[g].replica is home:
+            r.step()
+        tr = r._requests.get(g)
+        if tr is not None:           # still in flight on the survivor
+            assert tr.req.deadline_s == pytest.approx(7.0)
+        out = _drive(r)
+        assert out[g].state is RequestState.FINISHED
+        np.testing.assert_array_equal(out[g].tokens, _refs(m)[0])
+    finally:
+        faults.reset()
+
+
+# --- chaos inside fused decode / tree speculation ----------------------------
+
+
+def test_death_during_fused_decode_failover_token_identical(memorized_lm):
+    """Kill a replica while its streams decode through the FUSED
+    multi-step window (fuse_steps=4): failover replays from the host
+    token mirror byte-identically — the fused window must not have
+    advanced state the router's request log doesn't know about."""
+    m = memorized_lm
+    try:
+        r = Router([EngineReplica(_engine(m, "fd0", fuse_steps=4)),
+                    EngineReplica(_engine(m, "fd1", fuse_steps=4))])
+        grids = [r.submit(PROMPTS[i], BUDGETS[i]) for i in range(4)]
+        gs = r.submit(PATTERN[:5], 8, temperature=0.9, top_p=0.95,
+                      seed=5)
+        out = _steps(r, 3)           # inside the fused windows
+        faults.inject("replica.die", nth=1)
+        out.update(_drive(r))
+        refs = _refs(m)
+        for i, g in enumerate(grids):
+            np.testing.assert_array_equal(out[g].tokens, refs[i])
+        np.testing.assert_array_equal(
+            out[gs].tokens, _sampled_ref(m, PATTERN[:5], 8, seed=5))
+        assert r.counters()["failovers"] >= 1
+    finally:
+        faults.reset()
+
+
+def test_death_during_tree_speculation_failover_token_identical(
+        memorized_lm):
+    """Kill a replica mid tree-speculative decode (NgramDraft token
+    trees): the survivor — itself speculating — continues every stream
+    byte-identically from the seed-replayed request log."""
+    m = memorized_lm
+    kw = dict(draft=NgramDraft(), spec_k=3, spec_tree=True,
+              spec_width=2)
+    try:
+        r = Router([EngineReplica(_engine(m, "td0", **kw)),
+                    EngineReplica(_engine(m, "td1", **kw))])
+        grids = [r.submit(PROMPTS[i], BUDGETS[i]) for i in range(4)]
+        out = _steps(r, 3)
+        faults.inject("replica.die", nth=1)
+        out.update(_drive(r))
+        refs = _refs(m)
+        for i, g in enumerate(grids):
+            np.testing.assert_array_equal(out[g].tokens, refs[i])
+        assert r.counters()["failovers"] >= 1
+    finally:
+        faults.reset()
+
+
+# --- AutoscaleController hysteresis ------------------------------------------
+
+
+def _idle_router(m, names, **kw):
+    return Router([EngineReplica(_engine(m, n, **kw)) for n in names])
+
+
+def test_autoscale_scales_up_on_shed_and_respects_bounds(memorized_lm):
+    """Shed onset is overload: after ``up_sustain`` consecutive
+    overloaded ticks the controller adds a replica through the
+    factory; the cooldown then blocks (and records) the next wish;
+    ``max_replicas`` caps growth."""
+    m = memorized_lm
+    r = _idle_router(m, ["as0"], num_slots=1, max_queue=1)
+    minted = []
+
+    def factory():
+        rep = EngineReplica(_engine(m, f"as{len(minted) + 1}",
+                                    num_slots=1, max_queue=1))
+        minted.append(rep)
+        return rep
+
+    ctl = AutoscaleController(r, factory, min_serving=1, max_replicas=2,
+                              up_sustain=2, cooldown=3)
+    kept = []
+
+    def shed_once():
+        # submit until every replica refuses: the admitted requests
+        # are kept (they must still finish), the rejection is the
+        # controller's shed-onset signal
+        with pytest.raises(AdmissionRejected):
+            for i in range(6):
+                kept.append(r.submit(PROMPTS[i % len(PROMPTS)], 4))
+
+    shed_once()
+    assert ctl.tick() == {}                  # streak 1 of 2: no action
+    shed_once()                              # fresh shed delta
+    actions = ctl.tick()                     # streak 2: scale up
+    assert actions.get("as1") == "add"
+    assert len(r.replicas) == 2 and minted
+    assert ctl.counts()["scale_up"] == 1
+    # cooldown: the next sustained overload is BLOCKED and recorded
+    shed_once()
+    ctl.tick()
+    shed_once()
+    ctl.tick()
+    assert ctl.counts()["blocked"] >= 1
+    assert any(d["action"] == "blocked" and "cooldown" in d["reason"]
+               for d in ctl.decisions)
+    assert len(r.replicas) == 2              # max_replicas caps growth
+    out = r.run(max_steps=2000)
+    assert set(kept) <= set(out)             # admitted work all served
+
+
+def test_autoscale_scales_down_after_sustained_idle(memorized_lm):
+    """Sustained idle shrinks the fleet LIFO (controller-added replica
+    first) down to ``min_serving``, where further wishes are blocked —
+    a standing blocker records a bounded decision log, not one entry
+    per tick."""
+    m = memorized_lm
+    r = _idle_router(m, ["sd0"])
+    ctl = AutoscaleController(
+        r, lambda: EngineReplica(_engine(m, "sd-added")),
+        min_serving=1, max_replicas=2, idle_sustain=2, cooldown=0)
+    added = r.add_replica(lambda: EngineReplica(_engine(m, "sd1")))
+    ctl._added.append(added.name)            # adopt as controller-added
+    acted = {}
+    for _ in range(6):
+        acted.update(ctl.tick())
+        r.step()                             # lets retirement land
+    assert acted.get("sd1") == "remove"
+    assert "sd1" not in {x.name for x in r.replicas}
+    assert ctl.counts()["scale_down"] == 1
+    # at the floor: the wish is blocked once per refilled sustain
+    # window (every idle_sustain ticks), not once per tick
+    before = len(ctl.decisions)
+    for _ in range(8):
+        ctl.tick()
+    blocked = [d for d in ctl.decisions[before:]
+               if d["action"] == "blocked"]
+    assert blocked and len(blocked) <= 8 // ctl.idle_sustain
+    assert all("min_serving" in d["reason"] for d in blocked)
+    # the fleet still serves
+    g = r.submit(PROMPTS[0], BUDGETS[0])
+    out = r.run(max_steps=500)
+    np.testing.assert_array_equal(out[g], _refs(m)[0])
+
+
+def test_autoscale_never_removes_draining_replica(memorized_lm):
+    """Composition with the burn controller: while any replica is
+    draining for SLO burn, scale-down is blocked — one replica cannot
+    be both drained and retired, and drain-for-burn wins."""
+    m = memorized_lm
+    r = _idle_router(m, ["nd0", "nd1", "nd2"])
+    ctl = AutoscaleController(
+        r, lambda: EngineReplica(_engine(m, "nd-x")),
+        min_serving=1, max_replicas=4, idle_sustain=1, cooldown=0)
+    r.replica("nd2").drain()
+    acted = {}
+    for _ in range(3):
+        acted.update(ctl.tick())
+    assert "remove" not in acted.values()
+    assert any(d["action"] == "blocked" and "draining" in d["reason"]
+               for d in ctl.decisions)
+    # resume: with nothing draining, idle shrink proceeds
+    r.replica("nd2").resume()
+    acted = {}
+    for _ in range(3):
+        acted.update(ctl.tick())
+        r.step()
+    assert "remove" in acted.values()
+
+
+def test_controller_chain_merges_burn_and_autoscale(memorized_lm):
+    """ControllerChain ticks burn first, autoscale second, and the
+    router accepts the chain as its attached controller."""
+    m = memorized_lm
+    r = _idle_router(m, ["cc0", "cc1"])
+    burn = SLOBurnController(r, min_serving=1)
+    auto = AutoscaleController(
+        r, lambda: EngineReplica(_engine(m, "cc-x")),
+        min_serving=1, max_replicas=2, idle_sustain=1, cooldown=0)
+    chain = ControllerChain(burn, auto)
+    r.attach_controller(chain)
+    actions = chain.tick()
+    assert isinstance(actions, dict)
+    g = r.submit(PROMPTS[0], BUDGETS[0])
+    out = r.run(max_steps=2000)              # controller ticks inline
+    np.testing.assert_array_equal(out[g], _refs(m)[0])
+
+
+def test_retiring_replica_not_resumed_by_burn_controller(memorized_lm):
+    m = memorized_lm
+    r = _idle_router(m, ["rr0", "rr1"], num_slots=1)
+    burn = SLOBurnController(r, min_serving=1)
+    # load BOTH 1-slot replicas so rr1 has in-flight work and the
+    # remove below leaves it in the retiring DRAINING window instead
+    # of retiring instantly
+    grids = [r.submit(PROMPTS[i], BUDGETS[i]) for i in range(2)]
+    _steps(r, 1)
+    r.remove_replica("rr1")
+    rep = next(x for x in r.replicas if x.name == "rr1")
+    assert rep.retiring and rep.state is ReplicaState.DRAINING
+    burn._drained = {"rr1": True}            # claim drain ownership
+    actions = burn.tick()
+    assert actions.get("rr1") != "resume"
+    out = _drive(r)                          # finishes, then retires
+    assert "rr1" not in {x.name for x in r.replicas}
+    refs = _refs(m)
+    for i, g in enumerate(grids):
+        np.testing.assert_array_equal(out[g].tokens, refs[i])

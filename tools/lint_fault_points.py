@@ -1,0 +1,116 @@
+#!/usr/bin/env python
+"""Static check: fault-point names in code and docs/resilience.md agree.
+
+``resilience.faults`` addresses injection sites BY NAME: a chaos
+schedule (``loadgen.ChaosSpec``), a ``DKT_FAULTS`` env script, or a
+test arming ``faults.inject("serving.decode", ...)`` all bind to the
+string literal at the ``faults.point("...")`` site. Renaming a site
+breaks none of them loudly — the injection simply never fires and the
+chaos scenario silently tests nothing. The docs catalog
+(docs/resilience.md, the fault-point table) is the contract surface
+operators script against, so this linter holds the two sides equal:
+
+  1. AST-walk ``distkeras_tpu/`` for every ``faults.point("...")`` /
+     ``faults.corrupt("...", ...)`` call with a literal name;
+  2. parse the docs/resilience.md catalog table (rows whose first
+     cell is one backticked dotted name);
+  3. finding for every name on one side only, either direction.
+
+Dynamic point names (non-literal first args) are skipped — they are
+not lintable statically and the catalog documents the static surface.
+Wired into tier-1 via ``tests/test_lint_fault_points.py`` (with a
+negative-injection case: an undocumented point must produce a
+finding). The ``lint_report_series`` sibling covers metric names the
+same way.
+
+Exit status 1 when findings exist.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+import sys
+from pathlib import Path
+from typing import Dict, List, Set, Tuple
+
+Finding = Tuple[str, str]     # (point name, message)
+
+#: the faults-module attributes that take a point NAME as their first
+#: positional argument at an injection SITE (inject/clear take names
+#: too, but those are *users* of points, not definitions)
+_SITE_ATTRS = ("point", "corrupt")
+
+_ROW_RE = re.compile(r"^\|\s*`([a-z0-9_]+(?:\.[a-z0-9_]+)+)`\s*\|")
+
+
+def code_points(root: Path) -> Dict[str, List[str]]:
+    """Every literal ``faults.point/corrupt`` name under ``root`` ->
+    the ``file:line`` sites that declare it."""
+    out: Dict[str, List[str]] = {}
+    for path in sorted(root.rglob("*.py")):
+        try:
+            tree = ast.parse(path.read_text(), filename=str(path))
+        except SyntaxError:
+            continue
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            if not (isinstance(fn, ast.Attribute)
+                    and fn.attr in _SITE_ATTRS
+                    and isinstance(fn.value, ast.Name)
+                    and fn.value.id == "faults"):
+                continue
+            if not (node.args and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)):
+                continue     # dynamic name: not statically lintable
+            name = node.args[0].value
+            rel = path.relative_to(root.parent)
+            out.setdefault(name, []).append(
+                f"{rel}:{node.lineno}")
+    return out
+
+
+def doc_points(doc: str) -> Set[str]:
+    """Backticked dotted point names from the catalog table rows."""
+    return {m.group(1) for line in doc.splitlines()
+            if (m := _ROW_RE.match(line.strip()))}
+
+
+def check(root=None, doc_text=None) -> List[Finding]:
+    repo = Path(__file__).resolve().parent.parent
+    root = Path(root) if root else repo / "distkeras_tpu"
+    if doc_text is None:
+        doc_text = (repo / "docs" / "resilience.md").read_text()
+    in_code = code_points(root)
+    in_doc = doc_points(doc_text)
+    findings: List[Finding] = []
+    for name in sorted(set(in_code) - in_doc):
+        sites = ", ".join(in_code[name])
+        findings.append((name, f"fault point {name!r} ({sites}) is not "
+                               f"in the docs/resilience.md catalog — "
+                               f"add a table row (chaos schedules bind "
+                               f"to the documented name)"))
+    for name in sorted(in_doc - set(in_code)):
+        findings.append((name, f"docs/resilience.md catalogs fault "
+                               f"point {name!r} but no faults.point/"
+                               f"corrupt site declares it — renamed "
+                               f"or removed? chaos schedules armed on "
+                               f"it now silently no-op"))
+    return findings
+
+
+def main(argv=None) -> int:
+    findings = check()
+    for name, msg in findings:
+        print(f"lint_fault_points: {msg}", file=sys.stderr)
+    if findings:
+        print(f"lint_fault_points: {len(findings)} finding(s)",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
