@@ -128,6 +128,22 @@ REGRESSION_DROP = 0.9
 #: is the regression, a drop is the improvement
 LOWER_IS_BETTER = ("overlap_train_ckpt_overhead_x",)
 
+#: the complete pre-serving-stack headline roster (rounds <= 5): a
+#: prior BENCH record whose headline set is drawn ENTIRELY from these
+#: families predates the serving engine, schedulers and quantization
+#: ladder — its per-family numbers anchor nothing this code still
+#: runs, so ``_regression_check`` reports it as a stale anchor (the
+#: round-5 capture that kept re-surfacing the moe 0.735x flag against
+#: long-rewritten code is the motivating case)
+PRE_SERVING_FAMILIES = frozenset({
+    "resnet50_train_imgs_per_sec_per_chip",
+    "lm_train_tokens_per_sec_per_chip",
+    "lm_generate_new_tokens_per_sec_per_chip",
+    "lm_generate_p8192_decode_tokens_per_sec_per_chip",
+    "moe_lm_train_tokens_per_sec_per_chip",
+    "lm_big_train_tokens_per_sec_per_chip",
+})
+
 
 def _prev_headlines(root=None):
     """``(headlines, source, device_kind)`` from the newest
@@ -169,17 +185,31 @@ def _regression_check(rec, prev_heads, src, prev_kind=None):
     surfaced by the summary line) instead of flagging every run — a
     CPU smoke against a TPU capture would otherwise flag a bogus ~100x
     "drop" on every family, drowning the signal (the below-anchor
-    check is in-run, so it still applies). None when there is nothing
-    to compare and nothing flagged."""
+    check is in-run, so it still applies). An ERA check rides along
+    (quantized-decode PR): a prior record whose headlines predate the
+    serving stack entirely (no serving_/loadgen_/autoscale_ family —
+    the round-5 capture that kept re-reporting the moe 0.735x flag is
+    exactly this shape) is also stale — the engine, schedulers and
+    quantization ladder it anchored against no longer exist, so its
+    per-family ratios are archaeology, not regressions. None when
+    there is nothing to compare and nothing flagged."""
     flags = []
     out = {}
     prev = (prev_heads or {}).get(rec.get("metric")) or {}
+    pre_serving = bool(prev_heads) and \
+        set(prev_heads) <= PRE_SERVING_FAMILIES
     if prev_kind is not None and rec.get("device_kind") is not None \
             and rec["device_kind"] != prev_kind:
         out["stale_anchor"] = (
             f"{src} was captured on device_kind {prev_kind!r}, this "
             f"run is {rec['device_kind']!r}: cross-device anchor is "
             "stale, vs-prev comparison skipped")
+        prev = {}
+    elif pre_serving:
+        out["stale_anchor"] = (
+            f"{src} predates the serving stack (its headlines are all "
+            "pre-serving families): stale anchor, vs-prev comparison "
+            "skipped")
         prev = {}
     elif src:
         out["prev_source"] = src
@@ -493,10 +523,53 @@ def _with_fallbacks(fn, batch_candidates, label):
     raise RuntimeError(f"all batch sizes failed for {label}") from last_err
 
 
+#: the quantization ladder the decode family walks (quantized-decode
+#: PR): weight dtype x KV-cache dtype rungs — the bf16 anchor, each
+#: lever alone, and the fully-quantized corner
+QUANT_LADDER = (
+    ("bf16", {}),
+    ("w_int8", {"weights_dtype": "int8"}),
+    ("w_int4", {"weights_dtype": "int4"}),
+    ("kv_int8", {"cache_dtype": "int8"}),
+    ("kv_int4", {"cache_dtype": "int4"}),
+    ("w4kv4", {"weights_dtype": "int4", "cache_dtype": "int4"}),
+)
+
+
+def _quant_hbm_math(model, cfg):
+    """Untimed byte-math rider for the quant ladder: resident weight
+    bytes per weight rung and KV bytes/token per cache rung (the page
+    accounting the serving pool budgets with — scale planes included).
+    The point of recording it next to the rates: a rung whose rate
+    does NOT move while its bytes halve localizes the bottleneck."""
+    from distkeras_tpu.models.decoding import _resolve_head_dims
+    from distkeras_tpu.ops import quant_matmul as qm
+    from distkeras_tpu.serving.kv_pool import PagedKVPool
+
+    f32_w = sum(int(np.prod(l.shape)) * 4
+                for l in jax.tree_util.tree_leaves(model.params))
+    weight_bytes = {"bf16": f32_w // 2}
+    for bits, name in ((8, "int8"), (4, "int4")):
+        qt = qm.quantize_params_tree(model.params, bits=bits)
+        weight_bytes[name] = sum(
+            np.asarray(l).nbytes
+            for l in jax.tree_util.tree_leaves(qt))
+    _resolve_head_dims(model.module, model.params)
+    kv_per_tok = {}
+    for dt_name, dt in (("bf16", jnp.bfloat16), ("int8", "int8"),
+                        ("int4", "int4")):
+        pb = PagedKVPool._page_bytes(model.module, 16, dt, 16)
+        kv_per_tok[dt_name] = pb // 16
+    return {"weight_bytes": weight_bytes,
+            "kv_bytes_per_token": kv_per_tok}
+
+
 def bench_generate(batch: int, new_tokens: int, n_passes: int,
                    calls_per_pass: int = 5):
     """KV-cache decode throughput on the same LM config as ``--model lm``
-    (weights+cache-read-bound; the serving-side metric).
+    (weights+cache-read-bound; the serving-side metric), across the
+    quantization ladder (``QUANT_LADDER``: bf16 anchor, int8/int4
+    weights, int8/int4 KV, and the int4-weights x int4-KV corner).
 
     Each pass issues ``calls_per_pass`` generate calls BACK-TO-BACK with
     one device sync at the end (``as_numpy=False``) — the serving-loop
@@ -515,30 +588,34 @@ def bench_generate(batch: int, new_tokens: int, n_passes: int,
     prompts = np.zeros((batch, 8), np.int32)
     out = generate(model, prompts, max_new_tokens=new_tokens)  # compile
     assert out.shape == (batch, 8 + new_tokens)
-    generate(model, prompts, max_new_tokens=new_tokens,
-             weights_dtype="int8")  # compile the int8 variant too
+    for _, kw in QUANT_LADDER[1:]:       # compile every rung up front
+        generate(model, prompts, max_new_tokens=new_tokens, **kw)
 
-    def passes(wd):
+    def passes(kw):
         t0 = time.perf_counter()
         outs = [generate(model, prompts, max_new_tokens=new_tokens,
-                         seed=j, as_numpy=False, weights_dtype=wd)
+                         seed=j, as_numpy=False, **kw)
                 for j in range(calls_per_pass)]
         _ = np.asarray(outs[-1][0, -1])  # one sync for the whole pass
         return batch * new_tokens * calls_per_pass / (
             time.perf_counter() - t0)
 
-    rates, single, int8_rates = [], [], []
+    rates, single = [], []
+    ladder_rates = {name: [] for name, _ in QUANT_LADDER[1:]}
     for i in range(n_passes):
-        rates.append(passes("auto"))
-        int8_rates.append(passes("int8"))
+        rates.append(passes({}))
+        for name, kw in QUANT_LADDER[1:]:
+            ladder_rates[name].append(passes(kw))
         t0 = time.perf_counter()
         _ = generate(model, prompts, max_new_tokens=new_tokens)
         single.append(batch * new_tokens / (time.perf_counter() - t0))
         print(f"pass {i}: {rates[-1]:.1f} tok/s pipelined, "
-              f"{int8_rates[-1]:.1f} int8, "
-              f"{single[-1]:.1f} single-call", file=sys.stderr,
+              + ", ".join(f"{ladder_rates[n][-1]:.1f} {n}"
+                          for n, _ in QUANT_LADDER[1:])
+              + f", {single[-1]:.1f} single-call", file=sys.stderr,
               flush=True)
-    return rates, single, int8_rates
+    hbm_math = _quant_hbm_math(model, cfg)
+    return rates, single, ladder_rates, hbm_math
 
 
 def bench_serving(num_slots: int, prompt_len: int, new_tokens: int,
@@ -2198,25 +2275,37 @@ def _lm_param_count(cfg, kv_heads=None) -> int:
     return 2 * cfg["vocab"] * d + cfg["num_layers"] * (attn + mlp)
 
 
+def _cache_bytes_per_entry(cache_dt):
+    """KV payload bytes per cache entry for a grid dtype knob: legacy
+    bool (the pre-int4 int8 flag), "auto"/bf16, "int8", or "int4"
+    (nibble-packed pages — half a byte)."""
+    if cache_dt is True:
+        cache_dt = "int8"
+    if cache_dt in (False, None, "auto"):
+        return 2.0, False
+    return (0.5 if cache_dt == "int4" else 1.0), True
+
+
 def _serving_footprint_gb(batch, kv_heads, p_len, new_tokens,
-                          cache_int8, cfg) -> float:
+                          cache_dt, cfg) -> float:
     """Estimated peak HBM of one long-context generate program: KV cache
     (the dominant term at depth) + resident weights (f32 params + the
     bf16 serving copy) + prefill activations (~8 live [B, P, d] bf16
-    buffers under the flash-attention prefill)."""
+    buffers under the flash-attention prefill). ``cache_dt``: "auto"
+    (bf16), "int8", "int4", or the legacy bool."""
     d_head = cfg["d_model"] // cfg["num_heads"]
     layers = cfg["num_layers"]
     cap = _serving_cap(p_len + 1 + new_tokens)
-    per_kv = 1 if cache_int8 else 2
-    cache = batch * kv_heads * cap * d_head * 2 * layers * per_kv
-    if cache_int8:
+    per_kv, quantized = _cache_bytes_per_entry(cache_dt)
+    cache = int(batch * kv_heads * cap * d_head * 2 * layers * per_kv)
+    if quantized:
         cache += batch * kv_heads * cap * 2 * layers * 4    # f32 scales
     weights = _lm_param_count(cfg, kv_heads) * 6            # f32 + bf16
     act = 8 * batch * p_len * cfg["d_model"] * 2
     return (cache + weights + act) / 1e9
 
 
-def _serving_batch(kv_heads, p_len, new_tokens, cache_int8, cfg,
+def _serving_batch(kv_heads, p_len, new_tokens, cache_dt, cfg,
                    max_batch=None) -> int:
     """Largest ladder batch whose estimated footprint fits the budget —
     per-VARIANT sizing (round 5): the gqa4-int8 cache at P=8192 is ~16x
@@ -2226,7 +2315,7 @@ def _serving_batch(kv_heads, p_len, new_tokens, cache_int8, cfg,
         if max_batch is not None and b > max_batch:
             continue
         if _serving_footprint_gb(b, kv_heads, p_len, new_tokens,
-                                 cache_int8, cfg) <= SERVING_HBM_BUDGET_GB:
+                                 cache_dt, cfg) <= SERVING_HBM_BUDGET_GB:
             return b
     return 1
 
@@ -2278,7 +2367,8 @@ def bench_generate_long(max_batch: int, new_tokens: int, n_passes: int,
     per-variant): decode throughput with a REAL cache depth — prompt
     ingested by the batched prefill (models.decoding.prefill), then
     ``new_tokens`` decoded against the deep cache. Grid: MHA vs GQA-4,
-    bf16 vs int8 KV cache, at each prompt length; each variant runs at
+    bf16 vs int8 vs int4 KV cache, at each prompt length; each variant
+    runs at
     the largest batch its OWN cache+weights footprint allows
     (``_serving_batch``), with the ladder as the OOM fallback. This is
     the regime the KV roofline lives in (the cache read dominates;
@@ -2303,12 +2393,13 @@ def bench_generate_long(max_batch: int, new_tokens: int, n_passes: int,
             traceback.print_exc(file=sys.stderr)
             continue
         for p_len in prompt_lens:
-            for cache_dt in ("auto", "int8"):
+            for cache_dt in ("auto", "int8", "int4"):
                 label = (f"{name}_p{p_len}_"
-                         f"{'bf16' if cache_dt == 'auto' else 'int8'}")
-                kw = {} if cache_dt == "auto" else {"cache_dtype": "int8"}
+                         f"{'bf16' if cache_dt == 'auto' else cache_dt}")
+                kw = ({} if cache_dt == "auto"
+                      else {"cache_dtype": cache_dt})
                 b_want = _serving_batch(kv_heads, p_len, new_tokens,
-                                        cache_dt == "int8", cfg,
+                                        cache_dt, cfg,
                                         max_batch=max_batch)
                 ladder = [b for b in SERVING_BATCH_LADDER if b <= b_want]
                 for b_here in ladder:
@@ -2361,7 +2452,7 @@ def bench_decode_batch_curve(kv_heads, cache_dt, p_len, batches,
 
     cfg = LM_CFG
     rs = np.random.RandomState(0)
-    kw = {} if cache_dt == "auto" else {"cache_dtype": "int8"}
+    kw = {} if cache_dt == "auto" else {"cache_dtype": cache_dt}
     model = Model.build(zoo.transformer_lm(
         cfg["vocab"], d_model=cfg["d_model"], num_heads=cfg["num_heads"],
         num_layers=cfg["num_layers"], mlp_ratio=cfg["mlp_ratio"],
@@ -2815,14 +2906,17 @@ def _run_mode(mode, args, on_accel, peak, device_kind):
         headline = rate(headline_variant)
         # explicit inversion flags: any cache-shrinking lever measuring
         # slower than its anchor at the same config is reported, not
-        # buried (int8 vs bf16 per (heads, depth); gqa vs mha per depth)
+        # buried (each quantized rung vs bf16 per (heads, depth); gqa
+        # vs mha per depth)
         inversions = []
         for nm in ("mha", "gqa4"):
             for p in prompt_lens:
-                bf, i8 = rate(f"{nm}_p{p}_bf16"), rate(f"{nm}_p{p}_int8")
-                if bf and i8 and i8 < bf:
-                    inversions.append(
-                        f"{nm}_p{p}: int8 {i8} < bf16 {bf}")
+                bf = rate(f"{nm}_p{p}_bf16")
+                for q in ("int8", "int4"):
+                    iq = rate(f"{nm}_p{p}_{q}")
+                    if bf and iq and iq < bf:
+                        inversions.append(
+                            f"{nm}_p{p}: {q} {iq} < bf16 {bf}")
         mha_ref = rate(f"mha_p{p_top}_bf16")
         # tok/s-vs-batch curve at depth for the winning config (VERDICT
         # r4 weak #4: is the deep-cache number throughput or overhead?)
@@ -2830,7 +2924,8 @@ def _run_mode(mode, args, on_accel, peak, device_kind):
         if on_accel:
             kvh = LM_CFG["num_heads"] if headline_variant.startswith(
                 "mha") else int(headline_variant.split("_")[0][3:])
-            cdt = "int8" if headline_variant.endswith("int8") else "auto"
+            cdt = headline_variant.rsplit("_", 1)[-1]
+            cdt = "auto" if cdt == "bf16" else cdt
             try:
                 curve = bench_decode_batch_curve(
                     kvh, cdt, p_top, (4, 8, 16), new_tokens, 2)
@@ -2872,10 +2967,16 @@ def _run_mode(mode, args, on_accel, peak, device_kind):
     if mode == "generate":
         batch = 8 if on_accel else 2
         new_tokens = 128 if on_accel else 8
-        rates, single, int8_rates = bench_generate(batch, new_tokens,
-                                                   3 if on_accel else 1,
-                                                   5 if on_accel else 2)
+        rates, single, ladder, hbm_math = bench_generate(
+            batch, new_tokens, 3 if on_accel else 1,
+            5 if on_accel else 2)
         value = statistics.median(rates)
+        quant_ladder = {
+            name: {"tokens_per_sec": round(statistics.median(rs), 1),
+                   "best_pass": round(max(rs), 1),
+                   "vs_bf16": round(statistics.median(rs) / value, 3)
+                   if value else None}
+            for name, rs in ladder.items()}
         rec = {
             "metric": "lm_generate_new_tokens_per_sec_per_chip",
             "value": round(value, 1),
@@ -2887,8 +2988,15 @@ def _run_mode(mode, args, on_accel, peak, device_kind):
             "spread": _spread(rates),
             "single_call_tokens_per_sec": round(statistics.median(single),
                                                 1),
-            "int8_tokens_per_sec": round(statistics.median(int8_rates), 1),
-            "int8_best_pass": round(max(int8_rates), 1),
+            # the quantization ladder (weights x KV rungs; vs_bf16 is a
+            # same-run speed ratio against the bf16 anchor above) and
+            # the byte-math rider that localizes which term each rung
+            # actually shrinks
+            "quant_ladder": quant_ladder,
+            "int8_tokens_per_sec":
+                quant_ladder["w_int8"]["tokens_per_sec"],
+            "int8_best_pass": quant_ladder["w_int8"]["best_pass"],
+            "hbm_math": hbm_math,
             "batch_size": batch,
             "new_tokens": new_tokens,
             "device_kind": device_kind,

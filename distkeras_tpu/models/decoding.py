@@ -82,8 +82,20 @@ def init_cache(module: Sequential, batch: int, max_len: int,
     roofline (docs/PERF.md), so int8 halves the dominant term vs bf16;
     the scale read is Dh=64x smaller than the payload. Composes with GQA
     (scales are per KV head).
+
+    ``dtype="int4"`` (this PR) extends the ladder one more rung: entries
+    quantize to 4-bit symmetric (``scale = max|x| / 7``). In THIS
+    unpacked request/slab cache the payload still occupies one int8 byte
+    per entry holding a value in [-7, 7] — the dequant contract
+    (``q * scale``) is byte-for-byte the int8 contract, so every cache
+    read path is shared verbatim; the 2x byte saving is realized where
+    it matters, in ``PagedKVPool``'s packed page planes (two nibbles
+    per byte along the position axis). The empty ``"q4"`` marker leaf
+    records the 4-bit grid in the pytree STRUCTURE (jit-static, rides
+    through scans/vmaps for free).
     """
-    int8 = (isinstance(dtype, str) and dtype == "int8") or \
+    int4 = isinstance(dtype, str) and dtype == "int4"
+    int8 = int4 or (isinstance(dtype, str) and dtype == "int8") or \
         (not isinstance(dtype, str) and jnp.dtype(dtype) == jnp.int8)
     cache = []
     for layer in module.layers:
@@ -109,11 +121,17 @@ def init_cache(module: Sequential, batch: int, max_len: int,
                     "(Model.build resolves it) or pass head_dim explicitly")
             shape = (batch, h, max_len, dh)
             if int8:
-                cache.append({
+                kv = {
                     "k": jnp.zeros(shape, jnp.int8),
                     "v": jnp.zeros(shape, jnp.int8),
                     "k_scale": jnp.zeros(shape[:3], jnp.float32),
-                    "v_scale": jnp.zeros(shape[:3], jnp.float32)})
+                    "v_scale": jnp.zeros(shape[:3], jnp.float32)}
+                if int4:
+                    # structural marker, not data: 4-dim so every
+                    # blind cache tree_map (slab row insert/slice,
+                    # offload gather/scatter) stays shape-compatible
+                    kv["q4"] = jnp.zeros((1, 1, 1, 1), jnp.int8)
+                cache.append(kv)
             else:
                 cache.append({"k": jnp.zeros(shape, dtype),
                               "v": jnp.zeros(shape, dtype)})
@@ -131,13 +149,50 @@ def init_cache(module: Sequential, batch: int, max_len: int,
     return cache
 
 
-def _quantize_kv(x):
-    """[..., Dh] float -> (int8 payload, f32 [...] per-vector scale)."""
+def _quantize_kv(x, bits: int = 8):
+    """[..., Dh] float -> (int8 payload, f32 [...] per-vector scale).
+    ``bits=4`` quantizes to the symmetric 4-bit grid (values in
+    [-7, 7], ``scale = max|x| / 7``) while still returning one int8
+    byte per entry — the dequant contract (``q * scale``) is identical
+    across bit widths, so every read path is shared; nibble packing is
+    a storage concern owned by the paged pool."""
+    qmax = 7.0 if bits == 4 else 127.0
     xf = x.astype(jnp.float32)
-    scale = jnp.max(jnp.abs(xf), axis=-1) / 127.0
+    scale = jnp.max(jnp.abs(xf), axis=-1) / qmax
     safe = jnp.where(scale == 0.0, 1.0, scale)
-    q = jnp.round(xf / safe[..., None]).astype(jnp.int8)
+    q = jnp.clip(jnp.round(xf / safe[..., None]),
+                 -qmax, qmax).astype(jnp.int8)
     return q, jnp.where(scale == 0.0, 0.0, safe)
+
+
+def _kv_bits(kv) -> int:
+    """Quantization bit width of a cache dict: 4 when the ``"q4"``
+    marker leaf is present (pytree-structural, jit-static), else 8."""
+    return 4 if "q4" in kv else 8
+
+
+def pack_int4(q):
+    """Pack an int4-valued int8 array to nibbles along ``axis=-2``
+    (the position axis of a [..., L, D] plane): byte row ``r`` holds
+    position ``r`` in the LOW nibble and position ``r + L//2`` in the
+    HIGH nibble, halving the sublane extent (L must be even). All
+    nibble math runs in int32 for portable two's-complement handling."""
+    n = q.shape[-2]
+    lo = q[..., : n // 2, :].astype(jnp.int32)
+    hi = q[..., n // 2:, :].astype(jnp.int32)
+    b = ((hi & 15) << 4) | (lo & 15)
+    return (b - 256 * (b > 127)).astype(jnp.int8)
+
+
+def unpack_int4(b):
+    """Inverse of :func:`pack_int4`: [..., L//2, D] packed bytes ->
+    [..., L, D] int4-valued int8 (positions in order along axis -2)."""
+    b32 = b.astype(jnp.int32) & 255
+    lo = b32 & 15
+    lo = lo - 16 * (lo > 7)
+    hi = (b32 >> 4) & 15
+    hi = hi - 16 * (hi > 7)
+    return jnp.concatenate([lo, hi], axis=-2).astype(jnp.int8)
 
 
 def _cache_write(kv, k, v, t):
@@ -147,15 +202,19 @@ def _cache_write(kv, k, v, t):
     kh = k.transpose(0, 2, 1, 3)
     vh = v.transpose(0, 2, 1, 3)
     if "k_scale" in kv:
-        qk, sk = _quantize_kv(kh)
-        qv, sv = _quantize_kv(vh)
-        return {
+        bits = _kv_bits(kv)
+        qk, sk = _quantize_kv(kh, bits)
+        qv, sv = _quantize_kv(vh, bits)
+        out = {
             "k": lax.dynamic_update_slice_in_dim(kv["k"], qk, t, axis=2),
             "v": lax.dynamic_update_slice_in_dim(kv["v"], qv, t, axis=2),
             "k_scale": lax.dynamic_update_slice_in_dim(
                 kv["k_scale"], sk, t, axis=2),
             "v_scale": lax.dynamic_update_slice_in_dim(
                 kv["v_scale"], sv, t, axis=2)}
+        if bits == 4:
+            out["q4"] = kv["q4"]
+        return out
     return {"k": lax.dynamic_update_slice_in_dim(
                 kv["k"], kh.astype(kv["k"].dtype), t, axis=2),
             "v": lax.dynamic_update_slice_in_dim(
@@ -681,13 +740,17 @@ def _cache_write_slots(kv, k, v, t):
     hit = (jnp.arange(L)[None, :] == t[:, None])         # [S, L]
     hit4 = hit[:, None, :, None]                         # [S, 1, L, 1]
     if "k_scale" in kv:
-        qk, sk = _quantize_kv(kh)
-        qv, sv = _quantize_kv(vh)
+        bits = _kv_bits(kv)
+        qk, sk = _quantize_kv(kh, bits)
+        qv, sv = _quantize_kv(vh, bits)
         hit3 = hit[:, None, :]                           # [S, 1, L]
-        return {"k": jnp.where(hit4, qk, kv["k"]),
-                "v": jnp.where(hit4, qv, kv["v"]),
-                "k_scale": jnp.where(hit3, sk, kv["k_scale"]),
-                "v_scale": jnp.where(hit3, sv, kv["v_scale"])}
+        out = {"k": jnp.where(hit4, qk, kv["k"]),
+               "v": jnp.where(hit4, qv, kv["v"]),
+               "k_scale": jnp.where(hit3, sk, kv["k_scale"]),
+               "v_scale": jnp.where(hit3, sv, kv["v_scale"])}
+        if bits == 4:
+            out["q4"] = kv["q4"]
+        return out
     return {"k": jnp.where(hit4, kh.astype(kv["k"].dtype), kv["k"]),
             "v": jnp.where(hit4, vh.astype(kv["v"].dtype), kv["v"])}
 
@@ -736,6 +799,19 @@ def _window_valid_mask(t, w_len: int, L: int, tree, window):
     return valid
 
 
+def _attn_out(p, out, dt):
+    """Output projection shared by the serving readouts: the fused
+    dequant-matmul when the engine left ``wo`` quantized
+    (``ops.quant_matmul`` qdict), the plain einsum otherwise."""
+    wo = p["wo"]
+    if isinstance(wo, dict):
+        from distkeras_tpu.ops.quant_matmul import quant_matmul
+        b, s_len = out.shape[:2]
+        y = quant_matmul(out.reshape(b * s_len, -1), wo)
+        return y.astype(dt).reshape(b, s_len, -1)
+    return jnp.einsum("bshe,hed->bsd", out, wo.astype(dt))
+
+
 def _slot_attn_readout(attn: MultiHeadAttention, p, q, kv, t, dt,
                        tree=None):
     """Masked per-slot attention of the projected decode queries against
@@ -769,7 +845,7 @@ def _slot_attn_readout(attn: MultiHeadAttention, p, q, kv, t, dt,
     w = jax.nn.softmax(s, axis=-1)
     out = _decode_mix(w, kv).astype(dt)              # [S, W, Hkv, G, D]
     out = out.reshape(b, w_len, attn.num_heads, dh)
-    return jnp.einsum("bshe,hed->bsd", out, p["wo"].astype(dt))
+    return _attn_out(p, out, dt)
 
 
 def _decode_attn_slots(attn: MultiHeadAttention, p, kv, x, t):
@@ -866,6 +942,33 @@ def _cache_write_pages(kv, k, v, t, table, page_len: int):
     # table entry is >= N already) routes the scatter out of bounds,
     # where mode="drop" discards it
     pp = jnp.where((lp >= 0) & (lp < n_logical), pp, n_pages)
+    if "q4" in kv:
+        # int4 pool pages are nibble-PACKED along the position axis
+        # ([N, H, page_len//2, D] bytes — pack_int4's half-split): the
+        # one-position write is a read-modify-write of the byte row
+        # shared with position off +- page_len//2. The gather clamps
+        # sentinel pages to a real page (garbage merged safely — the
+        # scatter at the out-of-range pp drops it); scale planes stay
+        # per-position, so their write is the int8 write verbatim.
+        qk, sk = _quantize_kv(kh, 4)
+        qv, sv = _quantize_kv(vh, 4)
+        half = page_len // 2
+        prow = off % half
+        hi = (off >= half)[:, None, None]                # [S, 1, 1]
+        gp = jnp.clip(pp, 0, n_pages - 1)
+        out = {"k_scale": kv["k_scale"].at[pp, :, off].set(
+                   sk, mode="drop"),
+               "v_scale": kv["v_scale"].at[pp, :, off].set(
+                   sv, mode="drop"),
+               "q4": kv["q4"]}
+        for key, q in (("k", qk), ("v", qv)):
+            cur = kv[key][gp, :, prow].astype(jnp.int32) & 255
+            nib = q.astype(jnp.int32) & 15
+            b = jnp.where(hi, (cur & 0x0F) | (nib << 4),
+                          (cur & 0xF0) | nib)
+            b = (b - 256 * (b > 127)).astype(jnp.int8)
+            out[key] = kv[key].at[pp, :, prow].set(b, mode="drop")
+        return out
     if "k_scale" in kv:
         qk, sk = _quantize_kv(kh)
         qv, sv = _quantize_kv(vh)
@@ -889,6 +992,12 @@ def _gather_pages(kv, table):
     out = {}
     for key in ("k", "v"):
         pg = kv[key][table]                  # [S, P, H, page_len, D]
+        if "q4" in kv:
+            # packed int4 pages gather as [S, P, H, page_len//2, D]
+            # bytes; unpacking along the page-position axis restores
+            # the unpacked int4-valued int8 plane the shared slab
+            # readout dequantizes (q * scale — same contract as int8)
+            pg = unpack_int4(pg)
         s, p, h, pl, d = pg.shape
         out[key] = pg.transpose(0, 2, 1, 3, 4).reshape(s, h, p * pl, d)
     if "k_scale" in kv:
@@ -896,6 +1005,8 @@ def _gather_pages(kv, table):
             pg = kv[key][table]              # [S, P, H, page_len]
             s, p, h, pl = pg.shape
             out[key] = pg.transpose(0, 2, 1, 3).reshape(s, h, p * pl)
+    if "q4" in kv:
+        out["q4"] = kv["q4"]
     return out
 
 
@@ -910,8 +1021,13 @@ def _use_paged_kernel(kv, page_len: int, paged_kernel) -> bool:
     from distkeras_tpu.ops.paged_attention import page_aligned
     if paged_kernel is None:
         paged_kernel = backend_is_tpu()
-    return bool(paged_kernel) and page_aligned(page_len,
-                                               "k_scale" in kv)
+    if "q4" in kv:
+        quant = "int4"
+    elif "k_scale" in kv:
+        quant = "int8"
+    else:
+        quant = False
+    return bool(paged_kernel) and page_aligned(page_len, quant)
 
 
 def _paged_attn_readout(attn: MultiHeadAttention, p, q, kv, t, table,
@@ -943,7 +1059,7 @@ def _paged_attn_readout(attn: MultiHeadAttention, p, q, kv, t, table,
         anc=None if tree is None else tree["anc"],
         interpret=None if backend_is_tpu() else True, **sc)
     out = o.reshape(b, w_len, nh, dh).astype(dt)
-    return jnp.einsum("bshe,hed->bsd", out, p["wo"].astype(dt))
+    return _attn_out(p, out, dt)
 
 
 def _decode_attn_slots_paged(attn: MultiHeadAttention, p, kv, x, t,
@@ -1311,7 +1427,7 @@ def decode_fused_slots(module: Sequential, params, state, cache, tok, t,
                        page_len: int = 0, *, temperature=None,
                        top_k=None, top_p=None, keys=None,
                        moe_dispatched: bool = True, moe_stats=None,
-                       paged_kernel=None):
+                       paged_kernel=None, sampler=None):
     """``num_steps`` consecutive ``decode_step_slots[_paged]``
     iterations as one compiled scan. tok/t: [S] ints (per-slot pending
     input and write position); ``stop``: [S] int per-slot stop tokens
@@ -1332,6 +1448,10 @@ def decode_fused_slots(module: Sequential, params, state, cache, tok, t,
     garbage, overwritten before any mask admits them)."""
     greedy = temperature is None
     stats_on = moe_stats is not None
+    # fused-sampling PR: the engine routes the per-step draw through
+    # ``ops.sampling.sample_tokens`` (same key-split discipline, same
+    # byte stream) when its fused_sampling knob is on
+    sample = _sample_vec if sampler is None else sampler
 
     def body(carry, _):
         if greedy:
@@ -1356,8 +1476,8 @@ def decode_fused_slots(module: Sequential, params, state, cache, tok, t,
         else:
             split = jax.vmap(jax.random.split)(ks)
             ks = split[:, 0]
-            nxt = _sample_vec(logits, temperature, top_k, top_p,
-                              split[:, 1]).astype(cur.dtype)
+            nxt = sample(logits, temperature, top_k, top_p,
+                         split[:, 1]).astype(cur.dtype)
         # generate()'s stop rule, per slot: done rows hold the stop
         # token (padding the window), and a freshly emitted stop marks
         # the row done for the remaining steps
@@ -1434,6 +1554,22 @@ def _sample_vec(logits, temperature, top_k, top_p, rng):
     ``lax.top_k`` uses, so the vector path admits exactly the scalar
     path's candidate set."""
     greedy = jnp.argmax(logits, axis=-1)
+    lf = _masked_logits_vec(logits, temperature, top_k, top_p)
+    if rng.ndim > 1:                                     # per-slot keys
+        sampled = jax.vmap(jax.random.categorical)(rng, lf)
+    else:
+        sampled = jax.random.categorical(rng, lf, axis=-1)
+    return jnp.where(temperature > 0.0, sampled, greedy)
+
+
+def _masked_logits_vec(logits, temperature, top_k, top_p):
+    """The mask half of :func:`_sample_vec`: temperature-scaled f32
+    logits with the rank top-k and exclusive-cumsum nucleus cuts
+    applied (NEG_INF outside the candidate set). Shared with
+    ``ops.sampling.sample_epilogue`` so the fused sampling path admits
+    BIT-IDENTICAL candidate sets — ``categorical(key, lf)`` IS
+    ``argmax(lf + gumbel(key))``, which is exactly how the fused
+    epilogue factors it."""
     lf = logits.astype(jnp.float32)
     safe_t = jnp.where(temperature > 0.0, temperature, 1.0)
     lf = lf / safe_t[:, None]
@@ -1449,12 +1585,8 @@ def _sample_vec(logits, temperature, top_k, top_p, rng):
     keep_sorted = exclusive < top_p[:, None]
     thresh = jnp.min(jnp.where(keep_sorted, sorted_logits, jnp.inf),
                      axis=-1, keepdims=True)
-    lf = jnp.where((top_p >= 1.0)[:, None] | (lf >= thresh), lf, NEG_INF)
-    if rng.ndim > 1:                                     # per-slot keys
-        sampled = jax.vmap(jax.random.categorical)(rng, lf)
-    else:
-        sampled = jax.random.categorical(rng, lf, axis=-1)
-    return jnp.where(temperature > 0.0, sampled, greedy)
+    return jnp.where((top_p >= 1.0)[:, None] | (lf >= thresh), lf,
+                     NEG_INF)
 
 
 def _per_seq_vec(value, b, dtype, none_sentinel, name):
@@ -1530,12 +1662,28 @@ def _fuse_qkv_params(module: Sequential, params):
 def _project_qkv(attn: MultiHeadAttention, p, xc):
     """q/k/v projections for the serving paths: the fused ``wqkv``
     matmul when the tree carries it (see ``_fuse_qkv_params``), the
-    three separate einsums otherwise."""
+    fused dequant-matmul when the engine left the projections
+    quantized (``ServingEngine(weight_quant=)`` — ``ops.quant_matmul``
+    qdicts; the kernel unpacks int8/int4 bytes in-register, so the
+    float weights never touch HBM), the three separate einsums
+    otherwise."""
     if "wqkv" in p:
         qkv = jnp.einsum("bsd,dhe->bshe", xc, p["wqkv"].astype(xc.dtype))
         h, hkv = attn.num_heads, attn.kv_heads
         return (qkv[:, :, :h], qkv[:, :, h:h + hkv],
                 qkv[:, :, h + hkv:])
+    if isinstance(p["wq"], dict):
+        from distkeras_tpu.ops.quant_matmul import quant_matmul
+        b, s_len, d = xc.shape
+        x2 = xc.reshape(b * s_len, d)
+
+        def proj(wdict, heads):
+            y = quant_matmul(x2, wdict).astype(xc.dtype)
+            return y.reshape(b, s_len, heads, -1)
+
+        return (proj(p["wq"], attn.num_heads),
+                proj(p["wk"], attn.kv_heads),
+                proj(p["wv"], attn.kv_heads))
     dt = xc.dtype
     q = jnp.einsum("bsd,dhe->bshe", xc, p["wq"].astype(dt))
     k = jnp.einsum("bsd,dhe->bshe", xc, p["wk"].astype(dt))
@@ -1678,7 +1826,8 @@ def generate(model: Model, prompts, max_new_tokens: int,
     # normalize: np.int8/jnp.int8 mean the quantized path, same as "int8"
     # (a raw astype(int8) of float weights would zero them); other int
     # dtypes are meaningless for weights
-    if weights_dtype is not None and weights_dtype != "int8":
+    if weights_dtype is not None and weights_dtype not in ("int8",
+                                                           "int4"):
         dt = jnp.dtype(weights_dtype)
         if dt == jnp.dtype(jnp.int8):
             weights_dtype = "int8"
@@ -1687,8 +1836,8 @@ def generate(model: Model, prompts, max_new_tokens: int,
             # sub-unity weights (bool/ints round them to 0/1)
             raise ValueError(
                 f"weights_dtype {dt.name!r} unsupported: use a float "
-                "dtype, 'int8' (weight-only quantized serving), 'auto' "
-                "or None")
+                "dtype, 'int8'/'int4' (weight-only quantized serving), "
+                "'auto' or None")
     # serving-weight cache: one entry per dtype, each validated against
     # the SOURCE params by identity (strong ref -> no id()-reuse hazard);
     # a loop alternating dtypes must not re-pay full-tree conversion.
@@ -1702,22 +1851,28 @@ def generate(model: Model, prompts, max_new_tokens: int,
               if v[0] is not model.params]:
         del cache_all[k]
     scales = None
-    if weights_dtype == "int8":
-        # weight-only int8 serving (models.quantize): matrices stored as
-        # {q: int8, scale: f32[out]}; dequant happens INSIDE the scan
-        # body so XLA fuses q*scale into each step's matmul reads — the
-        # weight HBM traffic per decoded token is int8, halving the
-        # dominant read again vs bf16 (docs/PERF.md roofline)
+    if weights_dtype in ("int8", "int4"):
+        # weight-only quantized serving (models.quantize): matrices
+        # stored as {q: int8, scale: f32[out]}; dequant happens INSIDE
+        # the scan body so XLA fuses q*scale into each step's matmul
+        # reads — the weight HBM traffic per decoded token is int8,
+        # halving the dominant read again vs bf16 (docs/PERF.md
+        # roofline). "int4" swaps in the 4-bit grid (bits=4): the
+        # accuracy rung below int8 — here it still stores one byte per
+        # entry; the serving engine's fused dequant-matmul kernel is
+        # where nibble packing pays the extra 2x (ops.quant_matmul)
         from distkeras_tpu.models.quantize import quantize_params
-        cached = cache_all.get("int8")
+        cached = cache_all.get(weights_dtype)
         if cached is None:
-            q, s = quantize_params(jax.device_get(model.params))
+            q, s = quantize_params(
+                jax.device_get(model.params),
+                bits=4 if weights_dtype == "int4" else 8)
             # scales go to device too: per-call H2D of hundreds of small
             # numpy leaves would reintroduce the per-call overhead this
             # cache exists to avoid (device_put preserves None leaves)
             cached = (model.params,
                       (jax.device_put(q), jax.device_put(s)))
-            cache_all["int8"] = cached
+            cache_all[weights_dtype] = cached
         run_params, scales = cached[1]
     elif weights_dtype is None:
         run_params = model.params
@@ -1770,9 +1925,10 @@ def generate(model: Model, prompts, max_new_tokens: int,
         samp_key = (float(temperature), top_k,
                     None if top_p is None else float(top_p), stop_token)
     key = (b, p_len, int(max_new_tokens)) + samp_key + (
-        jnp.dtype(cache_dtype).name,
+        "int4" if (isinstance(cache_dtype, str) and cache_dtype == "int4")
+        else jnp.dtype(cache_dtype).name,
         None if weights_dtype is None
-        else ("int8" if weights_dtype == "int8"
+        else (weights_dtype if weights_dtype in ("int8", "int4")
               else jnp.dtype(weights_dtype).name),
         prefill_chunk)
     jit_cache = getattr(model, "_jit_generate", None)

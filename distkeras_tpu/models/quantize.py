@@ -36,12 +36,20 @@ import numpy as np
 from distkeras_tpu.models.core import Model, user_float
 
 
-def _quantize_leaf(w: np.ndarray) -> Dict[str, np.ndarray]:
-    """Symmetric per-last-axis-channel int8: w ≈ q * scale."""
+def _quantize_leaf(w: np.ndarray, bits: int = 8) -> Dict[str, np.ndarray]:
+    """Symmetric per-last-axis-channel quantization: w ≈ q * scale.
+    ``bits=8`` is the established int8 grid; ``bits=4`` (quantized-
+    decode PR) quantizes to [-7, 7] while still storing one int8 byte
+    per entry — the dequant contract is identical, and the serving
+    engine's fused dequant-matmul kernel owns nibble PACKING for the
+    matrices it streams (``ops.quant_matmul``)."""
+    if bits not in (4, 8):
+        raise ValueError(f"bits must be 4 or 8, got {bits}")
+    qmax = 7.0 if bits == 4 else 127.0
     absmax = np.abs(w).max(axis=tuple(range(w.ndim - 1)), keepdims=True)
-    scale = (absmax / 127.0).astype(np.float32)
+    scale = (absmax / qmax).astype(np.float32)
     scale = np.where(scale == 0.0, 1.0, scale)          # all-zero channels
-    q = np.clip(np.round(w / scale), -127, 127).astype(np.int8)
+    q = np.clip(np.round(w / scale), -qmax, qmax).astype(np.int8)
     return {"q": q, "scale": scale.reshape(-1).astype(np.float32)}
 
 
@@ -64,15 +72,17 @@ def _is_quantizable(leaf, name: str) -> bool:
             and np.issubdtype(np.asarray(leaf).dtype, np.floating))
 
 
-def quantize_params(params) -> Tuple[Any, Any]:
+def quantize_params(params, bits: int = 8) -> Tuple[Any, Any]:
     """params pytree -> (same-structure tree of int8 ``q`` / passthrough
-    leaves, matching tree of f32 ``scale`` / None leaves)."""
+    leaves, matching tree of f32 ``scale`` / None leaves). ``bits=4``
+    uses the 4-bit grid (:func:`_quantize_leaf`); storage stays one
+    int8 byte per entry, so :func:`dequantize_params` serves both."""
     flat, treedef = jax.tree_util.tree_flatten_with_path(params)
     qs, scales = [], []
     for path, leaf in flat:
         name = str(getattr(path[-1], "key", "")) if path else ""
         if _is_quantizable(leaf, name):
-            d = _quantize_leaf(np.asarray(leaf))
+            d = _quantize_leaf(np.asarray(leaf), bits)
             qs.append(d["q"])
             scales.append(d["scale"])
         else:

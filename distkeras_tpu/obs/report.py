@@ -362,6 +362,53 @@ def to_json(report: Dict) -> str:
     return json.dumps(report, indent=2, sort_keys=True)
 
 
+# --- weight-quantization accuracy (quantized-decode PR) ---------------------
+
+
+def weight_quant_report(source, weight_quant=None) -> Dict:
+    """The accuracy-drift artifact of serving quantized weights: one
+    deterministic dict from the per-leaf reconstruction errors a
+    ``ServingEngine(weight_quant=...)`` computes at construction
+    (``engine.weight_quant_error`` — ``ops.quant_matmul.quant_error``
+    per quantized leaf). ``source`` is the engine itself or the raw
+    path-keyed error dict."""
+    errors = getattr(source, "weight_quant_error", source)
+    if weight_quant is None:
+        weight_quant = getattr(source, "weight_quant", None)
+    if not errors:
+        raise ValueError(
+            "no weight-quantization errors to report (engine built "
+            "without weight_quant?)")
+    worst = max(errors, key=lambda k: errors[k]["rel_rms"])
+    return {
+        "schema_version": SCHEMA_VERSION,
+        "weight_quant": weight_quant,
+        "num_leaves": len(errors),
+        "mean_rel_rms": (sum(v["rel_rms"] for v in errors.values())
+                         / len(errors)),
+        "worst_leaf": worst,
+        "worst_rel_rms": errors[worst]["rel_rms"],
+        "max_abs_err": max(v["max_abs_err"] for v in errors.values()),
+        "leaves": {k: dict(v) for k, v in sorted(errors.items())},
+    }
+
+
+def weight_quant_markdown(report: Dict) -> str:
+    """Review-comment form of :func:`weight_quant_report`: headline +
+    one row per quantized leaf."""
+    lines = [
+        f"# Weight quantization accuracy ({report['weight_quant']})", "",
+        f"{report['num_leaves']} quantized leaves — mean rel-RMS "
+        f"{_fmt(report['mean_rel_rms'])}, worst "
+        f"{_fmt(report['worst_rel_rms'])} at `{report['worst_leaf']}`.",
+        "",
+        "| leaf | rel RMS | max abs err |", "|---|---|---|"]
+    for k, v in report["leaves"].items():
+        lines.append(f"| `{k}` | {_fmt(v['rel_rms'])} "
+                     f"| {_fmt(v['max_abs_err'], 4)} |")
+    return "\n".join(lines) + "\n"
+
+
 def _fmt(v, nd: int = 3) -> str:
     if v is None:
         return "-"

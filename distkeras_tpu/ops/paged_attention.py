@@ -36,6 +36,13 @@ Feature contract (everything the gather path supports):
     the kernel (scores * k_scale after the D contraction,
     probabilities * v_scale before the V contraction), so HBM traffic
     stays int8 + scales.
+  * **int4 caches** (this PR) — pages arrive nibble-PACKED along the
+    position axis (``[N, Hkv, page_len//2, D]`` bytes, two positions
+    per byte, ``models.decoding.pack_int4``'s half-split); the kernel
+    unpacks each page block on the VPU and dequantizes through the
+    same per-token scale planes, halving the payload HBM read again
+    vs int8. The packed byte plane must itself satisfy the int8
+    sublane rule, hence the ``page_len % 64`` gate.
   * **Sentinels** — a table entry >= N (unallocated logical page)
     clamps in the index map and its program skips compute; pages
     entirely past ``t + W - 1`` (or entirely before a sliding
@@ -52,9 +59,10 @@ mode (the off-TPU/CI oracle) across GQA/int8/window/W>1/scrambled
 page orders, and end-to-end through the serving engine.
 
 Tiling: the page block's second-to-last dim is ``page_len``, so the
-Mosaic sublane rule wants ``page_len % 8 == 0`` for float caches and
-``% 32`` for int8; ``page_aligned`` is the shared gate — callers fall
-back to the gather path for unaligned pools (the engine default
+Mosaic sublane rule wants ``page_len % 8 == 0`` for float caches,
+``% 32`` for int8, and ``% 64`` for packed int4 (the byte plane is
+``page_len // 2`` rows); ``page_aligned`` is the shared gate — callers
+fall back to the gather path for unaligned pools (the engine default
 ``page_len=16`` qualifies for float caches).
 """
 
@@ -77,15 +85,52 @@ from distkeras_tpu.compat import backend_is_tpu
 from distkeras_tpu.ops.attention import NEG_INF
 
 
-def page_aligned(page_len: int, quantized: bool) -> bool:
+def page_alignment(quantized) -> int:
+    """The ``page_len`` divisor the kernel's sublane tiling demands for
+    a cache quantization mode. ``quantized`` spans the dtype ladder:
+    falsy / a float dtype name -> 8 (f32/bf16 sublane rule), ``True`` /
+    ``8`` / ``"int8"`` -> 32 (int8 sublane rule), ``4`` / ``"int4"`` ->
+    64 (the packed byte plane is ``page_len // 2`` int8 rows, and THAT
+    must hit the % 32 int8 rule)."""
+    if isinstance(quantized, str):
+        name = quantized.lower()
+        if name in ("int4", "4"):
+            return 64
+        if name == "int8":
+            return 32
+        if name in ("f32", "float32", "bf16", "bfloat16", "float16"):
+            return 8
+        raise ValueError(f"unknown cache quantization mode {quantized!r}")
+    if quantized == 4:
+        return 64
+    return 32 if quantized else 8
+
+
+def page_aligned(page_len: int, quantized=False) -> bool:
     """Can the kernel tile this pool? The page block's sublane dim is
-    ``page_len``: Mosaic wants multiples of 8 (f32/bf16) / 32 (int8)."""
-    return int(page_len) % (32 if quantized else 8) == 0
+    ``page_len``: Mosaic wants multiples of 8 (f32/bf16) / 32 (int8) /
+    64 (int4 — see :func:`page_alignment` for the full matrix)."""
+    return int(page_len) % page_alignment(quantized) == 0
+
+
+def _unpack4(b, dt):
+    """In-kernel nibble unpack of a ``[page_len//2, D]`` packed int4
+    byte block to ``[page_len, D]`` in the compute dtype. Matches
+    ``models.decoding.pack_int4``'s half-split layout (byte row r =
+    position r low nibble, position r + page_len//2 high nibble), so
+    the sublane concat lands positions in order. All nibble math runs
+    in int32 (portable two's complement on VPU and in interpret mode)."""
+    b32 = b.astype(jnp.int32) & 255
+    lo = b32 & 15
+    lo = lo - 16 * (lo > 7)
+    hi = (b32 >> 4) & 15
+    hi = hi - 16 * (hi > 7)
+    return jnp.concatenate([lo, hi], axis=0).astype(dt)
 
 
 def _kernel(t_ref, tb_ref, *refs, scale: float, page_len: int,
             g: int, w_len: int, hkv: int, window, quantized: bool,
-            n_pages: int, tree: bool):
+            int4: bool, n_pages: int, tree: bool):
     if tree:
         anc_ref, refs = refs[0], refs[1:]
     else:
@@ -153,7 +198,14 @@ def _kernel(t_ref, tb_ref, *refs, scale: float, page_len: int,
         # the bh_block amortization of ops.decode_attention)
         for h in range(hkv):
             q = q_ref[0, h]                    # [rows, D]
-            kblk = k_ref[0, h].astype(q.dtype) if quantized else k_ref[0, h]
+            if int4:
+                # packed page: [page_len//2, D] bytes -> [page_len, D];
+                # dequant stays the shared q * scale contract below
+                kblk = _unpack4(k_ref[0, h], q.dtype)
+            elif quantized:
+                kblk = k_ref[0, h].astype(q.dtype)
+            else:
+                kblk = k_ref[0, h]
             s = lax.dot_general(q, kblk, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) \
                 * scale
@@ -172,7 +224,12 @@ def _kernel(t_ref, tb_ref, *refs, scale: float, page_len: int,
                                                 keepdims=True)
             if vs_ref is not None:
                 p = p * vs_ref[0, h][None, :]  # dequant values
-            vblk = v_ref[0, h].astype(q.dtype) if quantized else v_ref[0, h]
+            if int4:
+                vblk = _unpack4(v_ref[0, h], q.dtype)
+            elif quantized:
+                vblk = v_ref[0, h].astype(q.dtype)
+            else:
+                vblk = v_ref[0, h]
             acc_ref[h] = acc_prev * alpha + lax.dot_general(
                 p.astype(vblk.dtype), vblk, (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32)
@@ -209,13 +266,26 @@ def paged_decode_attention(q, k_pages, v_pages, t, table, *,
     from its ancestor count (``t + depth``). A lower-triangular ``anc``
     reproduces the plain window-causal mask exactly."""
     s, w_len, hkv, g, d = q.shape
-    n_pages, _, page_len, _ = k_pages.shape
+    n_pages, _, payload_rows, _ = k_pages.shape
     n_logical = table.shape[1]
     quantized = k_scale is not None
-    if not page_aligned(page_len, quantized):
+    # int4 pools arrive nibble-PACKED along the position axis (pack_
+    # int4's half-split): the payload block holds page_len // 2 byte
+    # rows while the per-position scale plane keeps the true page_len —
+    # that shape disagreement IS the int4 signal (no extra flag to
+    # thread through jit)
+    int4 = quantized and k_scale.shape[2] != payload_rows
+    page_len = k_scale.shape[2] if int4 else payload_rows
+    if int4 and page_len != 2 * payload_rows:
+        raise ValueError(
+            f"int4 payload rows {payload_rows} do not match scale "
+            f"plane page_len {page_len} (expected page_len // 2)")
+    mode = "int4" if int4 else ("int8" if quantized else False)
+    if not page_aligned(page_len, mode):
         raise ValueError(
             f"page_len {page_len} is not kernel-tileable "
-            f"({'int8 wants % 32' if quantized else 'wants % 8'}); "
+            f"(% {page_alignment(mode)} for "
+            f"{mode or 'float'} pages); "
             "use models.decoding._gather_pages instead")
     if scale is None:
         scale = d ** -0.5
@@ -265,8 +335,8 @@ def paged_decode_attention(q, k_pages, v_pages, t, table, *,
         operands.append(anc_rows)
     in_specs += [
         pl.BlockSpec((1, hkv, rows_p, d), q_map),
-        pl.BlockSpec((1, hkv, page_len, d), kv_map),
-        pl.BlockSpec((1, hkv, page_len, d), kv_map),
+        pl.BlockSpec((1, hkv, payload_rows, d), kv_map),
+        pl.BlockSpec((1, hkv, payload_rows, d), kv_map),
     ]
     operands += [qr, k_pages, v_pages]
     if quantized:
@@ -276,7 +346,7 @@ def paged_decode_attention(q, k_pages, v_pages, t, table, *,
     kernel = functools.partial(
         _kernel, scale=float(scale), page_len=int(page_len), g=int(g),
         w_len=int(w_len), hkv=int(hkv), window=window,
-        quantized=quantized, n_pages=int(n_pages),
+        quantized=quantized, int4=int4, n_pages=int(n_pages),
         tree=anc is not None)
     kwargs = {}
     if not interpret:

@@ -311,6 +311,8 @@ class ServingEngine:
                  max_len: int = 256,
                  prefill_chunk: Optional[int] = None,
                  cache_dtype=None, weights_dtype="auto",
+                 weight_quant: Optional[str] = None,
+                 hbm_budget: Optional[int] = None,
                  metrics: Optional[ServingMetrics] = None,
                  max_queue: Optional[int] = None,
                  tracer=None, slo=None,
@@ -329,6 +331,7 @@ class ServingEngine:
                  moe_decode: str = "dispatched",
                  ep_mesh=None,
                  overlap: bool = True, fuse_steps: int = 0,
+                 fused_sampling: bool = False,
                  engine_id: Optional[str] = None):
         module = model.module
         if not isinstance(module, Sequential):
@@ -352,14 +355,52 @@ class ServingEngine:
                            else jnp.float32)
         # same "auto" weight policy as generate(): pre-cast matrix
         # weights to the compute dtype once (free for bf16 models, a
-        # no-op for f32); int8 weight serving is a documented non-goal
-        # of this engine revision
+        # no-op for f32)
         if weights_dtype == "auto":
             weights_dtype = compute_dt if (
                 compute_dt is not None
                 and compute_dt != jnp.dtype(jnp.float32)) else None
-        self._params = (model.params if weights_dtype is None
-                        else _serving_params(model.params, weights_dtype))
+
+        # --- quantized decode-GEMM weights (quantized-decode PR) --------
+        # weight_quant replaces the float weight tree with per-channel
+        # int8/int4 qdicts (``ops.quant_matmul``): every compiled
+        # serving program dequantizes IN-GRAPH as its first op (the
+        # int bytes are what lives in HBM; XLA fuses the dequant into
+        # each consumer), and the decode/fused programs additionally
+        # keep the attention projections quantized for the fused
+        # dequant-matmul kernel when the backend gate is open.
+        if weight_quant not in (None, "int8", "int4"):
+            raise ValueError(
+                f"weight_quant must be None, 'int8' or 'int4', "
+                f"got {weight_quant!r}")
+        if weight_quant is not None and ep_mesh is not None:
+            raise ValueError(
+                "weight_quant does not compose with expert parallelism "
+                "(the per-leaf expert shardings assume float leaves, "
+                "not qdicts) — serve EP models unquantized")
+        self.weight_quant = weight_quant
+        #: path-keyed per-leaf quantization error (max_abs_err /
+        #: rel_rms) — ``obs.report.weight_quant_report`` renders it
+        self.weight_quant_error = None
+        self._wq_keep_attn = False
+        self._wq_dequant_dt = (compute_dt if compute_dt is not None
+                               else jnp.float32)
+        if weight_quant is not None:
+            from distkeras_tpu.ops import quant_matmul as _qm
+            qtree = _qm.quantize_params_tree(
+                model.params, bits=4 if weight_quant == "int4" else 8)
+            self.weight_quant_error = _qm.tree_quant_errors(
+                model.params, qtree)
+            self._params = qtree
+            # shape misalignments degrade per-leaf to the XLA
+            # reference inside quant_matmul, so the keep-attn decision
+            # only needs the backend gate (TPU, or a test forcing
+            # interpreter mode at construction+trace time)
+            self._wq_keep_attn = _qm.kernel_enabled()
+        else:
+            self._params = (model.params if weights_dtype is None
+                            else _serving_params(model.params,
+                                                 weights_dtype))
         self._state = model.state
 
         # --- MoE serving (MoE-serving PR) -------------------------------
@@ -408,12 +449,26 @@ class ServingEngine:
                 raise ValueError(
                     "decode_kernel applies to the paged readout only; "
                     "a slab engine always uses the einsum path")
+            if hbm_budget is not None:
+                raise ValueError(
+                    "hbm_budget needs kv_layout='paged' (the slab pool "
+                    "has no page budget to size)")
         if kv_layout == "paged":
+            # hbm_budget sizes the page pool from a device-memory
+            # envelope: the resident WEIGHT bytes (quantized or not —
+            # this is where int4 weights + int4 KV pages compound into
+            # more admitted streams) are reserved off the top and the
+            # remainder becomes whole pages
+            reserve = (sum(np.asarray(l).nbytes for l in
+                           jax.tree_util.tree_leaves(self._params))
+                       if hbm_budget is not None else 0)
             self.pool = PagedKVPool(module, self.num_slots, self.max_len,
                                     page_len=page_len,
                                     num_pages=num_pages,
                                     host_pages=host_kv_pages,
-                                    dtype=cache_dtype)
+                                    dtype=cache_dtype,
+                                    hbm_budget=hbm_budget,
+                                    reserve_bytes=reserve)
             self.page_len = self.pool.page_len
             self.prefix = PrefixCache(self.pool) if prefix_cache else None
             if prefix_granularity < 1:
@@ -454,6 +509,12 @@ class ServingEngine:
                 f"fuse_steps must be >= 0, got {fuse_steps}")
         #: fused multi-step decode window (engaged when >= 2)
         self.fuse_steps = fuse_steps
+        #: fused sampling epilogue (quantized-decode PR): sampled
+        #: decode steps draw through ``ops.sampling.sample_tokens`` —
+        #: the in-kernel mask+gumbel epilogue on TPU, the
+        #: byte-identical reference factorization elsewhere (either
+        #: way the token streams match the unfused sampler exactly)
+        self.fused_sampling = bool(fused_sampling)
         self._fused_fns = {}                 # greedy_only -> jit scan
         #: the launched-but-unfetched decode step (lag-1 pipeline)
         self._pending: Optional[_PendingStep] = None
@@ -725,12 +786,25 @@ class ServingEngine:
         # whole point: per-chip weight traffic shrinks with the mesh
         self._params = jax.device_put(self._params, shardings)
 
-    def _jit_serving(self, f, n_args: int):
+    def _jit_serving(self, f, n_args: int, keep_attn: bool = False):
         """Compile one serving program: plain ``jax.jit``, or — under
         expert parallelism — ``jit(shard_map(f))`` with the params
         (always argument 0) split by the expert specs and every other
         argument/output replicated (the MoE psum makes outputs agree
-        across the axis)."""
+        across the axis). Under ``weight_quant`` every program first
+        dequantizes the qdict tree in-graph; ``keep_attn`` (the
+        decode/fused programs, whose only attention-weight consumers
+        are ``_project_qkv`` / ``_attn_out``) leaves the attention
+        projections quantized for the fused dequant-matmul kernel."""
+        if self.weight_quant is not None:
+            from distkeras_tpu.ops.quant_matmul import dequant_params_tree
+            inner, dt = f, self._wq_dequant_dt
+            keep = keep_attn and self._wq_keep_attn
+
+            def f(params, *rest):
+                return inner(
+                    dequant_params_tree(params, dt, keep_attn=keep),
+                    *rest)
         if self._ep_mesh is None:
             return jax.jit(f)
         from jax.sharding import PartitionSpec as P
@@ -1268,6 +1342,12 @@ class ServingEngine:
                         return jnp.argmax(logits, axis=-1), cache, moe
                     n_args = 5
             else:
+                if self.fused_sampling:
+                    from distkeras_tpu.ops.sampling import sample_tokens
+                    sampler = sample_tokens
+                else:
+                    sampler = _sample_vec
+
                 def body(params, state, cache, tok, t, temp, topk, topp,
                          keys, tables):
                     logits, cache, moe = step(params, state, cache,
@@ -1276,8 +1356,8 @@ class ServingEngine:
                     # only on its own seed, not on which neighbours
                     # share the batch
                     split = jax.vmap(jax.random.split)(keys)
-                    nxt = _sample_vec(logits, temp, topk, topp,
-                                      split[:, 1])
+                    nxt = sampler(logits, temp, topk, topp,
+                                  split[:, 1])
                     return nxt, cache, split[:, 0], moe
 
                 if paged:
@@ -1289,7 +1369,7 @@ class ServingEngine:
                                     topk, topp, keys, None)
                     n_args = 9
 
-            fn = self._jit_serving(fn, n_args)
+            fn = self._jit_serving(fn, n_args, keep_attn=True)
             self._step_fns[greedy_only] = fn
             self._recompile.watch(
                 "serving.decode_greedy" if greedy_only
@@ -1332,6 +1412,10 @@ class ServingEngine:
                                     None)
                     n_args = 6
             else:
+                if self.fused_sampling:
+                    from distkeras_tpu.ops.sampling import sample_tokens
+                    moe_kw = dict(moe_kw, sampler=sample_tokens)
+
                 def body(params, state, cache, tok, t, stop, temp,
                          topk, topp, keys, tables):
                     toks, cache, keys, moe = decode_fused_slots(
@@ -1351,7 +1435,7 @@ class ServingEngine:
                                     temp, topk, topp, keys, None)
                     n_args = 10
 
-            fn = self._jit_serving(fn, n_args)
+            fn = self._jit_serving(fn, n_args, keep_attn=True)
             self._fused_fns[greedy_only] = fn
             self._recompile.watch(
                 "serving.decode_fused_greedy" if greedy_only

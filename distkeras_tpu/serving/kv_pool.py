@@ -63,7 +63,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from distkeras_tpu.models.decoding import init_cache
+from distkeras_tpu.models.decoding import (init_cache, pack_int4,
+                                           unpack_int4)
 
 
 @jax.jit
@@ -144,19 +145,34 @@ def _write_pages(pool, staging, table):
     batch-1 staging cache lands on physical page ``table[p]``; sentinel
     entries (>= N) drop. One compiled program serves every insert —
     which pages to SKIP (shared prefix pages, pages past the prompt)
-    is encoded by the sentinel, not by program shape."""
-    def write(pl, st):
-        page_len = pl.shape[2]
+    is encoded by the sentinel, not by program shape. int4 pools
+    (``"q4"`` marker) nibble-pack the payload pages here: the staging
+    cache stays unpacked (one int8 byte per entry, the shared dequant
+    contract), the POOL planes are where the 2x byte saving lives."""
+    def write(pl, st, packed):
+        page_len = 2 * pl.shape[2] if packed else pl.shape[2]
         if st.ndim == 4:
             _, h, s_max, d = st.shape
             pages = st.reshape(h, s_max // page_len, page_len, d) \
                       .transpose(1, 0, 2, 3)
+            if packed:
+                pages = pack_int4(pages)
         else:
             _, h, s_max = st.shape
             pages = st.reshape(h, s_max // page_len, page_len) \
                       .transpose(1, 0, 2)
         return pl.at[table].set(pages.astype(pl.dtype), mode="drop")
-    return jax.tree_util.tree_map(write, pool, staging)
+    out = []
+    for pl_kv, st_kv in zip(pool, staging):
+        if pl_kv is None:
+            out.append(None)
+            continue
+        q4 = "q4" in pl_kv
+        out.append({
+            key: pl if key == "q4"
+            else write(pl, st_kv[key], q4 and key in ("k", "v"))
+            for key, pl in pl_kv.items()})
+    return out
 
 
 @jax.jit
@@ -183,9 +199,11 @@ def _load_pages(staging, pool, table, valid):
     staging content. The prefix-cache hit path: shared pages (and a
     copy-on-write donor) materialize as the staging prefix the
     remaining prefill chunks attend to."""
-    def load(st, pl):
-        page_len = pl.shape[2]
-        g = pl[table]                        # [P, H, page_len, D?]
+    def load(st, pl, packed):
+        g = pl[table]                        # [P, H, page_len(/2), D?]
+        if packed:
+            g = unpack_int4(g)               # [P, H, page_len, D]
+        page_len = g.shape[2]
         if st.ndim == 4:
             _, h, s_max, d = st.shape
             cur = st.reshape(h, s_max // page_len, page_len, d) \
@@ -198,7 +216,17 @@ def _load_pages(staging, pool, table, valid):
                 .transpose(1, 0, 2)
         sel = jnp.where(valid[:, None, None], g.astype(cur.dtype), cur)
         return sel.transpose(1, 0, 2).reshape(1, h, s_max)
-    return jax.tree_util.tree_map(load, staging, pool)
+    out = []
+    for st_kv, pl_kv in zip(staging, pool):
+        if st_kv is None:
+            out.append(None)
+            continue
+        q4 = "q4" in pl_kv
+        out.append({
+            key: st if key == "q4"
+            else load(st, pl_kv[key], q4 and key in ("k", "v"))
+            for key, st in st_kv.items()})
+    return out
 
 
 class PagedKVPool:
@@ -213,7 +241,9 @@ class PagedKVPool:
 
     def __init__(self, module, num_slots: int, max_len: int,
                  page_len: int = 16, num_pages: Optional[int] = None,
-                 host_pages: int = 0, dtype=jnp.float32):
+                 host_pages: int = 0, dtype=jnp.float32,
+                 hbm_budget: Optional[int] = None,
+                 reserve_bytes: int = 0):
         if num_slots < 1:
             raise ValueError(f"num_slots must be >= 1, got {num_slots}")
         if max_len < 1:
@@ -224,8 +254,35 @@ class PagedKVPool:
         self.num_slots = int(num_slots)
         self.max_len = int(max_len)
         self.page_len = int(page_len)
+        self._int4 = isinstance(dtype, str) and dtype == "int4"
+        if self._int4 and self.page_len % 2:
+            raise ValueError(
+                f"int4 pages nibble-pack two positions per byte; "
+                f"page_len must be even, got {page_len}")
         #: logical pages per slot: the page-table width (covers max_len)
         self.pages_per_slot = -(-self.max_len // self.page_len)
+        #: bytes ONE physical page occupies across every layer's
+        #: planes — quantized payload (int4: packed, page_len // 2
+        #: bytes per head-dim row) AND the per-token scale planes.
+        #: Satellite fix: budget math that counts payload bytes only
+        #: overcommits quantized admission by the scale-plane share
+        #: (Dh=64 -> ~6% at int8, ~12% at int4 f32 scales).
+        self.page_bytes = self._page_bytes(module, self.page_len, dtype,
+                                           self.max_len)
+        if hbm_budget is not None:
+            # size the pool to a BYTE budget: pages = what fits after
+            # reserved bytes (weights etc.) — quantization translates
+            # directly into more resident pages, hence more admitted
+            # streams under the same budget
+            if num_pages is not None:
+                raise ValueError(
+                    "pass num_pages or hbm_budget, not both")
+            avail = int(hbm_budget) - int(reserve_bytes)
+            num_pages = avail // self.page_bytes
+            if num_pages < 1:
+                raise ValueError(
+                    f"hbm_budget {hbm_budget} - reserve {reserve_bytes}"
+                    f" does not fit one {self.page_bytes}-byte page")
         if num_pages is None:
             # capacity parity with the slab pool by default; real
             # deployments size this to the HBM budget and rely on
@@ -244,6 +301,17 @@ class PagedKVPool:
         # the page length
         self.cache = init_cache(module, self.num_pages, self.page_len,
                                 dtype, check_len=self.max_len)
+        if self._int4:
+            # the POOL stores packed nibbles: the unpacked-payload
+            # planes init_cache built become [N, H, page_len//2, D]
+            # byte planes (zeros pack to zeros — no convert pass)
+            self.cache = [
+                kv if kv is None else {
+                    key: (jnp.zeros(a.shape[:2] + (a.shape[2] // 2,)
+                                    + a.shape[3:], jnp.int8)
+                          if key in ("k", "v") else a)
+                    for key, a in kv.items()}
+                for kv in self.cache]
         self.tables = np.full((self.num_slots, self.pages_per_slot),
                               self.num_pages, np.int32)
         #: cached [pages_per_slot] logical-page index — reused by the
@@ -288,6 +356,30 @@ class PagedKVPool:
         self._pending_host: List[Dict] = []
         #: lazy-fence odometer (tests pin laziness through it)
         self.host_fences = 0
+
+    @staticmethod
+    def _page_bytes(module, page_len: int, dtype, max_len: int) -> int:
+        """Per-physical-page byte cost across all layers, from an
+        abstract (eval_shape — nothing allocated) one-page probe:
+        payload planes (int4: halved, two nibbles per byte) plus scale
+        planes. The structural ``"q4"`` marker is per-LAYER, not
+        per-page, and is excluded."""
+        probe = jax.eval_shape(
+            lambda: init_cache(module, 1, page_len, dtype,
+                               check_len=max_len))
+        int4 = isinstance(dtype, str) and dtype == "int4"
+        total = 0
+        for kv in probe:
+            if kv is None:
+                continue
+            for key, a in kv.items():
+                if key == "q4":
+                    continue
+                n = int(np.prod(a.shape)) * jnp.dtype(a.dtype).itemsize
+                if int4 and key in ("k", "v"):
+                    n //= 2
+                total += n
+        return total
 
     # -- device views -------------------------------------------------------
 

@@ -203,3 +203,78 @@ def test_regression_check_inverts_for_lower_is_better_metric():
     out = bench._regression_check(worse, prev, "BENCH_r05.json")
     assert any("rose" in f for f in out["flags"])
     assert any("below_anchor" in f for f in out["flags"])
+
+
+def test_regression_check_flags_pre_serving_era_anchor():
+    """A prior record whose headline roster is entirely pre-serving
+    families (the real BENCH_r05 shape) is a stale anchor: the moe
+    0.735x comparison against it is archaeology, not a regression.
+    The in-run below-anchor tripwire still applies."""
+    metric = "moe_lm_train_tokens_per_sec_per_chip"
+    prev = {metric: {"value": 47156.5, "vs_baseline": 0.735},
+            "lm_train_tokens_per_sec_per_chip":
+                {"value": 100.0, "vs_baseline": 1.0}}
+    assert set(prev) <= bench.PRE_SERVING_FAMILIES
+    rec = {"metric": metric, "value": 20000.0, "vs_baseline": 0.735}
+    out = bench._regression_check(rec, prev, "BENCH_r05.json")
+    assert "predates the serving stack" in out["stale_anchor"]
+    assert "value_vs_prev" not in out          # comparison skipped
+    assert any("below_anchor" in f for f in out["flags"])  # in-run
+
+
+def test_regression_check_runs_against_serving_era_anchor():
+    """One serving-era family in the prior roster means the record
+    postdates the stack: comparisons run (and flag) normally."""
+    prev = {"lm_train_tokens_per_sec_per_chip":
+                {"value": 1000.0, "vs_baseline": 2.0},
+            "serving_steady_decode_tokens_per_sec_per_chip":
+                {"value": 50.0, "vs_baseline": 0.95}}
+    rec = {"metric": "lm_train_tokens_per_sec_per_chip",
+           "value": 850.0, "vs_baseline": 2.0}
+    out = bench._regression_check(rec, prev, "BENCH_r06.json")
+    assert "stale_anchor" not in out
+    assert out["value_vs_prev"] == 0.85
+    assert any("dropped" in f for f in out["flags"])
+
+
+def test_footprint_cache_dtype_ladder():
+    """int4 pages are half of int8's payload; both quantized rungs pay
+    the f32 scale planes; the legacy bool knob still means int8."""
+    args = (8, 16, 8192, 256)
+    bf16 = bench._serving_footprint_gb(*args, "auto", bench.LM_CFG)
+    i8 = bench._serving_footprint_gb(*args, "int8", bench.LM_CFG)
+    i4 = bench._serving_footprint_gb(*args, "int4", bench.LM_CFG)
+    assert bf16 > i8 > i4
+    assert i8 == bench._serving_footprint_gb(*args, True, bench.LM_CFG)
+    assert bf16 == bench._serving_footprint_gb(*args, False,
+                                               bench.LM_CFG)
+    # int4 sizes at least the int8 batch at the same config
+    assert bench._serving_batch(4, 8192, 256, "int4", bench.LM_CFG) >= \
+        bench._serving_batch(4, 8192, 256, "int8", bench.LM_CFG)
+
+
+def test_quant_ladder_covers_every_rung():
+    names = [n for n, _ in bench.QUANT_LADDER]
+    assert names[0] == "bf16" and bench.QUANT_LADDER[0][1] == {}
+    assert {"w_int8", "w_int4", "kv_int8", "kv_int4",
+            "w4kv4"} <= set(names)
+    corner = dict(bench.QUANT_LADDER)["w4kv4"]
+    assert corner == {"weights_dtype": "int4", "cache_dtype": "int4"}
+
+
+def test_quant_hbm_math_rider():
+    """The untimed byte rider: int4 weights ~halve int8's bytes; the
+    KV bytes/token ladder ordering holds with scale planes counted."""
+    from distkeras_tpu.models import Model, zoo
+
+    cfg = dict(vocab=64, d_model=32, num_heads=4, num_layers=2,
+               mlp_ratio=2, seq=16)
+    model = Model.build(zoo.transformer_lm(
+        cfg["vocab"], d_model=cfg["d_model"],
+        num_heads=cfg["num_heads"], num_layers=cfg["num_layers"],
+        mlp_ratio=cfg["mlp_ratio"], use_rope=True), (16,), seed=0)
+    hm = bench._quant_hbm_math(model, cfg)
+    wb, kv = hm["weight_bytes"], hm["kv_bytes_per_token"]
+    assert wb["int8"] < wb["bf16"] * 0.75
+    assert wb["int4"] < wb["int8"]
+    assert kv["bf16"] > kv["int8"] > kv["int4"]

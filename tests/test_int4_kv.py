@@ -1,0 +1,352 @@
+"""int4 KV cache (quantized-decode PR): the 4-bit rung of the cache
+dtype ladder. Unpacked request/slab caches share the int8 read paths
+byte-for-byte (one int8 byte per entry, values in [-7, 7], the same
+``q * scale`` dequant contract); the PAGED pool stores two positions
+per byte (``pack_int4``'s half-split along the page position axis) and
+the Pallas paged-attention kernel unpacks in-kernel. The oracle
+discipline matches the int8 suite: kernel vs the ``_gather_pages``
+reference in interpret mode across GQA/window/scrambled-page/W > 1
+cases, pack/unpack bitwise roundtrips, RMW nibble isolation, and
+end-to-end token/byte identity through the serving engine."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from distkeras_tpu.models.decoding import (_cache_write_pages,
+                                           _gather_pages, _quantize_kv,
+                                           _use_paged_kernel, generate,
+                                           init_cache, pack_int4,
+                                           unpack_int4)
+from distkeras_tpu.ops.attention import NEG_INF
+from distkeras_tpu.ops.paged_attention import (page_aligned,
+                                               page_alignment,
+                                               paged_decode_attention)
+from distkeras_tpu.serving import ServingEngine
+from distkeras_tpu.serving.kv_pool import PagedKVPool
+
+
+def _pool4(rs, n_pages, hkv, page_len, d):
+    """A random PACKED int4 page pool (the PagedKVPool device layout)."""
+    k = jnp.asarray(rs.randn(n_pages, hkv, page_len, d), jnp.float32)
+    v = jnp.asarray(rs.randn(n_pages, hkv, page_len, d), jnp.float32)
+    qk, ks = _quantize_kv(k, 4)
+    qv, vs = _quantize_kv(v, 4)
+    return {"k": pack_int4(qk), "v": pack_int4(qv),
+            "k_scale": ks, "v_scale": vs,
+            "q4": jnp.zeros((1, 1, 1, 1), jnp.int8)}
+
+
+def _reference(q, kv, table, t, scale, window=None):
+    """The gather-path readout (``test_paged_kernel._reference``, int4
+    edition — ``_gather_pages`` unpacks, then the shared dequant)."""
+    view = _gather_pages(kv, jnp.asarray(table))
+    k = view["k"].astype(jnp.float32) * view["k_scale"][..., None]
+    v = view["v"].astype(jnp.float32) * view["v_scale"][..., None]
+    L = k.shape[2]
+    w_len = q.shape[1]
+    qg = q.astype(jnp.float32) * scale
+    s = jnp.einsum("bqhgd,bhkd->bhgqk", qg, k,
+                   preferred_element_type=jnp.float32)
+    pos = t[:, None] + jnp.arange(w_len)
+    valid = jnp.arange(L)[None, None, :] <= pos[:, :, None]
+    if window is not None:
+        valid &= jnp.arange(L)[None, None, :] > (pos - window)[:, :, None]
+    s = jnp.where(valid[:, None, None, :, :], s, NEG_INF)
+    w = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhgqk,bhkd->bqhgd", w, v,
+                      preferred_element_type=jnp.float32)
+
+
+#: scrambled physical placement with sentinel entries, page_len=64
+#: edition of the int8 suite's TABLE/T
+TABLE = np.array([[7, 2, 9, 10], [0, 5, 10, 10], [3, 1, 4, 6]],
+                 np.int32)
+T = np.array([100, 70, 130], np.int32)
+
+
+# --- nibble packing ---------------------------------------------------------
+
+
+def test_pack_unpack_roundtrip_bitwise():
+    rs = np.random.RandomState(0)
+    q = jnp.asarray(rs.randint(-7, 8, size=(3, 2, 64, 16)), jnp.int8)
+    np.testing.assert_array_equal(np.asarray(unpack_int4(pack_int4(q))),
+                                  np.asarray(q))
+
+
+def test_quantize_kv_int4_grid():
+    rs = np.random.RandomState(1)
+    x = jnp.asarray(rs.randn(2, 3, 16), jnp.float32)
+    q, s = _quantize_kv(x, 4)
+    qn = np.asarray(q)
+    assert qn.min() >= -7 and qn.max() <= 7
+    # absmax entries hit the grid edge exactly
+    err = np.abs(np.asarray(x) - qn * np.asarray(s)[..., None])
+    assert err.max() <= np.asarray(s).max() / 2 + 1e-7
+    # zero vectors stay exactly zero (zero-safe scale)
+    q0, s0 = _quantize_kv(jnp.zeros((2, 16)), 4)
+    assert not np.asarray(q0).any() and not np.asarray(s0).any()
+
+
+# --- kernel vs gather oracle ------------------------------------------------
+
+
+@pytest.mark.parametrize("g", [1, 4])
+@pytest.mark.parametrize("w_len", [1, 3])
+def test_int4_kernel_matches_gather_reference(g, w_len):
+    rs = np.random.RandomState(2)
+    kv = _pool4(rs, 10, 2, 64, 16)
+    q = jnp.asarray(rs.randn(3, w_len, 2, g, 16), jnp.float32)
+    scale = 16 ** -0.5
+    out = paged_decode_attention(
+        q, kv["k"], kv["v"], T, TABLE, scale=scale,
+        k_scale=kv["k_scale"], v_scale=kv["v_scale"], interpret=True)
+    ref = _reference(q, kv, TABLE, T, scale)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-4)
+    # bitwise at the comparison dtype: the two paths agree exactly
+    # once both land in the serving compute dtype (bf16)
+    np.testing.assert_array_equal(
+        np.asarray(out.astype(jnp.bfloat16).astype(jnp.float32)),
+        np.asarray(ref.astype(jnp.bfloat16).astype(jnp.float32)))
+
+
+def test_int4_kernel_window_masking():
+    rs = np.random.RandomState(3)
+    kv = _pool4(rs, 10, 2, 64, 16)
+    q = jnp.asarray(rs.randn(3, 2, 2, 2, 16), jnp.float32)
+    scale = 16 ** -0.5
+    out = paged_decode_attention(
+        q, kv["k"], kv["v"], T, TABLE, scale=scale, window=40,
+        k_scale=kv["k_scale"], v_scale=kv["v_scale"], interpret=True)
+    ref = _reference(q, kv, TABLE, T, scale, window=40)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-4)
+
+
+def test_int4_shape_mismatch_rejected():
+    """A packed payload whose scale plane is not exactly 2x its rows is
+    a layout bug, not a silently-different page_len."""
+    rs = np.random.RandomState(4)
+    kv = _pool4(rs, 4, 2, 64, 16)
+    with pytest.raises(ValueError, match="int4 payload rows"):
+        paged_decode_attention(
+            jnp.asarray(rs.randn(1, 1, 2, 1, 16), jnp.float32),
+            kv["k"], kv["v"], np.array([3]), np.array([[0]]),
+            k_scale=kv["k_scale"][:, :, :48],
+            v_scale=kv["v_scale"][:, :, :48], interpret=True)
+
+
+# --- RMW page writes --------------------------------------------------------
+
+
+def test_int4_rmw_write_and_nibble_isolation():
+    """One-position writes into the packed plane: the written position
+    dequantizes to its own 4-bit grid value, and the OTHER position
+    sharing the byte row keeps its exact bits."""
+    rs = np.random.RandomState(5)
+    kv = _pool4(rs, 6, 2, 64, 16)
+    table = np.array([[4, 1, 3]], np.int32)
+    for t_pos in (0, 31, 32, 63, 64, 70, 129):
+        kh = jnp.asarray(rs.randn(1, 1, 2, 16), jnp.float32)
+        vh = jnp.asarray(rs.randn(1, 1, 2, 16), jnp.float32)
+        buddy = t_pos + 32 if (t_pos % 64) < 32 else t_pos - 32
+        view0 = _gather_pages(kv, jnp.asarray(table))
+        before = np.asarray(view0["k"][0, :, buddy])
+        kv = _cache_write_pages(kv, kh, vh, jnp.asarray([t_pos]),
+                                jnp.asarray(table), 64)
+        view = _gather_pages(kv, jnp.asarray(table))
+        got = (view["k"].astype(jnp.float32)
+               * view["k_scale"][..., None])[0, :, t_pos]
+        qk, sk = _quantize_kv(kh.transpose(0, 2, 1, 3), 4)
+        want = (qk.astype(jnp.float32) * sk[..., None])[0, :, 0]
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=1e-6)
+        np.testing.assert_array_equal(
+            np.asarray(view["k"][0, :, buddy]), before)
+
+
+def test_int4_sentinel_write_drops():
+    """An out-of-range position (the free/prefilling sentinel) must not
+    corrupt any page — the RMW's merged garbage is scatter-dropped."""
+    rs = np.random.RandomState(6)
+    kv = _pool4(rs, 4, 2, 64, 16)
+    table = np.array([[2, 4]], np.int32)     # 4 is the sentinel (>= N)
+    before = jax.tree_util.tree_map(np.asarray, kv)
+    kv2 = _cache_write_pages(
+        kv, jnp.asarray(rs.randn(1, 1, 2, 16), jnp.float32),
+        jnp.asarray(rs.randn(1, 1, 2, 16), jnp.float32),
+        jnp.asarray([500]), jnp.asarray(table), 64)
+    for key in ("k", "v", "k_scale", "v_scale"):
+        np.testing.assert_array_equal(np.asarray(kv2[key]), before[key])
+
+
+# --- pool staging transfers -------------------------------------------------
+
+
+def _int4_lm(pattern_lm):
+    from distkeras_tpu.models.decoding import _resolve_head_dims
+    _resolve_head_dims(pattern_lm.module, pattern_lm.params)
+    return pattern_lm
+
+
+def test_pool_insert_then_load_prefix_roundtrip(pattern_lm):
+    """Staging (unpacked) -> pool (packed) -> staging: the pack/unpack
+    pair through ``insert_pages``/``load_prefix`` is bitwise."""
+    m = _int4_lm(pattern_lm)
+    pool = PagedKVPool(m.module, num_slots=1, max_len=32, page_len=8,
+                       dtype="int4")
+    rs = np.random.RandomState(7)
+    staging = pool.make_request_cache()
+    staging = [
+        kv if kv is None else {
+            key: (jnp.asarray(
+                rs.randint(-7, 8, a.shape), jnp.int8)
+                if key in ("k", "v") else
+                (a if key == "q4" else
+                 jnp.asarray(rs.rand(*a.shape), jnp.float32)))
+            for key, a in kv.items()}
+        for kv in staging]
+    for lp in range(pool.pages_per_slot):
+        pool.assign(0, lp, pool.alloc_page())
+    pool.insert_pages(staging, 0, 0, 32)
+    loaded = pool.load_prefix(pool.make_request_cache(),
+                              pool.slot_pages(0), 32)
+    for st, ld in zip(staging, loaded):
+        if st is None:
+            continue
+        for key in ("k", "v", "k_scale", "v_scale"):
+            np.testing.assert_array_equal(np.asarray(ld[key]),
+                                          np.asarray(st[key]))
+
+
+def test_int4_pool_requires_even_page_len(pattern_lm):
+    m = _int4_lm(pattern_lm)
+    with pytest.raises(ValueError, match="even"):
+        PagedKVPool(m.module, num_slots=1, max_len=10, page_len=5,
+                    dtype="int4")
+
+
+def test_int4_offload_restore_bitwise(pattern_lm):
+    """The host offload tier moves PACKED bytes — swap-out/swap-in of
+    an int4 page is byte-identical, like every other dtype."""
+    m = _int4_lm(pattern_lm)
+    pool = PagedKVPool(m.module, num_slots=1, max_len=16, page_len=8,
+                       dtype="int4", host_pages=2)
+    pid = pool.alloc_page()
+    rs = np.random.RandomState(8)
+    pool.cache = [
+        kv if kv is None else {
+            key: (a if key == "q4" else
+                  jnp.asarray(rs.randint(-100, 100, a.shape))
+                  .astype(a.dtype))
+            for key, a in kv.items()}
+        for kv in pool.cache]
+    before = [None if kv is None else
+              {key: np.asarray(a[pid]) for key, a in kv.items()
+               if key != "q4"}
+              for kv in pool.cache]
+    hids = pool.offload_pages([pid])
+    assert hids is not None
+    pool.cache = jax.tree_util.tree_map(jnp.zeros_like, pool.cache)
+    pool.restore_pages(hids, [pid])
+    for kv, want in zip(pool.cache, before):
+        if kv is None:
+            continue
+        for key, arr in want.items():
+            np.testing.assert_array_equal(np.asarray(kv[key][pid]), arr)
+
+
+# --- init_cache / slab ladder -----------------------------------------------
+
+
+def test_init_cache_int4_structure(pattern_lm):
+    m = _int4_lm(pattern_lm)
+    cache = init_cache(m.module, 2, 16, "int4")
+    kvs = [kv for kv in cache if kv is not None]
+    assert kvs
+    for kv in kvs:
+        assert kv["k"].dtype == jnp.int8          # unpacked staging/slab
+        assert "q4" in kv
+        assert kv["k"].shape[2] == 16
+        assert kv["k_scale"].shape == kv["k"].shape[:3]
+
+
+def test_generate_int4_cache_token_identical(pattern_lm):
+    """The slab int4 cache through generate(): the memorized pattern's
+    argmax margins dwarf 4-bit cache noise, so greedy continuation is
+    token-identical to the float cache."""
+    m = pattern_lm
+    p = np.array([3, 1, 4, 1, 5, 9])
+    np.testing.assert_array_equal(
+        generate(m, p[None], 6, cache_dtype="int4")[0],
+        generate(m, p[None], 6)[0])
+
+
+# --- fallback decision / dtype matrix ---------------------------------------
+
+
+def test_use_paged_kernel_dtype_matrix():
+    """The gather-fallback decision across the dtype ladder: forced-on
+    still refuses a page_len the kernel cannot tile for THAT dtype."""
+    f32 = {"k": 0, "v": 0}
+    i8 = {"k": 0, "v": 0, "k_scale": 0, "v_scale": 0}
+    i4 = dict(i8, q4=0)
+    assert _use_paged_kernel(f32, 8, True)
+    assert not _use_paged_kernel(f32, 4, True)
+    assert _use_paged_kernel(i8, 32, True)
+    assert not _use_paged_kernel(i8, 16, True)
+    assert _use_paged_kernel(i4, 64, True)
+    assert not _use_paged_kernel(i4, 32, True)   # %32 is int8-only
+    assert not _use_paged_kernel(i4, 64, False)  # forced off wins
+    assert not _use_paged_kernel(f32, 8, False)
+
+
+def test_page_alignment_full_matrix():
+    assert page_alignment(False) == 8
+    assert page_alignment("f32") == page_alignment("bfloat16") == 8
+    assert page_alignment(True) == page_alignment("int8") == 32
+    assert page_alignment(8) == 32
+    assert page_alignment(4) == page_alignment("int4") == 64
+    assert page_aligned(16, "bf16") and not page_aligned(12, "bf16")
+    assert page_aligned(128, "int4") and not page_aligned(96, "int4")
+    with pytest.raises(ValueError, match="unknown cache quantization"):
+        page_alignment("int2")
+
+
+# --- end-to-end through the serving engine ----------------------------------
+
+
+PATTERN = np.array([3, 1, 4, 1, 5, 9, 2, 6, 5, 3, 5, 8])
+
+
+def test_engine_int4_greedy_token_identical(pattern_lm):
+    """cache_dtype="int4" through the paged engine (gather path at
+    page_len=8): greedy output token-identical to generate()."""
+    eng = ServingEngine(pattern_lm, num_slots=2, max_len=32, page_len=8,
+                        cache_dtype="int4")
+    r0 = eng.submit(PATTERN[:4], 7)
+    r1 = eng.submit(PATTERN[:6], 5)
+    out = eng.run(max_steps=500)
+    np.testing.assert_array_equal(
+        out[r0], generate(pattern_lm, PATTERN[None, :4], 7)[0])
+    np.testing.assert_array_equal(
+        out[r1], generate(pattern_lm, PATTERN[None, :6], 5)[0])
+
+
+def test_engine_int4_kernel_sampled_matches_gather(pattern_lm):
+    """page_len=64 int4 pool: the Pallas kernel (interpret mode) and
+    the gather fallback draw byte-identical sampled streams — the
+    serving-level bitwise oracle for the packed in-kernel dequant."""
+    def drive(kernel):
+        eng = ServingEngine(pattern_lm, num_slots=2, max_len=128,
+                            page_len=64, cache_dtype="int4",
+                            decode_kernel=kernel)
+        rid = eng.submit(PATTERN[:4], 8, temperature=0.9, top_p=0.95,
+                         seed=7)
+        return eng.run(max_steps=500)[rid]
+
+    np.testing.assert_array_equal(drive("paged"), drive("off"))

@@ -1,0 +1,155 @@
+"""HBM byte accounting for the paged KV pool (quantized-decode PR).
+
+Satellite fix: ``PagedKVPool.page_bytes`` must count EVERYTHING a
+physical page allocates — quantized payload (int4: two nibbles per
+byte) AND the per-token f32 scale planes — and the byte-budget pool
+sizing (``hbm_budget``) plus the engine's cost-aware admission must
+run on that number. The accounting tests pin ``page_bytes`` against
+the actually-allocated buffers; the admission test demonstrates the
+tentpole's capacity claim: int4 KV admits MORE concurrent streams
+than bf16 under the SAME byte budget.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from distkeras_tpu.models import Model, zoo
+from distkeras_tpu.serving.engine import ServingEngine
+from distkeras_tpu.serving.kv_pool import PagedKVPool
+
+PATTERN = np.array([3, 1, 4, 1, 5, 9, 2, 6, 5, 3, 5, 8])
+
+
+@pytest.fixture(scope="module")
+def lm():
+    from distkeras_tpu.models.decoding import _resolve_head_dims
+    m = Model.build(
+        zoo.transformer_lm(29, d_model=32, num_heads=4, num_layers=2,
+                           mlp_ratio=2, use_rope=True), (12,), seed=0)
+    _resolve_head_dims(m.module, m.params)   # bare-module pool probes
+    return m
+
+
+def _allocated_bytes(pool):
+    """Actually-allocated device bytes of the page planes, split into
+    (page-proportional planes, structural markers)."""
+    pages, markers = 0, 0
+    for kv in pool.cache:
+        if kv is None:
+            continue
+        for key, a in kv.items():
+            n = np.asarray(a).nbytes
+            if key == "q4":
+                markers += n
+            else:
+                pages += n
+    return pages, markers
+
+
+@pytest.mark.parametrize("dtype,name", [
+    (jnp.float32, "f32"), (jnp.bfloat16, "bf16"),
+    ("int8", "int8"), ("int4", "int4")])
+def test_page_bytes_matches_allocated_buffers(lm, dtype, name):
+    """``num_pages * page_bytes`` equals the bytes the pool actually
+    allocated, for every rung of the KV ladder (the structural int4
+    marker is per-layer, not per-page, and stays excluded)."""
+    pool = PagedKVPool(lm.module, 3, 64, page_len=16, dtype=dtype)
+    pages, markers = _allocated_bytes(pool)
+    assert pages == pool.num_pages * pool.page_bytes, name
+    if name == "int4":
+        assert markers > 0            # one (1,1,1,1) int8 leaf per layer
+        assert markers <= len(pool.cache)
+
+
+def test_page_bytes_includes_scale_planes(lm):
+    """The satellite bug: budget math counting payload only. At D=8
+    with f32 per-token scales the scale planes are a third of the
+    int8 page and two thirds of the int4 payload — page_bytes must
+    carry them."""
+    f32 = PagedKVPool(lm.module, 1, 32, page_len=16, dtype=jnp.float32)
+    i8 = PagedKVPool(lm.module, 1, 32, page_len=16, dtype="int8")
+    i4 = PagedKVPool(lm.module, 1, 32, page_len=16, dtype="int4")
+    layers = sum(1 for kv in f32.cache if kv is not None)
+    hkv, d = 4, 8                                   # pattern-LM geometry
+    payload_i8 = layers * 2 * hkv * 16 * d          # int8 k+v bytes/page
+    scales = layers * 2 * hkv * 16 * 4              # f32 k+v scale rows
+    assert i8.page_bytes == payload_i8 + scales
+    assert i4.page_bytes == payload_i8 // 2 + scales
+    assert f32.page_bytes == payload_i8 * 4         # no scale planes
+
+
+def test_hbm_budget_sizes_pool(lm):
+    pb = PagedKVPool(lm.module, 2, 64, page_len=16,
+                     dtype="int4").page_bytes
+    pool = PagedKVPool(lm.module, 2, 64, page_len=16, dtype="int4",
+                       hbm_budget=10 * pb + pb // 2, reserve_bytes=pb)
+    assert pool.num_pages == 9        # (10.5 - 1) pages round down
+    with pytest.raises(ValueError, match="not both"):
+        PagedKVPool(lm.module, 2, 64, page_len=16, num_pages=4,
+                    hbm_budget=1 << 20)
+    with pytest.raises(ValueError, match="does not fit"):
+        PagedKVPool(lm.module, 2, 64, page_len=16, hbm_budget=pb,
+                    reserve_bytes=pb)
+    with pytest.raises(ValueError, match="even"):
+        PagedKVPool(lm.module, 2, 64, page_len=15, dtype="int4")
+
+
+def test_int4_kv_admits_more_streams_under_same_budget(lm):
+    """The capacity claim, end to end through the engine's cost-aware
+    admission: same hbm_budget, same weights — the int4-KV engine
+    holds MORE concurrent decoding streams than the bf16 engine
+    (whose worst-case page demand exhausts the budget after one)."""
+    probe = ServingEngine(lm, num_slots=4, max_len=32, page_len=8)
+    weight_bytes = sum(np.asarray(l).nbytes for l in
+                      jax.tree_util.tree_leaves(probe._params))
+    bf16_pb = PagedKVPool(lm.module, 1, 32, page_len=8,
+                          dtype=jnp.bfloat16).page_bytes
+    # envelope: four bf16 pages of KV. An 8-token prompt costs
+    # pages_for(9) = 2 pages at admission, so bf16 seats two streams.
+    budget = weight_bytes + 4 * bf16_pb
+
+    def occupied(cache_dtype):
+        eng = ServingEngine(lm, num_slots=4, max_len=32, page_len=8,
+                            cache_dtype=cache_dtype, hbm_budget=budget)
+        for _ in range(6):
+            eng.submit(PATTERN[:8], 4)
+        eng.step()
+        return eng.pool.num_pages, eng.scheduler.occupied
+
+    bf16_pages, bf16_occ = occupied(jnp.bfloat16)
+    int4_pages, int4_occ = occupied("int4")
+    assert bf16_pages == 4 and bf16_occ == 2
+    assert int4_pages > bf16_pages
+    assert int4_occ > bf16_occ
+
+
+def test_quantized_weights_free_budget_for_pages(lm):
+    """weight_quant shrinks the reserve side of the same envelope:
+    f32 weights + the rest as pages vs int4 weights + the rest as
+    pages — the quantized engine ends up with strictly more pages."""
+    f32_w = sum(np.asarray(l).nbytes for l in
+                jax.tree_util.tree_leaves(lm.params))
+    budget = f32_w + 6 * PagedKVPool(
+        lm.module, 1, 32, page_len=8, dtype="int4").page_bytes
+    base = ServingEngine(lm, num_slots=2, max_len=32, page_len=8,
+                         cache_dtype="int4", hbm_budget=budget)
+    quant = ServingEngine(lm, num_slots=2, max_len=32, page_len=8,
+                          cache_dtype="int4", weight_quant="int4",
+                          hbm_budget=budget)
+    assert quant.pool.num_pages > base.pool.num_pages
+
+
+def test_staging_cache_accounting(lm):
+    """make_request_cache covers pages_per_slot * page_len positions;
+    its int4 planes pack the same way the pool's do (bitwise-roundtrip
+    covered in test_int4_kv; here: the byte shape contract)."""
+    pool = PagedKVPool(lm.module, 2, 64, page_len=16, dtype="int4")
+    st = pool.make_request_cache()
+    for kv in st:
+        if kv is None:
+            continue
+        assert kv["k"].shape[2] == pool.pages_per_slot * pool.page_len
+        assert "q4" in kv

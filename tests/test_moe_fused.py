@@ -263,3 +263,27 @@ def test_fused_unknown_activation_fails_early():
     with pytest.raises((KeyError, ValueError)):
         moe_kernels.fused_moe_apply(xt, w1, b1, w2, b2, sg, dest, keep,
                                     capacity=c, activation="not_an_act")
+
+
+def test_raw_custom_vjp_op_matches_wrapper():
+    """``moe_fused_experts`` (the raw custom-VJP op behind
+    ``fused_moe_apply``) run directly under interpret=True matches the
+    wrapper bitwise — the wrapper only resolves static knobs, so any
+    divergence means the positional-statics plumbing broke."""
+    e, d, h, c = 2, 8, 16, 4
+    rs = np.random.RandomState(3)
+    xt = jnp.asarray(rs.randn(6, d), jnp.float32)
+    w1 = jnp.asarray(rs.randn(e, d, h) * 0.1, jnp.float32)
+    b1 = jnp.asarray(rs.randn(e, h) * 0.1, jnp.float32)
+    w2 = jnp.asarray(rs.randn(e, h, d) * 0.1, jnp.float32)
+    b2 = jnp.asarray(rs.randn(e, d) * 0.1, jnp.float32)
+    sg = jnp.asarray(rs.rand(12), jnp.float32)
+    dest = jnp.asarray(rs.permutation(12) % (e * c), jnp.int32)
+    keep = jnp.asarray(rs.rand(12) > 0.3)
+    want = moe_kernels.fused_moe_apply(
+        xt, w1, b1, w2, b2, sg, dest, keep, capacity=c,
+        activation="gelu", interpret=True)
+    block_c = moe_kernels.choose_block_c(moe_kernels.kernel_capacity(c))
+    got = moe_kernels.moe_fused_experts(
+        "gelu", c, block_c, True, xt, w1, b1, w2, b2, sg, dest, keep)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))

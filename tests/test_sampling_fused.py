@@ -1,0 +1,140 @@
+"""Fused sampling epilogue (quantized-decode PR).
+
+``ops.sampling``: the in-kernel top-k/top-p mask + gumbel draw,
+pinned byte-identical against the unfused ``decoding._sample_vec``
+(the factorization ``categorical(key, lf) == argmax(lf + gumbel(key))``
+plus the shared ``_masked_logits_vec`` mask program make this exact,
+not approximate), and the ``ServingEngine(fused_sampling=True)``
+wiring — including the fused multi-step (chain-shaped) decode window.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from distkeras_tpu.models.decoding import _sample_vec
+from distkeras_tpu.ops import sampling as sp
+from distkeras_tpu.serving.engine import ServingEngine
+
+PATTERN = np.array([3, 1, 4, 1, 5, 9, 2, 6, 5, 3, 5, 8])
+
+S = 5
+TEMP = jnp.asarray([0.0, 0.7, 1.0, 1.3, 0.9], jnp.float32)
+TOPK = jnp.asarray([0, 5, 0, 3, 1], jnp.int32)
+TOPP = jnp.asarray([1.0, 0.9, 0.5, 1.0, 0.8], jnp.float32)
+
+
+def _keys(n, off=0):
+    return jax.vmap(jax.random.PRNGKey)(jnp.arange(n) + off)
+
+
+# --- the factorization: gumbel-argmax == categorical -----------------------
+
+
+@pytest.mark.parametrize("seed", range(5))
+def test_sample_tokens_byte_identical_to_sample_vec(seed):
+    """Reference path (V=29 fails the lane gate): the external-gumbel
+    factorization must reproduce ``_sample_vec`` BIT for bit — mixed
+    greedy/sampled rows, top-k and nucleus cuts active."""
+    rs = np.random.RandomState(seed)
+    logits = jnp.asarray(rs.randn(S, 29) * 2, jnp.float32)
+    keys = _keys(S, seed * 100)
+    np.testing.assert_array_equal(
+        np.asarray(sp.sample_tokens(logits, TEMP, TOPK, TOPP, keys)),
+        np.asarray(_sample_vec(logits, TEMP, TOPK, TOPP, keys)))
+
+
+# --- the kernel vs the oracle (interpret mode) -----------------------------
+
+
+@pytest.mark.parametrize("seed", range(5))
+def test_kernel_matches_unfused_sampler(seed):
+    """The Pallas epilogue (interpreter mode — the CI oracle) emits
+    token-identical streams to BOTH the reference factorization and
+    the unfused sampler at an aligned vocab (V=128; S=5 exercises the
+    row-pad path)."""
+    rs = np.random.RandomState(seed)
+    logits = jnp.asarray(rs.randn(S, 128) * 2, jnp.float32)
+    keys = _keys(S, seed * 7)
+    g = sp.gumbel_noise(keys, 128)
+    with sp.force_interpret():
+        assert sp.fused_supported(128)
+        kout = sp.sample_epilogue(logits, TEMP, TOPK, TOPP, g)
+    rout = sp.sample_epilogue(logits, TEMP, TOPK, TOPP, g)
+    vout = _sample_vec(logits, TEMP, TOPK, TOPP, keys)
+    np.testing.assert_array_equal(np.asarray(kout), np.asarray(rout))
+    np.testing.assert_array_equal(np.asarray(kout), np.asarray(vout))
+
+
+def test_kernel_tie_break_matches_rank_mask():
+    """Exact ties at the top-k boundary: the in-kernel stable
+    lowest-index-first tie reconstruction must admit the same
+    candidates as the rank mask (every vocab entry duplicated 4x)."""
+    rs = np.random.RandomState(42)
+    logits = jnp.asarray(np.repeat(rs.randn(S, 32), 4, axis=1),
+                         jnp.float32)
+    keys = _keys(S)
+    g = sp.gumbel_noise(keys, 128)
+    with sp.force_interpret():
+        kout = sp.sample_epilogue(logits, TEMP, TOPK, TOPP, g)
+    np.testing.assert_array_equal(
+        np.asarray(kout),
+        np.asarray(_sample_vec(logits, TEMP, TOPK, TOPP, keys)))
+
+
+def test_gate_requires_lane_alignment():
+    assert not sp.fused_supported(128)        # CPU, no force
+    with sp.force_interpret():
+        assert sp.fused_supported(128)
+        assert not sp.fused_supported(29)
+
+
+# --- engine wiring ---------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def memorized_lm(pattern_lm):
+    return pattern_lm
+
+
+def _sampled_stream(eng, seed=7):
+    rid = eng.submit(PATTERN[:4], 8, temperature=0.9, top_k=6,
+                     top_p=0.9, seed=seed)
+    return eng.run(max_steps=300)[rid]
+
+
+def test_engine_fused_sampling_byte_identical(memorized_lm):
+    """``fused_sampling=True`` must not change one byte of a sampled
+    request's stream (same seed, same knobs) — the whole point of the
+    factorization."""
+    m = memorized_lm
+    base = _sampled_stream(ServingEngine(m, num_slots=2, max_len=32))
+    got = _sampled_stream(ServingEngine(m, num_slots=2, max_len=32,
+                                        fused_sampling=True))
+    np.testing.assert_array_equal(got, base)
+
+
+def test_engine_fused_sampling_with_fused_steps(memorized_lm):
+    """The chain-shaped fused decode window (fuse_steps) with the
+    fused epilogue still reproduces the single-step unfused stream."""
+    m = memorized_lm
+    base = _sampled_stream(ServingEngine(m, num_slots=2, max_len=32))
+    got = _sampled_stream(
+        ServingEngine(m, num_slots=2, max_len=32, fuse_steps=4,
+                      fused_sampling=True))
+    np.testing.assert_array_equal(got, base)
+
+
+def test_engine_fused_sampling_greedy_unchanged(memorized_lm):
+    """Greedy requests never touch the sampler: fused_sampling engines
+    emit the same greedy tokens as the baseline."""
+    m = memorized_lm
+    eng0 = ServingEngine(m, num_slots=1, max_len=32)
+    rid0 = eng0.submit(PATTERN[:4], 7)
+    eng1 = ServingEngine(m, num_slots=1, max_len=32,
+                         fused_sampling=True)
+    rid1 = eng1.submit(PATTERN[:4], 7)
+    np.testing.assert_array_equal(eng0.run(max_steps=300)[rid0],
+                                  eng1.run(max_steps=300)[rid1])
